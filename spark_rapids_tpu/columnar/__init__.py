@@ -1,0 +1,4 @@
+from spark_rapids_tpu.columnar.batch import (  # noqa: F401
+    ColumnVector, ColumnarBatch, round_capacity,
+    from_arrow, to_arrow, from_pydict, to_pydict,
+)

@@ -1,0 +1,285 @@
+"""Device columnar batch currency.
+
+Reference parity: sql-plugin/src/main/java/com/nvidia/spark/rapids/
+GpuColumnVector.java (cudf ColumnVector wrapped as Spark ColumnVector) and
+ColumnarBatch usage throughout the exec layer.
+
+TPU-first design decisions, deliberately different from the cuDF model:
+
+- **Arrow-ish planes as JAX arrays.** A column is (data, validity) device
+  arrays; strings are (offsets, bytes, validity). XLA operates on whole
+  planes; there is no per-element object model.
+- **Bucketed static capacity.** Every batch's arrays are padded to a
+  power-of-two row capacity. `num_rows` is a host-side int. This keeps XLA
+  shapes static so each operator stage compiles once per size bucket instead
+  of once per batch (cuDF has dynamic shapes; XLA must not).
+- **Validity is a bool plane, True = valid.** Data lanes of invalid or padded
+  rows are *defined garbage*: kernels must mask through validity. Padded rows
+  (row >= num_rows) always have validity False.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+
+MIN_CAPACITY = 8
+
+
+def round_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
+    """Round a row count up to the capacity bucket (next power of two)."""
+    n = max(int(n), 1, minimum)
+    return 1 << (n - 1).bit_length()
+
+
+def _pad_to(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
+    if arr.shape[0] == capacity:
+        return arr
+    out = np.full((capacity,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+@dataclasses.dataclass
+class ColumnVector:
+    """One device-resident column.
+
+    data:
+      - fixed-width types: jnp array[capacity] of the type's np_dtype
+      - StringType: dict(offsets=int32[capacity+1], bytes=uint8[byte_cap])
+    validity: bool[capacity], True = valid. None means all rows < num_rows
+      are valid (padded tail is implicitly invalid).
+    """
+
+    dtype: T.DataType
+    data: Union[jax.Array, Dict[str, jax.Array]]
+    validity: Optional[jax.Array] = None
+
+    @property
+    def capacity(self) -> int:
+        if isinstance(self.data, dict):
+            return int(self.data["offsets"].shape[0]) - 1
+        return int(self.data.shape[0])
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, T.StringType)
+
+    def validity_or_default(self, num_rows: int) -> jax.Array:
+        """Materialize the validity plane (capacity-length bool)."""
+        cap = self.capacity
+        if self.validity is not None:
+            return self.validity
+        return jnp.arange(cap) < num_rows
+
+    def device_memory_size(self) -> int:
+        def sz(a):
+            return int(np.prod(a.shape)) * a.dtype.itemsize
+        total = 0
+        if isinstance(self.data, dict):
+            total += sum(sz(a) for a in self.data.values())
+        else:
+            total += sz(self.data)
+        if self.validity is not None:
+            total += sz(self.validity)
+        return total
+
+
+@dataclasses.dataclass
+class ColumnarBatch:
+    """A set of equal-capacity columns plus the true row count."""
+
+    columns: List[ColumnVector]
+    num_rows: int
+
+    @property
+    def num_cols(self) -> int:
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        if not self.columns:
+            return round_capacity(self.num_rows)
+        return self.columns[0].capacity
+
+    def device_memory_size(self) -> int:
+        return sum(c.device_memory_size() for c in self.columns)
+
+    def column(self, i: int) -> ColumnVector:
+        return self.columns[i]
+
+    def select(self, indices: Sequence[int]) -> "ColumnarBatch":
+        return ColumnarBatch([self.columns[i] for i in indices], self.num_rows)
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device conversion (the R2C / C2R transition analog; reference
+# GpuRowToColumnarExec / GpuColumnarToRowExec, here via Arrow planes).
+# ---------------------------------------------------------------------------
+
+def _np_valid_from_arrow(arr) -> Optional[np.ndarray]:
+    import pyarrow as pa  # noqa: F401
+    if arr.null_count == 0:
+        return None
+    # pyarrow validity bitmap -> bool array
+    return np.asarray(arr.is_valid())
+
+
+def column_from_arrow(arr, dtype: T.DataType, capacity: int) -> ColumnVector:
+    """Build a device ColumnVector from a pyarrow Array (one chunk)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    n = len(arr)
+    valid_np = _np_valid_from_arrow(arr)
+
+    if isinstance(dtype, T.StringType):
+        arr = arr.cast(pa.large_string()) if not pa.types.is_large_string(arr.type) else arr
+        # fill nulls with "" so offsets stay monotone and bytes well-defined
+        filled = pc.fill_null(arr, "")
+        if isinstance(filled, pa.ChunkedArray):
+            filled = filled.combine_chunks()
+        off_buf = np.frombuffer(filled.buffers()[1], dtype=np.int64)
+        buf_offsets = off_buf[filled.offset: filled.offset + n + 1]
+        byte_len = int(buf_offsets[-1] - buf_offsets[0])
+        data_buf = np.frombuffer(filled.buffers()[2] or b"", dtype=np.uint8)
+        base = int(buf_offsets[0])
+        bytes_np = data_buf[base: base + byte_len]
+        offsets_np = (buf_offsets - base).astype(np.int32)
+        byte_cap = round_capacity(max(byte_len, 1))
+        off_padded = np.full(capacity + 1, offsets_np[-1], dtype=np.int32)
+        off_padded[: n + 1] = offsets_np
+        data = {
+            "offsets": jnp.asarray(off_padded),
+            "bytes": jnp.asarray(_pad_to(bytes_np, byte_cap)),
+        }
+    elif isinstance(dtype, T.BooleanType):
+        np_arr = np.asarray(pc.fill_null(arr, False), dtype=np.bool_)
+        data = jnp.asarray(_pad_to(np_arr, capacity))
+    elif isinstance(dtype, T.NullType):
+        data = jnp.zeros(capacity, dtype=np.int8)
+        valid_np = np.zeros(n, dtype=np.bool_)
+    elif isinstance(dtype, T.DecimalType):
+        np_arr = np.zeros(n, dtype=np.int64)
+        py = arr.to_pylist()
+        scale = dtype.scale
+        for i, v in enumerate(py):
+            if v is not None:
+                np_arr[i] = int((v.scaleb(scale)).to_integral_value())
+        data = jnp.asarray(_pad_to(np_arr, capacity))
+    elif isinstance(dtype, T.TimestampType):
+        import pyarrow as pa
+        cast = arr.cast(pa.timestamp("us"))
+        np_arr = np.asarray(pc.fill_null(cast, 0)).astype("datetime64[us]").astype(np.int64)
+        data = jnp.asarray(_pad_to(np_arr, capacity))
+    elif isinstance(dtype, T.DateType):
+        np_arr = np.asarray(pc.fill_null(arr, 0)).astype("datetime64[D]").astype(np.int32)
+        data = jnp.asarray(_pad_to(np_arr, capacity))
+    else:
+        np_arr = np.asarray(pc.fill_null(arr, 0)).astype(dtype.np_dtype)
+        data = jnp.asarray(_pad_to(np_arr, capacity))
+
+    if valid_np is None:
+        validity = None
+    else:
+        validity = jnp.asarray(_pad_to(valid_np.astype(np.bool_), capacity, fill=False))
+    return ColumnVector(dtype, data, validity)
+
+
+def from_arrow(table) -> ColumnarBatch:
+    """pyarrow Table -> device ColumnarBatch (single upload per plane)."""
+    table = table.combine_chunks()
+    n = table.num_rows
+    cap = round_capacity(n)
+    cols = []
+    for i, field in enumerate(table.schema):
+        dtype = T.from_arrow(field.type)
+        chunked = table.column(i)
+        arr = chunked.chunk(0) if chunked.num_chunks else chunked.combine_chunks()
+        cols.append(column_from_arrow(arr, dtype, cap))
+    return ColumnarBatch(cols, n)
+
+
+def column_to_numpy(col: ColumnVector, num_rows: int):
+    """Device -> host materialization of one column as (values, validity)."""
+    valid = None
+    if col.validity is not None:
+        valid = np.asarray(col.validity)[:num_rows]
+    if col.is_string:
+        offsets = np.asarray(col.data["offsets"])[: num_rows + 1]
+        raw = np.asarray(col.data["bytes"])
+        out = []
+        for i in range(num_rows):
+            if valid is not None and not valid[i]:
+                out.append(None)
+            else:
+                out.append(bytes(raw[offsets[i]: offsets[i + 1]]).decode("utf-8", "replace"))
+        return out, valid
+    vals = np.asarray(col.data)[:num_rows]
+    return vals, valid
+
+
+def to_arrow(batch: ColumnarBatch, names: Optional[Sequence[str]] = None):
+    """Device ColumnarBatch -> pyarrow Table (C2R boundary)."""
+    import pyarrow as pa
+    n = batch.num_rows
+    arrays = []
+    fields = []
+    for i, col in enumerate(batch.columns):
+        name = names[i] if names else f"c{i}"
+        at = T.to_arrow(col.dtype)
+        vals, valid = column_to_numpy(col, n)
+        if col.is_string:
+            arr = pa.array(vals, type=at)
+        elif isinstance(col.dtype, T.NullType):
+            arr = pa.nulls(n, type=at)
+        elif isinstance(col.dtype, T.DecimalType):
+            import decimal
+            scale = col.dtype.scale
+            py = [None if (valid is not None and not valid[j])
+                  else decimal.Decimal(int(vals[j])).scaleb(-scale)
+                  for j in range(n)]
+            arr = pa.array(py, type=at)
+        elif isinstance(col.dtype, T.TimestampType):
+            mask = None if valid is None else ~valid
+            arr = pa.array(vals.astype("datetime64[us]"), type=at,
+                           mask=mask)
+        elif isinstance(col.dtype, T.DateType):
+            mask = None if valid is None else ~valid
+            arr = pa.array(vals.astype("datetime64[D]"), type=at, mask=mask)
+        else:
+            mask = None if valid is None else ~valid
+            arr = pa.array(vals, type=at, mask=mask)
+        arrays.append(arr)
+        fields.append(pa.field(name, at))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def from_pydict(d: dict, schema: Optional[T.Schema] = None) -> ColumnarBatch:
+    import pyarrow as pa
+    if schema is not None:
+        pa_schema = pa.schema([pa.field(f.name, T.to_arrow(f.dtype)) for f in schema.fields])
+        return from_arrow(pa.table(d, schema=pa_schema))
+    return from_arrow(pa.table(d))
+
+
+def to_pydict(batch: ColumnarBatch, names: Optional[Sequence[str]] = None) -> dict:
+    return to_arrow(batch, names).to_pydict()
+
+
+def empty_like_schema(schema: T.Schema, capacity: int = MIN_CAPACITY) -> ColumnarBatch:
+    cols = []
+    for f in schema.fields:
+        if isinstance(f.dtype, T.StringType):
+            data = {"offsets": jnp.zeros(capacity + 1, jnp.int32),
+                    "bytes": jnp.zeros(MIN_CAPACITY, jnp.uint8)}
+        else:
+            data = jnp.zeros(capacity, dtype=f.dtype.np_dtype)
+        cols.append(ColumnVector(f.dtype, data, jnp.zeros(capacity, jnp.bool_)))
+    return ColumnarBatch(cols, 0)

@@ -1,0 +1,1 @@
+from spark_rapids_tpu.ops import kernels  # noqa: F401

@@ -1,0 +1,419 @@
+"""Core device kernels: hashing, normalization, sort, compaction, gather,
+concat, and join candidate expansion.
+
+Reference parity: the libcudf Table algebra surface enumerated in SURVEY.md
+§2.9.1 (join gather-maps, groupby agg, sort/OrderByArg, filter, gather,
+concat, slice) and jni.Hash (Spark-compatible murmur3/xxhash64).
+
+TPU-first design: everything here is shape-static and branch-free so XLA can
+tile it onto the VPU/MXU. Dynamic-result ops (filter, join) follow the
+count-then-gather discipline: a jitted counting pass, a host readback of one
+scalar, then a jitted gather pass compiled per output-capacity bucket
+(the JoinGatherer analog from SURVEY.md §7.3.1).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector, ColumnarBatch, round_capacity
+
+# ---------------------------------------------------------------------------
+# Spark-compatible Murmur3 (x86_32, seed 42) -- reference jni.Hash murmur3.
+# Matching Spark's hash exactly means a future live-Spark adapter places rows
+# exactly where CPU Spark would for hash partitioning.
+# ---------------------------------------------------------------------------
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+SPARK_MURMUR3_SEED = 42
+
+
+def _rotl32(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mm3_mix_k1(k1):
+    k1 = k1 * _C1
+    k1 = _rotl32(k1, 15)
+    return k1 * _C2
+
+
+def _mm3_mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(h1, 13)
+    return h1 * np.uint32(5) + np.uint32(0xE6546B64)
+
+
+def _mm3_fmix(h1, length):
+    h1 = h1 ^ length.astype(jnp.uint32) if hasattr(length, "astype") else h1 ^ np.uint32(length)
+    h1 = h1 ^ (h1 >> 16)
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> 13)
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> 16)
+
+
+def murmur3_int32(values: jax.Array, seed: jax.Array) -> jax.Array:
+    """Murmur3 of an int32 plane (Spark hashInt)."""
+    k1 = _mm3_mix_k1(values.astype(jnp.uint32))
+    h1 = _mm3_mix_h1(seed.astype(jnp.uint32), k1)
+    return _mm3_fmix(h1, 4)
+
+
+def murmur3_int64(values: jax.Array, seed: jax.Array) -> jax.Array:
+    """Murmur3 of an int64 plane (Spark hashLong: low word then high word)."""
+    v = values.astype(jnp.uint64)
+    low = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    high = (v >> jnp.uint64(32)).astype(jnp.uint32)
+    h1 = seed.astype(jnp.uint32)
+    h1 = _mm3_mix_h1(h1, _mm3_mix_k1(low))
+    h1 = _mm3_mix_h1(h1, _mm3_mix_k1(high))
+    return _mm3_fmix(h1, 8)
+
+
+def murmur3_bytes(offsets: jax.Array, raw: jax.Array, seed: jax.Array) -> jax.Array:
+    """Per-row Murmur3 over variable-length byte slices (Spark
+    hashUnsafeBytes over UTF8 payloads): 4-byte little-endian words for the
+    aligned prefix, then each trailing byte mixed individually as a
+    sign-extended int. Variable trip count handled with a lax.while_loop over
+    the batch max length; shorter rows mask out (branch-free)."""
+    cap = offsets.shape[0] - 1
+    starts = offsets[:-1].astype(jnp.int32)
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    nbytes = raw.shape[0]
+
+    def byte_at(pos):
+        idx = jnp.clip(pos, 0, nbytes - 1)
+        return raw[idx]
+
+    def word_body(state):
+        i, h1 = state
+        pos = starts + 4 * i
+        b0 = byte_at(pos).astype(jnp.uint32)
+        b1 = byte_at(pos + 1).astype(jnp.uint32)
+        b2 = byte_at(pos + 2).astype(jnp.uint32)
+        b3 = byte_at(pos + 3).astype(jnp.uint32)
+        k1 = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+        mixed = _mm3_mix_h1(h1, _mm3_mix_k1(k1))
+        active = (i + 1) * 4 <= lens
+        return i + 1, jnp.where(active, mixed, h1)
+
+    def word_cond(state):
+        i, _ = state
+        return (i + 1) * 4 <= jnp.max(lens)
+
+    h0 = jnp.broadcast_to(seed.astype(jnp.uint32), (cap,))
+    _, h1 = lax.while_loop(word_cond, word_body, (jnp.int32(0), h0))
+
+    aligned = lens - (lens % 4)
+    for j in range(3):
+        pos = starts + aligned + j
+        active = aligned + j < lens
+        b = byte_at(pos).astype(jnp.int8).astype(jnp.int32).astype(jnp.uint32)
+        mixed = _mm3_mix_h1(h1, _mm3_mix_k1(b))
+        h1 = jnp.where(active, mixed, h1)
+    return _mm3_fmix(h1, lens)
+
+
+def spark_hash_column(col: ColumnVector, num_rows: int, seed: jax.Array) -> jax.Array:
+    """Spark Murmur3Hash semantics per type: null fields pass the running
+    seed through unchanged."""
+    d = col.dtype
+    if isinstance(d, T.StringType):
+        h = murmur3_bytes(col.data["offsets"], col.data["bytes"], seed)
+    elif isinstance(d, T.BooleanType):
+        h = murmur3_int32(col.data.astype(jnp.int32), seed)
+    elif isinstance(d, (T.Int8Type, T.Int16Type, T.Int32Type, T.DateType)):
+        h = murmur3_int32(col.data.astype(jnp.int32), seed)
+    elif isinstance(d, T.Float32Type):
+        v = jnp.where(col.data == 0.0, jnp.zeros_like(col.data), col.data)  # -0.0 -> +0.0
+        h = murmur3_int32(lax.bitcast_convert_type(v, jnp.int32), seed)
+    elif isinstance(d, T.Float64Type):
+        v = jnp.where(col.data == 0.0, jnp.zeros_like(col.data), col.data)
+        h = murmur3_int64(lax.bitcast_convert_type(v, jnp.int64), seed)
+    else:  # int64, timestamp, decimal64
+        h = murmur3_int64(col.data.astype(jnp.int64), seed)
+    valid = col.validity_or_default(num_rows)
+    if seed.ndim == 0:
+        seed = jnp.broadcast_to(seed, h.shape)
+    return jnp.where(valid, h, seed.astype(jnp.uint32))
+
+
+def spark_murmur3_batch(cols: Sequence[ColumnVector], num_rows: int,
+                        seed: int = SPARK_MURMUR3_SEED) -> jax.Array:
+    """Chained per-row hash over columns = Spark Murmur3Hash(cols, 42)."""
+    cap = cols[0].capacity
+    h = jnp.full((cap,), np.uint32(seed))
+    for c in cols:
+        h = spark_hash_column(c, num_rows, h)
+    return h.astype(jnp.int32)
+
+
+# -- xxhash64 (reference jni.Hash.xxhash64) ---------------------------------
+
+_XXP1 = np.uint64(0x9E3779B185EBCA87)
+_XXP2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_XXP3 = np.uint64(0x165667B19E3779F9)
+_XXP5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r):
+    return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def xxhash64_int64(values: jax.Array, seed: int = 42) -> jax.Array:
+    v = values.astype(jnp.uint64)
+    h = np.uint64(seed) + _XXP5 + np.uint64(8)
+    k1 = _rotl64(v * _XXP2, 31) * _XXP1
+    h = h ^ k1
+    h = _rotl64(h, 27) * _XXP1 + np.uint64(0x85EBCA77C2B2AE63)
+    h = (h ^ (h >> np.uint64(33))) * _XXP2
+    h = (h ^ (h >> np.uint64(29))) * _XXP3
+    return (h ^ (h >> np.uint64(32))).astype(jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# Key normalization: map a column to an order-preserving uint64 plane so
+# sorts/joins/groupbys work on uniform fixed-width lanes.
+# ---------------------------------------------------------------------------
+
+_SIGN64 = np.uint64(0x8000000000000000)
+
+
+def normalize_key(col: ColumnVector, num_rows: int,
+                  for_order: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Returns (key_u64, null_flags). Key order matches value order for all
+    fixed-width types. Strings get a 64-bit double-hash of the bytes:
+    equality-faithful up to astronomically-unlikely collisions, NOT
+    order-faithful (string ORDER BY uses the host sort path)."""
+    d = col.dtype
+    valid = col.validity_or_default(num_rows)
+    if isinstance(d, T.StringType):
+        if for_order:
+            raise NotImplementedError("device string ordering; use host sort")
+        h1 = murmur3_bytes(col.data["offsets"], col.data["bytes"], jnp.uint32(0x12345671))
+        h2 = murmur3_bytes(col.data["offsets"], col.data["bytes"], jnp.uint32(0x89ABCDE3))
+        key = (h1.astype(jnp.uint64) << jnp.uint64(32)) | h2.astype(jnp.uint64)
+    elif isinstance(d, T.BooleanType):
+        key = col.data.astype(jnp.uint64)
+    elif isinstance(d, T.Float32Type):
+        v = jnp.where(jnp.isnan(col.data), jnp.float32(np.nan), col.data)
+        v = jnp.where(v == 0.0, jnp.zeros_like(v), v)
+        key = _order_float_bits(lax.bitcast_convert_type(v, jnp.int32).astype(jnp.int64), 32)
+    elif isinstance(d, T.Float64Type):
+        v = jnp.where(jnp.isnan(col.data), jnp.float64(np.nan), col.data)
+        v = jnp.where(v == 0.0, jnp.zeros_like(v), v)
+        key = _order_float_bits(lax.bitcast_convert_type(v, jnp.int64), 64)
+    else:
+        key = col.data.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN64
+    key = jnp.where(valid, key, jnp.uint64(0))
+    return key, ~valid
+
+
+def _order_float_bits(bits: jax.Array, width: int) -> jax.Array:
+    """IEEE total-order transform: negatives flip all bits, positives flip
+    the sign bit. NaN (canonicalized, positive payload) sorts above +inf,
+    matching Spark's NaN ordering."""
+    u = bits.astype(jnp.uint64)
+    if width == 32:
+        mask = jnp.uint64(0xFFFFFFFF)
+        sign = jnp.uint64(0x80000000)
+        u = u & mask
+        neg = (u & sign) != 0
+        return jnp.where(neg, (~u) & mask, u | sign)
+    neg = (u & _SIGN64) != 0
+    return jnp.where(neg, ~u, u | _SIGN64)
+
+
+# ---------------------------------------------------------------------------
+# Sort / argsort (reference cudf OrderByArg sort)
+# ---------------------------------------------------------------------------
+
+def lexsort_indices(keys: List[Tuple[jax.Array, jax.Array, bool, bool]],
+                    num_rows: int) -> jax.Array:
+    """Stable lexicographic argsort. keys = [(key_u64, null_flags, ascending,
+    nulls_first)]. Padded rows (>= num_rows) sort to the very end. Returns an
+    int32 permutation of the full capacity."""
+    cap = keys[0][0].shape[0]
+    operands: List[jax.Array] = []
+    in_range = jnp.arange(cap) < num_rows
+    operands.append(jnp.where(in_range, 0, 1).astype(jnp.uint8))
+    for key, nulls, asc, nulls_first in keys:
+        # null-ordering plane: 0 sorts before 1
+        null_rank = jnp.uint8(0) if nulls_first else jnp.uint8(1)
+        val_rank = jnp.uint8(1) if nulls_first else jnp.uint8(0)
+        operands.append(jnp.where(nulls, null_rank, val_rank))
+        operands.append(key if asc else ~key)
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    out = lax.sort(tuple(operands) + (iota,), num_keys=len(operands), is_stable=True)
+    return out[-1]
+
+
+# ---------------------------------------------------------------------------
+# Gather (reference GatherMap + OutOfBoundsPolicy.NULLIFY)
+# ---------------------------------------------------------------------------
+
+def gather_column(col: ColumnVector, indices: jax.Array, src_rows: int) -> ColumnVector:
+    """Row gather of one column. indices: int32[out_cap]; -1 emits null."""
+    oob = indices < 0
+    safe = jnp.clip(indices, 0, col.capacity - 1)
+    src_valid = col.validity_or_default(src_rows)
+    valid = src_valid[safe] & ~oob
+    if col.is_string:
+        offsets = col.data["offsets"]
+        raw = col.data["bytes"]
+        lens = (offsets[1:] - offsets[:-1])[safe]
+        lens = jnp.where(valid, lens, 0)
+        new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(lens).astype(jnp.int32)])
+        out_bytes = _gather_string_bytes(raw, offsets, safe, new_off)
+        data = {"offsets": new_off, "bytes": out_bytes}
+    else:
+        data = col.data[safe]
+    return ColumnVector(col.dtype, data, valid)
+
+
+def _gather_string_bytes(raw, offsets, row_idx, new_off):
+    """For each output byte b: output row = searchsorted(new_off, b), source
+    byte = src_start + (b - out_start). Output byte plane keeps the source
+    byte capacity (gather never grows payload)."""
+    nbytes = raw.shape[0]
+    b = jnp.arange(nbytes, dtype=jnp.int32)
+    row = jnp.searchsorted(new_off, b, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, row_idx.shape[0] - 1)
+    src_row = row_idx[row]
+    src = offsets[src_row] + (b - new_off[row])
+    src = jnp.clip(src, 0, nbytes - 1)
+    return jnp.where(b < new_off[-1], raw[src], 0).astype(jnp.uint8)
+
+
+def gather_batch(batch: ColumnarBatch, indices: jax.Array, out_rows: int) -> ColumnarBatch:
+    cols = [gather_column(c, indices, batch.num_rows) for c in batch.columns]
+    return ColumnarBatch(cols, out_rows)
+
+
+# ---------------------------------------------------------------------------
+# Filter: count-then-gather compaction
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _count_true(mask: jax.Array, num_rows) -> jax.Array:
+    cap = mask.shape[0]
+    return jnp.sum((mask & (jnp.arange(cap) < num_rows)).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _compact_indices(mask: jax.Array, num_rows, out_cap: int) -> jax.Array:
+    cap = mask.shape[0]
+    mask = mask & (jnp.arange(cap) < num_rows)
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    scatter_to = jnp.where(mask, pos, out_cap)  # non-selected drop
+    out = jnp.full(out_cap + 1, -1, jnp.int32)
+    out = out.at[scatter_to].set(jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    return out[:out_cap]
+
+
+def filter_indices(mask: jax.Array, num_rows: int) -> Tuple[jax.Array, int]:
+    """mask: bool[capacity]. One device->host scalar readback for the count
+    (the price of a dynamic result size; paid per batch, not per element)."""
+    count = int(_count_true(mask, num_rows))
+    out_cap = round_capacity(max(count, 1))
+    return _compact_indices(mask, num_rows, out_cap), count
+
+
+def filter_batch(batch: ColumnarBatch, mask: jax.Array) -> ColumnarBatch:
+    idx, count = filter_indices(mask, batch.num_rows)
+    return gather_batch(batch, idx, count)
+
+
+# ---------------------------------------------------------------------------
+# Slice / concat (reference cudf Table.concatenate / contiguous split)
+# ---------------------------------------------------------------------------
+
+def slice_batch(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:
+    out_cap = round_capacity(max(length, 1))
+    idx = jnp.arange(out_cap, dtype=jnp.int32) + start
+    idx = jnp.where(jnp.arange(out_cap) < length, idx, -1)
+    return gather_batch(batch, idx, length)
+
+
+def concat_batches(batches: List[ColumnarBatch]) -> ColumnarBatch:
+    nonempty = [b for b in batches if b.num_rows > 0]
+    if not nonempty:
+        return batches[0]
+    if len(nonempty) == 1:
+        return nonempty[0]
+    total = sum(b.num_rows for b in nonempty)
+    cap = round_capacity(total)
+    out_cols = []
+    for ci in range(nonempty[0].num_cols):
+        cols = [b.columns[ci] for b in nonempty]
+        rows = [b.num_rows for b in nonempty]
+        out_cols.append(_concat_columns(cols, rows, cap))
+    return ColumnarBatch(out_cols, total)
+
+
+def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> ColumnVector:
+    dtype = cols[0].dtype
+    validity = jnp.concatenate([c.validity_or_default(r)[:r] for c, r in zip(cols, rows)])
+    pad = cap - validity.shape[0]
+    if pad > 0:
+        validity = jnp.concatenate([validity, jnp.zeros(pad, jnp.bool_)])
+
+    if isinstance(dtype, T.StringType):
+        # Host readback of per-part byte lengths keeps destination offsets
+        # static; concat happens between batches, off the jitted hot path.
+        byte_lens = [int(np.asarray(c.data["offsets"][r])) for c, r in zip(cols, rows)]
+        total_bytes = sum(byte_lens)
+        out_byte_cap = round_capacity(max(total_bytes, 1))
+        out_bytes = jnp.zeros(out_byte_cap, jnp.uint8)
+        off_parts = [jnp.zeros(1, jnp.int32)]
+        base_rows = 0
+        base_bytes = 0
+        for c, r, blen in zip(cols, rows, byte_lens):
+            o = c.data["offsets"]
+            off_parts.append(o[1: r + 1].astype(jnp.int32) + np.int32(base_bytes))
+            src = c.data["bytes"]
+            part_cap = src.shape[0]
+            dest = jnp.where(jnp.arange(part_cap) < blen,
+                             base_bytes + jnp.arange(part_cap), out_byte_cap)
+            out_bytes = out_bytes.at[dest].set(src, mode="drop")
+            base_rows += r
+            base_bytes += blen
+        offsets = jnp.concatenate(off_parts)
+        opad = cap + 1 - offsets.shape[0]
+        if opad > 0:
+            offsets = jnp.concatenate([offsets, jnp.broadcast_to(offsets[-1:], (opad,))])
+        return ColumnVector(dtype, {"offsets": offsets, "bytes": out_bytes}, validity)
+
+    merged = jnp.concatenate([c.data[:r] for c, r in zip(cols, rows)])
+    if cap - merged.shape[0] > 0:
+        merged = jnp.concatenate([merged, jnp.zeros(cap - merged.shape[0], merged.dtype)])
+    return ColumnVector(dtype, merged, validity)
+
+
+# ---------------------------------------------------------------------------
+# Join candidate expansion (count-then-gather; the JoinGatherer analog)
+# ---------------------------------------------------------------------------
+
+def expand_ranges(lo: jax.Array, hi: jax.Array, total: int) -> Tuple[jax.Array, jax.Array]:
+    """Given per-probe candidate ranges [lo_i, hi_i) into a sorted build side,
+    emit flat (probe_idx, build_pos) pairs. total = sum(hi-lo), a host scalar.
+    Tail entries (>= total) are -1."""
+    out_cap = round_capacity(max(total, 1))
+    counts = (hi - lo).astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    r = jnp.arange(out_cap, dtype=jnp.int32)
+    probe = jnp.searchsorted(offsets, r, side="right").astype(jnp.int32) - 1
+    probe = jnp.clip(probe, 0, lo.shape[0] - 1)
+    pos = lo[probe] + (r - offsets[probe])
+    in_range = r < total
+    return jnp.where(in_range, probe, -1), jnp.where(in_range, pos, -1)

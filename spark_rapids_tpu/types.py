@@ -1,0 +1,415 @@
+"""Data type system and TypeSig algebra.
+
+Reference parity: com/nvidia/spark/rapids/TypeChecks.scala (TypeSig, the
+algebra of supported types with per-op notes used both for tagging and for
+generating supported_ops docs). This implementation keeps the same two roles
+-- (1) a closed set of SQL types with nesting, (2) a set-algebra used by every
+operator rule to declare what it supports -- but is organised around what XLA
+can natively represent: fixed-width primitives map 1:1 onto device arrays,
+strings are offset+bytes planes, decimals are scaled integers.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+import numpy as np
+
+
+class DataType:
+    """Base of the closed SQL type set."""
+
+    #: jax/numpy dtype of the primary device plane, or None for nested reprs.
+    np_dtype: Optional[np.dtype] = None
+
+    def __repr__(self) -> str:
+        return self.__class__.__name__.replace("Type", "").lower()
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other)
+
+    def __hash__(self) -> int:
+        return hash(type(self))
+
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, (IntegralType, FractionalType, DecimalType))
+
+    @property
+    def is_integral(self) -> bool:
+        return isinstance(self, IntegralType)
+
+    def default_size(self) -> int:
+        """Estimated bytes per row (reference: GpuBatchUtils size estimation)."""
+        if self.np_dtype is not None:
+            return np.dtype(self.np_dtype).itemsize
+        return 16
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.int8)  # carrier plane; every row invalid
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+
+
+class IntegralType(DataType):
+    pass
+
+
+class Int8Type(IntegralType):
+    np_dtype = np.dtype(np.int8)
+
+
+class Int16Type(IntegralType):
+    np_dtype = np.dtype(np.int16)
+
+
+class Int32Type(IntegralType):
+    np_dtype = np.dtype(np.int32)
+
+
+class Int64Type(IntegralType):
+    np_dtype = np.dtype(np.int64)
+
+
+class FractionalType(DataType):
+    pass
+
+
+class Float32Type(FractionalType):
+    np_dtype = np.dtype(np.float32)
+
+
+class Float64Type(FractionalType):
+    np_dtype = np.dtype(np.float64)
+
+
+class DateType(DataType):
+    """Days since epoch, int32 (Spark DateType semantics)."""
+    np_dtype = np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    """Microseconds since epoch UTC, int64 (Spark TimestampType semantics)."""
+    np_dtype = np.dtype(np.int64)
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class DecimalType(DataType):
+    """Decimal as scaled int64 (precision<=18) or int128-as-2xint64.
+
+    Reference keeps DECIMAL128 in libcudf (jni DecimalUtils); on TPU we store
+    unscaled values in int64 lanes (precision<=18 for round 1) and perform
+    arithmetic with explicit rescaling in the expression compiler.
+    """
+    precision: int = 10
+    scale: int = 0
+
+    def __repr__(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def np_dtype(self):  # type: ignore[override]
+        return np.dtype(np.int64)
+
+    MAX_INT64_PRECISION = 18
+
+
+class StringType(DataType):
+    """UTF-8 strings: int32 offsets plane + uint8 bytes plane on device.
+
+    Dictionary-encoded variant (codes + host dictionary) is produced by scans
+    for group/join keys -- see columnar/strings.py.
+    """
+    np_dtype = None
+
+    def default_size(self) -> int:
+        return 24  # offsets + avg payload estimate
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class ArrayType(DataType):
+    element: DataType = dataclasses.field(default_factory=Int32Type)
+    contains_null: bool = True
+
+    def __repr__(self) -> str:
+        return f"array<{self.element!r}>"
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class StructField:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class StructType(DataType):
+    fields: tuple = ()
+
+    def __repr__(self) -> str:
+        inner = ",".join(f"{f.name}:{f.dtype!r}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def field_names(self):
+        return [f.name for f in self.fields]
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class MapType(DataType):
+    key: DataType = dataclasses.field(default_factory=StringType)
+    value: DataType = dataclasses.field(default_factory=StringType)
+
+    def __repr__(self) -> str:
+        return f"map<{self.key!r},{self.value!r}>"
+
+
+# Singletons for the non-parameterised types.
+NULL = NullType()
+BOOLEAN = BooleanType()
+INT8 = Int8Type()
+INT16 = Int16Type()
+INT32 = Int32Type()
+INT64 = Int64Type()
+FLOAT32 = Float32Type()
+FLOAT64 = Float64Type()
+STRING = StringType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    fields: tuple
+
+    @staticmethod
+    def of(*pairs) -> "Schema":
+        return Schema(tuple(StructField(n, t) for n, t in pairs))
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+    @property
+    def types(self):
+        return [f.dtype for f in self.fields]
+
+    def __len__(self):
+        return len(self.fields)
+
+    def index_of(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __repr__(self):
+        return "Schema(" + ", ".join(f"{f.name}:{f.dtype!r}" for f in self.fields) + ")"
+
+
+# ---------------------------------------------------------------------------
+# TypeSig: set algebra over supported types (reference TypeChecks.scala:168).
+# ---------------------------------------------------------------------------
+
+_BASE_ORDER = [
+    "NULL", "BOOLEAN", "INT8", "INT16", "INT32", "INT64", "FLOAT32",
+    "FLOAT64", "DECIMAL64", "STRING", "DATE", "TIMESTAMP", "ARRAY",
+    "STRUCT", "MAP",
+]
+
+
+def _tag_of(dtype: DataType) -> str:
+    if isinstance(dtype, NullType):
+        return "NULL"
+    if isinstance(dtype, BooleanType):
+        return "BOOLEAN"
+    if isinstance(dtype, Int8Type):
+        return "INT8"
+    if isinstance(dtype, Int16Type):
+        return "INT16"
+    if isinstance(dtype, Int32Type):
+        return "INT32"
+    if isinstance(dtype, Int64Type):
+        return "INT64"
+    if isinstance(dtype, Float32Type):
+        return "FLOAT32"
+    if isinstance(dtype, Float64Type):
+        return "FLOAT64"
+    if isinstance(dtype, DecimalType):
+        return "DECIMAL64"
+    if isinstance(dtype, StringType):
+        return "STRING"
+    if isinstance(dtype, DateType):
+        return "DATE"
+    if isinstance(dtype, TimestampType):
+        return "TIMESTAMP"
+    if isinstance(dtype, ArrayType):
+        return "ARRAY"
+    if isinstance(dtype, StructType):
+        return "STRUCT"
+    if isinstance(dtype, MapType):
+        return "MAP"
+    raise TypeError(f"unknown dtype {dtype!r}")
+
+
+class TypeSig:
+    """Immutable set of type tags with optional nested-type constraints and
+    per-type notes (rendered into supported-ops docs, reference
+    TypeChecks.scala "ps notes")."""
+
+    def __init__(self, tags: Iterable[str] = (), nested: Optional["TypeSig"] = None,
+                 notes: Optional[dict] = None):
+        self.tags = frozenset(tags)
+        self.nested_sig = nested
+        self.notes = dict(notes or {})
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def none() -> "TypeSig":
+        return TypeSig()
+
+    @staticmethod
+    def all() -> "TypeSig":
+        return TypeSig(_BASE_ORDER, nested=TypeSig(_BASE_ORDER))
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        nested = self.nested_sig or other.nested_sig
+        if self.nested_sig and other.nested_sig:
+            nested = self.nested_sig + other.nested_sig
+        return TypeSig(self.tags | other.tags, nested, {**self.notes, **other.notes})
+
+    def __sub__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(self.tags - other.tags, self.nested_sig, self.notes)
+
+    def nested(self) -> "TypeSig":
+        """Allow the same set inside arrays/structs/maps."""
+        return TypeSig(self.tags | {"ARRAY", "STRUCT", "MAP"}, nested=self)
+
+    def with_note(self, tag: str, note: str) -> "TypeSig":
+        notes = dict(self.notes)
+        notes[tag] = note
+        return TypeSig(self.tags, self.nested_sig, notes)
+
+    # -- checks ------------------------------------------------------------
+    def supports(self, dtype: DataType) -> bool:
+        tag = _tag_of(dtype)
+        if tag not in self.tags:
+            return False
+        if isinstance(dtype, ArrayType):
+            inner = self.nested_sig or TypeSig.none()
+            return inner.supports(dtype.element)
+        if isinstance(dtype, StructType):
+            inner = self.nested_sig or TypeSig.none()
+            return all(inner.supports(f.dtype) for f in dtype.fields)
+        if isinstance(dtype, MapType):
+            inner = self.nested_sig or TypeSig.none()
+            return inner.supports(dtype.key) and inner.supports(dtype.value)
+        return True
+
+    def reason_not_supported(self, dtype: DataType) -> Optional[str]:
+        if self.supports(dtype):
+            return None
+        tag = _tag_of(dtype)
+        if tag in self.notes:
+            return f"{dtype!r} is not supported ({self.notes[tag]})"
+        return f"{dtype!r} is not supported"
+
+    def __repr__(self):
+        ordered = [t for t in _BASE_ORDER if t in self.tags]
+        return "TypeSig(" + "+".join(ordered) + ")"
+
+
+# Common signatures mirroring the reference's named combinations
+# (TypeChecks.scala: integral, numeric, commonCudfTypes, ...).
+class Sigs:
+    INTEGRAL = TypeSig(["INT8", "INT16", "INT32", "INT64"])
+    FP = TypeSig(["FLOAT32", "FLOAT64"])
+    NUMERIC = INTEGRAL + FP + TypeSig(["DECIMAL64"])
+    COMMON = NUMERIC + TypeSig(["BOOLEAN", "STRING", "DATE", "TIMESTAMP", "NULL"])
+    ORDERABLE = COMMON
+    COMPARABLE = COMMON
+    ALL = TypeSig.all()
+    NONE = TypeSig.none()
+
+
+def common_type(a: DataType, b: DataType) -> DataType:
+    """Numeric widening for binary expressions (Spark's findTightestCommonType
+    subset used by the expression compiler)."""
+    if a == b:
+        return a
+    order = [INT8, INT16, INT32, INT64, FLOAT32, FLOAT64]
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        scale = max(a.scale, b.scale)
+        precision = min(max(a.precision - a.scale, b.precision - b.scale) + scale,
+                        DecimalType.MAX_INT64_PRECISION)
+        return DecimalType(precision, scale)
+    if isinstance(a, DecimalType) and b.is_integral:
+        return a
+    if isinstance(b, DecimalType) and a.is_integral:
+        return b
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        return FLOAT64
+    if a in order and b in order:
+        return order[max(order.index(a), order.index(b))]
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    raise TypeError(f"no common type for {a!r} and {b!r}")
+
+
+def from_arrow(at) -> DataType:
+    """Map a pyarrow type to our type set (host IO boundary)."""
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return BOOLEAN
+    if pa.types.is_int8(at):
+        return INT8
+    if pa.types.is_int16(at):
+        return INT16
+    if pa.types.is_int32(at):
+        return INT32
+    if pa.types.is_int64(at):
+        return INT64
+    if pa.types.is_float32(at):
+        return FLOAT32
+    if pa.types.is_float64(at):
+        return FLOAT64
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return STRING
+    if pa.types.is_date32(at):
+        return DATE
+    if pa.types.is_timestamp(at):
+        return TIMESTAMP
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_null(at):
+        return NULL
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow(at.value_type))
+    if pa.types.is_struct(at):
+        return StructType(tuple(StructField(f.name, from_arrow(f.type)) for f in at))
+    if pa.types.is_map(at):
+        return MapType(from_arrow(at.key_type), from_arrow(at.item_type))
+    raise TypeError(f"unsupported arrow type {at}")
+
+
+def to_arrow(dtype: DataType):
+    import pyarrow as pa
+    mapping = {
+        BOOLEAN: pa.bool_(), INT8: pa.int8(), INT16: pa.int16(),
+        INT32: pa.int32(), INT64: pa.int64(), FLOAT32: pa.float32(),
+        FLOAT64: pa.float64(), STRING: pa.string(), DATE: pa.date32(),
+        TIMESTAMP: pa.timestamp("us"), NULL: pa.null(),
+    }
+    if isinstance(dtype, DecimalType):
+        return pa.decimal128(dtype.precision, dtype.scale)
+    if isinstance(dtype, ArrayType):
+        return pa.list_(to_arrow(dtype.element))
+    if isinstance(dtype, StructType):
+        return pa.struct([pa.field(f.name, to_arrow(f.dtype)) for f in dtype.fields])
+    if isinstance(dtype, MapType):
+        return pa.map_(to_arrow(dtype.key), to_arrow(dtype.value))
+    return mapping[dtype]
