@@ -1,0 +1,98 @@
+"""Whole-stage compilation of expression lists.
+
+The TPU-idiomatic replacement for cuDF's kernel-per-expression model
+(reference GpuProjectExec/GpuFilterExec calling one cudf kernel per op,
+basicPhysicalOperators.scala): an entire projection/filter expression list
+is traced once into a single jitted XLA computation per (expression
+fingerprint, batch capacity bucket, column layout). XLA fuses the whole
+stage; num_rows is a traced scalar so row-count changes don't recompile.
+
+ANSI errors surface as per-code boolean planes returned from the jitted fn;
+the host raises SparkException if any fire (data-dependent raising cannot
+happen inside a trace).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector, ColumnarBatch
+from spark_rapids_tpu.expr.core import EvalCtx, Expression, SparkException
+
+_STAGE_CACHE: Dict[Tuple, object] = {}
+
+
+def _planes_of(col: ColumnVector):
+    if isinstance(col.data, dict):
+        return {"offsets": col.data["offsets"], "bytes": col.data["bytes"],
+                "validity": col.validity}
+    return {"data": col.data, "validity": col.validity}
+
+
+def _col_from_planes(planes, dtype: T.DataType) -> ColumnVector:
+    if "offsets" in planes:
+        return ColumnVector(dtype, {"offsets": planes["offsets"],
+                                    "bytes": planes["bytes"]}, planes["validity"])
+    return ColumnVector(dtype, planes["data"], planes["validity"])
+
+
+def _layout_key(col: ColumnVector):
+    if isinstance(col.data, dict):
+        return ("str", col.data["offsets"].shape, col.data["bytes"].shape,
+                col.validity is None)
+    return (str(col.data.dtype), col.data.shape, col.validity is None)
+
+
+def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
+              ansi: bool = False) -> List[ColumnVector]:
+    """Evaluate expressions over a batch as one jitted stage."""
+    fp = tuple(e.fingerprint() for e in exprs)
+    layout = tuple(_layout_key(c) for c in batch.columns)
+    key = (fp, layout, batch.capacity, ansi)
+    fn = _STAGE_CACHE.get(key)
+    in_dtypes = [c.dtype for c in batch.columns]
+    out_dtypes = [e.data_type() for e in exprs]
+
+    if fn is None:
+        def stage(col_planes, num_rows):
+            cols = [_col_from_planes(p, dt) for p, dt in zip(col_planes, in_dtypes)]
+            ctx = EvalCtx(cols, num_rows, batch.capacity, ansi)
+            outs = [e.eval_tpu(ctx) for e in exprs]
+            out_planes = [_planes_of(c) for c in outs]
+            err = {code: mask for code, mask in ctx.errors}
+            return out_planes, err
+
+        fn = jax.jit(stage)
+        _STAGE_CACHE[key] = fn
+
+    col_planes = [_planes_of(c) for c in batch.columns]
+    out_planes, err = fn(col_planes, jnp.int32(batch.num_rows))
+    if err:
+        for code, mask in err.items():
+            if bool(jnp.any(mask)):
+                raise SparkException(f"[{code}] ANSI mode error in stage")
+    return [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
+
+
+def run_projection(exprs: Sequence[Expression], batch: ColumnarBatch,
+                   ansi: bool = False) -> ColumnarBatch:
+    cols = run_stage(exprs, batch, ansi)
+    return ColumnarBatch(cols, batch.num_rows)
+
+
+def can_compile(e: Expression) -> Tuple[bool, str]:
+    """Best-effort static check that an expression will trace on device;
+    the overrides engine uses this plus the registry checks."""
+    sup = getattr(e, "supported_on_tpu", None)
+    if sup is not None and not sup():
+        return False, f"{type(e).__name__} not supported on TPU"
+    for c in e.children:
+        ok, why = can_compile(c)
+        if not ok:
+            return False, why
+    return True, ""

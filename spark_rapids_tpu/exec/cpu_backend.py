@@ -1,0 +1,418 @@
+"""CPU reference backend: a pandas/numpy interpreter of the plan algebra.
+
+This plays the role CPU Spark plays for the reference's differential test
+harness (integration_tests asserts GPU results == CPU results;
+SURVEY.md §4.1): an independent implementation the TPU engine is diffed
+against, and the fallback executor for operators/expressions the TPU
+planner rejects (reference per-operator fallback).
+
+Implementation notes:
+- Data currency is List[CpuCol] (numpy values + validity) per plan schema.
+- Grouping/joining keys are pre-normalized to exact integer codes so SQL
+  semantics hold where pandas' own NaN/NA rules differ (NaN groups equal,
+  nulls group together, null join keys never match).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import CpuCol, Expression
+from spark_rapids_tpu.expr.aggregates import CountAll, NamedAgg
+from spark_rapids_tpu.plan import nodes as P
+
+
+# ---------------------------------------------------------------------------
+# pyarrow <-> CpuCol
+# ---------------------------------------------------------------------------
+
+def table_to_cols(table: pa.Table) -> List[CpuCol]:
+    out = []
+    for i, field in enumerate(table.schema):
+        dtype = T.from_arrow(field.type)
+        arr = table.column(i).combine_chunks()
+        valid = np.ones(len(arr), np.bool_) if arr.null_count == 0 \
+            else np.asarray(arr.is_valid())
+        if isinstance(dtype, T.StringType):
+            vals = np.array(arr.to_pylist(), object)
+        elif isinstance(dtype, T.DecimalType):
+            vals = np.array([0 if v is None else int(v.scaleb(dtype.scale))
+                             for v in arr.to_pylist()], np.int64)
+        elif isinstance(dtype, T.TimestampType):
+            vals = np.asarray(arr.cast(pa.timestamp("us")).fill_null(0)) \
+                .astype("datetime64[us]").astype(np.int64)
+        elif isinstance(dtype, T.DateType):
+            vals = np.asarray(arr.fill_null(0)).astype("datetime64[D]").astype(np.int32)
+        elif isinstance(dtype, T.NullType):
+            vals = np.zeros(len(arr), np.int8)
+            valid = np.zeros(len(arr), np.bool_)
+        else:
+            vals = np.asarray(arr.fill_null(0)).astype(dtype.np_dtype)
+        out.append(CpuCol(dtype, vals, valid))
+    return out
+
+
+def cols_to_table(cols: List[CpuCol], names: List[str]) -> pa.Table:
+    arrays = []
+    fields = []
+    for c, name in zip(cols, names):
+        at = T.to_arrow(c.dtype)
+        if isinstance(c.dtype, T.StringType):
+            vals = [v if (ok and isinstance(v, str)) else None
+                    for v, ok in zip(c.values, c.valid)]
+            arr = pa.array(vals, type=at)
+        elif isinstance(c.dtype, T.NullType):
+            arr = pa.nulls(len(c.values), type=at)
+        elif isinstance(c.dtype, T.DecimalType):
+            import decimal
+            vals = [decimal.Decimal(int(v)).scaleb(-c.dtype.scale) if ok else None
+                    for v, ok in zip(c.values, c.valid)]
+            arr = pa.array(vals, type=at)
+        elif isinstance(c.dtype, T.TimestampType):
+            arr = pa.array(c.values.astype("datetime64[us]"), type=at, mask=~c.valid)
+        elif isinstance(c.dtype, T.DateType):
+            arr = pa.array(c.values.astype(np.int32).astype("datetime64[D]"),
+                           type=at, mask=~c.valid)
+        else:
+            arr = pa.array(c.values.astype(c.dtype.np_dtype), type=at, mask=~c.valid)
+        arrays.append(arr)
+        fields.append(pa.field(name, at))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def _gather_cols(cols: List[CpuCol], idx: np.ndarray) -> List[CpuCol]:
+    """Row gather with -1 -> null."""
+    out = []
+    oob = idx < 0
+    safe = np.where(oob, 0, idx)
+    for c in cols:
+        vals = c.values[safe]
+        if isinstance(c.dtype, T.StringType):
+            vals = vals.copy()
+            vals[oob] = None
+        valid = c.valid[safe] & ~oob
+        out.append(CpuCol(c.dtype, vals, valid))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Key normalization for grouping/joining/sorting (exact SQL semantics)
+# ---------------------------------------------------------------------------
+
+def _norm_key_np(c: CpuCol, shared_dict: Optional[dict] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (uint64 order-preserving codes, null_mask). shared_dict lets
+    join sides share one string dictionary."""
+    nulls = ~c.valid
+    if isinstance(c.dtype, T.StringType):
+        if shared_dict is None:
+            uniq = sorted({v for v, ok in zip(c.values, c.valid) if ok and v is not None})
+            shared_dict = {s: i for i, s in enumerate(uniq)}
+        codes = np.array([shared_dict.get(v, 0) if ok else 0
+                          for v, ok in zip(c.values, c.valid)], np.uint64)
+        return codes, nulls
+    if isinstance(c.dtype, (T.Float32Type, T.Float64Type)):
+        v = c.values.astype(np.float64)
+        v = np.where(np.isnan(v), np.nan, v)
+        v = np.where(v == 0.0, 0.0, v)  # -0.0 -> +0.0
+        bits = v.view(np.uint64) if v.dtype == np.float64 else v.astype(np.float64).view(np.uint64)
+        bits = np.where(np.isnan(v), np.uint64(0x7FF8000000000000), bits)
+        neg = (bits >> np.uint64(63)) != 0
+        key = np.where(neg, ~bits, bits | np.uint64(1 << 63))
+        return np.where(nulls, np.uint64(0), key), nulls
+    key = c.values.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
+    return np.where(nulls, np.uint64(0), key), nulls
+
+
+def _shared_string_dict(*cols: CpuCol) -> dict:
+    uniq = set()
+    for c in cols:
+        uniq |= {v for v, ok in zip(c.values, c.valid) if ok and v is not None}
+    return {s: i for i, s in enumerate(sorted(uniq))}
+
+
+# ---------------------------------------------------------------------------
+# Node interpreters
+# ---------------------------------------------------------------------------
+
+def execute_cpu(plan: P.PlanNode, ansi: bool = False) -> pa.Table:
+    cols = _exec(plan, ansi)
+    return cols_to_table(cols, plan.schema.names)
+
+
+def _exec(plan: P.PlanNode, ansi: bool) -> List[CpuCol]:
+    return apply_node(plan, [_exec(c, ansi) for c in plan.children], ansi)
+
+
+def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
+               ansi: bool = False) -> List[CpuCol]:
+    """Interpret one plan node given its children's results. Used both by the
+    full-plan interpreter and by per-operator CPU fallback inside TPU plans
+    (the reference's convertIfNeeded fallback path)."""
+    if isinstance(plan, P.InMemorySource):
+        return table_to_cols(plan.table)
+    if isinstance(plan, P.ParquetScan):
+        import pyarrow.parquet as pq
+        tables = [pq.read_table(p, columns=plan.columns) for p in plan.paths]
+        table = pa.concat_tables(tables, promote_options="permissive") \
+            if len(tables) > 1 else tables[0]
+        return table_to_cols(table)
+    if isinstance(plan, P.Range):
+        vals = np.arange(plan.start, plan.end, plan.step, np.int64)
+        return [CpuCol(T.INT64, vals, np.ones(len(vals), np.bool_))]
+    if isinstance(plan, P.Project):
+        return [e.eval_cpu(children[0], ansi) for e in plan.exprs]
+    if isinstance(plan, P.Filter):
+        pred = plan.condition.eval_cpu(children[0], ansi)
+        keep = pred.values.astype(np.bool_) & pred.valid
+        return _gather_cols(children[0], np.nonzero(keep)[0])
+    if isinstance(plan, P.Aggregate):
+        return _exec_aggregate(plan, children[0], ansi)
+    if isinstance(plan, P.Sort):
+        return _exec_sort(plan, children[0], ansi)
+    if isinstance(plan, P.Limit):
+        child = children[0]
+        n = len(child[0].values) if child else 0
+        return _gather_cols(child, np.arange(min(plan.n, n)))
+    if isinstance(plan, P.Union):
+        return _exec_union(plan, children)
+    if isinstance(plan, P.Join):
+        return _exec_join(plan, children[0], children[1], ansi)
+    if isinstance(plan, P.Expand):
+        child = children[0]
+        parts = []
+        for proj in plan.projections:
+            parts.append([e.eval_cpu(child, ansi) for e in proj])
+        out = []
+        out_types = plan.schema.types
+        for i in range(len(plan.projections[0])):
+            vals = np.concatenate([_cast_vals(p[i], out_types[i]) for p in parts])
+            valid = np.concatenate([p[i].valid for p in parts])
+            out.append(CpuCol(out_types[i], vals, valid))
+        return out
+    raise NotImplementedError(f"CPU backend: {type(plan).__name__}")
+
+
+def _cast_vals(c: CpuCol, dt: T.DataType):
+    if isinstance(dt, T.StringType):
+        return c.values
+    return c.values.astype(dt.np_dtype)
+
+
+def _exec_union(plan: P.Union, parts: List[List[CpuCol]]) -> List[CpuCol]:
+    out = []
+    for i, f in enumerate(plan.schema.fields):
+        vals = np.concatenate([_cast_vals(p[i], f.dtype) for p in parts])
+        valid = np.concatenate([p[i].valid for p in parts])
+        out.append(CpuCol(f.dtype, vals, valid))
+    return out
+
+
+def _exec_sort(plan: P.Sort, child: List[CpuCol], ansi: bool) -> List[CpuCol]:
+    n = len(child[0].values) if child else 0
+    if n == 0:
+        return child
+    # np.lexsort: last key is primary
+    keys = []
+    for o in reversed(plan.orders):
+        c = o.expr.eval_cpu(child, ansi)
+        code, nulls = _norm_key_np(c)
+        if not o.ascending:
+            code = ~code
+        nf = o.resolved_nulls_first()
+        null_plane = np.where(nulls, 0 if nf else 1, 1 if nf else 0).astype(np.uint8)
+        keys.append(code)
+        keys.append(null_plane)
+    perm = np.lexsort(keys)
+    return _gather_cols(child, perm)
+
+
+def _exec_aggregate(plan: P.Aggregate, child: List[CpuCol], ansi: bool) -> List[CpuCol]:
+    n = len(child[0].values) if child else 0
+    key_cols = [e.eval_cpu(child, ansi) for e in plan.group_exprs]
+
+    # evaluate agg inputs
+    agg_inputs: List[Optional[CpuCol]] = []
+    for a in plan.aggs:
+        if isinstance(a.fn, CountAll) or not a.fn.children:
+            agg_inputs.append(None)
+        else:
+            agg_inputs.append(a.fn.children[0].eval_cpu(child, ansi))
+
+    if not key_cols:
+        return _global_agg(plan, agg_inputs, n)
+
+    # group ids via normalized codes
+    df_data = {}
+    for i, kc in enumerate(key_cols):
+        code, nulls = _norm_key_np(kc)
+        s = pd.array(code.view(np.int64), dtype="Int64")
+        s[nulls] = pd.NA
+        df_data[f"__k{i}"] = s
+    df = pd.DataFrame(df_data)
+    grouped = df.groupby(list(df_data.keys()), dropna=False, sort=True)
+    gid = grouped.ngroup().to_numpy()
+    n_groups = int(gid.max()) + 1 if n else 0
+    first_idx = np.zeros(n_groups, np.int64)
+    seen = np.zeros(n_groups, np.bool_)
+    for i in range(n - 1, -1, -1):
+        first_idx[gid[i]] = i
+    out: List[CpuCol] = []
+    for kc in key_cols:
+        out.append(_gather_cols([kc], first_idx)[0])
+    for a, inp in zip(plan.aggs, agg_inputs):
+        out.append(_agg_by_gid(a, inp, gid, n_groups))
+    return out
+
+
+def _agg_by_gid(a: NamedAgg, inp: Optional[CpuCol], gid: np.ndarray,
+                n_groups: int) -> CpuCol:
+    spec = a.fn.pandas_spec()
+    rt = a.fn.result_type()
+    if spec == "size":
+        cnt = np.bincount(gid, minlength=n_groups).astype(np.int64)
+        return CpuCol(T.INT64, cnt, np.ones(n_groups, np.bool_))
+    assert inp is not None
+    valid = inp.valid
+    if isinstance(inp.dtype, T.StringType):
+        ser = pd.Series([v if ok else None for v, ok in zip(inp.values, valid)],
+                        dtype=object)
+    else:
+        vals = inp.values.astype(np.float64) if not inp.dtype.is_integral \
+            else inp.values.astype(np.int64)
+        if inp.dtype.is_integral or isinstance(inp.dtype, (T.BooleanType, T.DateType,
+                                                           T.TimestampType, T.DecimalType)):
+            ser = pd.Series(pd.array(inp.values.astype(np.int64), dtype="Int64"))
+        else:
+            ser = pd.Series(pd.array(vals, dtype="Float64"))
+        ser[~valid] = pd.NA
+    g = ser.groupby(pd.Series(gid))
+    ddof = None
+    if isinstance(spec, tuple):
+        spec, ddof = spec
+    if spec == "sum":
+        res = g.sum(min_count=1)
+    elif spec == "count":
+        res = g.count()
+    elif spec == "mean":
+        res = g.mean()
+    elif spec == "min":
+        res = g.min()
+    elif spec == "max":
+        res = g.max()
+    elif spec == "first":
+        res = g.first()
+    elif spec == "last":
+        res = g.last()
+    elif spec == "std":
+        res = g.std(ddof=1 if ddof is None else ddof)
+    elif spec == "var":
+        res = g.var(ddof=1 if ddof is None else ddof)
+    else:
+        raise NotImplementedError(spec)
+    res = res.reindex(range(n_groups))
+    na = res.isna().to_numpy()
+    if isinstance(rt, T.StringType):
+        vals = res.to_numpy(dtype=object)
+        return CpuCol(rt, vals, ~na)
+    filled = res.fillna(0).to_numpy(dtype=np.float64)
+    return CpuCol(rt, filled.astype(rt.np_dtype), ~na)
+
+
+def _global_agg(plan: P.Aggregate, agg_inputs, n: int) -> List[CpuCol]:
+    out = []
+    gid = np.zeros(max(n, 0), np.int64)
+    for a, inp in zip(plan.aggs, agg_inputs):
+        if n == 0:
+            rt = a.fn.result_type()
+            if a.fn.pandas_spec() in ("size", "count"):
+                out.append(CpuCol(T.INT64, np.zeros(1, np.int64),
+                                  np.ones(1, np.bool_)))
+            else:
+                npdt = object if isinstance(rt, T.StringType) else rt.np_dtype
+                out.append(CpuCol(rt, np.zeros(1, npdt), np.zeros(1, np.bool_)))
+        else:
+            out.append(_agg_by_gid(a, inp, gid, 1))
+    return out
+
+
+def _exec_join(plan: P.Join, left: List[CpuCol], right: List[CpuCol],
+               ansi: bool) -> List[CpuCol]:
+    ln = len(left[0].values) if left else 0
+    rn = len(right[0].values) if right else 0
+    lk = [e.eval_cpu(left, ansi) for e in plan.left_keys]
+    rk = [e.eval_cpu(right, ansi) for e in plan.right_keys]
+
+    if plan.how == "cross":
+        lidx = np.repeat(np.arange(ln), rn)
+        ridx = np.tile(np.arange(rn), ln)
+    else:
+        # build pair lists via sorted codes per key, exact semantics: null
+        # keys never match; NaN matches NaN (normalized code equality)
+        lcodes = []
+        rcodes = []
+        lnull = np.zeros(ln, np.bool_)
+        rnull = np.zeros(rn, np.bool_)
+        for lc, rc in zip(lk, rk):
+            shared = _shared_string_dict(lc, rc) \
+                if isinstance(lc.dtype, T.StringType) else None
+            lcd, lnu = _norm_key_np(lc, shared)
+            rcd, rnu = _norm_key_np(rc, shared)
+            lcodes.append(lcd)
+            rcodes.append(rcd)
+            lnull |= lnu
+            rnull |= rnu
+        ldf = pd.DataFrame({f"k{i}": c.view(np.int64) for i, c in enumerate(lcodes)})
+        rdf = pd.DataFrame({f"k{i}": c.view(np.int64) for i, c in enumerate(rcodes)})
+        ldf["_l"] = np.arange(ln)
+        rdf["_r"] = np.arange(rn)
+        ldf = ldf[~lnull]
+        rdf = rdf[~rnull]
+        merged = ldf.merge(rdf, on=[f"k{i}" for i in range(len(lcodes))], how="inner")
+        lidx = merged["_l"].to_numpy()
+        ridx = merged["_r"].to_numpy()
+
+    # extra condition filters matched pairs
+    if plan.condition is not None:
+        pair_cols = _gather_cols(left, lidx) + _gather_cols(right, ridx)
+        pred = plan.condition.eval_cpu(pair_cols, ansi)
+        keep = pred.values.astype(np.bool_) & pred.valid
+        lidx, ridx = lidx[keep], ridx[keep]
+
+    how = plan.how
+    if how in ("inner", "cross"):
+        pass
+    elif how == "left":
+        matched = np.zeros(ln, np.bool_)
+        matched[lidx] = True
+        extra = np.nonzero(~matched)[0]
+        lidx = np.concatenate([lidx, extra])
+        ridx = np.concatenate([ridx, np.full(len(extra), -1)])
+    elif how == "right":
+        matched = np.zeros(rn, np.bool_)
+        matched[ridx] = True
+        extra = np.nonzero(~matched)[0]
+        lidx = np.concatenate([lidx, np.full(len(extra), -1)])
+        ridx = np.concatenate([ridx, extra])
+    elif how == "full":
+        lmatched = np.zeros(ln, np.bool_)
+        lmatched[lidx] = True
+        rmatched = np.zeros(rn, np.bool_)
+        rmatched[ridx] = True
+        lex = np.nonzero(~lmatched)[0]
+        rex = np.nonzero(~rmatched)[0]
+        lidx = np.concatenate([lidx, lex, np.full(len(rex), -1)])
+        ridx = np.concatenate([ridx, np.full(len(lex), -1), rex])
+    elif how == "left_semi":
+        hit = np.zeros(ln, np.bool_)
+        hit[lidx] = True
+        return _gather_cols(left, np.nonzero(hit)[0])
+    elif how == "left_anti":
+        hit = np.zeros(ln, np.bool_)
+        hit[lidx] = True
+        return _gather_cols(left, np.nonzero(~hit)[0])
+    return _gather_cols(left, lidx) + _gather_cols(right, ridx)

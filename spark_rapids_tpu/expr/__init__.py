@@ -1,0 +1,8 @@
+from spark_rapids_tpu.expr.core import (  # noqa: F401
+    Expression, BoundRef, Col, Literal, Alias, EvalCtx, CpuCol,
+    Add, Subtract, Multiply, Divide, IntegralDivide, Remainder, UnaryMinus, Abs,
+    EqualTo, EqualNullSafe, LessThan, LessThanOrEqual, GreaterThan,
+    GreaterThanOrEqual, And, Or, Not, IsNull, IsNotNull, IsNaN, In,
+    If, CaseWhen, Coalesce, Cast, SparkException,
+    col, lit,
+)

@@ -1,0 +1,332 @@
+"""Aggregate function descriptors.
+
+Reference parity: aggregate/aggregateFunctions.scala (GpuSum, GpuCount,
+GpuMin, GpuMax, GpuAverage, GpuFirst/Last, M2/stddev/variance) and the
+update/merge/evaluate phase structure of GpuAggregateExec.
+
+An AggFunction declares, like the reference's CudfAggregate pairs:
+- state_schema: the partial-aggregation buffer columns
+- update ops: segmented reductions applied to input rows per group
+- merge ops: segmented reductions combining partial states per group
+- evaluate: final projection from state columns to the result column
+
+The exec layer (exec/aggregate.py) drives these through the sort-based
+segmented kernels in ops/groupby.py. The CPU differential path uses pandas
+groupby -- an independent implementation.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector
+from spark_rapids_tpu.expr.core import Expression
+
+
+class AggFunction:
+    """Base; children are input expressions evaluated before aggregation."""
+
+    def __init__(self, *children: Expression):
+        self.children = list(children)
+
+    def result_type(self) -> T.DataType:
+        raise NotImplementedError
+
+    def state_schema(self) -> List[Tuple[str, T.DataType]]:
+        raise NotImplementedError
+
+    def update_ops(self) -> List[Tuple[str, int]]:
+        """[(segmented_op, input_index)] producing each state column."""
+        raise NotImplementedError
+
+    def merge_ops(self) -> List[str]:
+        """Segmented op per state column for combining partials."""
+        raise NotImplementedError
+
+    def evaluate_tpu(self, state_cols: List[ColumnVector], n_groups: int) -> ColumnVector:
+        raise NotImplementedError
+
+    def pandas_spec(self):
+        """(colname_fn, agg) description for the CPU pandas path; see
+        exec/cpu_exec.py."""
+        raise NotImplementedError
+
+    def fingerprint(self) -> str:
+        kids = ",".join(c.fingerprint() for c in self.children)
+        return f"{type(self).__name__}({kids})"
+
+    def transform(self, fn):
+        clone = type(self)(*[c.transform(fn) for c in self.children])
+        return clone
+
+    def alias(self, name):
+        return NamedAgg(self, name)
+
+    def __repr__(self):
+        return self.fingerprint()
+
+
+class NamedAgg:
+    def __init__(self, fn: AggFunction, name: str):
+        self.fn = fn
+        self.name = name
+
+    def transform(self, f):
+        return NamedAgg(self.fn.transform(f), self.name)
+
+
+class Sum(AggFunction):
+    """Spark sum: int inputs -> long; float -> double; null if all null."""
+
+    def result_type(self):
+        dt = self.children[0].data_type()
+        if dt.is_integral:
+            return T.INT64
+        if isinstance(dt, T.DecimalType):
+            return T.DecimalType(min(dt.precision + 10, 18), dt.scale)
+        return T.FLOAT64
+
+    def state_schema(self):
+        return [("sum", self.result_type())]
+
+    def update_ops(self):
+        return [("sum", 0)]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        return state_cols[0]
+
+    def pandas_spec(self):
+        return "sum"
+
+
+class Count(AggFunction):
+    def result_type(self):
+        return T.INT64
+
+    def state_schema(self):
+        return [("count", T.INT64)]
+
+    def update_ops(self):
+        return [("count", 0)]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        c = state_cols[0]
+        return ColumnVector(T.INT64, c.data, None)
+
+    def pandas_spec(self):
+        return "count"
+
+
+class CountAll(AggFunction):
+    """count(*) / count(1)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def result_type(self):
+        return T.INT64
+
+    def state_schema(self):
+        return [("count", T.INT64)]
+
+    def update_ops(self):
+        return [("count_all", -1)]  # -1: no input column needed
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        return ColumnVector(T.INT64, state_cols[0].data, None)
+
+    def pandas_spec(self):
+        return "size"
+
+    def transform(self, fn):
+        return self
+
+
+class Min(AggFunction):
+    def result_type(self):
+        return self.children[0].data_type()
+
+    def state_schema(self):
+        return [("min", self.result_type())]
+
+    def update_ops(self):
+        return [("min", 0)]
+
+    def merge_ops(self):
+        return ["min"]
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        return state_cols[0]
+
+    def pandas_spec(self):
+        return "min"
+
+
+class Max(AggFunction):
+    def result_type(self):
+        return self.children[0].data_type()
+
+    def state_schema(self):
+        return [("max", self.result_type())]
+
+    def update_ops(self):
+        return [("max", 0)]
+
+    def merge_ops(self):
+        return ["max"]
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        return state_cols[0]
+
+    def pandas_spec(self):
+        return "max"
+
+
+class Average(AggFunction):
+    """avg: state (sum: double, count: long); result double.
+    (Decimal avg via double in round 1, documented incompat.)"""
+
+    def result_type(self):
+        return T.FLOAT64
+
+    def state_schema(self):
+        return [("sum", T.FLOAT64), ("count", T.INT64)]
+
+    def update_ops(self):
+        return [("sum", 0), ("count", 0)]
+
+    def merge_ops(self):
+        return ["sum", "sum"]
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        s, c = state_cols
+        cnt = c.data.astype(jnp.float64)
+        val = s.data.astype(jnp.float64) / jnp.where(cnt == 0, 1.0, cnt)
+        return ColumnVector(T.FLOAT64, val, (c.data > 0))
+
+    def pandas_spec(self):
+        return "mean"
+
+
+class First(AggFunction):
+    """first(expr, ignoreNulls=True) -- our batch-sorted implementation picks
+    the first non-null in group-sorted order; with ignore_nulls=False Spark's
+    result is non-deterministic anyway."""
+
+    op = "first"
+
+    def result_type(self):
+        return self.children[0].data_type()
+
+    def state_schema(self):
+        return [("val", self.result_type())]
+
+    def update_ops(self):
+        return [(self.op, 0)]
+
+    def merge_ops(self):
+        return [self.op]
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        return state_cols[0]
+
+    def pandas_spec(self):
+        return "first"
+
+
+class Last(First):
+    op = "last"
+
+    def pandas_spec(self):
+        return "last"
+
+
+class _MomentAgg(AggFunction):
+    """Shared machinery for variance/stddev via (n, sum, sum_sq) states with
+    the final moment computed as m2 = sumsq - sum^2/n. The reference uses
+    cudf M2 merging; sum-of-squares is algebraically identical with double
+    precision and our deterministic sorted-order summation keeps it stable
+    enough for SQL parity tests."""
+
+    ddof = 1  # 1 = sample, 0 = population
+
+    def result_type(self):
+        return T.FLOAT64
+
+    def state_schema(self):
+        return [("n", T.INT64), ("sum", T.FLOAT64), ("sumsq", T.FLOAT64)]
+
+    def update_ops(self):
+        return [("count", 0), ("sum", 0), ("sumsq", 0)]
+
+    def merge_ops(self):
+        return ["sum", "sum", "sum"]
+
+    def _moments(self, state_cols):
+        n = state_cols[0].data.astype(jnp.float64)
+        s = state_cols[1].data.astype(jnp.float64)
+        ss = state_cols[2].data.astype(jnp.float64)
+        denom = n - self.ddof
+        m2 = ss - (s * s) / jnp.where(n == 0, 1.0, n)
+        m2 = jnp.maximum(m2, 0.0)
+        var = m2 / jnp.where(denom <= 0, 1.0, denom)
+        return n, denom, var
+
+
+class VarianceSamp(_MomentAgg):
+    ddof = 1
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        n, denom, var = self._moments(state_cols)
+        return ColumnVector(T.FLOAT64, jnp.where(denom <= 0, jnp.nan, var),
+                            (n > 0))
+
+    def pandas_spec(self):
+        return "var"
+
+
+class VariancePop(_MomentAgg):
+    ddof = 0
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        n, denom, var = self._moments(state_cols)
+        return ColumnVector(T.FLOAT64, var, (n > 0))
+
+    def pandas_spec(self):
+        return ("var", 0)
+
+
+class StddevSamp(_MomentAgg):
+    ddof = 1
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        n, denom, var = self._moments(state_cols)
+        return ColumnVector(T.FLOAT64,
+                            jnp.where(denom <= 0, jnp.nan, jnp.sqrt(var)), (n > 0))
+
+    def pandas_spec(self):
+        return "std"
+
+
+class StddevPop(_MomentAgg):
+    ddof = 0
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        n, denom, var = self._moments(state_cols)
+        return ColumnVector(T.FLOAT64, jnp.sqrt(var), (n > 0))
+
+    def pandas_spec(self):
+        return ("std", 0)

@@ -1,0 +1,275 @@
+"""Date/time expressions (reference datetimeExpressions.scala).
+
+DateType = days since 1970-01-01 (int32); TimestampType = microseconds since
+epoch UTC (int64). Civil-date decomposition uses the proleptic-Gregorian
+days-from-civil algorithm expressed branch-free in jnp; this is the same
+date algebra Spark uses (java.time), so results match for the full range.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector
+from spark_rapids_tpu.expr.core import CpuCol, Expression, _valid_of
+
+
+def _civil_from_days(days):
+    """days since epoch -> (year, month, day). Branch-free version of the
+    public-domain civil_from_days algorithm (Howard Hinnant)."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097                                   # [0, 146096]
+    yoe = jnp.floor_divide(doe - jnp.floor_divide(doe, 1460)
+                           + jnp.floor_divide(doe, 36524)
+                           - jnp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _civil_from_days_np(days):
+    z = days.astype(np.int64) + 719468
+    era = np.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = np.floor_divide(doe - np.floor_divide(doe, 1460)
+                          + np.floor_divide(doe, 36524)
+                          - np.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + np.floor_divide(yoe, 4) - np.floor_divide(yoe, 100))
+    mp = np.floor_divide(5 * doy + 2, 153)
+    d = doy - np.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + np.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_of(col, is_ts: bool):
+    if is_ts:
+        return jnp.floor_divide(col, 86_400_000_000)
+    return col
+
+
+class _DatePart(Expression):
+    part = "year"
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        is_ts = isinstance(c.dtype, T.TimestampType)
+        days = _days_of(c.data.astype(jnp.int64), is_ts)
+        y, m, d = _civil_from_days(days)
+        val = {"year": y, "month": m, "day": d}[self.part]
+        return ColumnVector(T.INT32, val.astype(jnp.int32), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        is_ts = isinstance(c.dtype, T.TimestampType)
+        days = (np.floor_divide(c.values.astype(np.int64), 86_400_000_000)
+                if is_ts else c.values.astype(np.int64))
+        y, m, d = _civil_from_days_np(days)
+        val = {"year": y, "month": m, "day": d}[self.part]
+        return CpuCol(T.INT32, val.astype(np.int32), c.valid)
+
+
+class Year(_DatePart):
+    part = "year"
+
+
+class Month(_DatePart):
+    part = "month"
+
+
+class DayOfMonth(_DatePart):
+    part = "day"
+
+
+class _TimePart(Expression):
+    """hour/minute/second from a timestamp (UTC session tz for round 1)."""
+
+    part = "hour"
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    @staticmethod
+    def _compute(us):
+        sec_of_day = jnp.mod(jnp.floor_divide(us, 1_000_000), 86400)
+        return {
+            "hour": jnp.floor_divide(sec_of_day, 3600),
+            "minute": jnp.mod(jnp.floor_divide(sec_of_day, 60), 60),
+            "second": jnp.mod(sec_of_day, 60),
+        }
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        v = self._compute(c.data.astype(jnp.int64))[self.part]
+        return ColumnVector(T.INT32, v.astype(jnp.int32), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        us = c.values.astype(np.int64)
+        sec_of_day = np.mod(np.floor_divide(us, 1_000_000), 86400)
+        val = {
+            "hour": np.floor_divide(sec_of_day, 3600),
+            "minute": np.mod(np.floor_divide(sec_of_day, 60), 60),
+            "second": np.mod(sec_of_day, 60),
+        }[self.part]
+        return CpuCol(T.INT32, val.astype(np.int32), c.valid)
+
+
+class Hour(_TimePart):
+    part = "hour"
+
+
+class Minute(_TimePart):
+    part = "minute"
+
+
+class Second(_TimePart):
+    part = "second"
+
+
+class DayOfWeek(Expression):
+    """Spark dayofweek: 1 = Sunday ... 7 = Saturday."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return DayOfWeek(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        days = _days_of(c.data.astype(jnp.int64), isinstance(c.dtype, T.TimestampType))
+        dow = jnp.mod(days + 4, 7) + 1  # 1970-01-01 was a Thursday (=5)
+        return ColumnVector(T.INT32, dow.astype(jnp.int32), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        is_ts = isinstance(c.dtype, T.TimestampType)
+        days = (np.floor_divide(c.values.astype(np.int64), 86_400_000_000)
+                if is_ts else c.values.astype(np.int64))
+        return CpuCol(T.INT32, (np.mod(days + 4, 7) + 1).astype(np.int32), c.valid)
+
+
+class DateAdd(Expression):
+    """date_add(date, n)."""
+
+    negate = False
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self):
+        return T.DATE
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        l = self.children[0].eval_tpu(ctx)
+        r = self.children[1].eval_tpu(ctx)
+        n = r.data.astype(jnp.int32)
+        if self.negate:
+            n = -n
+        return ColumnVector(T.DATE, l.data.astype(jnp.int32) + n,
+                            _valid_of(l, ctx) & _valid_of(r, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        l = self.children[0].eval_cpu(cols, ansi)
+        r = self.children[1].eval_cpu(cols, ansi)
+        n = r.values.astype(np.int32)
+        if self.negate:
+            n = -n
+        return CpuCol(T.DATE, l.values.astype(np.int32) + n, l.valid & r.valid)
+
+
+class DateSub(DateAdd):
+    negate = True
+
+
+class DateDiff(Expression):
+    """datediff(end, start) in days."""
+
+    def __init__(self, end, start):
+        self.children = [end, start]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return DateDiff(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        e = self.children[0].eval_tpu(ctx)
+        s = self.children[1].eval_tpu(ctx)
+        return ColumnVector(T.INT32, e.data.astype(jnp.int32) - s.data.astype(jnp.int32),
+                            _valid_of(e, ctx) & _valid_of(s, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        e = self.children[0].eval_cpu(cols, ansi)
+        s = self.children[1].eval_cpu(cols, ansi)
+        return CpuCol(T.INT32, e.values.astype(np.int32) - s.values.astype(np.int32),
+                      e.valid & s.valid)
+
+
+class LastDay(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.DATE
+
+    def with_children(self, children):
+        return LastDay(children[0])
+
+    @staticmethod
+    def _month_len(y, m):
+        leap = ((jnp.mod(y, 4) == 0) & (jnp.mod(y, 100) != 0)) | (jnp.mod(y, 400) == 0)
+        lengths = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+        base = lengths[jnp.clip(m - 1, 0, 11)]
+        return jnp.where((m == 2) & leap, 29, base)
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        days = c.data.astype(jnp.int64)
+        y, m, d = _civil_from_days(days)
+        return ColumnVector(T.DATE,
+                            (days - d + self._month_len(y, m)).astype(jnp.int32),
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        import calendar
+        import datetime
+        c = self.children[0].eval_cpu(cols, ansi)
+        out = np.zeros(len(c.values), np.int32)
+        for i, v in enumerate(c.values):
+            if c.valid[i]:
+                d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+                last = calendar.monthrange(d.year, d.month)[1]
+                out[i] = (d.replace(day=last) - datetime.date(1970, 1, 1)).days
+        return CpuCol(T.DATE, out, c.valid)

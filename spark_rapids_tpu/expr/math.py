@@ -1,0 +1,358 @@
+"""Math expressions (reference mathExpressions.scala).
+
+Unary double functions follow Spark semantics: null-propagating, NaN for
+out-of-domain inputs (sqrt(-1) -> NaN, log(0) -> null in Spark? -- no:
+Spark log(0) = null pre-3.0? Current Spark returns null for log(x<=0) only
+under ANSI; standard returns NULL for x<=0 via strictness of Logarithm.
+We match current Spark: log/ln of non-positive -> null).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector
+from spark_rapids_tpu.expr.core import CpuCol, Expression, _valid_of
+
+
+class _UnaryDouble(Expression):
+    fn_tpu = None
+    fn_cpu = None
+    #: rows where the input is outside the domain become null (Spark).
+    domain = None  # fn(values) -> bool mask of in-domain rows
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.FLOAT64
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        v = c.data.astype(np.float64)
+        valid = _valid_of(c, ctx)
+        if type(self).domain is not None:
+            ok = type(self).domain(v)
+            valid = valid & ok
+            v = jnp.where(ok, v, 1.0)
+        return ColumnVector(T.FLOAT64, type(self).fn_tpu(v), valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        v = c.values.astype(np.float64)
+        valid = c.valid
+        with np.errstate(all="ignore"):
+            if type(self).domain is not None:
+                ok = type(self).domain(v)
+                valid = valid & ok
+                v = np.where(ok, v, 1.0)
+            return CpuCol(T.FLOAT64, type(self).fn_cpu(v), valid)
+
+
+class Sqrt(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.sqrt)
+    fn_cpu = staticmethod(np.sqrt)
+
+
+class Exp(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.exp)
+    fn_cpu = staticmethod(np.exp)
+
+
+class Log(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.log)
+    fn_cpu = staticmethod(np.log)
+    domain = staticmethod(lambda v: v > 0)
+
+
+class Log10(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.log10)
+    fn_cpu = staticmethod(np.log10)
+    domain = staticmethod(lambda v: v > 0)
+
+
+class Log2(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.log2)
+    fn_cpu = staticmethod(np.log2)
+    domain = staticmethod(lambda v: v > 0)
+
+
+class Sin(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.sin)
+    fn_cpu = staticmethod(np.sin)
+
+
+class Cos(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.cos)
+    fn_cpu = staticmethod(np.cos)
+
+
+class Tan(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.tan)
+    fn_cpu = staticmethod(np.tan)
+
+
+class Asin(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.arcsin)
+    fn_cpu = staticmethod(np.arcsin)
+
+
+class Acos(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.arccos)
+    fn_cpu = staticmethod(np.arccos)
+
+
+class Atan(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.arctan)
+    fn_cpu = staticmethod(np.arctan)
+
+
+class Sinh(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.sinh)
+    fn_cpu = staticmethod(np.sinh)
+
+
+class Cosh(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.cosh)
+    fn_cpu = staticmethod(np.cosh)
+
+
+class Tanh(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.tanh)
+    fn_cpu = staticmethod(np.tanh)
+
+
+_LONG_MIN = -(2 ** 63)
+_LONG_MAX = 2 ** 63 - 1
+
+
+def _double_to_long_tpu(v):
+    """Scala Double.toLong semantics: NaN -> 0, clamp to Long range."""
+    v = jnp.where(jnp.isnan(v), 0.0, v)
+    return jnp.clip(v, float(_LONG_MIN), float(_LONG_MAX)).astype(np.int64)
+
+
+def _double_to_long_np(v):
+    v = np.where(np.isnan(v), 0.0, v)
+    return np.clip(v, float(_LONG_MIN), float(_LONG_MAX)).astype(np.int64)
+
+
+class Ceil(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT64
+
+    def with_children(self, children):
+        return Ceil(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        v = jnp.ceil(c.data.astype(np.float64))
+        return ColumnVector(T.INT64, _double_to_long_tpu(v), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        with np.errstate(all="ignore"):
+            v = np.ceil(c.values.astype(np.float64))
+            return CpuCol(T.INT64, _double_to_long_np(v), c.valid)
+
+
+class Floor(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT64
+
+    def with_children(self, children):
+        return Floor(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        v = jnp.floor(c.data.astype(np.float64))
+        return ColumnVector(T.INT64, _double_to_long_tpu(v), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        with np.errstate(all="ignore"):
+            v = np.floor(c.values.astype(np.float64))
+            return CpuCol(T.INT64, _double_to_long_np(v), c.valid)
+
+
+class Pow(Expression):
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self):
+        return T.FLOAT64
+
+    def with_children(self, children):
+        return Pow(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        l = self.children[0].eval_tpu(ctx)
+        r = self.children[1].eval_tpu(ctx)
+        v = jnp.power(l.data.astype(np.float64), r.data.astype(np.float64))
+        return ColumnVector(T.FLOAT64, v, _valid_of(l, ctx) & _valid_of(r, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        l = self.children[0].eval_cpu(cols, ansi)
+        r = self.children[1].eval_cpu(cols, ansi)
+        with np.errstate(all="ignore"):
+            v = np.power(l.values.astype(np.float64), r.values.astype(np.float64))
+        return CpuCol(T.FLOAT64, v, l.valid & r.valid)
+
+
+class Round(Expression):
+    """round(x, d): HALF_UP for decimals/integers, HALF_EVEN quirk: Spark
+    round() on doubles is HALF_UP too (BigDecimal HALF_UP)."""
+
+    def __init__(self, child, scale: int = 0):
+        self.children = [child]
+        self.scale = scale
+
+    def data_type(self):
+        dt = self.children[0].data_type()
+        return dt if dt.is_numeric else T.FLOAT64
+
+    def _params(self):
+        return str(self.scale)
+
+    def with_children(self, children):
+        return Round(children[0], self.scale)
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        valid = _valid_of(c, ctx)
+        dt = self.data_type()
+        if dt.is_integral:
+            if self.scale >= 0:
+                return c
+            f = 10 ** (-self.scale)
+            half = f // 2
+            sign = jnp.sign(c.data)
+            mag = jnp.abs(c.data.astype(np.int64))
+            v = sign * (((mag + half) // f) * f)
+            return ColumnVector(dt, v.astype(dt.np_dtype), valid)
+        v = c.data.astype(np.float64)
+        f = 10.0 ** self.scale
+        inv = 10.0 ** (-self.scale)
+        scaled = v * f
+        # HALF_UP: away from zero. Rescale by multiply (not divide): XLA
+        # strength-reduces constant division to reciprocal-multiply anyway,
+        # and the CPU path mirrors it so both engines agree bit-for-bit
+        # (<=1 ulp from Spark's BigDecimal rounding; documented incompat
+        # like the reference's improvedFloatOps).
+        r = jnp.sign(scaled) * jnp.floor(jnp.abs(scaled) + 0.5)
+        return ColumnVector(T.FLOAT64, r * inv, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        dt = self.data_type()
+        with np.errstate(all="ignore"):
+            if dt.is_integral:
+                if self.scale >= 0:
+                    return c
+                f = 10 ** (-self.scale)
+                half = f // 2
+                sign = np.sign(c.values)
+                mag = np.abs(c.values.astype(np.int64))
+                v = sign * (((mag + half) // f) * f)
+                return CpuCol(dt, v.astype(dt.np_dtype), c.valid)
+            v = c.values.astype(np.float64)
+            f = 10.0 ** self.scale
+            inv = 10.0 ** (-self.scale)
+            scaled = v * f
+            r = np.sign(scaled) * np.floor(np.abs(scaled) + 0.5)
+            return CpuCol(T.FLOAT64, r * inv, c.valid)
+
+
+class Signum(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.sign)
+    fn_cpu = staticmethod(np.sign)
+
+
+class Atan2(Expression):
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self):
+        return T.FLOAT64
+
+    def with_children(self, children):
+        return Atan2(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        l = self.children[0].eval_tpu(ctx)
+        r = self.children[1].eval_tpu(ctx)
+        v = jnp.arctan2(l.data.astype(np.float64), r.data.astype(np.float64))
+        return ColumnVector(T.FLOAT64, v, _valid_of(l, ctx) & _valid_of(r, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        l = self.children[0].eval_cpu(cols, ansi)
+        r = self.children[1].eval_cpu(cols, ansi)
+        with np.errstate(all="ignore"):
+            v = np.arctan2(l.values.astype(np.float64), r.values.astype(np.float64))
+        return CpuCol(T.FLOAT64, v, l.valid & r.valid)
+
+
+class Greatest(Expression):
+    """greatest(...): max ignoring nulls; null only if all null."""
+
+    largest = True
+
+    def __init__(self, *children):
+        self.children = list(children)
+
+    def data_type(self):
+        dt = self.children[0].data_type()
+        for c in self.children[1:]:
+            dt = T.common_type(dt, c.data_type())
+        return dt
+
+    def with_children(self, children):
+        return type(self)(*children)
+
+    def eval_tpu(self, ctx):
+        out = self.data_type()
+        cs = [c.eval_tpu(ctx) for c in self.children]
+        acc = None
+        acc_valid = None
+        for c in cs:
+            v = c.data.astype(out.np_dtype)
+            cv = _valid_of(c, ctx)
+            if acc is None:
+                acc, acc_valid = v, cv
+            else:
+                pick_new = cv & (~acc_valid | (v > acc if self.largest else v < acc))
+                acc = jnp.where(pick_new, v, acc)
+                acc_valid = acc_valid | cv
+        return ColumnVector(out, acc, acc_valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        out = self.data_type()
+        cs = [c.eval_cpu(cols, ansi) for c in self.children]
+        acc = None
+        acc_valid = None
+        with np.errstate(all="ignore"):
+            for c in cs:
+                v = c.values.astype(out.np_dtype)
+                cv = c.valid
+                if acc is None:
+                    acc, acc_valid = v.copy(), cv.copy()
+                else:
+                    pick_new = cv & (~acc_valid | (v > acc if self.largest else v < acc))
+                    acc = np.where(pick_new, v, acc)
+                    acc_valid = acc_valid | cv
+        return CpuCol(out, acc, acc_valid)
+
+
+class Least(Greatest):
+    largest = False

@@ -1,0 +1,713 @@
+"""String expressions on device byte planes.
+
+Reference parity: org/apache/spark/sql/rapids/stringFunctions.scala and the
+string pieces of GpuCast.scala (CastStrings JNI).
+
+Device representation is offsets(int32[cap+1]) + bytes(uint8). Kernels are
+branch-free over byte planes; per-row variable length is handled with
+searchsorted row mapping (same trick as kernels.gather) or bounded
+while_loops over the batch max length. Ops we cannot (yet) express
+efficiently on device report supported_on_tpu() = False and the planner
+falls the enclosing exec back to CPU -- the reference's per-op fallback
+discipline.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector, round_capacity
+from spark_rapids_tpu.expr.core import (
+    CpuCol, EvalCtx, Expression, SparkException, _valid_of,
+)
+
+
+def _lens(col: ColumnVector) -> jax.Array:
+    o = col.data["offsets"]
+    return o[1:] - o[:-1]
+
+
+def _starts(col: ColumnVector) -> jax.Array:
+    return col.data["offsets"][:-1]
+
+
+class StringLength(Expression):
+    """length(): number of UTF-8 characters (not bytes), like Spark."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return StringLength(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        raw = c.data["bytes"]
+        o = c.data["offsets"]
+        # count non-continuation bytes per row: prefix-sum over the byte plane
+        is_start = (raw & 0xC0) != 0x80
+        csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                jnp.cumsum(is_start.astype(jnp.int32))])
+        nchars = csum[o[1:]] - csum[o[:-1]]
+        return ColumnVector(T.INT32, nchars.astype(jnp.int32), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        vals = np.array([len(s) if isinstance(s, str) else 0 for s in c.values], np.int32)
+        return CpuCol(T.INT32, vals, c.valid)
+
+
+class _CaseMap(Expression):
+    """ASCII upper/lower; rows containing non-ASCII map byte-wise only for
+    ASCII letters (Spark does full Unicode -- non-ASCII batches should be
+    tagged off-device by the planner via contains_non_ascii stats; round 1
+    applies ASCII mapping and documents the incompat)."""
+
+    upper: bool = True
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        raw = c.data["bytes"]
+        if self.upper:
+            shifted = jnp.where((raw >= 97) & (raw <= 122), raw - 32, raw)
+        else:
+            shifted = jnp.where((raw >= 65) & (raw <= 90), raw + 32, raw)
+        return ColumnVector(T.STRING, {"offsets": c.data["offsets"], "bytes": shifted},
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        f = str.upper if self.upper else str.lower
+        vals = np.array([f(s) if isinstance(s, str) else s for s in c.values], object)
+        return CpuCol(T.STRING, vals, c.valid)
+
+
+class Upper(_CaseMap):
+    upper = True
+
+
+class Lower(_CaseMap):
+    upper = False
+
+
+class Substring(Expression):
+    """substring(str, pos, len): 1-based pos, negative counts from end;
+    character (not byte) positions, like Spark."""
+
+    def __init__(self, child, pos: int, length: int = 1 << 30):
+        self.children = [child]
+        self.pos = pos
+        self.length = length
+
+    def data_type(self):
+        return T.STRING
+
+    def _params(self):
+        return f"{self.pos},{self.length}"
+
+    def with_children(self, children):
+        return Substring(children[0], self.pos, self.length)
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        o = c.data["offsets"]
+        raw = c.data["bytes"]
+        is_start = ((raw & 0xC0) != 0x80).astype(jnp.int32)
+        char_csum = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(is_start)])
+        nchars = char_csum[o[1:]] - char_csum[o[:-1]]
+        # resolve 1-based/negative start to 0-based char index
+        if self.pos > 0:
+            start_char = jnp.minimum(self.pos - 1, nchars)
+        elif self.pos == 0:
+            start_char = jnp.zeros_like(nchars)
+        else:
+            start_char = jnp.maximum(nchars + self.pos, 0)
+        take = max(self.length, 0)
+        end_char = jnp.minimum(start_char + take, nchars)
+        # char index -> byte offset: byte b is the k-th char start where
+        # k = char_csum[b] - char_csum[row_start]. Build per-row byte offsets
+        # by searching the cumulative char counts.
+        target_start = char_csum[o[:-1]] + start_char
+        target_end = char_csum[o[:-1]] + end_char
+        byte_start = jnp.searchsorted(char_csum, target_start, side="left").astype(jnp.int32)
+        byte_end = jnp.searchsorted(char_csum, target_end, side="left").astype(jnp.int32)
+        byte_start = jnp.minimum(byte_start - 1, o[1:])
+        byte_end = jnp.minimum(byte_end - 1, o[1:])
+        byte_start = jnp.maximum(byte_start, o[:-1])
+        byte_end = jnp.maximum(byte_end, byte_start)
+        out_lens = byte_end - byte_start
+        new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(out_lens).astype(jnp.int32)])
+        nb = raw.shape[0]
+        b = jnp.arange(nb, dtype=jnp.int32)
+        row = jnp.clip(jnp.searchsorted(new_off, b, side="right").astype(jnp.int32) - 1,
+                       0, nchars.shape[0] - 1)
+        src = jnp.clip(byte_start[row] + (b - new_off[row]), 0, nb - 1)
+        out_bytes = jnp.where(b < new_off[-1], raw[src], 0).astype(jnp.uint8)
+        return ColumnVector(T.STRING, {"offsets": new_off, "bytes": out_bytes},
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        out = []
+        for s in c.values:
+            if not isinstance(s, str):
+                out.append(s)
+                continue
+            if self.pos > 0:
+                start = self.pos - 1
+            elif self.pos == 0:
+                start = 0
+            else:
+                start = max(len(s) + self.pos, 0)
+            out.append(s[start: start + max(self.length, 0)])
+        return CpuCol(T.STRING, np.array(out, object), c.valid)
+
+
+class ConcatStrings(Expression):
+    """concat(s1, s2, ...): null if any input null (Spark concat)."""
+
+    def __init__(self, *children):
+        self.children = list(children)
+
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return ConcatStrings(*children)
+
+    def eval_tpu(self, ctx):
+        parts = [c.eval_tpu(ctx) for c in self.children]
+        valid = _valid_of(parts[0], ctx)
+        for p in parts[1:]:
+            valid = valid & _valid_of(p, ctx)
+        lens = sum(_lens(p) for p in parts)
+        lens = jnp.where(valid, lens, 0)
+        new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(lens).astype(jnp.int32)])
+        total_cap = round_capacity(int(sum(int(p.data["bytes"].shape[0]) for p in parts)))
+        b = jnp.arange(total_cap, dtype=jnp.int32)
+        row = jnp.clip(jnp.searchsorted(new_off, b, side="right").astype(jnp.int32) - 1,
+                       0, ctx.capacity - 1)
+        pos = b - new_off[row]  # position within the concatenated row
+        out = jnp.zeros(total_cap, jnp.uint8)
+        acc = jnp.zeros(ctx.capacity, jnp.int32)  # running char offset per row
+        for p in parts:
+            pl = _lens(p)
+            in_part = (pos >= acc[row]) & (pos < acc[row] + pl[row])
+            src = jnp.clip(_starts(p)[row] + (pos - acc[row]), 0,
+                           p.data["bytes"].shape[0] - 1)
+            out = jnp.where(in_part, p.data["bytes"][src], out)
+            acc = acc + pl
+        out = jnp.where(b < new_off[-1], out, 0).astype(jnp.uint8)
+        return ColumnVector(T.STRING, {"offsets": new_off, "bytes": out}, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        parts = [c.eval_cpu(cols, ansi) for c in self.children]
+        valid = parts[0].valid.copy()
+        for p in parts[1:]:
+            valid = valid & p.valid
+        out = []
+        for i in range(len(valid)):
+            if valid[i]:
+                out.append("".join(str(p.values[i]) for p in parts))
+            else:
+                out.append(None)
+        return CpuCol(T.STRING, np.array(out, object), valid)
+
+
+class _LiteralMatch(Expression):
+    """startswith/endswith/contains with a literal pattern: sliding fixed
+    window compare over the byte plane."""
+
+    mode = "starts"  # starts | ends | contains
+
+    def __init__(self, child, pattern: str):
+        self.children = [child]
+        self.pattern = pattern
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _params(self):
+        return repr(self.pattern)
+
+    def with_children(self, children):
+        return type(self)(children[0], self.pattern)
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        raw = c.data["bytes"]
+        o = c.data["offsets"]
+        lens = o[1:] - o[:-1]
+        pat = np.frombuffer(self.pattern.encode("utf-8"), np.uint8)
+        m = len(pat)
+        valid = _valid_of(c, ctx)
+        if m == 0:
+            return ColumnVector(T.BOOLEAN, jnp.ones(ctx.capacity, jnp.bool_), valid)
+        nb = raw.shape[0]
+
+        def window_eq(base):
+            eq = jnp.ones(base.shape, jnp.bool_)
+            for k in range(m):
+                idx = jnp.clip(base + k, 0, nb - 1)
+                eq = eq & (raw[idx] == pat[k])
+            return eq
+
+        fits = lens >= m
+        if self.mode == "starts":
+            res = fits & window_eq(o[:-1])
+        elif self.mode == "ends":
+            res = fits & window_eq(o[1:] - m)
+        else:  # contains: match at any byte start position
+            starts_eq = jnp.zeros(nb, jnp.bool_)
+            base = jnp.arange(nb, dtype=jnp.int32)
+            w = window_eq(base)
+            # map each byte position to its row; position must leave room
+            rowidx = jnp.searchsorted(o, base, side="right").astype(jnp.int32) - 1
+            rowidx = jnp.clip(rowidx, 0, ctx.capacity - 1)
+            in_row = (base + m) <= o[rowidx + 1]
+            hit = w & in_row
+            per_row = jnp.zeros(ctx.capacity, jnp.int32).at[rowidx].add(
+                hit.astype(jnp.int32), mode="drop")
+            res = fits & (per_row > 0)
+        return ColumnVector(T.BOOLEAN, res, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        f = {"starts": str.startswith, "ends": str.endswith,
+             "contains": str.__contains__}[self.mode]
+        vals = np.array([bool(f(s, self.pattern)) if isinstance(s, str) else False
+                         for s in c.values], np.bool_)
+        return CpuCol(T.BOOLEAN, vals, c.valid)
+
+
+class StartsWith(_LiteralMatch):
+    mode = "starts"
+
+
+class EndsWith(_LiteralMatch):
+    mode = "ends"
+
+
+class Contains(_LiteralMatch):
+    mode = "contains"
+
+
+class Like(Expression):
+    """SQL LIKE. Patterns reducible to starts/ends/contains/equality compile
+    to device kernels (the reference's regex-transpile-or-reject strategy,
+    RegexParser.scala); general patterns run on CPU via fnmatch-style
+    matching and mark the expression unsupported on device."""
+
+    def __init__(self, child, pattern: str, escape: str = "\\"):
+        self.children = [child]
+        self.pattern = pattern
+        self.escape = escape
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _params(self):
+        return repr(self.pattern)
+
+    def with_children(self, children):
+        return Like(children[0], self.pattern, self.escape)
+
+    def _transpile(self):
+        """Return an equivalent device expression, or None."""
+        p = self.pattern
+        esc = self.escape
+        # tokenize
+        literal = []
+        tokens: List[str] = []
+        i = 0
+        while i < len(p):
+            ch = p[i]
+            if ch == esc and i + 1 < len(p):
+                literal.append(p[i + 1])
+                tokens.append("LIT")
+                i += 2
+            elif ch == "%":
+                tokens.append("%")
+                literal.append("")
+                i += 1
+            elif ch == "_":
+                tokens.append("_")
+                literal.append("")
+                i += 1
+            else:
+                tokens.append("LIT")
+                literal.append(ch)
+                i += 1
+        if "_" in tokens:
+            return None
+        # split literal runs by %
+        runs: List[str] = []
+        cur = ""
+        for tk, li in zip(tokens, literal):
+            if tk == "%":
+                runs.append(cur)
+                cur = ""
+            else:
+                cur += li
+        runs.append(cur)
+        child = self.children[0]
+        if len(runs) == 1:
+            return _StringEquals(child, runs[0])
+        if len(runs) == 2:
+            a, b = runs
+            if a == "" and b == "":
+                return None  # trivially true; handled below
+            if a == "":
+                return EndsWith(child, b)
+            if b == "":
+                return StartsWith(child, a)
+            return _AndExpr(StartsWith(child, a), EndsWith(child, b), min_len=len(a) + len(b))
+        if len(runs) == 3 and runs[0] == "" and runs[2] == "" and runs[1]:
+            return Contains(child, runs[1])
+        return None
+
+    def supported_on_tpu(self):
+        return self._transpile() is not None or self.pattern.replace("%", "") == ""
+
+    def eval_tpu(self, ctx):
+        t = self._transpile()
+        if t is None:
+            if self.pattern.replace("%", "") == "":
+                c = self.children[0].eval_tpu(ctx)
+                return ColumnVector(T.BOOLEAN, jnp.ones(ctx.capacity, jnp.bool_),
+                                    _valid_of(c, ctx))
+            raise NotImplementedError(f"LIKE pattern {self.pattern!r} on device")
+        return t.eval_tpu(ctx)
+
+    def eval_cpu(self, cols, ansi=False):
+        import re
+        c = self.children[0].eval_cpu(cols, ansi)
+        rx = _like_to_regex(self.pattern, self.escape)
+        prog = re.compile(rx, re.DOTALL)
+        vals = np.array([bool(prog.fullmatch(s)) if isinstance(s, str) else False
+                         for s in c.values], np.bool_)
+        return CpuCol(T.BOOLEAN, vals, c.valid)
+
+
+def _like_to_regex(pattern: str, esc: str) -> str:
+    import re
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == esc and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+        elif ch == "%":
+            out.append(".*")
+            i += 1
+        elif ch == "_":
+            out.append(".")
+            i += 1
+        else:
+            out.append(re.escape(ch))
+            i += 1
+    return "".join(out)
+
+
+class _StringEquals(Expression):
+    def __init__(self, child, value: str):
+        self.children = [child]
+        self.value = value
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return _StringEquals(children[0], self.value)
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expr.core import EqualTo, Literal
+        return EqualTo(self.children[0], Literal(self.value, T.STRING)).eval_tpu(ctx)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        vals = np.array([s == self.value if isinstance(s, str) else False
+                         for s in c.values], np.bool_)
+        return CpuCol(T.BOOLEAN, vals, c.valid)
+
+
+class _AndExpr(Expression):
+    def __init__(self, a, b, min_len=0):
+        self.children = [a, b]
+        self.min_len = min_len
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return _AndExpr(children[0], children[1], self.min_len)
+
+    def eval_tpu(self, ctx):
+        a = self.children[0].eval_tpu(ctx)
+        b = self.children[1].eval_tpu(ctx)
+        res = a.data & b.data
+        if self.min_len:
+            src = self.children[0].children[0].eval_tpu(ctx)
+            res = res & ((_lens(src)) >= self.min_len)
+        return ColumnVector(T.BOOLEAN, res, _valid_of(a, ctx) & _valid_of(b, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        a = self.children[0].eval_cpu(cols, ansi)
+        b = self.children[1].eval_cpu(cols, ansi)
+        res = a.values & b.values
+        if self.min_len:
+            src = self.children[0].children[0].eval_cpu(cols, ansi)
+            lens = np.array([len(s) if isinstance(s, str) else 0 for s in src.values])
+            res = res & (lens >= self.min_len)
+        return CpuCol(T.BOOLEAN, res, a.valid & b.valid)
+
+
+# ---------------------------------------------------------------------------
+# Casts involving strings (reference GpuCast string paths / CastStrings JNI)
+# ---------------------------------------------------------------------------
+
+_DIGITS = np.frombuffer(b"0123456789", np.uint8)
+
+
+def _render_int64_tpu(values: jax.Array, valid: jax.Array) -> ColumnVector:
+    """int64 -> decimal string rendering on device: compute per-row digit
+    count, then scatter digits (branch-free, fixed 20-byte max per row)."""
+    cap = values.shape[0]
+    neg = values < 0
+    # abs in uint64 to handle INT64_MIN
+    mag = jnp.where(neg, (~values.astype(jnp.uint64)) + jnp.uint64(1),
+                    values.astype(jnp.uint64))
+    # digit count via comparisons (max 20 digits for uint64)
+    ndig = jnp.ones(cap, jnp.int32)
+    p = jnp.uint64(10)
+    for k in range(1, 20):
+        ndig = ndig + (mag >= p).astype(jnp.int32)
+        p = p * jnp.uint64(10)
+    lens = ndig + neg.astype(jnp.int32)
+    lens = jnp.where(valid, lens, 0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    total = new_off[-1]
+    bcap = cap * 20  # static upper bound
+    b = jnp.arange(bcap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off, b, side="right").astype(jnp.int32) - 1,
+                   0, cap - 1)
+    pos = b - new_off[row]  # position within the rendered number
+    is_sign = neg[row] & (pos == 0)
+    # digit index from the right: ndig-1-(pos - has_sign)
+    di = ndig[row] - 1 - (pos - neg[row].astype(jnp.int32))
+    di = jnp.clip(di, 0, 19)
+    # extract digit di (from least significant) of mag[row]
+    mrow = mag[row]
+    div = jnp.power(jnp.full(bcap, 10, jnp.uint64), di.astype(jnp.uint64))
+    digit = ((mrow // div) % jnp.uint64(10)).astype(jnp.int32)
+    ch = jnp.where(is_sign, np.uint8(45), (digit + 48).astype(jnp.uint8))
+    out = jnp.where(b < total, ch, 0).astype(jnp.uint8)
+    return ColumnVector(T.STRING, {"offsets": new_off, "bytes": out}, valid)
+
+
+def _parse_int64_tpu(col: ColumnVector, valid: jax.Array, ctx: EvalCtx):
+    """string -> int64: optional sign + digits, leading/trailing spaces
+    trimmed, anything else -> null (non-ANSI Spark)."""
+    o = col.data["offsets"]
+    raw = col.data["bytes"]
+    starts = o[:-1]
+    ends = o[1:]
+    nb = raw.shape[0]
+
+    def at(pos):
+        return raw[jnp.clip(pos, 0, nb - 1)]
+
+    # trim spaces
+    def trim(state):
+        s, e = state
+        lead = (s < e) & (at(s) == 32)
+        tail = (e > s) & (at(e - 1) == 32)
+        return jnp.where(lead, s + 1, s), jnp.where(tail, e - 1, e)
+
+    def trim_cond(state):
+        s, e = state
+        lead = (s < e) & (at(s) == 32)
+        tail = (e > s) & (at(e - 1) == 32)
+        return jnp.any(lead | tail)
+
+    s, e = lax.while_loop(trim_cond, trim, (starts, ends))
+    first = at(s)
+    has_sign = (first == 45) | (first == 43)
+    neg = first == 45
+    ds = s + has_sign.astype(jnp.int32)
+    ok = (e > ds)
+
+    def body(state):
+        i, acc, good, done = state
+        pos = ds + i
+        active = (pos < e) & ~done
+        byte = at(pos)
+        is_digit = (byte >= 48) & (byte <= 57)
+        acc2 = acc * 10 + (byte - 48).astype(jnp.int64)
+        acc = jnp.where(active & is_digit, acc2, acc)
+        good = good & (~active | is_digit)
+        done = done | (pos >= e)
+        return i + 1, acc, good, done
+
+    def cond(state):
+        i, _, _, done = state
+        return ~jnp.all(done)
+
+    n = starts.shape[0]
+    init = (jnp.int32(0), jnp.zeros(n, jnp.int64), ok,
+            jnp.zeros(n, jnp.bool_))
+    _, acc, good, _ = lax.while_loop(cond, body, init)
+    value = jnp.where(neg, -acc, acc)
+    out_valid = valid & good
+    if ctx is not None and ctx.ansi:
+        ctx.add_error("CAST_INVALID_INPUT", valid & ~good)
+    return value, out_valid
+
+
+def cast_string_tpu(c: ColumnVector, dst: T.DataType, ctx: EvalCtx) -> ColumnVector:
+    valid = _valid_of(c, ctx)
+    if isinstance(dst, T.StringType):
+        src = c.dtype
+        if isinstance(src, T.BooleanType):
+            from spark_rapids_tpu.expr.core import If, Literal, _RawCol
+            return If(_RawCol(ColumnVector(T.BOOLEAN, c.data, valid)),
+                      Literal("true", T.STRING),
+                      Literal("false", T.STRING)).eval_tpu(ctx)
+        if src.is_integral or isinstance(src, (T.DateType, T.TimestampType)):
+            if isinstance(src, (T.DateType, T.TimestampType)):
+                raise NotImplementedError("date/timestamp -> string on device")
+            return _render_int64_tpu(c.data.astype(jnp.int64), valid)
+        raise NotImplementedError(f"cast {src!r} -> string on device")
+    if isinstance(c.dtype, T.StringType):
+        if dst.is_integral:
+            v64, out_valid = _parse_int64_tpu(c, valid, ctx)
+            return ColumnVector(dst, v64.astype(dst.np_dtype), out_valid)
+        if isinstance(dst, (T.Float32Type, T.Float64Type)):
+            raise NotImplementedError("string -> float on device")
+        if isinstance(dst, T.BooleanType):
+            from spark_rapids_tpu.expr.core import _string_eq_tpu  # noqa
+            raise NotImplementedError("string -> bool on device")
+        raise NotImplementedError(f"cast string -> {dst!r} on device")
+    raise NotImplementedError
+
+
+def cast_string_cpu(c: CpuCol, dst: T.DataType, ansi: bool) -> CpuCol:
+    if isinstance(dst, T.StringType):
+        src = c.dtype
+        out = []
+        for i, v in enumerate(c.values):
+            if not c.valid[i]:
+                out.append(None)
+            elif isinstance(src, T.BooleanType):
+                out.append("true" if v else "false")
+            elif isinstance(src, (T.Float32Type, T.Float64Type)):
+                out.append(_spark_float_str(float(v)))
+            elif isinstance(src, T.DateType):
+                import datetime
+                out.append(str(datetime.date(1970, 1, 1)
+                               + datetime.timedelta(days=int(v))))
+            elif isinstance(src, T.TimestampType):
+                import datetime
+                dt = (datetime.datetime(1970, 1, 1)
+                      + datetime.timedelta(microseconds=int(v)))
+                out.append(dt.isoformat(sep=" "))
+            elif isinstance(src, T.DecimalType):
+                import decimal
+                out.append(str(decimal.Decimal(int(v)).scaleb(-src.scale)))
+            else:
+                out.append(str(int(v)))
+        return CpuCol(T.STRING, np.array(out, object),
+                      c.valid.copy())
+    # string -> X
+    n = len(c.values)
+    valid = c.valid.copy()
+    if dst.is_integral:
+        vals = np.zeros(n, np.int64)
+        for i, s in enumerate(c.values):
+            if not valid[i]:
+                continue
+            t = s.strip() if isinstance(s, str) else ""
+            try:
+                vals[i] = int(t)
+            except ValueError:
+                if ansi:
+                    raise SparkException(f"[CAST_INVALID_INPUT] '{s}' to int")
+                valid[i] = False
+        return CpuCol(dst, vals.astype(dst.np_dtype), valid)
+    if isinstance(dst, (T.Float32Type, T.Float64Type)):
+        vals = np.zeros(n, np.float64)
+        for i, s in enumerate(c.values):
+            if not valid[i]:
+                continue
+            try:
+                vals[i] = float(s.strip())
+            except ValueError:
+                if ansi:
+                    raise SparkException(f"[CAST_INVALID_INPUT] '{s}' to float")
+                valid[i] = False
+        return CpuCol(dst, vals.astype(dst.np_dtype), valid)
+    if isinstance(dst, T.BooleanType):
+        vals = np.zeros(n, np.bool_)
+        for i, s in enumerate(c.values):
+            if not valid[i]:
+                continue
+            t = (s.strip().lower() if isinstance(s, str) else "")
+            if t in ("true", "t", "yes", "y", "1"):
+                vals[i] = True
+            elif t in ("false", "f", "no", "n", "0"):
+                vals[i] = False
+            else:
+                if ansi:
+                    raise SparkException(f"[CAST_INVALID_INPUT] '{s}' to boolean")
+                valid[i] = False
+        return CpuCol(dst, vals, valid)
+    if isinstance(dst, T.DateType):
+        import datetime
+        vals = np.zeros(n, np.int32)
+        for i, s in enumerate(c.values):
+            if not valid[i]:
+                continue
+            try:
+                d = datetime.date.fromisoformat(s.strip())
+                vals[i] = (d - datetime.date(1970, 1, 1)).days
+            except ValueError:
+                if ansi:
+                    raise SparkException(f"[CAST_INVALID_INPUT] '{s}' to date")
+                valid[i] = False
+        return CpuCol(dst, vals, valid)
+    raise NotImplementedError(f"cast string -> {dst!r}")
+
+
+def _spark_float_str(v: float) -> str:
+    """Java Double.toString-ish rendering (Spark cast double->string)."""
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "Infinity"
+    if v == float("-inf"):
+        return "-Infinity"
+    if v == int(v) and abs(v) < 1e16:
+        return f"{int(v)}.0"
+    return repr(v)

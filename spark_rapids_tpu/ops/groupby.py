@@ -1,0 +1,138 @@
+"""Sort-based segmented groupby kernels.
+
+Reference parity: cudf GroupByAggregation (hash-based on GPU). The
+TPU-idiomatic formulation is sort-based: normalize keys to uint64 planes,
+stable-sort, derive segment ids from key boundaries, then apply
+jax.ops.segment_* reductions with a static segment capacity. Sorting keys
+also gives deterministic float aggregation order (the reference needs
+special handling for that; we get it for free).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector, ColumnarBatch, round_capacity
+from spark_rapids_tpu.ops import kernels as K
+
+
+def group_segments(key_cols: List[ColumnVector], num_rows: int
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort rows by the group keys. Returns (perm, seg_ids, seg_starts_mask)
+    over the full capacity, where perm is the sorting permutation, seg_ids
+    assigns each sorted position a dense group id (padded rows get id
+    capacity-1... they share the trailing group but are masked by callers),
+    and seg_starts_mask flags the first sorted row of each group."""
+    norm = [K.normalize_key(c, num_rows) for c in key_cols]
+    perm = K.lexsort_indices([(k, n, True, True) for k, n in norm], num_rows)
+    cap = perm.shape[0]
+    in_range = jnp.arange(cap) < num_rows
+    boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+    for k, nulls in norm:
+        ks = k[perm]
+        ns = nulls[perm]
+        diff = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                (ks[1:] != ks[:-1]) | (ns[1:] != ns[:-1])])
+        boundary = boundary | diff
+    boundary = boundary & in_range
+    seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_ids = jnp.where(in_range, seg_ids, cap - 1)
+    return perm, seg_ids, boundary
+
+
+def num_groups(boundary: jax.Array) -> int:
+    return int(jnp.sum(boundary.astype(jnp.int32)))
+
+
+_MAX_INIT = {
+    np.dtype(np.int8): np.iinfo(np.int8).min,
+    np.dtype(np.int16): np.iinfo(np.int16).min,
+    np.dtype(np.int32): np.iinfo(np.int32).min,
+    np.dtype(np.int64): np.iinfo(np.int64).min,
+    np.dtype(np.float32): -np.inf,
+    np.dtype(np.float64): -np.inf,
+    np.dtype(np.bool_): False,
+}
+_MIN_INIT = {
+    np.dtype(np.int8): np.iinfo(np.int8).max,
+    np.dtype(np.int16): np.iinfo(np.int16).max,
+    np.dtype(np.int32): np.iinfo(np.int32).max,
+    np.dtype(np.int64): np.iinfo(np.int64).max,
+    np.dtype(np.float32): np.inf,
+    np.dtype(np.float64): np.inf,
+    np.dtype(np.bool_): True,
+}
+
+
+def segmented_agg(op: str, values: jax.Array, valid: jax.Array,
+                  seg_ids: jax.Array, seg_cap: int
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Apply one segmented reduction. values/valid are in SORTED order.
+    Returns (out_values[seg_cap], out_valid[seg_cap]). SQL null semantics:
+    sum/min/max/avg ignore nulls and are null for all-null groups; count
+    counts non-null rows."""
+    vdt = values.dtype
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int64), seg_ids, num_segments=seg_cap)
+    if op == "count":
+        return nvalid, jnp.ones(seg_cap, jnp.bool_)
+    if op == "count_all":
+        ones = jnp.ones_like(seg_ids, dtype=jnp.int64)
+        return jax.ops.segment_sum(ones, seg_ids, num_segments=seg_cap), \
+            jnp.ones(seg_cap, jnp.bool_)
+    if op == "sum":
+        masked = jnp.where(valid, values, jnp.zeros_like(values))
+        out = jax.ops.segment_sum(masked, seg_ids, num_segments=seg_cap)
+        return out, nvalid > 0
+    if op == "sumsq":
+        masked = jnp.where(valid, values * values, jnp.zeros_like(values))
+        out = jax.ops.segment_sum(masked, seg_ids, num_segments=seg_cap)
+        return out, nvalid > 0
+    if op == "min":
+        init = _MIN_INIT[np.dtype(vdt)]
+        masked = jnp.where(valid, values, jnp.full_like(values, init))
+        out = jax.ops.segment_min(masked, seg_ids, num_segments=seg_cap)
+        return out, nvalid > 0
+    if op == "max":
+        init = _MAX_INIT[np.dtype(vdt)]
+        masked = jnp.where(valid, values, jnp.full_like(values, init))
+        out = jax.ops.segment_max(masked, seg_ids, num_segments=seg_cap)
+        return out, nvalid > 0
+    if op in ("first", "last"):
+        # position of first/last valid row per segment
+        n = values.shape[0]
+        pos = jnp.arange(n, dtype=jnp.int64)
+        if op == "first":
+            masked_pos = jnp.where(valid, pos, n)
+            sel = jax.ops.segment_min(masked_pos, seg_ids, num_segments=seg_cap)
+        else:
+            masked_pos = jnp.where(valid, pos, -1)
+            sel = jax.ops.segment_max(masked_pos, seg_ids, num_segments=seg_cap)
+        has = (sel >= 0) & (sel < n)
+        sel_c = jnp.clip(sel, 0, n - 1).astype(jnp.int32)
+        return values[sel_c], has & (nvalid > 0)
+    if op == "any":
+        masked = jnp.where(valid, values.astype(jnp.bool_), False)
+        out = jax.ops.segment_max(masked.astype(jnp.int32), seg_ids, num_segments=seg_cap)
+        return out.astype(jnp.bool_), nvalid > 0
+    if op == "all":
+        masked = jnp.where(valid, values.astype(jnp.bool_), True)
+        out = jax.ops.segment_min(masked.astype(jnp.int32), seg_ids, num_segments=seg_cap)
+        return out.astype(jnp.bool_), nvalid > 0
+    raise ValueError(f"unknown segmented op {op}")
+
+
+def gather_group_keys(key_cols: List[ColumnVector], perm: jax.Array,
+                      boundary: jax.Array, n_groups: int, num_rows: int
+                      ) -> List[ColumnVector]:
+    """Representative key row per group = first sorted row of each segment."""
+    first_idx, _ = K.filter_indices(boundary, boundary.shape[0])
+    out = []
+    for c in key_cols:
+        sorted_col = K.gather_column(c, perm, num_rows)
+        out.append(K.gather_column(sorted_col, first_idx, num_rows))
+    return out

@@ -1,0 +1,112 @@
+"""Device equi-join kernels.
+
+Reference parity: GpuHashJoin.scala:104 (gather-map producing probe) +
+JoinGatherer chunked assembly. cuDF builds a device hash table; the
+TPU-idiomatic design is sort + binary-search:
+
+1. normalize join keys to uint64 planes (ops.kernels.normalize_key),
+2. combine multi-column keys into one u64 by hash mixing,
+3. sort the BUILD side once by combined key,
+4. per probe row, searchsorted left/right gives the hash-equal candidate
+   range -- O(log n) per row, fully vectorized on the VPU,
+5. count-then-gather: expand candidate ranges into (probe, build) pairs
+   (host reads back ONE scalar = total candidates), then verify exact key
+   equality per pair over the normalized planes and compact.
+
+Null join keys never match (SQL semantics): null build rows are compacted
+away before the sort; null probe rows force empty candidate ranges.
+String keys use the equality-faithful 64-bit double-hash from
+normalize_key (collision odds ~2^-64 per pair; documented incompat,
+mirror of the reference's incompatOps discipline).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnVector, round_capacity
+from spark_rapids_tpu.ops import kernels as K
+
+
+def _combine_keys(cols: List[ColumnVector], num_rows: int
+                  ) -> Tuple[jax.Array, List[jax.Array], jax.Array]:
+    """Returns (combined u64 hash, per-col normalized planes, any_null)."""
+    planes = []
+    any_null = None
+    for c in cols:
+        k, nulls = K.normalize_key(c, num_rows)
+        planes.append(k)
+        any_null = nulls if any_null is None else (any_null | nulls)
+    h = jnp.zeros_like(planes[0])
+    for k in planes:
+        # 64-bit mix (splitmix64 finalizer per plane)
+        x = h ^ k
+        x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        h = x ^ (x >> jnp.uint64(31))
+    return h, planes, any_null
+
+
+def join_pairs(build_keys: List[ColumnVector], build_rows: int,
+               probe_keys: List[ColumnVector], probe_rows: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Compute matching (probe_idx, build_idx) pairs for an equi-join.
+    Returned as device arrays (int32) with -1 padding; second return is the
+    match count. Output order: probe-major (stable for the probe side)."""
+    bh, bplanes, bnull = _combine_keys(build_keys, build_rows)
+    ph, pplanes, pnull = _combine_keys(probe_keys, probe_rows)
+    bcap = bh.shape[0]
+    pcap = ph.shape[0]
+    b_in = (jnp.arange(bcap) < build_rows) & ~bnull
+    p_in = (jnp.arange(pcap) < probe_rows) & ~pnull
+
+    # compact non-null build rows, then sort by hash
+    bidx, bcount = K.filter_indices(b_in, bcap)
+    bsel = jnp.clip(bidx, 0, bcap - 1)
+    bh_c = jnp.where(bidx >= 0, bh[bsel], jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    order = jnp.argsort(bh_c)  # padded sentinel rows sort last
+    sorted_h = bh_c[order]
+    sorted_orig = jnp.where(bidx >= 0, bidx, -1)[order]
+
+    lo = jnp.searchsorted(sorted_h, ph, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(sorted_h, ph, side="right").astype(jnp.int32)
+    lo = jnp.where(p_in, lo, 0)
+    hi = jnp.where(p_in, hi, 0)
+    hi = jnp.minimum(hi, bcount)
+    lo = jnp.minimum(lo, hi)
+    total = int(jnp.sum((hi - lo).astype(jnp.int64)))
+
+    probe_i, build_pos = K.expand_ranges(lo, hi, total)
+    build_i = jnp.where(build_pos >= 0,
+                        sorted_orig[jnp.clip(build_pos, 0, bcap - 1)], -1)
+
+    # exact verification over normalized planes (hash could collide)
+    ok = (probe_i >= 0) & (build_i >= 0)
+    psel = jnp.clip(probe_i, 0, pcap - 1)
+    bsel2 = jnp.clip(build_i, 0, bcap - 1)
+    for pp, bp in zip(pplanes, bplanes):
+        ok = ok & (pp[psel] == bp[bsel2])
+    idx, match_count = K.filter_indices(ok, ok.shape[0])
+    sel = jnp.clip(idx, 0, ok.shape[0] - 1)
+    out_p = jnp.where(idx >= 0, probe_i[sel], -1)
+    out_b = jnp.where(idx >= 0, build_i[sel], -1)
+    return out_p, out_b, match_count
+
+
+def probe_matched_mask(pairs_idx: jax.Array, n: int, cap: int) -> jax.Array:
+    """bool[cap]: rows of a side that appear in the matched pairs."""
+    m = jnp.zeros(cap + 1, jnp.bool_)
+    sel = jnp.where(pairs_idx >= 0, pairs_idx, cap)
+    m = m.at[sel].set(True, mode="drop")
+    return m[:cap] & (jnp.arange(cap) < n)
+
+
+def unmatched_indices(mask_matched: jax.Array, n: int) -> Tuple[jax.Array, int]:
+    """Indices of in-range rows NOT matched (for outer joins)."""
+    cap = mask_matched.shape[0]
+    un = (~mask_matched) & (jnp.arange(cap) < n)
+    return K.filter_indices(un, cap)

@@ -1,0 +1,4 @@
+from spark_rapids_tpu.plan.nodes import (  # noqa: F401
+    PlanNode, InMemorySource, ParquetScan, Project, Filter, Aggregate,
+    Sort, SortOrder, Limit, Join, Union, Range, Expand,
+)

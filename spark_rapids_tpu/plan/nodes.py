@@ -1,0 +1,323 @@
+"""Backend-agnostic plan nodes.
+
+Reference parity: the reference rewrites Spark Catalyst *physical* plans
+(GpuOverrides.scala wraps SparkPlan nodes). Standing alone (no live Spark in
+this environment), this module plays Catalyst's role: a small physical plan
+algebra with schema inference and name binding. The overrides engine
+(plan/overrides.py) then walks these exactly like GpuOverrides walks
+SparkPlan -- tagging, converting supported subtrees to TPU execs, and
+falling back per-operator to the CPU backend.
+
+A thin adapter can later map real Spark physical plans onto these nodes
+(the SparkShims seam from SURVEY.md §7.3.7).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import (
+    Alias, BoundRef, Col, Expression, Literal,
+)
+from spark_rapids_tpu.expr.aggregates import AggFunction, NamedAgg
+
+
+class PlanNode:
+    children: List["PlanNode"] = []
+
+    @property
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        lines = [f"{pad}{self.describe()}"]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    def describe(self) -> str:
+        return self.name()
+
+
+def bind_expr(e: Expression, schema: T.Schema, case_sensitive: bool = False) -> Expression:
+    """Resolve Col names to BoundRefs against a child schema."""
+
+    def binder(node):
+        if isinstance(node, Col):
+            name = node.name
+            for i, f in enumerate(schema.fields):
+                if f.name == name or (not case_sensitive and f.name.lower() == name.lower()):
+                    return BoundRef(i, f.dtype, f.name)
+            raise KeyError(f"column {name!r} not found in {schema.names}")
+        return node
+
+    return e.transform(binder)
+
+
+def expr_name(e: Expression, idx: int) -> str:
+    if isinstance(e, Alias):
+        return e.name
+    if isinstance(e, (Col,)):
+        return e.name
+    if isinstance(e, BoundRef):
+        return e.name or f"c{idx}"
+    return f"col{idx}"
+
+
+class InMemorySource(PlanNode):
+    """A pyarrow Table split into partitions (local-mode data source)."""
+
+    def __init__(self, table, num_partitions: int = 1):
+        self.table = table
+        self.num_partitions = max(1, num_partitions)
+        self.children = []
+
+    @property
+    def schema(self) -> T.Schema:
+        return T.Schema(tuple(
+            T.StructField(f.name, T.from_arrow(f.type)) for f in self.table.schema))
+
+    def describe(self):
+        return f"InMemorySource[{self.table.num_rows} rows, {self.num_partitions} parts]"
+
+
+class ParquetScan(PlanNode):
+    """Parquet file scan (reference GpuParquetScan). Filter pushdown happens
+    in the overrides pass; `pushed_filters` prune row groups host-side."""
+
+    def __init__(self, paths: Sequence[str], schema: Optional[T.Schema] = None,
+                 columns: Optional[List[str]] = None,
+                 pushed_filters: Optional[List[Expression]] = None):
+        self.paths = list(paths)
+        self._schema = schema
+        self.columns = columns
+        self.pushed_filters = pushed_filters or []
+        self.children = []
+
+    @property
+    def schema(self) -> T.Schema:
+        if self._schema is None:
+            import pyarrow.parquet as pq
+            s = pq.read_schema(self.paths[0])
+            fields = [T.StructField(f.name, T.from_arrow(f.type)) for f in s]
+            if self.columns:
+                fields = [f for f in fields if f.name in self.columns]
+            self._schema = T.Schema(tuple(fields))
+        return self._schema
+
+    def describe(self):
+        return f"ParquetScan[{len(self.paths)} files]"
+
+
+class Range(PlanNode):
+    """spark.range(start, end, step) analog (reference GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1, num_partitions: int = 1):
+        self.start = start
+        self.end = end
+        self.step = step
+        self.num_partitions = max(1, num_partitions)
+        self.children = []
+
+    @property
+    def schema(self):
+        return T.Schema.of(("id", T.INT64))
+
+    def describe(self):
+        return f"Range[{self.start},{self.end},{self.step}]"
+
+
+class Project(PlanNode):
+    def __init__(self, exprs: List[Expression], child: PlanNode):
+        self.children = [child]
+        self.raw_exprs = exprs
+        self.exprs = [bind_expr(e, child.schema) for e in exprs]
+        self.names = [expr_name(e, i) for i, e in enumerate(exprs)]
+
+    @property
+    def schema(self):
+        return T.Schema(tuple(
+            T.StructField(n, e.data_type())
+            for n, e in zip(self.names, self.exprs)))
+
+    def describe(self):
+        return f"Project[{', '.join(self.names)}]"
+
+
+class Filter(PlanNode):
+    def __init__(self, condition: Expression, child: PlanNode):
+        self.children = [child]
+        self.condition = bind_expr(condition, child.schema)
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Filter[{self.condition!r}]"
+
+
+class Aggregate(PlanNode):
+    """Group-by aggregate. group_exprs evaluate per-row keys; aggs are
+    NamedAgg(fn, out_name). Empty group_exprs = global aggregation."""
+
+    def __init__(self, group_exprs: List[Expression], aggs: List[NamedAgg],
+                 child: PlanNode):
+        self.children = [child]
+        self.raw_group_exprs = group_exprs
+        self.group_exprs = [bind_expr(e, child.schema) for e in group_exprs]
+        self.group_names = [expr_name(e, i) for i, e in enumerate(group_exprs)]
+        self.aggs = [a.transform(lambda n: _bind_leaf(n, child.schema)) for a in aggs]
+
+    @property
+    def schema(self):
+        fields = [T.StructField(n, e.data_type())
+                  for n, e in zip(self.group_names, self.group_exprs)]
+        fields += [T.StructField(a.name, a.fn.result_type()) for a in self.aggs]
+        return T.Schema(tuple(fields))
+
+    def describe(self):
+        return (f"Aggregate[keys=[{', '.join(self.group_names)}], "
+                f"aggs=[{', '.join(a.name for a in self.aggs)}]]")
+
+
+def _bind_leaf(node, schema):
+    if isinstance(node, Col):
+        for i, f in enumerate(schema.fields):
+            if f.name == node.name or f.name.lower() == node.name.lower():
+                return BoundRef(i, f.dtype, f.name)
+        raise KeyError(f"column {node.name!r} not found in {schema.names}")
+    return node
+
+
+@dataclasses.dataclass
+class SortOrder:
+    expr: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # Spark default: nulls first iff asc
+
+    def resolved_nulls_first(self) -> bool:
+        return self.ascending if self.nulls_first is None else self.nulls_first
+
+
+class Sort(PlanNode):
+    def __init__(self, orders: List[SortOrder], child: PlanNode,
+                 global_sort: bool = True):
+        self.children = [child]
+        self.orders = [SortOrder(bind_expr(o.expr, child.schema), o.ascending,
+                                 o.nulls_first) for o in orders]
+        self.global_sort = global_sort
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        parts = [f"{o.expr!r} {'ASC' if o.ascending else 'DESC'}" for o in self.orders]
+        return f"Sort[{', '.join(parts)}]"
+
+
+class Limit(PlanNode):
+    def __init__(self, n: int, child: PlanNode):
+        self.children = [child]
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Limit[{self.n}]"
+
+
+class Join(PlanNode):
+    """Equi-join with optional extra condition (reference GpuShuffledHashJoin
+    / GpuBroadcastHashJoin; the planner picks the physical strategy)."""
+
+    KINDS = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+
+    def __init__(self, left: PlanNode, right: PlanNode,
+                 left_keys: List[Expression], right_keys: List[Expression],
+                 how: str = "inner", condition: Optional[Expression] = None):
+        assert how in self.KINDS, how
+        self.children = [left, right]
+        self.left_keys = [bind_expr(e, left.schema) for e in left_keys]
+        self.right_keys = [bind_expr(e, right.schema) for e in right_keys]
+        self.how = how
+        self.condition_raw = condition
+        # condition binds against the concatenated output schema
+        self.condition = (bind_expr(condition, self._concat_schema())
+                          if condition is not None else None)
+
+    def _concat_schema(self) -> T.Schema:
+        lf = list(self.children[0].schema.fields)
+        rf = list(self.children[1].schema.fields)
+        return T.Schema(tuple(lf + rf))
+
+    @property
+    def schema(self):
+        l, r = self.children
+        lf = list(l.schema.fields)
+        rf = list(r.schema.fields)
+        if self.how in ("left_semi", "left_anti"):
+            return l.schema
+        if self.how in ("right",):
+            lf = [T.StructField(f.name, f.dtype, True) for f in lf]
+        if self.how in ("left", "full"):
+            rf = [T.StructField(f.name, f.dtype, True) for f in rf]
+        if self.how == "full":
+            lf = [T.StructField(f.name, f.dtype, True) for f in lf]
+        return T.Schema(tuple(lf + rf))
+
+    def describe(self):
+        keys = ", ".join(f"{l!r}={r!r}" for l, r in zip(self.left_keys, self.right_keys))
+        return f"Join[{self.how}, {keys}]"
+
+
+class Union(PlanNode):
+    def __init__(self, children: List[PlanNode]):
+        assert children
+        first = children[0].schema
+        for c in children[1:]:
+            assert len(c.schema) == len(first), "UNION arity mismatch"
+        self.children = list(children)
+
+    @property
+    def schema(self):
+        schemas = [c.schema for c in self.children]
+        fields = []
+        for i, f in enumerate(schemas[0].fields):
+            dt = f.dtype
+            for s in schemas[1:]:
+                dt = T.common_type(dt, s.fields[i].dtype)
+            fields.append(T.StructField(f.name, dt))
+        return T.Schema(tuple(fields))
+
+    def describe(self):
+        return f"Union[{len(self.children)}]"
+
+
+class Expand(PlanNode):
+    """Multiple projections per input row (reference GpuExpandExec; used by
+    ROLLUP/CUBE/count-distinct rewrites)."""
+
+    def __init__(self, projections: List[List[Expression]], names: List[str],
+                 child: PlanNode):
+        self.children = [child]
+        self.projections = [[bind_expr(e, child.schema) for e in p]
+                            for p in projections]
+        self.names = names
+
+    @property
+    def schema(self):
+        p0 = self.projections[0]
+        return T.Schema(tuple(
+            T.StructField(n, e.data_type()) for n, e in zip(self.names, p0)))
+
+    def describe(self):
+        return f"Expand[{len(self.projections)} projections]"

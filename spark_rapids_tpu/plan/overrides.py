@@ -1,0 +1,425 @@
+"""Plan-rewrite engine: tagging, conversion, fallback, explain.
+
+Reference parity: GpuOverrides.scala (the rule registries + wrapAndTagPlan +
+doConvertPlan), RapidsMeta.scala (the wrapper/tagging hierarchy), and
+GpuTransitionOverrides (transition insertion -- here, CPU fallback bridging
+is handled inside CpuFallbackExec).
+
+Every plan node and expression is wrapped in a Meta, tagged with reasons it
+cannot run on TPU (type-signature checks, unregistered expressions, per-op
+config disables), and converted bottom-up: supported nodes become TpuExecs,
+unsupported ones become CpuFallbackExec over the CPU backend -- per-operator
+fallback exactly like the reference. Explain output lists every fallback
+with its reasons (spark.rapids.sql.explain=NOT_ON_TPU behaviour).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.types import Sigs, TypeSig
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr import datetime as DT
+from spark_rapids_tpu.expr import math as MA
+from spark_rapids_tpu.expr import strings as S
+from spark_rapids_tpu.plan import nodes as P
+
+
+# ---------------------------------------------------------------------------
+# Expression rules (reference: the 227 expr[...] registrations)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ExprRule:
+    name: str
+    input_sig: TypeSig
+    result_sig: TypeSig
+    doc: str = ""
+    extra: Optional[Callable[[E.Expression], Optional[str]]] = None
+
+
+EXPR_RULES: Dict[Type, ExprRule] = {}
+
+
+def expr_rule(cls: Type, input_sig: TypeSig = Sigs.COMMON,
+              result_sig: TypeSig = Sigs.COMMON, doc: str = "",
+              extra=None, name: Optional[str] = None):
+    EXPR_RULES[cls] = ExprRule(name or cls.__name__, input_sig, result_sig,
+                               doc, extra)
+
+
+_NUM = Sigs.NUMERIC + TypeSig(["NULL"])
+_NUMDT = _NUM + TypeSig(["DATE", "TIMESTAMP", "BOOLEAN"])
+
+expr_rule(E.BoundRef, Sigs.COMMON, Sigs.COMMON, "column reference")
+expr_rule(E.Literal, Sigs.COMMON, Sigs.COMMON, "literal value")
+expr_rule(E.Alias, Sigs.COMMON, Sigs.COMMON, "named expression")
+expr_rule(E.Add, _NUM, _NUM, "addition")
+expr_rule(E.Subtract, _NUM, _NUM, "subtraction")
+expr_rule(E.Multiply, _NUM, _NUM, "multiplication")
+expr_rule(E.Divide, _NUM, _NUM, "division (double result)")
+expr_rule(E.IntegralDivide, _NUM, _NUM, "integral division")
+expr_rule(E.Remainder, _NUM, _NUM, "modulo")
+expr_rule(E.UnaryMinus, _NUM, _NUM, "negation")
+expr_rule(E.Abs, _NUM, _NUM, "absolute value")
+
+
+def _no_string_order(e: E.Expression) -> Optional[str]:
+    for c in e.children:
+        if isinstance(c.data_type(), T.StringType):
+            return "string ordering comparison not supported on device"
+    return None
+
+
+expr_rule(E.EqualTo, Sigs.COMMON, Sigs.COMMON, "equality")
+expr_rule(E.EqualNullSafe, Sigs.COMMON, Sigs.COMMON, "null-safe equality")
+expr_rule(E.LessThan, _NUMDT, _NUMDT, "less than", extra=_no_string_order)
+expr_rule(E.LessThanOrEqual, _NUMDT, _NUMDT, "<=", extra=_no_string_order)
+expr_rule(E.GreaterThan, _NUMDT, _NUMDT, ">", extra=_no_string_order)
+expr_rule(E.GreaterThanOrEqual, _NUMDT, _NUMDT, ">=", extra=_no_string_order)
+expr_rule(E.And, Sigs.COMMON, Sigs.COMMON, "logical AND (Kleene)")
+expr_rule(E.Or, Sigs.COMMON, Sigs.COMMON, "logical OR (Kleene)")
+expr_rule(E.Not, Sigs.COMMON, Sigs.COMMON, "logical NOT")
+expr_rule(E.IsNull, Sigs.COMMON, Sigs.COMMON, "null test")
+expr_rule(E.IsNotNull, Sigs.COMMON, Sigs.COMMON, "not-null test")
+expr_rule(E.IsNaN, _NUM, _NUM, "NaN test")
+expr_rule(E.In, Sigs.COMMON, Sigs.COMMON, "IN literal list")
+expr_rule(E.If, Sigs.COMMON, Sigs.COMMON, "conditional")
+expr_rule(E.CaseWhen, Sigs.COMMON, Sigs.COMMON, "CASE WHEN")
+expr_rule(E.Coalesce, Sigs.COMMON, Sigs.COMMON, "coalesce")
+
+# Cast: only the device-implemented matrix (reference GpuCast type matrix)
+_CASTABLE_FIXED = (T.BooleanType, T.Int8Type, T.Int16Type, T.Int32Type,
+                   T.Int64Type, T.Float32Type, T.Float64Type, T.DateType,
+                   T.TimestampType, T.DecimalType)
+
+
+def _cast_check(e: E.Expression) -> Optional[str]:
+    src = e.children[0].data_type()
+    dst = e.to
+    if isinstance(src, T.StringType) and isinstance(dst, T.StringType):
+        return None
+    if isinstance(src, _CASTABLE_FIXED) and isinstance(dst, _CASTABLE_FIXED):
+        return None
+    if isinstance(dst, T.StringType):
+        if isinstance(src, (T.BooleanType,)) or src.is_integral:
+            return None
+        return f"cast {src!r} -> string not supported on device"
+    if isinstance(src, T.StringType):
+        if dst.is_integral:
+            return None
+        return f"cast string -> {dst!r} not supported on device"
+    if isinstance(src, T.NullType):
+        return None
+    return f"cast {src!r} -> {dst!r} not supported on device"
+
+
+expr_rule(E.Cast, Sigs.COMMON, Sigs.COMMON, "cast", extra=_cast_check)
+
+# strings
+expr_rule(S.StringLength, Sigs.COMMON, Sigs.COMMON, "character length")
+expr_rule(S.Upper, Sigs.COMMON, Sigs.COMMON, "uppercase (ASCII)")
+expr_rule(S.Lower, Sigs.COMMON, Sigs.COMMON, "lowercase (ASCII)")
+expr_rule(S.Substring, Sigs.COMMON, Sigs.COMMON, "substring")
+expr_rule(S.ConcatStrings, Sigs.COMMON, Sigs.COMMON, "string concat")
+expr_rule(S.StartsWith, Sigs.COMMON, Sigs.COMMON, "prefix match")
+expr_rule(S.EndsWith, Sigs.COMMON, Sigs.COMMON, "suffix match")
+expr_rule(S.Contains, Sigs.COMMON, Sigs.COMMON, "substring match")
+
+
+def _like_check(e):
+    if not e.supported_on_tpu():
+        return (f"LIKE pattern {e.pattern!r} does not transpile to device "
+                f"kernels (reference RegexParser reject strategy)")
+    return None
+
+
+expr_rule(S.Like, Sigs.COMMON, Sigs.COMMON, "SQL LIKE", extra=_like_check)
+expr_rule(S._StringEquals, Sigs.COMMON, Sigs.COMMON, "string equality")
+expr_rule(S._AndExpr, Sigs.COMMON, Sigs.COMMON, "internal AND")
+
+# math
+for _cls in (MA.Sqrt, MA.Exp, MA.Log, MA.Log10, MA.Log2, MA.Sin, MA.Cos,
+             MA.Tan, MA.Asin, MA.Acos, MA.Atan, MA.Sinh, MA.Cosh, MA.Tanh,
+             MA.Ceil, MA.Floor, MA.Pow, MA.Round, MA.Signum, MA.Atan2,
+             MA.Greatest, MA.Least):
+    expr_rule(_cls, _NUM, _NUM, _cls.__name__.lower())
+
+# datetime
+for _cls in (DT.Year, DT.Month, DT.DayOfMonth, DT.Hour, DT.Minute, DT.Second,
+             DT.DayOfWeek, DT.DateAdd, DT.DateSub, DT.DateDiff, DT.LastDay):
+    expr_rule(_cls, _NUMDT, _NUMDT, _cls.__name__.lower())
+
+
+# Aggregate function rules
+AGG_RULES: Dict[Type, ExprRule] = {}
+
+
+def agg_rule(cls, input_sig=_NUMDT, doc="", extra=None):
+    AGG_RULES[cls] = ExprRule(cls.__name__, input_sig, Sigs.COMMON, doc, extra)
+
+
+def _no_string_input(fn) -> Optional[str]:
+    for c in fn.children:
+        if isinstance(c.data_type(), T.StringType):
+            return f"{type(fn).__name__} over strings not supported on device"
+    return None
+
+
+agg_rule(A.Sum, _NUM, "sum")
+agg_rule(A.Count, Sigs.COMMON, "count non-null")
+agg_rule(A.CountAll, Sigs.COMMON, "count(*)")
+agg_rule(A.Min, _NUMDT, "min", extra=_no_string_input)
+agg_rule(A.Max, _NUMDT, "max", extra=_no_string_input)
+agg_rule(A.Average, _NUM, "avg")
+agg_rule(A.First, _NUMDT, "first", extra=_no_string_input)
+agg_rule(A.Last, _NUMDT, "last", extra=_no_string_input)
+agg_rule(A.StddevSamp, _NUM, "stddev_samp")
+agg_rule(A.StddevPop, _NUM, "stddev_pop")
+agg_rule(A.VarianceSamp, _NUM, "var_samp")
+agg_rule(A.VariancePop, _NUM, "var_pop")
+
+
+# ---------------------------------------------------------------------------
+# Expression tagging
+# ---------------------------------------------------------------------------
+
+def tag_expression(e: E.Expression, conf, reasons: List[str], where: str) -> None:
+    cls = type(e)
+    rule = EXPR_RULES.get(cls)
+    if rule is None:
+        reasons.append(f"{where}: expression {cls.__name__} is not supported on TPU")
+        return
+    key = f"spark.rapids.sql.expression.{rule.name}"
+    if not conf.is_op_enabled(key):
+        reasons.append(f"{where}: expression {rule.name} disabled by {key}")
+    try:
+        dt = e.data_type()
+        r = rule.result_sig.reason_not_supported(dt)
+        if r:
+            reasons.append(f"{where}: {rule.name} output {r}")
+    except Exception as ex:  # unresolved
+        reasons.append(f"{where}: cannot resolve {rule.name}: {ex}")
+        return
+    for ch in e.children:
+        try:
+            cdt = ch.data_type()
+            r = rule.input_sig.reason_not_supported(cdt)
+            if r:
+                reasons.append(f"{where}: {rule.name} input {r}")
+        except Exception:
+            pass
+    if rule.extra is not None:
+        r = rule.extra(e)
+        if r:
+            reasons.append(f"{where}: {r}")
+    for ch in e.children:
+        tag_expression(ch, conf, reasons, where)
+
+
+def tag_agg(fn: A.AggFunction, conf, reasons: List[str], where: str) -> None:
+    rule = AGG_RULES.get(type(fn))
+    if rule is None:
+        reasons.append(f"{where}: aggregate {type(fn).__name__} is not supported on TPU")
+        return
+    if rule.extra is not None:
+        r = rule.extra(fn)
+        if r:
+            reasons.append(f"{where}: {r}")
+    for ch in fn.children:
+        tag_expression(ch, conf, reasons, where)
+        r = rule.input_sig.reason_not_supported(ch.data_type())
+        if r:
+            reasons.append(f"{where}: {rule.name} input {r}")
+
+
+# ---------------------------------------------------------------------------
+# Plan metas
+# ---------------------------------------------------------------------------
+
+class SparkPlanMeta:
+    """Wrapper with tagging + conversion (reference RapidsMeta:83 /
+    SparkPlanMeta:598)."""
+
+    def __init__(self, plan: P.PlanNode, conf, parent: Optional["SparkPlanMeta"] = None):
+        self.plan = plan
+        self.conf = conf
+        self.parent = parent
+        self.children = [SparkPlanMeta(c, conf, self) for c in plan.children]
+        self.reasons: List[str] = []
+        self._tagged = False
+
+    # -- tagging -----------------------------------------------------------
+    def tag_for_tpu(self) -> None:
+        if self._tagged:
+            return
+        self._tagged = True
+        for c in self.children:
+            c.tag_for_tpu()
+        name = type(self.plan).__name__
+        key = f"spark.rapids.sql.exec.{name}"
+        if not self.conf.is_op_enabled(key):
+            self.reasons.append(f"{name} disabled by {key}")
+        if not self.conf.get(C.SQL_ENABLED):
+            self.reasons.append("spark.rapids.sql.enabled is false")
+        self._tag_schema()
+        self._tag_node()
+
+    def _tag_schema(self) -> None:
+        for f in self.plan.schema.fields:
+            r = Sigs.COMMON.reason_not_supported(f.dtype)
+            if r:
+                self.reasons.append(f"output column {f.name}: {r}")
+
+    def _tag_node(self) -> None:
+        p = self.plan
+        name = type(p).__name__
+        if isinstance(p, P.Project):
+            for e in p.exprs:
+                tag_expression(e, self.conf, self.reasons, name)
+        elif isinstance(p, P.Filter):
+            tag_expression(p.condition, self.conf, self.reasons, name)
+        elif isinstance(p, P.Aggregate):
+            for e in p.group_exprs:
+                tag_expression(e, self.conf, self.reasons, name)
+            for a in p.aggs:
+                tag_agg(a.fn, self.conf, self.reasons, name)
+        elif isinstance(p, P.Sort):
+            for o in p.orders:
+                tag_expression(o.expr, self.conf, self.reasons, name)
+                if isinstance(o.expr.data_type(), T.StringType):
+                    self.reasons.append(
+                        f"{name}: ORDER BY on strings requires host sort "
+                        f"(device string ordering lands with the radix "
+                        f"string-sort kernel)")
+        elif isinstance(p, P.Join):
+            for e in p.left_keys + p.right_keys:
+                tag_expression(e, self.conf, self.reasons, name)
+            if p.condition is not None:
+                tag_expression(p.condition, self.conf, self.reasons, name)
+        elif isinstance(p, P.Expand):
+            for proj in p.projections:
+                for e in proj:
+                    tag_expression(e, self.conf, self.reasons, name)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    # -- conversion --------------------------------------------------------
+    def convert(self):
+        from spark_rapids_tpu.exec import tpu_nodes as X
+        child_execs = [c.convert() for c in self.children]
+        p = self.plan
+        conf = self.conf
+        if not self.can_run_on_tpu:
+            return X.CpuFallbackExec(p, child_execs, conf)
+        if isinstance(p, P.InMemorySource):
+            return X.InMemoryScanExec(p, [], conf)
+        if isinstance(p, P.ParquetScan):
+            return X.ParquetScanExec(p, [], conf)
+        if isinstance(p, P.Range):
+            return X.RangeExec(p, [], conf)
+        if isinstance(p, P.Project):
+            return X.ProjectExec(p, child_execs, conf)
+        if isinstance(p, P.Filter):
+            return X.FilterExec(p, child_execs, conf)
+        if isinstance(p, P.Limit):
+            local = X.LimitExec(p, child_execs, conf)
+            if child_execs[0].num_partitions > 1:
+                coll = X.CollectExchangeExec(p, [local], conf)
+                return X.LimitExec(p, [coll], conf)
+            return local
+        if isinstance(p, P.Union):
+            return X.UnionExec(p, child_execs, conf)
+        if isinstance(p, P.Expand):
+            return X.ExpandExec(p, child_execs, conf)
+        if isinstance(p, P.Sort):
+            child = child_execs[0]
+            if child.num_partitions > 1 and p.global_sort:
+                child = X.CollectExchangeExec(p, [child], conf)
+            return X.SortExec(p, [child], conf)
+        if isinstance(p, P.Aggregate):
+            return self._convert_aggregate(p, child_execs, conf)
+        if isinstance(p, P.Join):
+            return self._convert_join(p, child_execs, conf)
+        raise NotImplementedError(f"no TPU conversion for {type(p).__name__}")
+
+    def _convert_aggregate(self, p, child_execs, conf):
+        from spark_rapids_tpu.exec import tpu_nodes as X
+        child = child_execs[0]
+        if child.num_partitions == 1:
+            return X.HashAggregateExec(p, [child], conf, mode="complete")
+        partial = X.HashAggregateExec(p, [child], conf, mode="partial")
+        nkeys = len(p.group_exprs)
+        if nkeys:
+            keys = [E.BoundRef(i, e.data_type(), n) for i, (e, n) in
+                    enumerate(zip(p.group_exprs, p.group_names))]
+            exch = X.ShuffleExchangeExec(p, [partial], conf, keys,
+                                         n_out=child.num_partitions)
+        else:
+            exch = X.CollectExchangeExec(p, [partial], conf)
+        return X.HashAggregateExec(p, [exch], conf, mode="final")
+
+    def _convert_join(self, p, child_execs, conf):
+        from spark_rapids_tpu.exec import tpu_nodes as X
+        left, right = child_execs
+        if p.how == "cross":
+            return X.CartesianProductExec(p, [left, right], conf)
+        if p.how in ("right", "full") and left.num_partitions > 1:
+            left = X.CollectExchangeExec(p, [left], conf)
+        return X.BroadcastHashJoinExec(p, [left, right], conf)
+
+    # -- explain -----------------------------------------------------------
+    def explain(self, indent: int = 0, all_ops: bool = False) -> str:
+        pad = "  " * indent
+        mark = "*" if self.can_run_on_tpu else "!"
+        lines = []
+        if all_ops or not self.can_run_on_tpu:
+            lines.append(f"{pad}{mark} {self.plan.describe()}")
+            for r in self.reasons:
+                lines.append(f"{pad}    @ cannot run on TPU because: {r}")
+        else:
+            lines.append(f"{pad}* {self.plan.describe()} [TPU]")
+        for c in self.children:
+            lines.append(c.explain(indent + 1, all_ops))
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Entry points (reference GpuOverrides.apply / ExplainPlan)
+# ---------------------------------------------------------------------------
+
+def wrap_and_tag(plan: P.PlanNode, conf) -> SparkPlanMeta:
+    meta = SparkPlanMeta(plan, conf)
+    meta.tag_for_tpu()
+    return meta
+
+
+def convert_plan(plan: P.PlanNode, conf):
+    """Returns (root_exec, meta). In explainOnly mode no device is required
+    by conversion since nothing executes until iteration."""
+    meta = wrap_and_tag(plan, conf)
+    exec_root = meta.convert()
+    if conf.get(C.TEST_MODE):
+        allowed = {s.strip() for s in
+                   str(conf.get(C.ALLOW_NON_TPU) or "").split(",") if s.strip()}
+        _assert_on_tpu(meta, allowed)
+    return exec_root, meta
+
+
+def _assert_on_tpu(meta: SparkPlanMeta, allowed: set) -> None:
+    name = type(meta.plan).__name__
+    if not meta.can_run_on_tpu and name not in allowed:
+        raise AssertionError(
+            f"{name} fell back to CPU in test mode: {meta.reasons}")
+    for c in meta.children:
+        _assert_on_tpu(c, allowed)
+
+
+def explain_plan(plan: P.PlanNode, conf, all_ops: bool = False) -> str:
+    meta = wrap_and_tag(plan, conf)
+    return meta.explain(all_ops=all_ops)

@@ -1,0 +1,112 @@
+"""Device admission semaphore (reference GpuSemaphore.scala /
+PrioritySemaphore.scala).
+
+Limits the number of tasks concurrently touching the device to
+`spark.rapids.sql.concurrentTpuTasks`. Priority follows the reference's
+design: tasks already holding device data (re-acquisition) outrank fresh
+tasks, reducing memory pressure; ties break by task id (older first).
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Dict, Optional
+
+
+class PrioritySemaphore:
+    def __init__(self, permits: int):
+        self._permits = permits
+        self._available = permits
+        self._lock = threading.Lock()
+        self._waiters = []  # heap of (-priority, seq, event)
+        self._seq = 0
+
+    def acquire(self, n: int = 1, priority: int = 0,
+                wait_metric=None) -> None:
+        import time
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            if self._available >= n and not self._waiters:
+                self._available -= n
+                return
+            ev = threading.Event()
+            self._seq += 1
+            heapq.heappush(self._waiters, (-priority, self._seq, n, ev))
+        while True:
+            ev.wait(timeout=0.05)
+            with self._lock:
+                if self._waiters and self._waiters[0][3] is ev \
+                        and self._available >= n:
+                    heapq.heappop(self._waiters)
+                    self._available -= n
+                    if wait_metric is not None:
+                        wait_metric.add(time.perf_counter_ns() - t0)
+                    return
+                if ev.is_set():
+                    ev.clear()
+
+    def release(self, n: int = 1) -> None:
+        with self._lock:
+            self._available += n
+            if self._waiters:
+                self._waiters[0][3].set()
+
+    @property
+    def available(self) -> int:
+        return self._available
+
+
+class TpuSemaphore:
+    """Task-aware wrapper: re-entrant per task, auto-released on task end
+    (reference GpuSemaphore.acquireIfNecessary / completion hook)."""
+
+    def __init__(self, permits: int):
+        self._sem = PrioritySemaphore(permits)
+        self._held: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def acquire_if_necessary(self, task_ctx) -> None:
+        tid = task_ctx.task_id
+        with self._lock:
+            if self._held.get(tid):
+                return
+        prio = 1 if task_ctx.holds_device_data else 0
+        self._sem.acquire(1, priority=prio,
+                          wait_metric=task_ctx.metric("semaphoreWaitTime"))
+        with self._lock:
+            self._held[tid] = 1
+        task_ctx.on_completion(lambda: self.release(task_ctx))
+
+    def release(self, task_ctx) -> None:
+        tid = task_ctx.task_id
+        with self._lock:
+            if not self._held.pop(tid, 0):
+                return
+        self._sem.release(1)
+
+    @property
+    def available(self) -> int:
+        return self._sem.available
+
+
+_global: Optional[TpuSemaphore] = None
+_glock = threading.Lock()
+
+
+def get_semaphore(conf=None) -> TpuSemaphore:
+    global _global
+    with _glock:
+        if _global is None:
+            from spark_rapids_tpu import config as C
+            c = conf
+            if c is None:
+                from spark_rapids_tpu.config import conf as get_conf
+                c = get_conf()
+            _global = TpuSemaphore(c.get(C.CONCURRENT_TPU_TASKS))
+        return _global
+
+
+def reset_semaphore() -> None:
+    global _global
+    with _glock:
+        _global = None

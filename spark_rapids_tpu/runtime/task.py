@@ -1,0 +1,73 @@
+"""Task context (the Spark TaskContext analog the exec layer sees).
+
+Reference parity: ScalableTaskCompletion (cheap completion callbacks),
+GpuTaskMetrics per-task accumulators, and the per-task thread association
+RmmSpark keeps for the retry framework.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List
+
+from spark_rapids_tpu.runtime.metrics import GpuMetric
+
+
+class TaskContext:
+    _counter = 0
+    _counter_lock = threading.Lock()
+    _local = threading.local()
+
+    def __init__(self, partition_id: int = 0, stage_id: int = 0):
+        with TaskContext._counter_lock:
+            TaskContext._counter += 1
+            self.task_id = TaskContext._counter
+        self.partition_id = partition_id
+        self.stage_id = stage_id
+        self.holds_device_data = False
+        self._metrics: Dict[str, GpuMetric] = {}
+        self._completion: List[Callable[[], None]] = []
+        self._failed = False
+
+    def metric(self, name: str) -> GpuMetric:
+        if name not in self._metrics:
+            self._metrics[name] = GpuMetric(name)
+        return self._metrics[name]
+
+    def on_completion(self, fn: Callable[[], None]) -> None:
+        self._completion.append(fn)
+
+    def complete(self, failed: bool = False) -> None:
+        self._failed = failed
+        for fn in reversed(self._completion):
+            try:
+                fn()
+            except Exception:
+                pass
+        self._completion.clear()
+
+    # -- thread association ------------------------------------------------
+    @staticmethod
+    def get() -> "TaskContext":
+        ctx = getattr(TaskContext._local, "ctx", None)
+        if ctx is None:
+            ctx = TaskContext()
+            TaskContext._local.ctx = ctx
+        return ctx
+
+    @staticmethod
+    def set_current(ctx: "TaskContext") -> None:
+        TaskContext._local.ctx = ctx
+
+    @staticmethod
+    def clear() -> None:
+        if hasattr(TaskContext._local, "ctx"):
+            del TaskContext._local.ctx
+
+    def __enter__(self):
+        TaskContext.set_current(self)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        self.complete(failed=et is not None)
+        TaskContext.clear()
+        return False

@@ -1,0 +1,2 @@
+from spark_rapids_tpu.sql.session import TpuSession  # noqa: F401
+from spark_rapids_tpu.sql.dataframe import DataFrame  # noqa: F401

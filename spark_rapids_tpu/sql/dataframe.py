@@ -1,0 +1,176 @@
+"""DataFrame API over the plan algebra (the user surface a Spark user would
+recognize; reference: the plugin is transparent to Spark's DataFrame API, so
+this module plays PySpark's role in the standalone framework)."""
+from __future__ import annotations
+
+from typing import List, Optional, Union as U
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.expr.aggregates import AggFunction, NamedAgg
+from spark_rapids_tpu.plan import nodes as P
+
+
+def _e(x):
+    return x if isinstance(x, E.Expression) else (E.col(x) if isinstance(x, str) else E.lit(x))
+
+
+class DataFrame:
+    def __init__(self, plan: P.PlanNode, session):
+        self.plan = plan
+        self.session = session
+
+    # -- transformations ---------------------------------------------------
+    def select(self, *exprs) -> "DataFrame":
+        bound = [_e(x) for x in exprs]
+        return DataFrame(P.Project(bound, self.plan), self.session)
+
+    def with_column(self, name: str, expr) -> "DataFrame":
+        existing = [E.col(n) for n in self.plan.schema.names if n != name]
+        return self.select(*existing, _e(expr).alias(name))
+
+    def filter(self, condition) -> "DataFrame":
+        return DataFrame(P.Filter(_e(condition), self.plan), self.session)
+
+    where = filter
+
+    def group_by(self, *keys) -> "GroupedData":
+        return GroupedData([_e(k) for k in keys], self)
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData([], self).agg(*aggs)
+
+    def order_by(self, *orders) -> "DataFrame":
+        os = []
+        for o in orders:
+            if isinstance(o, P.SortOrder):
+                os.append(o)
+            else:
+                os.append(P.SortOrder(_e(o)))
+        return DataFrame(P.Sort(os, self.plan), self.session)
+
+    orderBy = sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(P.Limit(n, self.plan), self.session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(P.Union([self.plan, other.plan]), self.session)
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        keys = [E.col(n) for n in self.plan.schema.names]
+        return DataFrame(P.Aggregate(keys, [], self.plan), self.session)
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
+        how = {"leftsemi": "left_semi", "semi": "left_semi",
+               "leftanti": "left_anti", "anti": "left_anti",
+               "outer": "full", "fullouter": "full", "left_outer": "left",
+               "right_outer": "right"}.get(how, how)
+        if how == "cross" or on is None:
+            return DataFrame(P.Join(self.plan, other.plan, [], [], "cross"),
+                             self.session)
+        if isinstance(on, str):
+            on = [on]
+        dedupe_names = None
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            lk = [E.col(k) for k in on]
+            rk = [E.col(k) for k in on]
+            dedupe_names = list(on)
+        elif isinstance(on, (list, tuple)):
+            lk, rk = zip(*on)
+            lk, rk = list(lk), list(rk)
+        else:
+            raise TypeError("join on= must be column name(s) or (left, right) pairs")
+        joined = DataFrame(P.Join(self.plan, other.plan, lk, rk, how), self.session)
+        if dedupe_names and how not in ("left_semi", "left_anti"):
+            # PySpark semantics: a single key column in the output. For right
+            # joins the surviving values come from the right side.
+            nleft = len(self.plan.schema)
+            out = []
+            lowered = {n.lower() for n in dedupe_names}
+            for i, f in enumerate(joined.plan.schema.fields):
+                if i >= nleft and f.name.lower() in lowered:
+                    continue  # drop right-side key duplicate
+                ref = E.BoundRef(i, f.dtype, f.name)
+                if i < nleft and f.name.lower() in lowered and how in ("right", "full"):
+                    # take the non-null side for the key
+                    ridx = nleft + _index_of(joined.plan.schema.names[nleft:], f.name)
+                    rref = E.BoundRef(ridx, joined.plan.schema.fields[ridx].dtype, f.name)
+                    out.append(E.Coalesce(ref, rref).alias(f.name))
+                else:
+                    out.append(ref.alias(f.name))
+            joined = DataFrame(P.Project(out, joined.plan), joined.session)
+        return joined
+
+    # -- actions -----------------------------------------------------------
+    @property
+    def schema(self):
+        return self.plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.schema.names
+
+    def collect(self):
+        """Execute with the TPU engine (per-op CPU fallback as tagged)."""
+        return self.session.collect(self.plan)
+
+    def collect_cpu(self):
+        """Execute entirely on the CPU reference backend."""
+        from spark_rapids_tpu.exec.cpu_backend import execute_cpu
+        return execute_cpu(self.plan, ansi=self.session.conf.get(C.ANSI_ENABLED))
+
+    def to_pydict(self):
+        return self.collect().to_pydict()
+
+    def count(self) -> int:
+        return self.collect().num_rows
+
+    def explain(self, mode: str = "placement") -> str:
+        from spark_rapids_tpu.plan.overrides import explain_plan
+        s = explain_plan(self.plan, self.session.conf, all_ops=True)
+        print(s)
+        return s
+
+    def __repr__(self):
+        return f"DataFrame[{self.plan.schema!r}]"
+
+
+class GroupedData:
+    def __init__(self, keys: List[E.Expression], df: DataFrame):
+        self.keys = keys
+        self.df = df
+
+    def agg(self, *aggs) -> DataFrame:
+        named: List[NamedAgg] = []
+        for i, a in enumerate(aggs):
+            if isinstance(a, NamedAgg):
+                named.append(a)
+            elif isinstance(a, AggFunction):
+                named.append(NamedAgg(a, _default_agg_name(a, i)))
+            else:
+                raise TypeError(f"not an aggregate: {a!r}")
+        return DataFrame(P.Aggregate(self.keys, named, self.df.plan),
+                         self.df.session)
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.expr.aggregates import CountAll
+        return self.agg(NamedAgg(CountAll(), "count"))
+
+
+def _index_of(names: List[str], name: str) -> int:
+    for i, n in enumerate(names):
+        if n.lower() == name.lower():
+            return i
+    raise KeyError(name)
+
+
+def _default_agg_name(a: AggFunction, i: int) -> str:
+    base = type(a).__name__.lower()
+    if a.children and isinstance(a.children[0], E.Col):
+        return f"{base}({a.children[0].name})"
+    return f"{base}_{i}"

@@ -1,0 +1,271 @@
+"""User-facing expression builders, mirroring pyspark.sql.functions for the
+subset the engine implements (reference sql-plugin-api functions.scala df_udf
+style surface)."""
+from __future__ import annotations
+
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.expr import aggregates as A
+from spark_rapids_tpu.expr import datetime as DT
+from spark_rapids_tpu.expr import math as MA
+from spark_rapids_tpu.expr import strings as S
+
+col = E.col
+lit = E.lit
+
+
+def _e(x):
+    return x if isinstance(x, E.Expression) else (E.col(x) if isinstance(x, str) else E.lit(x))
+
+
+# aggregates -----------------------------------------------------------------
+def sum(c):  # noqa: A001
+    return A.Sum(_e(c))
+
+
+def count(c="*"):
+    if c == "*":
+        return A.CountAll()
+    return A.Count(_e(c))
+
+
+def avg(c):
+    return A.Average(_e(c))
+
+
+mean = avg
+
+
+def min(c):  # noqa: A001
+    return A.Min(_e(c))
+
+
+def max(c):  # noqa: A001
+    return A.Max(_e(c))
+
+
+def first(c):
+    return A.First(_e(c))
+
+
+def last(c):
+    return A.Last(_e(c))
+
+
+def stddev(c):
+    return A.StddevSamp(_e(c))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c):
+    return A.StddevPop(_e(c))
+
+
+def variance(c):
+    return A.VarianceSamp(_e(c))
+
+
+var_samp = variance
+
+
+def var_pop(c):
+    return A.VariancePop(_e(c))
+
+
+# scalar ---------------------------------------------------------------------
+def coalesce(*cs):
+    return E.Coalesce(*[_e(c) for c in cs])
+
+
+def when(cond, value):
+    return _WhenBuilder([(cond, _e(value))])
+
+
+class _WhenBuilder(E.Expression):
+    def __init__(self, branches):
+        self._branches = branches
+        self.children = []
+
+    def when(self, cond, value):
+        return _WhenBuilder(self._branches + [(cond, _e(value))])
+
+    def otherwise(self, value):
+        return E.CaseWhen(self._branches, _e(value))
+
+    def _as_case(self):
+        return E.CaseWhen(self._branches)
+
+    def data_type(self):
+        return self._as_case().data_type()
+
+    def transform(self, fn):
+        return E.CaseWhen([(p.transform(fn), v.transform(fn))
+                           for p, v in self._branches]).transform(fn)
+
+    def eval_tpu(self, ctx):
+        return self._as_case().eval_tpu(ctx)
+
+    def eval_cpu(self, cols, ansi=False):
+        return self._as_case().eval_cpu(cols, ansi)
+
+    def fingerprint(self):
+        return self._as_case().fingerprint()
+
+
+def isnull(c):
+    return E.IsNull(_e(c))
+
+
+def isnan(c):
+    return E.IsNaN(_e(c))
+
+
+def abs(c):  # noqa: A001
+    return E.Abs(_e(c))
+
+
+def sqrt(c):
+    return MA.Sqrt(_e(c))
+
+
+def exp(c):
+    return MA.Exp(_e(c))
+
+
+def log(c):
+    return MA.Log(_e(c))
+
+
+def log10(c):
+    return MA.Log10(_e(c))
+
+
+def log2(c):
+    return MA.Log2(_e(c))
+
+
+def sin(c):
+    return MA.Sin(_e(c))
+
+
+def cos(c):
+    return MA.Cos(_e(c))
+
+
+def tan(c):
+    return MA.Tan(_e(c))
+
+
+def ceil(c):
+    return MA.Ceil(_e(c))
+
+
+def floor(c):
+    return MA.Floor(_e(c))
+
+
+def pow(a, b):  # noqa: A001
+    return MA.Pow(_e(a), _e(b))
+
+
+def round(c, scale=0):  # noqa: A001
+    return MA.Round(_e(c), scale)
+
+
+def signum(c):
+    return MA.Signum(_e(c))
+
+
+def atan2(a, b):
+    return MA.Atan2(_e(a), _e(b))
+
+
+def greatest(*cs):
+    return MA.Greatest(*[_e(c) for c in cs])
+
+
+def least(*cs):
+    return MA.Least(*[_e(c) for c in cs])
+
+
+# strings --------------------------------------------------------------------
+def length(c):
+    return S.StringLength(_e(c))
+
+
+def upper(c):
+    return S.Upper(_e(c))
+
+
+def lower(c):
+    return S.Lower(_e(c))
+
+
+def substring(c, pos, length_):
+    return S.Substring(_e(c), pos, length_)
+
+
+def concat(*cs):
+    return S.ConcatStrings(*[_e(c) for c in cs])
+
+
+def startswith(c, prefix):
+    return S.StartsWith(_e(c), prefix)
+
+
+def endswith(c, suffix):
+    return S.EndsWith(_e(c), suffix)
+
+
+def contains(c, s):
+    return S.Contains(_e(c), s)
+
+
+def like(c, pattern):
+    return S.Like(_e(c), pattern)
+
+
+# datetime -------------------------------------------------------------------
+def year(c):
+    return DT.Year(_e(c))
+
+
+def month(c):
+    return DT.Month(_e(c))
+
+
+def dayofmonth(c):
+    return DT.DayOfMonth(_e(c))
+
+
+def hour(c):
+    return DT.Hour(_e(c))
+
+
+def minute(c):
+    return DT.Minute(_e(c))
+
+
+def second(c):
+    return DT.Second(_e(c))
+
+
+def dayofweek(c):
+    return DT.DayOfWeek(_e(c))
+
+
+def date_add(c, n):
+    return DT.DateAdd(_e(c), _e(n))
+
+
+def date_sub(c, n):
+    return DT.DateSub(_e(c), _e(n))
+
+
+def datediff(end, start):
+    return DT.DateDiff(_e(end), _e(start))
+
+
+def last_day(c):
+    return DT.LastDay(_e(c))

@@ -1,0 +1,92 @@
+"""TpuSession: the driver (reference Plugin.scala driver/executor plugin
+bootstrap + the collect path). Owns config, converts plans through the
+overrides engine, and runs root partitions as concurrent tasks."""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import to_arrow
+from spark_rapids_tpu.plan import nodes as P
+from spark_rapids_tpu.runtime.task import TaskContext
+from spark_rapids_tpu.sql.dataframe import DataFrame
+
+
+class TpuSession:
+    def __init__(self, conf_overrides: Optional[Dict] = None):
+        self.conf = C.RapidsConf(conf_overrides)
+        self._last_meta = None
+
+    # -- sources -----------------------------------------------------------
+    def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
+        if isinstance(data, dict):
+            table = pa.table(data)
+        elif isinstance(data, pa.Table):
+            table = data
+        else:
+            raise TypeError(type(data))
+        return DataFrame(P.InMemorySource(table, num_partitions), self)
+
+    createDataFrame = create_dataframe
+
+    def read_parquet(self, *paths, columns=None) -> DataFrame:
+        import glob as _glob
+        import os
+        expanded: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                expanded.extend(sorted(_glob.glob(os.path.join(p, "*.parquet"))))
+            elif any(ch in p for ch in "*?["):
+                expanded.extend(sorted(_glob.glob(p)))
+            else:
+                expanded.append(p)
+        return DataFrame(P.ParquetScan(expanded, columns=columns), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        return DataFrame(P.Range(start, end, step, num_partitions), self)
+
+    # -- execution ---------------------------------------------------------
+    def collect(self, plan: P.PlanNode) -> pa.Table:
+        from spark_rapids_tpu.config import set_session_conf
+        from spark_rapids_tpu.plan.overrides import convert_plan
+        set_session_conf(self.conf)
+        exec_root, meta = convert_plan(plan, self.conf)
+        self._last_meta = meta
+        explain_mode = self.conf.get(C.SQL_EXPLAIN).upper()
+        if explain_mode in ("NOT_ON_TPU", "ALL"):
+            text = meta.explain(all_ops=explain_mode == "ALL")
+            if "@" in text or explain_mode == "ALL":
+                import logging
+                logging.getLogger("spark_rapids_tpu").info("\n%s", text)
+        names = plan.schema.names
+        nparts = exec_root.num_partitions
+
+        def run(p: int) -> List[pa.Table]:
+            with TaskContext(partition_id=p) as ctx:
+                return [to_arrow(b, names)
+                        for b in exec_root.execute_partition(ctx, p)]
+
+        if nparts == 1:
+            tables = run(0)
+        else:
+            tables = []
+            workers = min(nparts, 16)
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                for res in pool.map(run, range(nparts)):
+                    tables.extend(res)
+        if not tables:
+            fields = [pa.field(f.name, T.to_arrow(f.dtype))
+                      for f in plan.schema.fields]
+            return pa.Table.from_arrays(
+                [pa.array([], type=f.type) for f in fields], schema=pa.schema(fields))
+        return pa.concat_tables(tables)
+
+    def last_plan_explain(self) -> str:
+        return self._last_meta.explain(all_ops=True) if self._last_meta else ""

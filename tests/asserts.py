@@ -1,0 +1,109 @@
+"""Differential assertion helpers.
+
+Reference parity: integration_tests/src/main/python/asserts.py --
+assert_gpu_and_cpu_are_equal_collect (:583) runs the same query on CPU and
+GPU Spark and diffs; assert_gpu_fallback_collect (:443) asserts a specific
+exec fell back. Here the TPU engine is diffed against the independent
+pandas/numpy CPU backend.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import pyarrow as pa
+
+
+def _canon(table: pa.Table):
+    return table.to_pylist()
+
+
+def _sort_key(row):
+    out = []
+    for k in sorted(row):
+        v = row[k]
+        if v is None:
+            out.append((0, ""))
+        elif isinstance(v, float) and math.isnan(v):
+            out.append((2, "nan"))
+        else:
+            out.append((1, str(v)))
+    return out
+
+
+def _row_eq(a, b, approx: Optional[float]) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) or isinstance(b, float):
+        fa, fb = float(a), float(b)
+        if math.isnan(fa) or math.isnan(fb):
+            return math.isnan(fa) and math.isnan(fb)
+        if approx is not None:
+            if fa == fb:
+                return True
+            denom = max(abs(fa), abs(fb), 1e-300)
+            return abs(fa - fb) / denom < approx or abs(fa - fb) < 1e-12
+        return fa == fb
+    return a == b
+
+
+def assert_tables_equal(tpu: pa.Table, cpu: pa.Table,
+                        ignore_order: bool = False,
+                        approx_float: Optional[float] = None) -> None:
+    assert tpu.schema.names == cpu.schema.names, \
+        f"schema names differ: {tpu.schema.names} vs {cpu.schema.names}"
+    trows = _canon(tpu)
+    crows = _canon(cpu)
+    assert len(trows) == len(crows), \
+        f"row count differs: tpu={len(trows)} cpu={len(crows)}\n" \
+        f"tpu={trows[:20]}\ncpu={crows[:20]}"
+    if ignore_order:
+        trows = sorted(trows, key=_sort_key)
+        crows = sorted(crows, key=_sort_key)
+    for i, (tr, cr) in enumerate(zip(trows, crows)):
+        for k in tpu.schema.names:
+            assert _row_eq(tr[k], cr[k], approx_float), \
+                (f"row {i} col {k}: tpu={tr[k]!r} cpu={cr[k]!r}\n"
+                 f"tpu rows: {trows[max(0,i-2):i+3]}\n"
+                 f"cpu rows: {crows[max(0,i-2):i+3]}")
+
+
+def assert_tpu_and_cpu_are_equal_collect(df_fn: Callable, session,
+                                         ignore_order: bool = False,
+                                         approx_float: Optional[float] = None,
+                                         conf: Optional[dict] = None):
+    """df_fn(session) -> DataFrame. Runs it on the TPU engine and the CPU
+    backend and diffs results."""
+    if conf:
+        from spark_rapids_tpu.sql.session import TpuSession
+        overrides = dict(session.conf._values)
+        overrides.update(conf)
+        session = TpuSession(overrides)
+    df = df_fn(session)
+    tpu = df.collect()
+    cpu = df.collect_cpu()
+    assert_tables_equal(tpu, cpu, ignore_order, approx_float)
+    return tpu
+
+
+def assert_fallback_collect(df_fn: Callable, session, fallback_exec: str,
+                            ignore_order: bool = False):
+    """Asserts results match AND that the named plan node fell back to CPU
+    (reference assert_gpu_fallback_collect)."""
+    from spark_rapids_tpu.plan.overrides import wrap_and_tag
+    df = df_fn(session)
+    meta = wrap_and_tag(df.plan, session.conf)
+    found = []
+
+    def walk(m):
+        if type(m.plan).__name__ == fallback_exec and not m.can_run_on_tpu:
+            found.append(m)
+        for c in m.children:
+            walk(c)
+
+    walk(meta)
+    assert found, f"{fallback_exec} did not fall back:\n{meta.explain(all_ops=True)}"
+    tpu = df.collect()
+    cpu = df.collect_cpu()
+    assert_tables_equal(tpu, cpu, ignore_order)
+    return tpu

@@ -1,0 +1,31 @@
+"""Test environment: force the XLA CPU backend with 8 virtual devices BEFORE
+jax loads, so the full suite (including multi-chip sharding tests) runs
+without TPU hardware -- the host-simulator capability SURVEY.md §4.4 notes
+the reference lacks (its CI needs real GPUs)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# XLA:CPU fast-math rewrites f64 division into reciprocal-multiply (1 ulp
+# off); the TPU backend is unaffected, but differential tests on the CPU
+# simulator need exact IEEE semantics.
+if "xla_cpu_enable_fast_math" not in flags:
+    flags = (flags + " --xla_cpu_enable_fast_math=false").strip()
+os.environ["XLA_FLAGS"] = flags
+
+import jax  # noqa: E402
+
+# The hosting environment's site customization pins jax_platforms to its TPU
+# plugin regardless of JAX_PLATFORMS; override it explicitly for the suite.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_runtime():
+    yield
+    from spark_rapids_tpu.runtime.semaphore import reset_semaphore
+    reset_semaphore()

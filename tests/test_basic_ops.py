@@ -1,0 +1,130 @@
+"""Differential tests for project/filter/limit/union/range (reference
+integration_tests arithmetic_ops_test.py / cmp_test.py style)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+DATA = {
+    "a": pa.array([1, 2, None, 4, 5, -3, 7, None], pa.int64()),
+    "b": pa.array([1.5, -0.0, 3.25, None, float("nan"), 2.0, -8.5, 0.5]),
+    "c": pa.array([10, 20, 30, 40, None, 60, 70, 80], pa.int32()),
+    "s": pa.array(["foo", "", None, "barbaz", "hello world", "x", "FOO", "foo"]),
+}
+
+
+def make_df(s, parts=1):
+    return s.create_dataframe(dict(DATA), num_partitions=parts)
+
+
+def test_project_arithmetic(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            col("a") + col("c"), col("a") - lit(1), col("a") * col("a"),
+            (col("a") % lit(3)).alias("m"), (-col("a")).alias("neg")),
+        session)
+
+
+def test_project_division(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            (col("a") / col("c")).alias("d"),
+            (col("b") / lit(0.0)).alias("dz"),
+            (col("a") / lit(0)).alias("iz")),
+        session)
+
+
+def test_comparisons(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            col("a") > lit(2), col("b") <= col("a"),
+            (col("a") == col("c")).alias("eq"),
+            col("a").is_null(), col("b").is_not_null(),
+            F.isnan(col("b"))),
+        session)
+
+
+def test_boolean_logic_kleene(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            ((col("a") > lit(1)) & (col("c") > lit(20))).alias("and_"),
+            ((col("a") > lit(1)) | (col("c") > lit(20))).alias("or_"),
+            (~(col("a") > lit(1))).alias("not_")),
+        session)
+
+
+def test_filter(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).filter((col("a") > lit(1)) & col("b").is_not_null()),
+        session)
+
+
+def test_filter_no_match(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).filter(col("a") > lit(1000)), session)
+
+
+def test_conditional(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.when(col("a") > lit(2), lit(1)).when(col("a") > lit(0), lit(2))
+             .otherwise(lit(3)).alias("cw"),
+            F.coalesce(col("a"), col("c"), lit(-1)).alias("co")),
+        session)
+
+
+def test_casts(session):
+    from spark_rapids_tpu import types as T
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            col("a").cast(T.INT32), col("b").cast(T.INT64),
+            col("c").cast(T.FLOAT64), col("a").cast(T.BOOLEAN),
+            col("a").cast(T.STRING).alias("astr")),
+        session)
+
+
+def test_math_functions(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.sqrt(F.abs(col("b"))), F.exp(col("a")),
+            F.log(F.abs(col("b")) + lit(1.0)), F.floor(col("b")), F.ceil(col("b")),
+            F.pow(col("a"), lit(2)), F.round(col("b"), 1),
+            F.greatest(col("a"), col("c")), F.least(col("a"), col("c"))),
+        session, approx_float=1e-12)
+
+
+def test_limit(session):
+    assert_tpu_and_cpu_are_equal_collect(lambda s: make_df(s).limit(3), session)
+
+
+def test_union(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).union(make_df(s)), session)
+
+
+def test_range(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.range(0, 1000, 7).select(col("id") * lit(2)), session)
+
+
+def test_multi_partition_project(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s, parts=3).filter(col("a").is_not_null())
+                   .select((col("a") + lit(1)).alias("a1")),
+        session)
+
+
+def test_in_list(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(col("a").isin(1, 4, 7).alias("in_")),
+        session)
