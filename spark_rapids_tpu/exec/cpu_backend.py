@@ -90,6 +90,11 @@ def _gather_cols(cols: List[CpuCol], idx: np.ndarray) -> List[CpuCol]:
     oob = idx < 0
     safe = np.where(oob, 0, idx)
     for c in cols:
+        if len(c.values) == 0:
+            np_dt = object if isinstance(c.dtype, T.StringType) else c.dtype.np_dtype
+            out.append(CpuCol(c.dtype, np.zeros(len(idx), np_dt),
+                              np.zeros(len(idx), np.bool_)))
+            continue
         vals = c.values[safe]
         if isinstance(c.dtype, T.StringType):
             vals = vals.copy()
@@ -277,6 +282,11 @@ def _agg_by_gid(a: NamedAgg, inp: Optional[CpuCol], gid: np.ndarray,
         cnt = np.bincount(gid, minlength=n_groups).astype(np.int64)
         return CpuCol(T.INT64, cnt, np.ones(n_groups, np.bool_))
     assert inp is not None
+    if isinstance(inp.dtype, (T.Float32Type, T.Float64Type)):
+        # pandas conflates NaN with null; floats need explicit Spark
+        # semantics (NaN is a VALUE: sums/avg propagate it, min/max use the
+        # total order where NaN > +inf).
+        return _agg_float_np(spec, rt, inp, gid, n_groups)
     valid = inp.valid
     if isinstance(inp.dtype, T.StringType):
         ser = pd.Series([v if ok else None for v, ok in zip(inp.values, valid)],
@@ -321,6 +331,63 @@ def _agg_by_gid(a: NamedAgg, inp: Optional[CpuCol], gid: np.ndarray,
         return CpuCol(rt, vals, ~na)
     filled = res.fillna(0).to_numpy(dtype=np.float64)
     return CpuCol(rt, filled.astype(rt.np_dtype), ~na)
+
+
+def _agg_float_np(spec, rt, inp: CpuCol, gid: np.ndarray, n_groups: int) -> CpuCol:
+    ddof = None
+    if isinstance(spec, tuple):
+        spec, ddof = spec
+    v = inp.values.astype(np.float64)
+    valid = inp.valid
+    order = np.argsort(gid, kind="stable")
+    gs, vs, oks = gid[order], v[order], valid[order]
+    starts = np.searchsorted(gs, np.arange(n_groups), side="left")
+    nvalid = np.bincount(gs, weights=oks.astype(np.float64),
+                         minlength=n_groups).astype(np.int64)
+    has = nvalid > 0
+    with np.errstate(all="ignore"):
+        if spec == "count":
+            return CpuCol(T.INT64, nvalid, np.ones(n_groups, np.bool_))
+        if spec in ("sum", "mean", "std", "var"):
+            sums = np.add.reduceat(np.where(oks, vs, 0.0), starts) \
+                if n_groups else np.zeros(0)
+            if spec == "sum":
+                return CpuCol(rt, sums, has)
+            if spec == "mean":
+                return CpuCol(rt, sums / np.maximum(nvalid, 1), has)
+            sq = np.add.reduceat(np.where(oks, vs * vs, 0.0), starts) \
+                if n_groups else np.zeros(0)
+            n_ = nvalid.astype(np.float64)
+            m2 = np.maximum(sq - sums * sums / np.maximum(n_, 1.0), 0.0)
+            # propagate NaN through m2 when sums are NaN
+            m2 = np.where(np.isnan(sums) | np.isnan(sq), np.nan, m2)
+            dd = 1 if ddof is None else ddof
+            denom = n_ - dd
+            var = np.where(denom <= 0, np.nan, m2 / np.where(denom <= 0, 1.0, denom))
+            out = np.sqrt(var) if spec == "std" else var
+            return CpuCol(rt, out, has)
+        if spec in ("min", "max"):
+            # total-order bits reduction
+            vv = np.where(vs == 0.0, 0.0, vs)
+            bits = vv.view(np.uint64)
+            neg = (bits >> np.uint64(63)) != 0
+            key = np.where(neg, ~bits, bits | np.uint64(1 << 63))
+            ident = np.uint64(0xFFFFFFFFFFFFFFFF) if spec == "min" else np.uint64(0)
+            key = np.where(oks, key, ident)
+            red = np.minimum if spec == "min" else np.maximum
+            out_key = red.reduceat(key, starts) if n_groups else key[:0]
+            pos = (out_key & np.uint64(1 << 63)) != 0
+            raw = np.where(pos, out_key ^ np.uint64(1 << 63), ~out_key)
+            out = raw.view(np.float64)
+            return CpuCol(rt, out.astype(rt.np_dtype), has)
+        if spec in ("first", "last"):
+            pos = np.where(oks, np.arange(len(vs)), len(vs) if spec == "first" else -1)
+            red = np.minimum if spec == "first" else np.maximum
+            sel = red.reduceat(pos, starts) if n_groups else pos[:0]
+            ok = (sel >= 0) & (sel < len(vs))
+            out = vs[np.clip(sel, 0, max(len(vs) - 1, 0))]
+            return CpuCol(rt, out.astype(rt.np_dtype), has & ok)
+    raise NotImplementedError(spec)
 
 
 def _global_agg(plan: P.Aggregate, agg_inputs, n: int) -> List[CpuCol]:

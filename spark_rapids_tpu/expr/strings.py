@@ -145,14 +145,15 @@ class Substring(Expression):
         # char index -> byte offset: byte b is the k-th char start where
         # k = char_csum[b] - char_csum[row_start]. Build per-row byte offsets
         # by searching the cumulative char counts.
+        # byte position of char t in a row = last byte index whose prefix
+        # char-count equals csum[row_start]+t (side='right'-1 lands past any
+        # UTF-8 continuation bytes onto the next char-start byte).
         target_start = char_csum[o[:-1]] + start_char
         target_end = char_csum[o[:-1]] + end_char
-        byte_start = jnp.searchsorted(char_csum, target_start, side="left").astype(jnp.int32)
-        byte_end = jnp.searchsorted(char_csum, target_end, side="left").astype(jnp.int32)
-        byte_start = jnp.minimum(byte_start - 1, o[1:])
-        byte_end = jnp.minimum(byte_end - 1, o[1:])
-        byte_start = jnp.maximum(byte_start, o[:-1])
-        byte_end = jnp.maximum(byte_end, byte_start)
+        byte_start = jnp.searchsorted(char_csum, target_start, side="right").astype(jnp.int32) - 1
+        byte_end = jnp.searchsorted(char_csum, target_end, side="right").astype(jnp.int32) - 1
+        byte_start = jnp.clip(byte_start, o[:-1], o[1:])
+        byte_end = jnp.clip(byte_end, byte_start, o[1:])
         out_lens = byte_end - byte_start
         new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                    jnp.cumsum(out_lens).astype(jnp.int32)])

@@ -92,15 +92,27 @@ def segmented_agg(op: str, values: jax.Array, valid: jax.Array,
         masked = jnp.where(valid, values * values, jnp.zeros_like(values))
         out = jax.ops.segment_sum(masked, seg_ids, num_segments=seg_cap)
         return out, nvalid > 0
-    if op == "min":
-        init = _MIN_INIT[np.dtype(vdt)]
+    if op in ("min", "max"):
+        is_float = np.dtype(vdt) in (np.dtype(np.float32), np.dtype(np.float64))
+        if is_float:
+            # Spark total order: NaN greater than +inf, -0.0 == 0.0 via the
+            # order-preserving bit transform; reduce on bits, invert after.
+            width = 32 if np.dtype(vdt) == np.dtype(np.float32) else 64
+            if width == 32:
+                raw = jax.lax.bitcast_convert_type(values, jnp.int32).astype(jnp.int64)
+            else:
+                raw = jax.lax.bitcast_convert_type(values, jnp.int64)
+            bits = K._order_float_bits(raw, width)
+            init = jnp.uint64(0xFFFFFFFFFFFFFFFF) if op == "min" else jnp.uint64(0)
+            masked = jnp.where(valid, bits, init)
+            red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+            out_bits = red(masked, seg_ids, num_segments=seg_cap)
+            out = _invert_float_bits(out_bits, width, vdt)
+            return out, nvalid > 0
+        init = (_MIN_INIT if op == "min" else _MAX_INIT)[np.dtype(vdt)]
         masked = jnp.where(valid, values, jnp.full_like(values, init))
-        out = jax.ops.segment_min(masked, seg_ids, num_segments=seg_cap)
-        return out, nvalid > 0
-    if op == "max":
-        init = _MAX_INIT[np.dtype(vdt)]
-        masked = jnp.where(valid, values, jnp.full_like(values, init))
-        out = jax.ops.segment_max(masked, seg_ids, num_segments=seg_cap)
+        red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        out = red(masked, seg_ids, num_segments=seg_cap)
         return out, nvalid > 0
     if op in ("first", "last"):
         # position of first/last valid row per segment
@@ -124,6 +136,22 @@ def segmented_agg(op: str, values: jax.Array, valid: jax.Array,
         out = jax.ops.segment_min(masked.astype(jnp.int32), seg_ids, num_segments=seg_cap)
         return out.astype(jnp.bool_), nvalid > 0
     raise ValueError(f"unknown segmented op {op}")
+
+
+def _invert_float_bits(bits_u64: jax.Array, width: int, vdt):
+    """Inverse of kernels._order_float_bits."""
+    import jax.lax as lax
+    if width == 64:
+        sign = jnp.uint64(1 << 63)
+        pos = (bits_u64 & sign) != 0
+        raw = jnp.where(pos, bits_u64 ^ sign, ~bits_u64)
+        return lax.bitcast_convert_type(raw.astype(jnp.uint64), jnp.float64)
+    sign = jnp.uint64(0x80000000)
+    mask = jnp.uint64(0xFFFFFFFF)
+    b = bits_u64 & mask
+    pos = (b & sign) != 0
+    raw = jnp.where(pos, b ^ sign, (~b) & mask)
+    return lax.bitcast_convert_type(raw.astype(jnp.uint32), jnp.float32)
 
 
 def gather_group_keys(key_cols: List[ColumnVector], perm: jax.Array,

@@ -1,0 +1,118 @@
+"""Differential join tests (reference join_test.py)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+LEFT = {
+    "k": pa.array([1, 2, 3, 4, None, 2, 7], pa.int64()),
+    "ks": pa.array(["a", "b", "c", None, "e", "b", "g"]),
+    "lv": pa.array([10, 20, 30, 40, 50, 60, 70], pa.int32()),
+}
+RIGHT = {
+    "k": pa.array([2, 3, 3, 5, None, 2], pa.int64()),
+    "ks": pa.array(["b", "c", "x", "e", None, "b"]),
+    "rv": pa.array([200.5, 300.25, 301.0, None, 500.0, 201.75]),
+}
+
+
+def dfs(s, parts=1):
+    return (s.create_dataframe(dict(LEFT), num_partitions=parts),
+            s.create_dataframe(dict(RIGHT), num_partitions=1))
+
+
+ALL_HOW = ["inner", "left", "right", "full", "left_semi", "left_anti"]
+
+
+@pytest.mark.parametrize("how", ALL_HOW)
+def test_join_int_key(session, how):
+    def q(s):
+        l, r = dfs(s)
+        return l.join(r, on=[(col("k"), col("k"))], how=how)
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+def test_join_string_key(session, how):
+    def q(s):
+        l, r = dfs(s)
+        return l.join(r, on=[(col("ks"), col("ks"))], how=how)
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+def test_join_multi_key(session):
+    def q(s):
+        l, r = dfs(s)
+        return l.join(r, on=[(col("k"), col("k")), (col("ks"), col("ks"))],
+                      how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+def test_join_multi_partition_probe(session):
+    def q(s):
+        l, r = dfs(s, parts=3)
+        return l.join(r, on=[(col("k"), col("k"))], how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+def test_join_with_condition(session):
+    def q(s):
+        l, r = dfs(s)
+        return l.join(r, on=[(col("k"), col("k"))], how="inner",
+                      ).filter(col("lv") > lit(20))
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+def test_join_ast_condition(session):
+    """Extra non-equi condition evaluated on joined pairs (reference
+    conditional joins via cudf AST)."""
+    from spark_rapids_tpu.plan import nodes as P
+
+    def q(s):
+        l, r = dfs(s)
+        plan = P.Join(l.plan, r.plan, [col("k")], [col("k")], "left",
+                      condition=col("rv") > lit(201.0))
+        from spark_rapids_tpu.sql.dataframe import DataFrame
+        return DataFrame(plan, s)
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+def test_cross_join(session):
+    def q(s):
+        l, r = dfs(s)
+        return l.select(col("k").alias("lk")).limit(3).join(
+            r.select(col("k").alias("rk")).limit(2), how="cross")
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+def test_self_join_dedupe_on(session):
+    def q(s):
+        l, r = dfs(s)
+        return l.join(r, on="k", how="inner")
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+def test_join_empty_build(session):
+    def q(s):
+        l, r = dfs(s)
+        return l.join(r.filter(col("rv") > lit(1e9)),
+                      on=[(col("k"), col("k"))], how="left")
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+def test_join_then_agg(session):
+    def q(s):
+        l, r = dfs(s)
+        return (l.join(r, on=[(col("k"), col("k"))], how="inner")
+                .group_by(col("lv")).agg(F.sum("rv").alias("srv")))
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)

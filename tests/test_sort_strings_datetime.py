@@ -1,0 +1,231 @@
+"""Sort, string and datetime expression tests plus fallback assertions
+(reference sort_test.py / string_test.py / date_time_test.py and
+assert_gpu_fallback_collect)."""
+import datetime
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.plan.nodes import SortOrder
+
+from asserts import assert_tpu_and_cpu_are_equal_collect, assert_fallback_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+DATA = {
+    "a": pa.array([5, None, 1, 3, 3, None, 2, 8], pa.int64()),
+    "f": pa.array([1.5, float("nan"), None, -0.0, 0.0, 2.5, -3.5, None]),
+    "s": pa.array(["banana", "Apple", None, "", "cherry", "apple", "date", "b"]),
+    "d": pa.array([datetime.date(2024, 1, 15), datetime.date(1999, 12, 31),
+                   None, datetime.date(2024, 2, 29), datetime.date(1970, 1, 1),
+                   datetime.date(2038, 7, 4), datetime.date(2024, 1, 15),
+                   datetime.date(1969, 7, 20)]),
+    "ts": pa.array([datetime.datetime(2024, 1, 15, 10, 30, 45),
+                    datetime.datetime(1999, 12, 31, 23, 59, 59), None,
+                    datetime.datetime(2024, 2, 29, 0, 0, 1),
+                    datetime.datetime(1970, 1, 1, 0, 0, 0),
+                    datetime.datetime(2038, 7, 4, 12, 0, 0),
+                    datetime.datetime(2024, 1, 15, 18, 45, 0),
+                    datetime.datetime(1969, 7, 20, 20, 17, 40)],
+                   pa.timestamp("us")),
+}
+
+
+def make_df(s, parts=1):
+    return s.create_dataframe(dict(DATA), num_partitions=parts)
+
+
+# -- sort -------------------------------------------------------------------
+
+@pytest.mark.parametrize("asc", [True, False])
+def test_sort_int(session, asc):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).order_by(SortOrder(col("a"), ascending=asc)),
+        session)
+
+
+@pytest.mark.parametrize("asc", [True, False])
+@pytest.mark.parametrize("nulls_first", [True, False])
+def test_sort_float_nan(session, asc, nulls_first):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(col("f"), col("a")).order_by(
+            SortOrder(col("f"), ascending=asc, nulls_first=nulls_first),
+            SortOrder(col("a"))),
+        session)
+
+
+def test_sort_multi_key(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s, 2).order_by(
+            SortOrder(col("a"), ascending=True),
+            SortOrder(col("f"), ascending=False)),
+        session)
+
+
+def test_sort_date(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(col("d")).order_by(SortOrder(col("d"))),
+        session)
+
+
+def test_sort_string_falls_back(session):
+    """String ORDER BY requires host sort in round 1 -> CPU fallback with
+    identical results (reference per-op fallback discipline)."""
+    assert_fallback_collect(
+        lambda s: make_df(s).select(col("s")).order_by(SortOrder(col("s"))),
+        session, "Sort")
+
+
+# -- strings ----------------------------------------------------------------
+
+def test_string_length_case(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.length(col("s")).alias("len"), F.upper(col("s")).alias("up"),
+            F.lower(col("s")).alias("lo")),
+        session)
+
+
+def test_string_substring(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.substring(col("s"), 1, 3).alias("s13"),
+            F.substring(col("s"), 2, 2).alias("s22"),
+            F.substring(col("s"), -3, 2).alias("sm3")),
+        session)
+
+
+def test_string_concat(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.concat(col("s"), lit("_x"), col("s")).alias("c")),
+        session)
+
+
+def test_string_predicates(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.startswith(col("s"), "a").alias("sw"),
+            F.endswith(col("s"), "e").alias("ew"),
+            F.contains(col("s"), "an").alias("ct"),
+            (col("s") == lit("apple")).alias("eq")),
+        session)
+
+
+def test_like_transpiled(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.like(col("s"), "a%").alias("p1"),
+            F.like(col("s"), "%e").alias("p2"),
+            F.like(col("s"), "%an%").alias("p3"),
+            F.like(col("s"), "a%e").alias("p4"),
+            F.like(col("s"), "apple").alias("p5")),
+        session)
+
+
+def test_like_complex_falls_back(session):
+    assert_fallback_collect(
+        lambda s: make_df(s).select(F.like(col("s"), "a_b%c").alias("p")),
+        session, "Project")
+
+
+def test_string_group_key_unicode(session):
+    data = {"k": ["héllo", "wörld", "héllo", "日本語", None, "日本語"],
+            "v": [1, 2, 3, 4, 5, 6]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data).group_by(col("k")).agg(
+            F.sum("v").alias("sv")),
+        session, ignore_order=True)
+
+
+def test_utf8_length(session):
+    data = {"s": ["héllo", "日本語", "a🚀b", ""]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data).select(
+            F.length(col("s")).alias("n"),
+            F.substring(col("s"), 2, 2).alias("sub")),
+        session)
+
+
+def test_cast_int_string_roundtrip(session):
+    from spark_rapids_tpu import types as T
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            col("a").cast(T.STRING).alias("astr"),
+            col("a").cast(T.STRING).cast(T.INT64).alias("aint")),
+        session)
+
+
+def test_cast_string_to_int(session):
+    from spark_rapids_tpu import types as T
+    data = {"s": ["42", " -7 ", "abc", "", "123456789012", None, "+5", "1.5"]}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(data).select(
+            col("s").cast(T.INT64).alias("v")),
+        session)
+
+
+# -- datetime ---------------------------------------------------------------
+
+def test_date_parts(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.year(col("d")), F.month(col("d")), F.dayofmonth(col("d")),
+            F.dayofweek(col("d")), F.last_day(col("d")).alias("ld")),
+        session)
+
+
+def test_timestamp_parts(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.year(col("ts")), F.month(col("ts")), F.dayofmonth(col("ts")),
+            F.hour(col("ts")), F.minute(col("ts")), F.second(col("ts"))),
+        session)
+
+
+def test_date_arithmetic(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            F.date_add(col("d"), lit(30)).alias("plus30"),
+            F.date_sub(col("d"), lit(45)).alias("minus45"),
+            F.datediff(col("d"), lit(datetime.date(2000, 1, 1))).alias("dd")),
+        session)
+
+
+def test_date_group_key(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).group_by(col("d")).agg(F.count().alias("c")),
+        session, ignore_order=True)
+
+
+def test_ts_cast_date(session):
+    from spark_rapids_tpu import types as T
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).select(
+            col("ts").cast(T.DATE).alias("d2"),
+            col("d").cast(T.TIMESTAMP).alias("ts2")),
+        session)
+
+
+# -- explain ----------------------------------------------------------------
+
+def test_explain_reports_fallback(session):
+    from spark_rapids_tpu.plan.overrides import explain_plan
+    df = make_df(session).order_by(SortOrder(col("s")))
+    text = explain_plan(df.plan, session.conf, all_ops=True)
+    assert "cannot run on TPU because" in text
+    assert "ORDER BY on strings" in text
+
+
+def test_exec_disable_conf(session):
+    from spark_rapids_tpu.sql.session import TpuSession
+    s2 = TpuSession({"spark.rapids.sql.exec.Filter": "false"})
+    assert_fallback_collect(
+        lambda s: make_df(s).filter(col("a") > lit(2)), s2, "Filter")
