@@ -1,0 +1,149 @@
+"""Round benchmark: hot analytics on TPU vs host CPU.
+
+Scenario: the working set is resident (device HBM via df.cache() for the
+TPU engine — the ParquetCachedBatchSerializer analog; host RAM for the
+pyarrow baseline) and queries run repeatedly — the interactive-analytics
+case the reference accelerates. Two TPC-H-shaped queries:
+
+  q6: filter + sum(price*discount)            (scan/filter/reduce)
+  q1: group by 2 string keys, 5 aggregates    (sort/segmented aggregation)
+
+Prints ONE JSON line: geometric-mean wall-clock speedup vs the pyarrow
+CPU baseline, per-query detail included.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 30_000_000))  # ~SF5 lineitem
+REPS = int(os.environ.get("BENCH_REPS", 5))
+
+LO, HI = 8766, 9131  # [1994-01-01, 1995-01-01) in days since epoch
+
+
+def make_table():
+    import pyarrow as pa
+
+    rng = np.random.default_rng(42)
+    flags = np.array(["A", "N", "R"])[rng.integers(0, 3, ROWS)]
+    status = np.array(["F", "O"])[rng.integers(0, 2, ROWS)]
+    return pa.table({
+        "l_returnflag": flags,
+        "l_linestatus": status,
+        "l_quantity": rng.integers(1, 51, ROWS).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900.0, 105000.0, ROWS), 2),
+        "l_discount": np.round(rng.uniform(0.0, 0.10, ROWS), 2),
+        "l_shipdate": rng.integers(8400, 10600, ROWS).astype(np.int32),
+    })
+
+
+def timeit(fn):
+    fn()  # warmup (compile caches, lazy inits)
+    best, result = None, None
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        result = fn()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best, result
+
+
+def cpu_queries(t):
+    import pyarrow.compute as pc
+
+    def q6():
+        m = pc.and_(
+            pc.and_(
+                pc.and_(pc.greater_equal(t["l_shipdate"], LO),
+                        pc.less(t["l_shipdate"], HI)),
+                pc.and_(pc.greater_equal(t["l_discount"], 0.05),
+                        pc.less_equal(t["l_discount"], 0.07))),
+            pc.less(t["l_quantity"], 24.0))
+        f = t.filter(m)
+        return pc.sum(pc.multiply(f["l_extendedprice"], f["l_discount"])).as_py()
+
+    def q1():
+        f = t.filter(pc.less_equal(t["l_shipdate"], 10471))
+        g = f.group_by(["l_returnflag", "l_linestatus"]).aggregate([
+            ("l_quantity", "sum"), ("l_extendedprice", "sum"),
+            ("l_quantity", "mean"), ("l_discount", "mean"),
+            ("l_quantity", "count"),
+        ])
+        return {tuple(k): v for *k, v in zip(
+            g["l_returnflag"].to_pylist(), g["l_linestatus"].to_pylist(),
+            g["l_quantity_sum"].to_pylist())}
+
+    return q6, q1
+
+
+def tpu_queries(t):
+    from spark_rapids_tpu.sql.session import TpuSession
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.expr.core import col, lit
+
+    sess = TpuSession()
+    cached = sess.create_dataframe(t).cache()
+    cached.count()  # force HBM materialization
+
+    def q6():
+        cond = ((col("l_shipdate") >= lit(LO)) & (col("l_shipdate") < lit(HI))
+                & (col("l_discount") >= lit(0.05)) & (col("l_discount") <= lit(0.07))
+                & (col("l_quantity") < lit(24.0)))
+        out = (cached.filter(cond)
+               .agg(F.sum(col("l_extendedprice") * col("l_discount"))))
+        return list(out.to_pydict().values())[0][0]
+
+    def q1():
+        out = (cached.filter(col("l_shipdate") <= lit(10471))
+               .group_by("l_returnflag", "l_linestatus")
+               .agg(F.sum(col("l_quantity")), F.sum(col("l_extendedprice")),
+                    F.avg(col("l_quantity")), F.avg(col("l_discount")),
+                    F.count(col("l_quantity"))))
+        d = out.to_pydict()
+        return {(rf, ls): s for rf, ls, s in zip(
+            d["l_returnflag"], d["l_linestatus"], d["sum(l_quantity)"])}
+
+    return q6, q1
+
+
+def main():
+    t = make_table()
+    cq6, cq1 = cpu_queries(t)
+    tq6, tq1 = tpu_queries(t)
+
+    detail = {"rows": ROWS}
+    speedups = []
+    for name, cpu_fn, tpu_fn in [("q6", cq6, tq6), ("q1", cq1, tq1)]:
+        cpu_s, cpu_val = timeit(cpu_fn)
+        tpu_s, tpu_val = timeit(tpu_fn)
+        if name == "q6":
+            ok = abs(tpu_val - cpu_val) <= 1e-6 * max(1.0, abs(cpu_val))
+        else:
+            ok = (set(tpu_val) == set(cpu_val) and all(
+                abs(tpu_val[k] - cpu_val[k]) <= 1e-6 * max(1.0, abs(cpu_val[k]))
+                for k in cpu_val))
+        if not ok:
+            print(f"MISMATCH {name}: tpu={tpu_val} cpu={cpu_val}", file=sys.stderr)
+        sp = cpu_s / tpu_s
+        speedups.append(sp)
+        detail[name] = {"tpu_s": round(tpu_s, 4), "cpu_s": round(cpu_s, 4),
+                        "speedup": round(sp, 4), "match": ok}
+
+    geo = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
+    print(json.dumps({
+        "metric": "hot_analytics_q6_q1_geomean_speedup_vs_pyarrow_cpu",
+        "value": round(geo, 4),
+        "unit": "x",
+        "vs_baseline": round(geo, 4),
+        "detail": detail,
+    }))
+
+
+if __name__ == "__main__":
+    main()

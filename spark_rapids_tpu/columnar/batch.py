@@ -38,6 +38,111 @@ def round_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
     return 1 << (n - 1).bit_length()
 
 
+class LazyRowCount:
+    """A row count that lives on device until a host consumer forces it.
+
+    Every device->host scalar readback costs a full round trip (~100ms over
+    a tunneled PJRT link), so operators with data-dependent output sizes
+    (filter, join, group) keep the count as a device scalar. Traced code
+    reads it via `traced_rows` with NO synchronization; host control flow
+    that truly needs the int (capacity decisions, limits, empty checks)
+    materializes it once through the int dunders below.
+
+    The reference pays this as a stream sync per cudf kernel with a dynamic
+    result; deferring it is the TPU-idiomatic answer (SURVEY.md §7.3.1).
+    """
+
+    __slots__ = ("_dev", "_val")
+
+    def __init__(self, dev):
+        self._dev = dev
+        self._val: Optional[int] = None
+
+    def traced(self):
+        return self._dev if self._val is None else self._val
+
+    def materialize(self) -> int:
+        if self._val is None:
+            self._val = int(self._dev)
+        return self._val
+
+    @property
+    def is_materialized(self) -> bool:
+        return self._val is not None
+
+    def __int__(self):
+        return self.materialize()
+
+    __index__ = __int__
+
+    def __bool__(self):
+        return self.materialize() != 0
+
+    def __eq__(self, o):
+        return self.materialize() == o
+
+    def __ne__(self, o):
+        return self.materialize() != o
+
+    def __lt__(self, o):
+        return self.materialize() < o
+
+    def __le__(self, o):
+        return self.materialize() <= o
+
+    def __gt__(self, o):
+        return self.materialize() > o
+
+    def __ge__(self, o):
+        return self.materialize() >= o
+
+    def __add__(self, o):
+        return self.materialize() + o
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self.materialize() - o
+
+    def __rsub__(self, o):
+        return o - self.materialize()
+
+    def __mul__(self, o):
+        return self.materialize() * o
+
+    __rmul__ = __mul__
+
+    def __hash__(self):
+        return hash(self.materialize())
+
+    def __repr__(self):
+        return (f"LazyRowCount({self._val})" if self._val is not None
+                else "LazyRowCount(<device>)")
+
+
+def traced_rows(n):
+    """num_rows as a trace-safe value (device scalar or python int)."""
+    return n.traced() if isinstance(n, LazyRowCount) else n
+
+
+def rows_int(n) -> int:
+    """num_rows as a host int (synchronizes if lazy)."""
+    return int(n)
+
+
+def materialize_counts(batches: Sequence["ColumnarBatch"]) -> None:
+    """Force all lazy row counts in ONE bulk device fetch instead of a
+    serial sync per batch."""
+    lazies = [b.num_rows for b in batches
+              if isinstance(b.num_rows, LazyRowCount) and not b.num_rows.is_materialized]
+    if not lazies:
+        return
+    import jax as _jax
+    vals = _jax.device_get([lz._dev for lz in lazies])
+    for lz, v in zip(lazies, vals):
+        lz._val = int(v)
+
+
 def _pad_to(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
     if arr.shape[0] == capacity:
         return arr
@@ -52,7 +157,13 @@ class ColumnVector:
 
     data:
       - fixed-width types: jnp array[capacity] of the type's np_dtype
-      - StringType: dict(offsets=int32[capacity+1], bytes=uint8[byte_cap])
+      - StringType flat: dict(offsets=int32[capacity+1], bytes=uint8[byte_cap])
+      - StringType dict-encoded: dict(codes=int32[capacity],
+        dict_offsets=int32[k+1], dict_bytes=uint8[m]) — the vocab is small
+        and shared by all rows. Dictionary encoding is the default upload
+        layout for strings: hashing/grouping/equality run over the vocab
+        once and gather by code (string group-bys and joins become integer
+        ops on the MXU/VPU instead of byte-plane work).
     validity: bool[capacity], True = valid. None means all rows < num_rows
       are valid (padded tail is implicitly invalid).
     """
@@ -60,10 +171,17 @@ class ColumnVector:
     dtype: T.DataType
     data: Union[jax.Array, Dict[str, jax.Array]]
     validity: Optional[jax.Array] = None
+    #: dict columns only: True when vocab entries are known distinct
+    #: (dictionary_encode / unified concat). Transformed vocabs (upper()
+    #: can merge 'a' and 'A') set False — bucket-by-code aggregation
+    #: requires code uniqueness.
+    dict_unique: bool = True
 
     @property
     def capacity(self) -> int:
         if isinstance(self.data, dict):
+            if "codes" in self.data:
+                return int(self.data["codes"].shape[0])
             return int(self.data["offsets"].shape[0]) - 1
         return int(self.data.shape[0])
 
@@ -71,12 +189,20 @@ class ColumnVector:
     def is_string(self) -> bool:
         return isinstance(self.dtype, T.StringType)
 
-    def validity_or_default(self, num_rows: int) -> jax.Array:
+    @property
+    def is_dict(self) -> bool:
+        return isinstance(self.data, dict) and "codes" in self.data
+
+    @property
+    def dict_size(self) -> int:
+        return int(self.data["dict_offsets"].shape[0]) - 1
+
+    def validity_or_default(self, num_rows) -> jax.Array:
         """Materialize the validity plane (capacity-length bool)."""
         cap = self.capacity
         if self.validity is not None:
             return self.validity
-        return jnp.arange(cap) < num_rows
+        return jnp.arange(cap) < traced_rows(num_rows)
 
     def device_memory_size(self) -> int:
         def sz(a):
@@ -93,10 +219,25 @@ class ColumnVector:
 
 @dataclasses.dataclass
 class ColumnarBatch:
-    """A set of equal-capacity columns plus the true row count."""
+    """A set of equal-capacity columns plus the true row count.
+
+    row_mask (optional bool[capacity], True = live) is a selection vector:
+    filters mark rows dead instead of gathering survivors (TPU gathers cost
+    O(output); compaction of a mostly-surviving batch is the single most
+    expensive thing you can do on this hardware, while masking is free and
+    fuses into the next op). None means rows [0, num_rows) are live.
+    Operators must treat dead rows as NONEXISTENT (not as null rows).
+    """
 
     columns: List[ColumnVector]
     num_rows: int
+    row_mask: Optional[jax.Array] = None
+
+    def live_mask(self) -> jax.Array:
+        """bool[capacity] marking live rows."""
+        if self.row_mask is not None:
+            return self.row_mask
+        return jnp.arange(self.capacity) < traced_rows(self.num_rows)
 
     @property
     def num_cols(self) -> int:
@@ -131,6 +272,16 @@ def _np_valid_from_arrow(arr) -> Optional[np.ndarray]:
     return np.asarray(arr.is_valid())
 
 
+def _fixed_width_view(arr, np_dtype) -> np.ndarray:
+    """Zero-copy view of a fixed-width pyarrow array's data buffer (a host
+    `.astype()` round trip through object dtype is ~100x slower for
+    date/timestamp columns)."""
+    buf = arr.buffers()[1]
+    view = np.frombuffer(buf, dtype=np_dtype, count=arr.offset + len(arr))
+    out = view[arr.offset:]
+    return out if out.dtype == np_dtype else out.astype(np_dtype)
+
+
 def column_from_arrow(arr, dtype: T.DataType, capacity: int) -> ColumnVector:
     """Build a device ColumnVector from a pyarrow Array (one chunk)."""
     import pyarrow as pa
@@ -140,6 +291,35 @@ def column_from_arrow(arr, dtype: T.DataType, capacity: int) -> ColumnVector:
     valid_np = _np_valid_from_arrow(arr)
 
     if isinstance(dtype, T.StringType):
+        if pa.types.is_dictionary(arr.type):
+            denc = arr
+        else:
+            denc = arr.dictionary_encode()
+        vocab = denc.dictionary
+        # Dictionary layout pays off when the vocab is materially smaller
+        # than the data; otherwise flat offsets+bytes (e.g. unique IDs).
+        if len(vocab) <= max(64, n // 2):
+            codes = denc.indices
+            if codes.null_count:
+                codes = pc.fill_null(codes, 0)
+            codes_np = np.asarray(codes).astype(np.int32)
+            voc = vocab.cast(pa.large_string()) if not pa.types.is_large_string(vocab.type) else vocab
+            voff = np.frombuffer(voc.buffers()[1], dtype=np.int64)
+            voff = voff[voc.offset: voc.offset + len(voc) + 1]
+            base = int(voff[0])
+            vlen = int(voff[-1] - base)
+            vbytes = np.frombuffer(voc.buffers()[2] or b"", dtype=np.uint8)[base: base + vlen]
+            data = {
+                "codes": jnp.asarray(_pad_to(codes_np, capacity)),
+                "dict_offsets": jnp.asarray((voff - base).astype(np.int32)),
+                "dict_bytes": jnp.asarray(np.ascontiguousarray(vbytes)
+                                          if vlen else np.zeros(1, np.uint8)),
+            }
+            if valid_np is None:
+                validity = None
+            else:
+                validity = jnp.asarray(_pad_to(valid_np.astype(np.bool_), capacity, fill=False))
+            return ColumnVector(dtype, data, validity)
         arr = arr.cast(pa.large_string()) if not pa.types.is_large_string(arr.type) else arr
         # fill nulls with "" so offsets stay monotone and bytes well-defined
         filled = pc.fill_null(arr, "")
@@ -174,15 +354,18 @@ def column_from_arrow(arr, dtype: T.DataType, capacity: int) -> ColumnVector:
                 np_arr[i] = int((v.scaleb(scale)).to_integral_value())
         data = jnp.asarray(_pad_to(np_arr, capacity))
     elif isinstance(dtype, T.TimestampType):
-        import pyarrow as pa
         cast = arr.cast(pa.timestamp("us"))
-        np_arr = np.asarray(pc.fill_null(cast, 0)).astype("datetime64[us]").astype(np.int64)
-        data = jnp.asarray(_pad_to(np_arr, capacity))
+        if cast.null_count:
+            cast = pc.fill_null(cast, 0)
+        data = jnp.asarray(_pad_to(_fixed_width_view(cast, np.int64), capacity))
     elif isinstance(dtype, T.DateType):
-        np_arr = np.asarray(pc.fill_null(arr, 0)).astype("datetime64[D]").astype(np.int32)
-        data = jnp.asarray(_pad_to(np_arr, capacity))
+        if arr.null_count:
+            arr = pc.fill_null(arr, 0)
+        data = jnp.asarray(_pad_to(_fixed_width_view(arr, np.int32), capacity))
     else:
-        np_arr = np.asarray(pc.fill_null(arr, 0)).astype(dtype.np_dtype)
+        if arr.null_count:
+            arr = pc.fill_null(arr, 0)
+        np_arr = _fixed_width_view(arr, np.dtype(dtype.np_dtype))
         data = jnp.asarray(_pad_to(np_arr, capacity))
 
     if valid_np is None:
@@ -206,35 +389,73 @@ def from_arrow(table) -> ColumnarBatch:
     return ColumnarBatch(cols, n)
 
 
-def column_to_numpy(col: ColumnVector, num_rows: int):
-    """Device -> host materialization of one column as (values, validity)."""
+def column_to_numpy(col: ColumnVector, num_rows: int, sel=None):
+    """Device -> host materialization of one column as (values, validity).
+    sel: optional host int array of live row positions (selection-mask
+    compaction happens here, on host, where it is a cheap numpy take)."""
     valid = None
     if col.validity is not None:
-        valid = np.asarray(col.validity)[:num_rows]
-    if col.is_string:
-        offsets = np.asarray(col.data["offsets"])[: num_rows + 1]
-        raw = np.asarray(col.data["bytes"])
+        valid = np.asarray(col.validity)
+        valid = valid[sel] if sel is not None else valid[:num_rows]
+    if col.is_dict:
+        codes = np.asarray(col.data["codes"])
+        codes = codes[sel] if sel is not None else codes[:num_rows]
+        offsets = np.asarray(col.data["dict_offsets"])
+        raw = np.asarray(col.data["dict_bytes"])
+        vocab = [bytes(raw[offsets[i]: offsets[i + 1]]).decode("utf-8", "replace")
+                 for i in range(len(offsets) - 1)]
         out = []
-        for i in range(num_rows):
+        for i in range(len(codes)):
             if valid is not None and not valid[i]:
+                out.append(None)
+            else:
+                out.append(vocab[codes[i]])
+        return out, valid
+    if col.is_string:
+        offsets = np.asarray(col.data["offsets"])
+        raw = np.asarray(col.data["bytes"])
+        rows = sel if sel is not None else range(num_rows)
+        out = []
+        for j, i in enumerate(rows):
+            if valid is not None and not valid[j]:
                 out.append(None)
             else:
                 out.append(bytes(raw[offsets[i]: offsets[i + 1]]).decode("utf-8", "replace"))
         return out, valid
-    vals = np.asarray(col.data)[:num_rows]
+    vals = np.asarray(col.data)
+    vals = vals[sel] if sel is not None else vals[:num_rows]
     return vals, valid
 
 
+def fetch_batch_host(batch: ColumnarBatch) -> ColumnarBatch:
+    """Pull every plane of a batch to host in ONE bulk transfer (a
+    per-plane np.asarray costs a round trip each). Returns a batch whose
+    planes are host numpy arrays; the lazy row count rides along."""
+    leaves, treedef = jax.tree_util.tree_flatten(batch)
+    host = jax.device_get(leaves)
+    out = jax.tree_util.tree_unflatten(treedef, host)
+    n = int(out.num_rows)
+    if isinstance(batch.num_rows, LazyRowCount):
+        batch.num_rows._val = n
+    return ColumnarBatch(out.columns, n, out.row_mask)
+
+
 def to_arrow(batch: ColumnarBatch, names: Optional[Sequence[str]] = None):
-    """Device ColumnarBatch -> pyarrow Table (C2R boundary)."""
+    """Device ColumnarBatch -> pyarrow Table (C2R boundary). Selection-mask
+    compaction happens host-side with numpy (free next to the transfer)."""
     import pyarrow as pa
+    batch = fetch_batch_host(batch)
     n = batch.num_rows
+    sel = None
+    if batch.row_mask is not None:
+        sel = np.flatnonzero(np.asarray(batch.row_mask))
+        n = len(sel)
     arrays = []
     fields = []
     for i, col in enumerate(batch.columns):
         name = names[i] if names else f"c{i}"
         at = T.to_arrow(col.dtype)
-        vals, valid = column_to_numpy(col, n)
+        vals, valid = column_to_numpy(col, n, sel)
         if col.is_string:
             arr = pa.array(vals, type=at)
         elif isinstance(col.dtype, T.NullType):
@@ -271,6 +492,63 @@ def from_pydict(d: dict, schema: Optional[T.Schema] = None) -> ColumnarBatch:
 
 def to_pydict(batch: ColumnarBatch, names: Optional[Sequence[str]] = None) -> dict:
     return to_arrow(batch, names).to_pydict()
+
+
+# ---------------------------------------------------------------------------
+# JAX pytree registration: ColumnVector/ColumnarBatch/LazyRowCount pass
+# straight through jax.jit, so a WHOLE operator (filter, group+aggregate,
+# sort, join) fuses into one XLA computation — one dispatch per batch
+# instead of one per kernel. Dtypes are static aux data; row counts are
+# traced scalars (no recompile per batch size, no host sync).
+# ---------------------------------------------------------------------------
+
+def _cv_flatten(c: ColumnVector):
+    if isinstance(c.data, dict):
+        if "codes" in c.data:
+            return ((c.data["codes"], c.data["dict_offsets"],
+                     c.data["dict_bytes"], c.validity),
+                    ("dict", c.dtype, c.dict_unique))
+        return (c.data["offsets"], c.data["bytes"], c.validity), ("str", c.dtype)
+    return (c.data, c.validity), ("fixed", c.dtype)
+
+
+def _cv_unflatten(aux, children):
+    kind, dtype = aux[0], aux[1]
+    if kind == "dict":
+        codes, doff, dby, validity = children
+        return ColumnVector(dtype, {"codes": codes, "dict_offsets": doff,
+                                    "dict_bytes": dby}, validity,
+                            dict_unique=aux[2])
+    if kind == "str":
+        off, by, validity = children
+        return ColumnVector(dtype, {"offsets": off, "bytes": by}, validity)
+    data, validity = children
+    return ColumnVector(dtype, data, validity)
+
+
+def _lrc_flatten(lz: LazyRowCount):
+    return (lz.traced(),), None
+
+
+def _lrc_unflatten(aux, children):
+    v = children[0]
+    return v if isinstance(v, int) else LazyRowCount(v)
+
+
+def _cb_flatten(b: ColumnarBatch):
+    return (b.columns, b.num_rows, b.row_mask), None
+
+
+def _cb_unflatten(aux, children):
+    cols, n, row_mask = children
+    if not isinstance(n, (int, LazyRowCount)):
+        n = LazyRowCount(n)  # raw int leaves come back as device scalars
+    return ColumnarBatch(cols, n, row_mask)
+
+
+jax.tree_util.register_pytree_node(ColumnVector, _cv_flatten, _cv_unflatten)
+jax.tree_util.register_pytree_node(LazyRowCount, _lrc_flatten, _lrc_unflatten)
+jax.tree_util.register_pytree_node(ColumnarBatch, _cb_flatten, _cb_unflatten)
 
 
 def empty_like_schema(schema: T.Schema, capacity: int = MIN_CAPACITY) -> ColumnarBatch:

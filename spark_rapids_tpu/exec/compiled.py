@@ -29,22 +29,25 @@ _STAGE_CACHE: Dict[Tuple, object] = {}
 
 def _planes_of(col: ColumnVector):
     if isinstance(col.data, dict):
-        return {"offsets": col.data["offsets"], "bytes": col.data["bytes"],
-                "validity": col.validity}
+        out = dict(col.data)
+        out["validity"] = col.validity
+        return out
     return {"data": col.data, "validity": col.validity}
 
 
 def _col_from_planes(planes, dtype: T.DataType) -> ColumnVector:
-    if "offsets" in planes:
-        return ColumnVector(dtype, {"offsets": planes["offsets"],
-                                    "bytes": planes["bytes"]}, planes["validity"])
-    return ColumnVector(dtype, planes["data"], planes["validity"])
+    planes = dict(planes)
+    validity = planes.pop("validity")
+    if "data" in planes:
+        return ColumnVector(dtype, planes["data"], validity)
+    return ColumnVector(dtype, planes, validity)
 
 
 def _layout_key(col: ColumnVector):
     if isinstance(col.data, dict):
-        return ("str", col.data["offsets"].shape, col.data["bytes"].shape,
-                col.validity is None)
+        kind = "dict" if "codes" in col.data else "str"
+        return (kind,) + tuple(sorted((k, v.shape) for k, v in col.data.items())) \
+            + (col.validity is None,)
     return (str(col.data.dtype), col.data.shape, col.validity is None)
 
 
@@ -59,9 +62,12 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
     out_dtypes = [e.data_type() for e in exprs]
 
     if fn is None:
-        def stage(col_planes, num_rows):
+        cap = batch.capacity  # capture the int, NOT the batch (a closure
+        # holding the batch would pin its device planes in the stage cache)
+
+        def stage(col_planes, num_rows, live):
             cols = [_col_from_planes(p, dt) for p, dt in zip(col_planes, in_dtypes)]
-            ctx = EvalCtx(cols, num_rows, batch.capacity, ansi)
+            ctx = EvalCtx(cols, num_rows, cap, ansi, live=live)
             outs = [e.eval_tpu(ctx) for e in exprs]
             out_planes = [_planes_of(c) for c in outs]
             err = {code: mask for code, mask in ctx.errors}
@@ -70,19 +76,27 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
         fn = jax.jit(stage)
         _STAGE_CACHE[key] = fn
 
+    from spark_rapids_tpu.columnar.batch import traced_rows
     col_planes = [_planes_of(c) for c in batch.columns]
-    out_planes, err = fn(col_planes, jnp.int32(batch.num_rows))
+    out_planes, err = fn(col_planes, jnp.asarray(traced_rows(batch.num_rows), jnp.int32),
+                         batch.live_mask())
+    raise_errors(err)
+    return [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
+
+
+def raise_errors(err: Dict[str, jax.Array]) -> None:
+    """Check ANSI error planes from a fused stage. Only synchronizes when
+    the stage ran in ANSI mode and produced error masks."""
     if err:
         for code, mask in err.items():
             if bool(jnp.any(mask)):
                 raise SparkException(f"[{code}] ANSI mode error in stage")
-    return [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
 
 
 def run_projection(exprs: Sequence[Expression], batch: ColumnarBatch,
                    ansi: bool = False) -> ColumnarBatch:
     cols = run_stage(exprs, batch, ansi)
-    return ColumnarBatch(cols, batch.num_rows)
+    return ColumnarBatch(cols, batch.num_rows, batch.row_mask)
 
 
 def can_compile(e: Expression) -> Tuple[bool, str]:

@@ -1,0 +1,33 @@
+"""Whole-operator fusion: one jitted XLA computation per operator stage.
+
+Reference parity/divergence: the reference calls one cuDF kernel per
+primitive (a gather here, a hash there) — cheap when the device is on the
+local PCIe bus. Over a tunneled PJRT link every eager dispatch costs
+milliseconds, so this framework fuses an ENTIRE operator (expression eval
++ filter-compact, or expression eval + sort + segmented aggregation) into
+a single jit'd function over ColumnarBatch pytrees. XLA then fuses across
+the whole stage; the host issues exactly one call per operator per batch.
+
+The cache is keyed by a semantic fingerprint (expression fingerprints +
+operator shape); jax.jit's own signature cache handles layout/capacity
+variation beneath each entry.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+
+_FUSE_CACHE: Dict[Tuple, Callable] = {}
+
+
+def fused(key: Tuple, builder: Callable[[], Callable]) -> Callable:
+    fn = _FUSE_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(builder())
+        _FUSE_CACHE[key] = fn
+    return fn
+
+
+def clear_cache() -> None:
+    _FUSE_CACHE.clear()

@@ -22,16 +22,19 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import (
-    ColumnVector, ColumnarBatch, from_arrow, to_arrow, round_capacity,
+    ColumnVector, ColumnarBatch, LazyRowCount, from_arrow, to_arrow,
+    round_capacity, traced_rows,
 )
 from spark_rapids_tpu.exec import compiled
 from spark_rapids_tpu.exec import cpu_backend as CPU
-from spark_rapids_tpu.expr.core import BoundRef, Cast, Expression
+from spark_rapids_tpu.exec import fuse
+from spark_rapids_tpu.expr.core import Alias, BoundRef, Cast, EvalCtx, Expression
 from spark_rapids_tpu.expr.aggregates import CountAll
 from spark_rapids_tpu.ops import groupby as G
 from spark_rapids_tpu.ops import join as J
@@ -143,6 +146,44 @@ class ParquetScanExec(TpuExec):
             out_rows.add(rb.num_rows)
 
 
+class CachedScanExec(TpuExec):
+    """Materializes the child once into HBM-resident batches stored on the
+    CachedRelation plan node (shared across collects of the same
+    DataFrame); later scans stream straight from device memory."""
+
+    _lock = threading.Lock()
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    @property
+    def num_partitions(self):
+        if self.plan.materialized is not None:
+            return len(self.plan.materialized)
+        return self.children[0].num_partitions
+
+    def _materialize(self):
+        with CachedScanExec._lock:
+            if self.plan.materialized is None:
+                child = self.children[0]
+                out = []
+                for p in range(child.num_partitions):
+                    with TaskContext(partition_id=p) as tctx:
+                        batches = list(child.execute_partition(tctx, p))
+                    if batches:
+                        # ONE device batch per partition: every query over
+                        # the cache then costs a fixed handful of fused
+                        # dispatches instead of one chain per source chunk.
+                        batches = [K.compact_batch(K.concat_batches(batches))]
+                    out.append(batches)
+                self.plan.materialized = out
+        return self.plan.materialized
+
+    def execute_partition(self, ctx, pidx):
+        yield from self._materialize()[pidx]
+
+
 class RangeExec(TpuExec):
     @property
     def num_partitions(self):
@@ -168,29 +209,76 @@ class RangeExec(TpuExec):
 
 
 class ProjectExec(TpuExec):
+    def _trivial_indices(self):
+        """Pure column selection (only BoundRef / Alias(BoundRef)) costs no
+        kernel at all: planes are shared, just re-listed."""
+        idx = []
+        for e in self.plan.exprs:
+            inner = e.children[0] if isinstance(e, Alias) else e
+            if isinstance(inner, BoundRef) and inner.dtype == e.data_type():
+                idx.append(inner.index)
+            else:
+                return None
+        return idx
+
     def execute_partition(self, ctx, pidx):
         op_t = self.metrics.metric(M.OP_TIME)
         ansi = self.conf.get(C.ANSI_ENABLED)
+        exprs = self.plan.exprs
+        trivial = self._trivial_indices()
+        if trivial is not None:
+            for batch in self.children[0].execute_partition(ctx, pidx):
+                yield ColumnarBatch([batch.columns[i] for i in trivial],
+                                    batch.num_rows, batch.row_mask)
+            return
+
+        def build():
+            def fn(batch):
+                ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                               batch.capacity, ansi, live=batch.live_mask())
+                cols = [e.eval_tpu(ectx) for e in exprs]
+                return (ColumnarBatch(cols, batch.num_rows, batch.row_mask),
+                        dict(ectx.errors))
+            return fn
+
+        key = ("project", tuple(e.fingerprint() for e in exprs), ansi)
+        fn = fuse.fused(key, build)
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
             with op_t.ns():
-                yield compiled.run_projection(self.plan.exprs, batch, ansi)
+                out, errs = fn(batch)
+            compiled.raise_errors(errs)
+            yield out
 
 
 class FilterExec(TpuExec):
+    """Predicate eval + compaction fused into ONE jitted computation per
+    batch; the surviving-row count stays on device (LazyRowCount)."""
+
     def execute_partition(self, ctx, pidx):
         op_t = self.metrics.metric(M.FILTER_TIME)
         out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
         ansi = self.conf.get(C.ANSI_ENABLED)
+        cond = self.plan.condition
+
+        def build():
+            def fn(batch):
+                ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                               batch.capacity, ansi, live=batch.live_mask())
+                pred = cond.eval_tpu(ectx)
+                mask = (pred.data.astype(jnp.bool_)
+                        & pred.validity_or_default(batch.num_rows))
+                return K.mask_filter_batch(batch, mask), dict(ectx.errors)
+            return fn
+
+        fn = fuse.fused(("filter", cond.fingerprint(), ansi), build)
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
             with op_t.ns():
-                [pred] = compiled.run_stage([self.plan.condition], batch, ansi)
-                mask = pred.data.astype(jnp.bool_) & pred.validity_or_default(batch.num_rows)
-                out = K.filter_batch(batch, mask)
+                out, errs = fn(batch)
+            compiled.raise_errors(errs)
             out_rows.add(out.num_rows)
-            if out.num_rows or batch.num_rows == 0:
-                yield out
+            yield out
 
 
 class LimitExec(TpuExec):
@@ -199,6 +287,8 @@ class LimitExec(TpuExec):
         for batch in self.children[0].execute_partition(ctx, pidx):
             if remaining <= 0:
                 break
+            if batch.row_mask is not None:
+                batch = K.compact_batch(batch)
             if batch.num_rows <= remaining:
                 remaining -= batch.num_rows
                 yield batch
@@ -290,6 +380,8 @@ class SortExec(TpuExec):
             return
         self._acquire(ctx)
         batch = K.concat_batches(batches) if len(batches) > 1 else batches[0]
+        if batch.row_mask is not None:
+            batch = K.compact_batch(batch)
         with sort_t.ns():
             key_exprs = [o.expr for o in self.plan.orders]
             key_cols = compiled.run_stage(key_exprs, batch)
@@ -302,6 +394,287 @@ class SortExec(TpuExec):
             yield K.gather_batch(batch, perm, batch.num_rows)
 
 
+
+class _AggKernels:
+    """Aggregation kernel builders holding ONLY expression-level state.
+
+    Deliberately separate from the exec node: the jitted closures built
+    here live in the global fuse cache; if they captured the exec they
+    would pin its child tree — including HBM-resident cached batches —
+    for the process lifetime.
+    """
+
+    _BUCKET_LIMIT = 4096
+    _MATMUL_LIMIT = 64
+
+    def __init__(self, group_exprs, group_names, aggs, pre_filter):
+        self.group_exprs = group_exprs
+        self.group_names = group_names
+        self.aggs = aggs
+        self.pre_filter = pre_filter
+
+    def _state_input_exprs(self):
+        """Expressions evaluated per input row: keys then, per agg, its input
+        cast to each state dtype that needs the raw input."""
+        exprs = list(self.group_exprs)
+        for a in self.aggs:
+            if a.fn.children:
+                exprs.append(a.fn.children[0])
+            else:
+                exprs.append(None)
+        return exprs
+
+    def _build_update(self, ansi: bool):
+        """Build the fused update phase: expression eval + sort-group +
+        segmented reductions as ONE traced computation over batch pytrees."""
+        def fn(batch):
+            live = batch.live_mask()
+            errs = {}
+            if self.pre_filter is not None:
+                pctx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                               batch.capacity, ansi, live=live)
+                pred = self.pre_filter.eval_tpu(pctx)
+                live = live & pred.data.astype(jnp.bool_)
+                if pred.validity is not None:
+                    live = live & pred.validity
+                batch = ColumnarBatch(
+                    batch.columns,
+                    LazyRowCount(jnp.sum(live.astype(jnp.int32))), live)
+                errs.update(pctx.errors)
+            ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                           batch.capacity, ansi, live=live)
+            out = self._update_batch(batch, ectx)
+            errs.update(ectx.errors)
+            return out, errs
+        return fn
+
+    def _update_batch(self, batch: ColumnarBatch, ectx) -> ColumnarBatch:
+        nkeys = len(self.group_exprs)
+        exprs = [e for e in self._state_input_exprs() if e is not None]
+        cols = [e.eval_tpu(ectx) for e in exprs]
+        key_cols = cols[:nkeys]
+        input_cols = {}
+        ci = nkeys
+        for ai, a in enumerate(self.aggs):
+            if a.fn.children:
+                input_cols[ai] = cols[ci]
+                ci += 1
+        cap = batch.capacity
+        live = batch.live_mask()
+
+        def col_valid(src):
+            return live if src.validity is None else (src.validity & live)
+
+        if nkeys == 0:
+            out_cols = []
+            for ai, a in enumerate(self.aggs):
+                for (sname, sdt), (op, idx) in zip(a.fn.state_schema(),
+                                                   a.fn.update_ops()):
+                    if idx >= 0:
+                        src = input_cols[ai]
+                        if src.is_string:
+                            raise NotImplementedError("string agg state on device")
+                        vals = src.data
+                        if vals.dtype != sdt.np_dtype:
+                            vals = vals.astype(sdt.np_dtype)
+                        ov, oval = G.global_agg(op, vals, col_valid(src))
+                    else:
+                        ov, oval = G.global_agg(op, jnp.zeros(cap, sdt.np_dtype), live)
+                    out_cols.append(_resize_plane(ov, oval, sdt, round_capacity(1)))
+            return ColumnarBatch(out_cols, 1)
+
+        fast = self._bucket_layout(key_cols)
+        if fast is not None:
+            return self._bucket_update(batch, key_cols, input_cols, live, fast)
+
+        if nkeys:
+            # Deferred shrink: output keeps the input capacity and the group
+            # count stays on device (LazyRowCount); the shrink to the true
+            # size happens once, at yield, not per batch.
+            perm, seg_ids, boundary = G.group_segments(key_cols, batch.num_rows,
+                                                       live=live)
+            n_groups = LazyRowCount(jnp.sum(boundary.astype(jnp.int32)))
+            seg_cap = cap
+            out_cap = cap
+        else:
+            perm = jnp.arange(cap, dtype=jnp.int32)
+            seg_ids = jnp.zeros(cap, jnp.int32)
+            boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+            n_groups = 1
+            seg_cap = 1
+            out_cap = round_capacity(1)
+        out_cols: List[ColumnVector] = []
+        if nkeys:
+            out_key_cols = G.gather_group_keys(key_cols, perm, boundary,
+                                               n_groups, batch.num_rows)
+            for c in out_key_cols:
+                out_cols.append(_resize_col(c, out_cap))
+        for ai, a in enumerate(self.aggs):
+            for (sname, sdt), (op, idx) in zip(a.fn.state_schema(), a.fn.update_ops()):
+                if idx >= 0:
+                    src = input_cols[ai]
+                    vals = src.data if not src.is_string else None
+                    if src.is_string:
+                        # min/max/first/last over strings: handled via host
+                        # fallback by tagging; sum/count never string
+                        raise NotImplementedError("string agg state on device")
+                    vals = vals.astype(sdt.np_dtype) if vals.dtype != sdt.np_dtype else vals
+                    sorted_vals = vals[perm]
+                    sorted_valid = col_valid(src)[perm]
+                else:
+                    sorted_vals = jnp.zeros(cap, sdt.np_dtype)
+                    sorted_valid = live[perm]
+                ov, oval = G.segmented_agg(op, sorted_vals, sorted_valid,
+                                           seg_ids, seg_cap)
+                out_cols.append(_resize_plane(ov, oval, sdt, out_cap))
+        return ColumnarBatch(out_cols, n_groups)
+
+    # -- bucketed (MXU) aggregation fast path ------------------------------
+
+    _BUCKET_LIMIT = 4096
+    _MATMUL_LIMIT = 64
+
+    def _bucket_layout(self, key_cols):
+        """When every group key has a small static cardinality (dict-encoded
+        strings, booleans), groups map to dense bucket ids and aggregation
+        needs NO sort: sums/counts become a one-hot matmul on the MXU (tiny
+        bucket spaces) or a bounded scatter-add. Returns per-key
+        (cardinality+1) strides or None if ineligible. The +1 slot per key
+        encodes NULL (Spark groups null keys)."""
+        sizes = []
+        for c in key_cols:
+            if c.is_dict and c.dict_unique:
+                sizes.append(c.dict_size + 1)
+            elif isinstance(c.dtype, T.BooleanType):
+                sizes.append(3)
+            else:
+                return None
+        total = 1
+        for s in sizes:
+            total *= s
+            if total > self._BUCKET_LIMIT:
+                return None
+        return sizes
+
+    def _bucket_update(self, batch, key_cols, input_cols, live, sizes):
+        B = 1
+        for s in sizes:
+            B *= s
+        bucket = jnp.zeros(batch.capacity, jnp.int32)
+        for c, s in zip(key_cols, sizes):
+            if c.is_dict:
+                code = c.data["codes"].astype(jnp.int32)
+            else:
+                code = c.data.astype(jnp.int32)
+            null_code = s - 1
+            if c.validity is not None:
+                code = jnp.where(c.validity, code, null_code)
+            bucket = bucket * s + jnp.clip(code, 0, null_code)
+        if B <= self._MATMUL_LIMIT:
+            occupancy = jnp.stack([jnp.any(live & (bucket == b))
+                                   for b in range(B)])
+        else:
+            occupancy = (jax.ops.segment_sum(
+                jnp.where(live, 1, 0), jnp.where(live, bucket, B),
+                num_segments=B + 1)[:B] > 0)
+        out_cols: List[ColumnVector] = []
+        # reconstruct key columns from the bucket index (B is small)
+        codes = []
+        rem = jnp.arange(B, dtype=jnp.int32)
+        for s in reversed(sizes):
+            codes.append(rem % s)
+            rem = rem // s
+        codes.reverse()
+        for c, s, code in zip(key_cols, sizes, codes):
+            kvalid = code < (s - 1)
+            if c.is_dict:
+                data = {"codes": code.astype(jnp.int32),
+                        "dict_offsets": c.data["dict_offsets"],
+                        "dict_bytes": c.data["dict_bytes"]}
+                out_cols.append(ColumnVector(c.dtype, data, kvalid))
+            else:
+                out_cols.append(ColumnVector(c.dtype, code.astype(c.data.dtype), kvalid))
+        for ai, a in enumerate(self.aggs):
+            for (sname, sdt), (op, idx) in zip(a.fn.state_schema(), a.fn.update_ops()):
+                if idx >= 0:
+                    src = input_cols[ai]
+                    if src.is_string:
+                        raise NotImplementedError("string agg state on device")
+                    vals = src.data
+                    vals = vals.astype(sdt.np_dtype) if vals.dtype != sdt.np_dtype else vals
+                    valid = live if src.validity is None else (src.validity & live)
+                else:
+                    vals = jnp.zeros(batch.capacity, sdt.np_dtype)
+                    valid = live
+                ov, oval = G.bucket_agg(op, vals, valid, bucket, B,
+                                        matmul_ok=B <= self._MATMUL_LIMIT)
+                out_cols.append(ColumnVector(sdt, ov, oval))
+        n_groups = LazyRowCount(jnp.sum(occupancy.astype(jnp.int32)))
+        return ColumnarBatch(out_cols, n_groups, occupancy)
+
+    def _merge_states(self, batch: ColumnarBatch) -> ColumnarBatch:
+        nkeys = len(self.group_exprs)
+        cap = batch.capacity
+        live = batch.live_mask()
+        if nkeys == 0:
+            out_cols = []
+            ci = 0
+            for a in self.aggs:
+                for (sname, sdt), op in zip(a.fn.state_schema(), a.fn.merge_ops()):
+                    src = batch.columns[ci]
+                    ci += 1
+                    src_valid = live if src.validity is None else (src.validity & live)
+                    ov, oval = G.global_agg(op, src.data, src_valid)
+                    out_cols.append(_resize_plane(ov, oval, sdt, round_capacity(1)))
+            return ColumnarBatch(out_cols, 1)
+        key_cols = batch.columns[:nkeys]
+        if nkeys:
+            perm, seg_ids, boundary = G.group_segments(key_cols, batch.num_rows,
+                                                       live=live)
+            n_groups = LazyRowCount(jnp.sum(boundary.astype(jnp.int32)))
+            seg_cap = cap
+            out_cap = cap
+        else:
+            perm = jnp.arange(cap, dtype=jnp.int32)
+            seg_ids = jnp.zeros(cap, jnp.int32)
+            boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+            n_groups = 1
+            seg_cap = 1
+            out_cap = round_capacity(1)
+        out_cols = []
+        if nkeys:
+            for c in G.gather_group_keys(key_cols, perm, boundary, n_groups,
+                                         batch.num_rows):
+                out_cols.append(_resize_col(c, out_cap))
+        ci = nkeys
+        for a in self.aggs:
+            for (sname, sdt), op in zip(a.fn.state_schema(), a.fn.merge_ops()):
+                src = batch.columns[ci]
+                ci += 1
+                sorted_vals = src.data[perm]
+                src_valid = live if src.validity is None else (src.validity & live)
+                ov, oval = G.segmented_agg(op, sorted_vals, src_valid[perm],
+                                           seg_ids, seg_cap)
+                out_cols.append(_resize_plane(ov, oval, sdt, out_cap))
+        return ColumnarBatch(out_cols, n_groups)
+
+    def _evaluate_states(self, state: ColumnarBatch) -> ColumnarBatch:
+        nkeys = len(self.group_exprs)
+        out_cols = list(state.columns[:nkeys])
+        ci = nkeys
+        for a in self.aggs:
+            n_state = len(a.fn.state_schema())
+            scols = state.columns[ci: ci + n_state]
+            ci += n_state
+            res = a.fn.evaluate_tpu(scols, state.num_rows)
+            # clamp dtype
+            rt = a.fn.result_type()
+            if not res.is_string and res.data.dtype != np.dtype(rt.np_dtype):
+                res = ColumnVector(rt, res.data.astype(rt.np_dtype), res.validity)
+            out_cols.append(res)
+        return ColumnarBatch(out_cols, state.num_rows, state.row_mask)
+
+
 class HashAggregateExec(TpuExec):
     """Sort-based segmented aggregation in three phases (reference
     GpuAggregateExec.scala three-pass design §2.4):
@@ -312,10 +685,15 @@ class HashAggregateExec(TpuExec):
     State layout: [key_0..key_k, agg0_state0.., agg1_state0..].
     """
 
-    def __init__(self, plan, children, conf, mode: str):
+    def __init__(self, plan, children, conf, mode: str, pre_filter=None):
         super().__init__(plan, children, conf)
         assert mode in ("partial", "final", "complete")
         self.mode = mode
+        self.kern = _AggKernels(plan.group_exprs, plan.group_names,
+                                plan.aggs, pre_filter)
+        # A filter condition absorbed into the update kernel (predicate
+        # fusion): scan -> filter -> partial agg runs as ONE dispatch.
+        self.pre_filter = pre_filter
 
     # ---- schema of the partial (state) batches ----
     def state_fields(self):
@@ -332,17 +710,33 @@ class HashAggregateExec(TpuExec):
             return T.Schema(tuple(self.state_fields()))
         return self.plan.schema
 
+    def _sig(self, phase: str, ansi: bool = False):
+        p = self.plan
+        gfp = tuple(e.fingerprint() for e in p.group_exprs)
+        afp = tuple((type(a.fn).__name__,)
+                    + tuple(c.fingerprint() for c in a.fn.children)
+                    for a in p.aggs)
+        pf = self.pre_filter.fingerprint() if self.pre_filter is not None else None
+        return ("hashagg", phase, gfp, afp, ansi, pf)
+
     def execute_partition(self, ctx, pidx):
         agg_t = self.metrics.metric(M.AGG_TIME)
         child_batches = self.children[0].execute_partition(ctx, pidx)
         nkeys = len(self.plan.group_exprs)
 
         if self.mode in ("partial", "complete"):
+            ansi = self.conf.get(C.ANSI_ENABLED)
+            update_fn = fuse.fused(self._sig("update", ansi),
+                                   lambda: self.kern._build_update(ansi))
             partials = []
             for batch in child_batches:
                 self._acquire(ctx)
                 with agg_t.ns():
-                    partials.append(self._update_batch(batch))
+                    out, errs = update_fn(batch)
+                    compiled.raise_errors(errs)
+                    if nkeys == 0:
+                        out = ColumnarBatch(out.columns, 1)
+                    partials.append(out)
             if not partials:
                 if nkeys == 0:
                     partials = [self._empty_state_batch()]
@@ -361,123 +755,31 @@ class HashAggregateExec(TpuExec):
             self._acquire(ctx)
             with agg_t.ns():
                 merged = self._merge(partials)
-                if self.mode == "partial":
-                    yield merged
-                else:
-                    yield self._evaluate(merged)
+                out = merged if self.mode == "partial" else self._evaluate(merged)
+                yield K.compact_batch(out)
 
     # -- phase helpers -----------------------------------------------------
 
-    def _state_input_exprs(self):
-        """Expressions evaluated per input row: keys then, per agg, its input
-        cast to each state dtype that needs the raw input."""
-        exprs = list(self.plan.group_exprs)
-        for a in self.plan.aggs:
-            if a.fn.children:
-                exprs.append(a.fn.children[0])
-            else:
-                exprs.append(None)
-        return exprs
-
-    def _update_batch(self, batch: ColumnarBatch) -> ColumnarBatch:
-        nkeys = len(self.plan.group_exprs)
-        exprs = [e for e in self._state_input_exprs() if e is not None]
-        cols = compiled.run_stage(exprs, batch) if exprs else []
-        key_cols = cols[:nkeys]
-        input_cols = {}
-        ci = nkeys
-        for ai, a in enumerate(self.plan.aggs):
-            if a.fn.children:
-                input_cols[ai] = cols[ci]
-                ci += 1
-        cap = batch.capacity
-        if nkeys:
-            perm, seg_ids, boundary = G.group_segments(key_cols, batch.num_rows)
-            n_groups = G.num_groups(boundary)
-            seg_cap = cap
-        else:
-            perm = jnp.arange(cap, dtype=jnp.int32)
-            seg_ids = jnp.zeros(cap, jnp.int32)
-            boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True)
-            n_groups = 1
-            seg_cap = 1
-        out_cap = round_capacity(max(n_groups, 1))
-        out_cols: List[ColumnVector] = []
-        if nkeys:
-            out_key_cols = G.gather_group_keys(key_cols, perm, boundary,
-                                               n_groups, batch.num_rows)
-            for c in out_key_cols:
-                out_cols.append(_resize_col(c, out_cap))
-        for ai, a in enumerate(self.plan.aggs):
-            for (sname, sdt), (op, idx) in zip(a.fn.state_schema(), a.fn.update_ops()):
-                if idx >= 0:
-                    src = input_cols[ai]
-                    vals = src.data if not src.is_string else None
-                    if src.is_string:
-                        # min/max/first/last over strings: handled via host
-                        # fallback by tagging; sum/count never string
-                        raise NotImplementedError("string agg state on device")
-                    vals = vals.astype(sdt.np_dtype) if vals.dtype != sdt.np_dtype else vals
-                    sorted_vals = vals[perm]
-                    sorted_valid = src.validity_or_default(batch.num_rows)[perm]
-                else:
-                    sorted_vals = jnp.zeros(cap, sdt.np_dtype)
-                    sorted_valid = jnp.arange(cap) < batch.num_rows
-                ov, oval = G.segmented_agg(op, sorted_vals, sorted_valid,
-                                           seg_ids, seg_cap)
-                out_cols.append(_resize_plane(ov, oval, sdt, out_cap))
-        return ColumnarBatch(out_cols, n_groups)
-
     def _merge(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
-        batch = K.concat_batches(partials) if len(partials) > 1 else partials[0]
+        if len(partials) == 1:
+            # A single partial already has unique keys — merging is identity.
+            return partials[0]
+        batch = K.concat_batches(partials)
         nkeys = len(self.plan.group_exprs)
         if nkeys == 0 and batch.num_rows <= 1:
             return batch
-        cap = batch.capacity
-        key_cols = batch.columns[:nkeys]
-        if nkeys:
-            perm, seg_ids, boundary = G.group_segments(key_cols, batch.num_rows)
-            n_groups = G.num_groups(boundary)
-            seg_cap = cap
-        else:
-            perm = jnp.arange(cap, dtype=jnp.int32)
-            seg_ids = jnp.zeros(cap, jnp.int32)
-            boundary = jnp.zeros(cap, jnp.bool_).at[0].set(True)
-            n_groups = 1
-            seg_cap = 1
-        out_cap = round_capacity(max(n_groups, 1))
-        out_cols = []
-        if nkeys:
-            for c in G.gather_group_keys(key_cols, perm, boundary, n_groups,
-                                         batch.num_rows):
-                out_cols.append(_resize_col(c, out_cap))
-        ci = nkeys
-        for a in self.plan.aggs:
-            for (sname, sdt), op in zip(a.fn.state_schema(), a.fn.merge_ops()):
-                src = batch.columns[ci]
-                ci += 1
-                sorted_vals = src.data[perm]
-                sorted_valid = src.validity_or_default(batch.num_rows)[perm]
-                ov, oval = G.segmented_agg(op, sorted_vals, sorted_valid,
-                                           seg_ids, seg_cap)
-                out_cols.append(_resize_plane(ov, oval, sdt, out_cap))
-        return ColumnarBatch(out_cols, n_groups)
+        fn = fuse.fused(self._sig("merge"), lambda: self.kern._merge_states)
+        out = fn(batch)
+        if nkeys == 0:
+            out = ColumnarBatch(out.columns, 1)
+        return out
 
     def _evaluate(self, state: ColumnarBatch) -> ColumnarBatch:
         nkeys = len(self.plan.group_exprs)
-        out_cols = list(state.columns[:nkeys])
-        ci = nkeys
-        for a in self.plan.aggs:
-            n_state = len(a.fn.state_schema())
-            scols = state.columns[ci: ci + n_state]
-            ci += n_state
-            res = a.fn.evaluate_tpu(scols, state.num_rows)
-            # clamp dtype
-            rt = a.fn.result_type()
-            if not res.is_string and res.data.dtype != np.dtype(rt.np_dtype):
-                res = ColumnVector(rt, res.data.astype(rt.np_dtype), res.validity)
-            out_cols.append(res)
-        return ColumnarBatch(out_cols, state.num_rows)
+        fn = fuse.fused(self._sig("evaluate"), lambda: self.kern._evaluate_states)
+        out = fn(state)
+        n = state.num_rows if nkeys else 1
+        return ColumnarBatch(out.columns, n, out.row_mask)
 
     def _empty_state_batch(self) -> ColumnarBatch:
         fields = self.state_fields()
@@ -592,17 +894,35 @@ class ShuffleExchangeExec(ExchangeExec):
 
     def _repartition(self, child_results):
         part_t = self.metrics.metric(M.PARTITION_TIME)
+        keys, n_out = self.keys, self.n_out
+
+        def build():
+            def fn(batch):
+                live = batch.live_mask()
+                ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                               batch.capacity, False, live=live)
+                key_cols = [e.eval_tpu(ectx) for e in keys]
+                h = K.spark_murmur3_batch(key_cols, batch.num_rows, live=live)
+                pid = _pmod(h, n_out)
+                subs = []
+                for p in range(n_out):
+                    m = live & (pid == p)
+                    subs.append(ColumnarBatch(
+                        batch.columns, LazyRowCount(jnp.sum(m.astype(jnp.int32))), m))
+                return subs
+            return fn
+
+        fn = fuse.fused(("hash_exchange",
+                         tuple(e.fingerprint() for e in keys), n_out), build)
         out: List[List[ColumnarBatch]] = [[] for _ in range(self.n_out)]
         for part in child_results:
             for batch in part:
                 with part_t.ns():
-                    key_cols = compiled.run_stage(self.keys, batch)
-                    h = K.spark_murmur3_batch(key_cols, batch.num_rows)
-                    pid = _pmod(h, self.n_out)
-                    for p in range(self.n_out):
-                        sub = K.filter_batch(batch, pid == p)
-                        if sub.num_rows:
-                            out[p].append(sub)
+                    # mask-sliced sub-batches: the planes are SHARED across
+                    # all n_out outputs (zero-copy partitioning); only the
+                    # selection masks differ.
+                    for p, sub in enumerate(fn(batch)):
+                        out[p].append(sub)
         return out
 
 
@@ -623,15 +943,26 @@ class RoundRobinExchangeExec(ExchangeExec):
         return self.n_out
 
     def _repartition(self, child_results):
+        n_out = self.n_out
+
+        def build():
+            def fn(batch):
+                live = batch.live_mask()
+                pid = jnp.cumsum(live.astype(jnp.int32)) % n_out
+                subs = []
+                for p in range(n_out):
+                    m = live & (pid == p)
+                    subs.append(ColumnarBatch(
+                        batch.columns, LazyRowCount(jnp.sum(m.astype(jnp.int32))), m))
+                return subs
+            return fn
+
+        fn = fuse.fused(("rr_exchange", n_out), build)
         out: List[List[ColumnarBatch]] = [[] for _ in range(self.n_out)]
         for part in child_results:
             for batch in part:
-                idx = jnp.arange(batch.capacity, dtype=jnp.int32)
-                pid = idx % self.n_out
-                for p in range(self.n_out):
-                    sub = K.filter_batch(batch, pid == p)
-                    if sub.num_rows:
-                        out[p].append(sub)
+                for p, sub in enumerate(fn(batch)):
+                    out[p].append(sub)
         return out
 
 
@@ -666,7 +997,7 @@ class BroadcastHashJoinExec(TpuExec):
                         with TaskContext(partition_id=p) as tctx:
                             batches.extend(right.execute_partition(tctx, p))
                     if batches:
-                        self._build = K.concat_batches(batches)
+                        self._build = K.compact_batch(K.concat_batches(batches))
                     else:
                         from spark_rapids_tpu.columnar.batch import empty_like_schema
                         self._build = empty_like_schema(right.schema)
@@ -683,6 +1014,8 @@ class BroadcastHashJoinExec(TpuExec):
             matched_build = jnp.zeros(build.capacity, jnp.bool_)
         for probe in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
+            if probe.row_mask is not None:
+                probe = K.compact_batch(probe)
             with join_t.ns():
                 probe_keys = compiled.run_stage(self.plan.left_keys, probe)
                 pi, bi, nmatch = J.join_pairs(self._build_keys, build.num_rows,
@@ -691,8 +1024,8 @@ class BroadcastHashJoinExec(TpuExec):
                 if how in ("left_semi", "left_anti"):
                     mask = J.probe_matched_mask(pi, probe.num_rows, probe.capacity)
                     if how == "left_anti":
-                        mask = (~mask) & (jnp.arange(probe.capacity) < probe.num_rows)
-                    yield K.filter_batch(probe, mask)
+                        mask = ~mask
+                    yield K.mask_filter_batch(probe, mask)
                     continue
                 if how in ("left", "full"):
                     mask = J.probe_matched_mask(pi, probe.num_rows, probe.capacity)
@@ -766,9 +1099,11 @@ class CartesianProductExec(TpuExec):
         for p in range(right.num_partitions):
             with TaskContext(partition_id=p) as tctx:
                 rbatches.extend(right.execute_partition(tctx, p))
-        build = K.concat_batches(rbatches) if rbatches else None
+        build = K.compact_batch(K.concat_batches(rbatches)) if rbatches else None
         for probe in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
+            if probe.row_mask is not None:
+                probe = K.compact_batch(probe)
             if build is None or build.num_rows == 0 or probe.num_rows == 0:
                 continue
             n = probe.num_rows * build.num_rows

@@ -64,15 +64,18 @@ class EvalCtx:
     """
 
     def __init__(self, columns: Sequence[ColumnVector], num_rows, capacity: int,
-                 ansi: bool = False):
+                 ansi: bool = False, live=None):
         self.columns = list(columns)
         self.num_rows = num_rows
         self.capacity = capacity
         self.ansi = ansi
+        self.live = live  # selection mask; dead rows never raise ANSI errors
         self.errors: List[Tuple[str, jax.Array]] = []
 
     @property
     def row_mask(self) -> jax.Array:
+        if self.live is not None:
+            return self.live
         return jnp.arange(self.capacity) < self.num_rows
 
     def add_error(self, code: str, mask: jax.Array) -> None:
@@ -338,7 +341,12 @@ class Alias(Expression):
 # ---------------------------------------------------------------------------
 
 def _valid_of(col: ColumnVector, ctx: EvalCtx) -> jax.Array:
-    return col.validity_or_default(ctx.num_rows)
+    # validity None means "valid wherever the row is live" — the live mask
+    # (selection vector) is the floor, NOT arange<num_rows, because masked
+    # batches have live rows at arbitrary positions.
+    if col.validity is not None:
+        return col.validity
+    return ctx.row_mask
 
 
 def _promote(l: ColumnVector, r: ColumnVector, out: T.DataType):
@@ -584,7 +592,17 @@ class Abs(Expression):
 
 def _string_eq_tpu(l: ColumnVector, r: ColumnVector) -> jax.Array:
     """Exact per-row string equality: lengths equal AND bytes equal, computed
-    with a bounded while_loop over 8-byte strides."""
+    with a bounded while_loop over 8-byte strides. Dict-encoded pairs with
+    a shared vocab short-circuit to integer code equality."""
+    from spark_rapids_tpu.ops.kernels import flatten_dict_column
+    if l.is_dict and r.is_dict and \
+            l.data["dict_offsets"] is r.data["dict_offsets"] and \
+            l.data["dict_bytes"] is r.data["dict_bytes"]:
+        return l.data["codes"] == r.data["codes"]
+    if l.is_dict:
+        l = flatten_dict_column(l, 0)
+    if r.is_dict:
+        r = flatten_dict_column(r, 0)
     lo, lb = l.data["offsets"], l.data["bytes"]
     ro, rb = r.data["offsets"], r.data["bytes"]
     ll = lo[1:] - lo[:-1]
@@ -943,6 +961,11 @@ class If(Expression):
 def _select_strings_tpu(mask, t: ColumnVector, f: ColumnVector, tv, fv) -> ColumnVector:
     """Per-row select between two string columns: build new offsets from the
     chosen lengths, then gather bytes from the chosen source."""
+    from spark_rapids_tpu.ops.kernels import flatten_dict_column
+    if t.is_dict:
+        t = flatten_dict_column(t, 0)
+    if f.is_dict:
+        f = flatten_dict_column(f, 0)
     to_, tb = t.data["offsets"], t.data["bytes"]
     fo, fb = f.data["offsets"], f.data["bytes"]
     tl = to_[1:] - to_[:-1]

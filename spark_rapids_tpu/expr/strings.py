@@ -29,12 +29,53 @@ from spark_rapids_tpu.expr.core import (
 
 
 def _lens(col: ColumnVector) -> jax.Array:
+    if col.is_dict:
+        o = col.data["dict_offsets"]
+        return (o[1:] - o[:-1])[col.data["codes"]]
     o = col.data["offsets"]
     return o[1:] - o[:-1]
 
 
 def _starts(col: ColumnVector) -> jax.Array:
     return col.data["offsets"][:-1]
+
+
+def _flat_view(c: ColumnVector) -> ColumnVector:
+    """The vocab of a dict column viewed as a small flat string column."""
+    return ColumnVector(T.STRING, {"offsets": c.data["dict_offsets"],
+                                   "bytes": c.data["dict_bytes"]}, None)
+
+
+def _flatten(c: ColumnVector, ctx) -> ColumnVector:
+    if not c.is_dict:
+        return c
+    from spark_rapids_tpu.ops.kernels import flatten_dict_column
+    return flatten_dict_column(c, ctx.num_rows)
+
+
+def _lift_unary(ctx, c: ColumnVector, compute) -> ColumnVector:
+    """Evaluate a unary string op. compute(flat_col, row_cap) returns a
+    ColumnVector over the flat row space (validity ignored). Dict-encoded
+    children evaluate over the VOCAB — O(vocab) instead of O(rows) — and
+    map back by code; string-valued results stay dict-encoded with a new
+    vocab (zero per-row byte work)."""
+    valid = _valid_of(c, ctx)
+    if c.is_dict:
+        flat = _flat_view(c)
+        res = compute(flat, flat.capacity)
+        codes = c.data["codes"]
+        if res.is_string:
+            # transformed vocab may contain duplicates (upper('a')==
+            # upper('A')) — mark codes non-unique so bucket-by-code
+            # grouping falls back to content-hash grouping
+            return ColumnVector(T.STRING, {
+                "codes": codes,
+                "dict_offsets": res.data["offsets"],
+                "dict_bytes": res.data["bytes"]}, c.validity,
+                dict_unique=False)
+        return ColumnVector(res.dtype, res.data[codes], valid)
+    res = compute(c, c.capacity)
+    return ColumnVector(res.dtype, res.data, valid)
 
 
 class StringLength(Expression):
@@ -51,14 +92,18 @@ class StringLength(Expression):
 
     def eval_tpu(self, ctx):
         c = self.children[0].eval_tpu(ctx)
-        raw = c.data["bytes"]
-        o = c.data["offsets"]
-        # count non-continuation bytes per row: prefix-sum over the byte plane
-        is_start = (raw & 0xC0) != 0x80
-        csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                                jnp.cumsum(is_start.astype(jnp.int32))])
-        nchars = csum[o[1:]] - csum[o[:-1]]
-        return ColumnVector(T.INT32, nchars.astype(jnp.int32), _valid_of(c, ctx))
+
+        def compute(flat, cap):
+            raw = flat.data["bytes"]
+            o = flat.data["offsets"]
+            # count non-continuation bytes per row: prefix-sum over bytes
+            is_start = (raw & 0xC0) != 0x80
+            csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                    jnp.cumsum(is_start.astype(jnp.int32))])
+            nchars = csum[o[1:]] - csum[o[:-1]]
+            return ColumnVector(T.INT32, nchars.astype(jnp.int32), None)
+
+        return _lift_unary(ctx, c, compute)
 
     def eval_cpu(self, cols, ansi=False):
         c = self.children[0].eval_cpu(cols, ansi)
@@ -85,13 +130,17 @@ class _CaseMap(Expression):
 
     def eval_tpu(self, ctx):
         c = self.children[0].eval_tpu(ctx)
-        raw = c.data["bytes"]
-        if self.upper:
-            shifted = jnp.where((raw >= 97) & (raw <= 122), raw - 32, raw)
-        else:
-            shifted = jnp.where((raw >= 65) & (raw <= 90), raw + 32, raw)
-        return ColumnVector(T.STRING, {"offsets": c.data["offsets"], "bytes": shifted},
-                            _valid_of(c, ctx))
+
+        def compute(flat, cap):
+            raw = flat.data["bytes"]
+            if self.upper:
+                shifted = jnp.where((raw >= 97) & (raw <= 122), raw - 32, raw)
+            else:
+                shifted = jnp.where((raw >= 65) & (raw <= 90), raw + 32, raw)
+            return ColumnVector(T.STRING, {"offsets": flat.data["offsets"],
+                                           "bytes": shifted}, None)
+
+        return _lift_unary(ctx, c, compute)
 
     def eval_cpu(self, cols, ansi=False):
         c = self.children[0].eval_cpu(cols, ansi)
@@ -128,8 +177,11 @@ class Substring(Expression):
 
     def eval_tpu(self, ctx):
         c = self.children[0].eval_tpu(ctx)
-        o = c.data["offsets"]
-        raw = c.data["bytes"]
+        return _lift_unary(ctx, c, self._compute)
+
+    def _compute(self, flat, cap):
+        o = flat.data["offsets"]
+        raw = flat.data["bytes"]
         is_start = ((raw & 0xC0) != 0x80).astype(jnp.int32)
         char_csum = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(is_start)])
         nchars = char_csum[o[1:]] - char_csum[o[:-1]]
@@ -163,8 +215,7 @@ class Substring(Expression):
                        0, nchars.shape[0] - 1)
         src = jnp.clip(byte_start[row] + (b - new_off[row]), 0, nb - 1)
         out_bytes = jnp.where(b < new_off[-1], raw[src], 0).astype(jnp.uint8)
-        return ColumnVector(T.STRING, {"offsets": new_off, "bytes": out_bytes},
-                            _valid_of(c, ctx))
+        return ColumnVector(T.STRING, {"offsets": new_off, "bytes": out_bytes}, None)
 
     def eval_cpu(self, cols, ansi=False):
         c = self.children[0].eval_cpu(cols, ansi)
@@ -196,7 +247,7 @@ class ConcatStrings(Expression):
         return ConcatStrings(*children)
 
     def eval_tpu(self, ctx):
-        parts = [c.eval_tpu(ctx) for c in self.children]
+        parts = [_flatten(c.eval_tpu(ctx), ctx) for c in self.children]
         valid = _valid_of(parts[0], ctx)
         for p in parts[1:]:
             valid = valid & _valid_of(p, ctx)
@@ -256,14 +307,16 @@ class _LiteralMatch(Expression):
 
     def eval_tpu(self, ctx):
         c = self.children[0].eval_tpu(ctx)
-        raw = c.data["bytes"]
-        o = c.data["offsets"]
+        return _lift_unary(ctx, c, self._compute)
+
+    def _compute(self, flat, cap):
+        raw = flat.data["bytes"]
+        o = flat.data["offsets"]
         lens = o[1:] - o[:-1]
         pat = np.frombuffer(self.pattern.encode("utf-8"), np.uint8)
         m = len(pat)
-        valid = _valid_of(c, ctx)
         if m == 0:
-            return ColumnVector(T.BOOLEAN, jnp.ones(ctx.capacity, jnp.bool_), valid)
+            return ColumnVector(T.BOOLEAN, jnp.ones(cap, jnp.bool_), None)
         nb = raw.shape[0]
 
         def window_eq(base):
@@ -279,18 +332,17 @@ class _LiteralMatch(Expression):
         elif self.mode == "ends":
             res = fits & window_eq(o[1:] - m)
         else:  # contains: match at any byte start position
-            starts_eq = jnp.zeros(nb, jnp.bool_)
             base = jnp.arange(nb, dtype=jnp.int32)
             w = window_eq(base)
             # map each byte position to its row; position must leave room
             rowidx = jnp.searchsorted(o, base, side="right").astype(jnp.int32) - 1
-            rowidx = jnp.clip(rowidx, 0, ctx.capacity - 1)
+            rowidx = jnp.clip(rowidx, 0, cap - 1)
             in_row = (base + m) <= o[rowidx + 1]
             hit = w & in_row
-            per_row = jnp.zeros(ctx.capacity, jnp.int32).at[rowidx].add(
+            per_row = jnp.zeros(cap, jnp.int32).at[rowidx].add(
                 hit.astype(jnp.int32), mode="drop")
             res = fits & (per_row > 0)
-        return ColumnVector(T.BOOLEAN, res, valid)
+        return ColumnVector(T.BOOLEAN, res, None)
 
     def eval_cpu(self, cols, ansi=False):
         c = self.children[0].eval_cpu(cols, ansi)
@@ -603,6 +655,17 @@ def cast_string_tpu(c: ColumnVector, dst: T.DataType, ctx: EvalCtx) -> ColumnVec
         raise NotImplementedError(f"cast {src!r} -> string on device")
     if isinstance(c.dtype, T.StringType):
         if dst.is_integral:
+            if c.is_dict:
+                # parse the vocab once, gather values/validity by code
+                flat = _flat_view(c)
+                k = flat.capacity
+                vv, vok = _parse_int64_tpu(flat, jnp.ones(k, jnp.bool_),
+                                           ctx if not ctx.ansi else None)
+                codes = c.data["codes"]
+                out_valid = valid & vok[codes]
+                if ctx.ansi:
+                    ctx.add_error("CAST_INVALID_INPUT", valid & ~vok[codes])
+                return ColumnVector(dst, vv[codes].astype(dst.np_dtype), out_valid)
             v64, out_valid = _parse_int64_tpu(c, valid, ctx)
             return ColumnVector(dst, v64.astype(dst.np_dtype), out_valid)
         if isinstance(dst, (T.Float32Type, T.Float64Type)):

@@ -23,7 +23,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.columnar.batch import ColumnVector, ColumnarBatch, round_capacity
+from spark_rapids_tpu.columnar.batch import (
+    ColumnVector, ColumnarBatch, LazyRowCount, materialize_counts,
+    round_capacity, traced_rows,
+)
 
 # ---------------------------------------------------------------------------
 # Spark-compatible Murmur3 (x86_32, seed 42) -- reference jni.Hash murmur3.
@@ -123,11 +126,22 @@ def murmur3_bytes(offsets: jax.Array, raw: jax.Array, seed: jax.Array) -> jax.Ar
     return _mm3_fmix(h1, lens)
 
 
-def spark_hash_column(col: ColumnVector, num_rows: int, seed: jax.Array) -> jax.Array:
+def spark_hash_column(col: ColumnVector, num_rows: int, seed: jax.Array,
+                      live=None) -> jax.Array:
     """Spark Murmur3Hash semantics per type: null fields pass the running
     seed through unchanged."""
     d = col.dtype
-    if isinstance(d, T.StringType):
+    if col.is_dict:
+        # hash the (small) vocab once, then gather by code; per-row seeds
+        # force the general path (vocab hash is seed-independent only for
+        # scalar seeds)
+        if seed.ndim == 0:
+            vh = murmur3_bytes(col.data["dict_offsets"], col.data["dict_bytes"], seed)
+            h = vh[col.data["codes"]]
+        else:
+            flat = flatten_dict_column(col, num_rows)
+            h = murmur3_bytes(flat.data["offsets"], flat.data["bytes"], seed)
+    elif isinstance(d, T.StringType):
         h = murmur3_bytes(col.data["offsets"], col.data["bytes"], seed)
     elif isinstance(d, T.BooleanType):
         h = murmur3_int32(col.data.astype(jnp.int32), seed)
@@ -138,22 +152,25 @@ def spark_hash_column(col: ColumnVector, num_rows: int, seed: jax.Array) -> jax.
         h = murmur3_int32(lax.bitcast_convert_type(v, jnp.int32), seed)
     elif isinstance(d, T.Float64Type):
         v = jnp.where(col.data == 0.0, jnp.zeros_like(col.data), col.data)
-        h = murmur3_int64(lax.bitcast_convert_type(v, jnp.int64), seed)
+        h = murmur3_int64(_bitcast_f64_u64(v).astype(jnp.int64), seed)
     else:  # int64, timestamp, decimal64
         h = murmur3_int64(col.data.astype(jnp.int64), seed)
-    valid = col.validity_or_default(num_rows)
+    if live is not None:
+        valid = live if col.validity is None else (col.validity & live)
+    else:
+        valid = col.validity_or_default(num_rows)
     if seed.ndim == 0:
         seed = jnp.broadcast_to(seed, h.shape)
     return jnp.where(valid, h, seed.astype(jnp.uint32))
 
 
 def spark_murmur3_batch(cols: Sequence[ColumnVector], num_rows: int,
-                        seed: int = SPARK_MURMUR3_SEED) -> jax.Array:
+                        seed: int = SPARK_MURMUR3_SEED, live=None) -> jax.Array:
     """Chained per-row hash over columns = Spark Murmur3Hash(cols, 42)."""
     cap = cols[0].capacity
     h = jnp.full((cap,), np.uint32(seed))
     for c in cols:
-        h = spark_hash_column(c, num_rows, h)
+        h = spark_hash_column(c, num_rows, h, live=live)
     return h.astype(jnp.int32)
 
 
@@ -189,14 +206,26 @@ _SIGN64 = np.uint64(0x8000000000000000)
 
 
 def normalize_key(col: ColumnVector, num_rows: int,
-                  for_order: bool = False) -> Tuple[jax.Array, jax.Array]:
+                  for_order: bool = False, live=None) -> Tuple[jax.Array, jax.Array]:
     """Returns (key_u64, null_flags). Key order matches value order for all
     fixed-width types. Strings get a 64-bit double-hash of the bytes:
     equality-faithful up to astronomically-unlikely collisions, NOT
     order-faithful (string ORDER BY uses the host sort path)."""
     d = col.dtype
-    valid = col.validity_or_default(num_rows)
-    if isinstance(d, T.StringType):
+    if live is not None:
+        valid = live if col.validity is None else (col.validity & live)
+    else:
+        valid = col.validity_or_default(num_rows)
+    if col.is_dict:
+        if for_order:
+            raise NotImplementedError("device string ordering; use host sort")
+        vh1 = murmur3_bytes(col.data["dict_offsets"], col.data["dict_bytes"],
+                            jnp.uint32(0x12345671))
+        vh2 = murmur3_bytes(col.data["dict_offsets"], col.data["dict_bytes"],
+                            jnp.uint32(0x89ABCDE3))
+        vkey = (vh1.astype(jnp.uint64) << jnp.uint64(32)) | vh2.astype(jnp.uint64)
+        key = vkey[col.data["codes"]]
+    elif isinstance(d, T.StringType):
         if for_order:
             raise NotImplementedError("device string ordering; use host sort")
         h1 = murmur3_bytes(col.data["offsets"], col.data["bytes"], jnp.uint32(0x12345671))
@@ -211,11 +240,43 @@ def normalize_key(col: ColumnVector, num_rows: int,
     elif isinstance(d, T.Float64Type):
         v = jnp.where(jnp.isnan(col.data), jnp.float64(np.nan), col.data)
         v = jnp.where(v == 0.0, jnp.zeros_like(v), v)
-        key = _order_float_bits(lax.bitcast_convert_type(v, jnp.int64), 64)
+        key = _order_float_bits(_bitcast_f64_u64(v), 64)
     else:
         key = col.data.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN64
     key = jnp.where(valid, key, jnp.uint64(0))
     return key, ~valid
+
+
+def _bitcast_f64_u64(v: jax.Array) -> jax.Array:
+    """Exact IEEE-754 f64 bit pattern as u64, ARITHMETICALLY — the TPU x64
+    rewriter cannot lower any 64-bit bitcast-convert, so the bits are
+    reconstructed from frexp (exact: the mantissa product is integral and
+    fits f64/u64). Matches java.lang.Double.doubleToLongBits (canonical
+    NaN), which Spark's murmur3 hashes."""
+    nan = jnp.isnan(v)
+    pinf = v == jnp.inf
+    ninf = v == -jnp.inf
+    zero = v == 0.0
+    # sign via compare, not jnp.signbit (which bitcasts internally); -0.0
+    # is normalized to +0.0 by callers (Spark normalizes it before hashing)
+    sign = jnp.where(v < 0.0, jnp.uint64(1) << jnp.uint64(63), jnp.uint64(0))
+    a = jnp.abs(v)
+    m, e = jnp.frexp(a)  # a = m * 2^e, m in [0.5, 1)
+    biased = (e + 1022).astype(jnp.int64)
+    normal = biased > 0
+    mant = (m * np.float64(2.0 ** 53)).astype(jnp.uint64)  # [2^52, 2^53)
+    norm_bits = (jnp.where(normal, biased, 0).astype(jnp.uint64)
+                 << jnp.uint64(52)) | (mant & ((jnp.uint64(1) << jnp.uint64(52)) - jnp.uint64(1)))
+    # Subnormals: XLA flushes them to zero in f64 arithmetic on both the
+    # TPU emulation and the CPU backend (FTZ), so they hash/compare as
+    # +/-0 here — consistent with every other op in the engine, divergent
+    # from Spark CPU only for exact-subnormal inputs (documented incompat,
+    # reference keeps a similar float incompat list).
+    mag = jnp.where(normal, norm_bits, jnp.uint64(0))
+    mag = jnp.where(zero, jnp.uint64(0), mag)
+    mag = jnp.where(pinf | ninf, jnp.uint64(0x7FF0000000000000), mag)
+    mag = jnp.where(nan, jnp.uint64(0x7FF8000000000000), mag)
+    return sign | mag
 
 
 def _order_float_bits(bits: jax.Array, width: int) -> jax.Array:
@@ -238,13 +299,13 @@ def _order_float_bits(bits: jax.Array, width: int) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def lexsort_indices(keys: List[Tuple[jax.Array, jax.Array, bool, bool]],
-                    num_rows: int) -> jax.Array:
+                    num_rows: int, live=None) -> jax.Array:
     """Stable lexicographic argsort. keys = [(key_u64, null_flags, ascending,
-    nulls_first)]. Padded rows (>= num_rows) sort to the very end. Returns an
-    int32 permutation of the full capacity."""
+    nulls_first)]. Dead rows (mask False / >= num_rows) sort to the very
+    end. Returns an int32 permutation of the full capacity."""
     cap = keys[0][0].shape[0]
     operands: List[jax.Array] = []
-    in_range = jnp.arange(cap) < num_rows
+    in_range = live if live is not None else (jnp.arange(cap) < num_rows)
     operands.append(jnp.where(in_range, 0, 1).astype(jnp.uint8))
     for key, nulls, asc, nulls_first in keys:
         # null-ordering plane: 0 sorts before 1
@@ -261,13 +322,25 @@ def lexsort_indices(keys: List[Tuple[jax.Array, jax.Array, bool, bool]],
 # Gather (reference GatherMap + OutOfBoundsPolicy.NULLIFY)
 # ---------------------------------------------------------------------------
 
-def gather_column(col: ColumnVector, indices: jax.Array, src_rows: int) -> ColumnVector:
-    """Row gather of one column. indices: int32[out_cap]; -1 emits null."""
+def gather_column(col: ColumnVector, indices: jax.Array, src_rows: int,
+                  src_live=None) -> ColumnVector:
+    """Row gather of one column. indices: int32[out_cap]; -1 emits null.
+    src_live: liveness plane of the source batch (selection mask); dead
+    source rows gather as null."""
     oob = indices < 0
     safe = jnp.clip(indices, 0, col.capacity - 1)
-    src_valid = col.validity_or_default(src_rows)
+    if src_live is not None:
+        src_valid = src_live if col.validity is None else (col.validity & src_live)
+    else:
+        src_valid = col.validity_or_default(src_rows)
     valid = src_valid[safe] & ~oob
-    if col.is_string:
+    if col.is_dict:
+        # dict strings gather as integer codes; the vocab is shared.
+        data = {"codes": col.data["codes"][safe],
+                "dict_offsets": col.data["dict_offsets"],
+                "dict_bytes": col.data["dict_bytes"]}
+        return ColumnVector(col.dtype, data, valid, dict_unique=col.dict_unique)
+    elif col.is_string:
         offsets = col.data["offsets"]
         raw = col.data["bytes"]
         lens = (offsets[1:] - offsets[:-1])[safe]
@@ -296,7 +369,9 @@ def _gather_string_bytes(raw, offsets, row_idx, new_off):
 
 
 def gather_batch(batch: ColumnarBatch, indices: jax.Array, out_rows: int) -> ColumnarBatch:
-    cols = [gather_column(c, indices, batch.num_rows) for c in batch.columns]
+    live = batch.live_mask() if batch.row_mask is not None else None
+    cols = [gather_column(c, indices, batch.num_rows, src_live=live)
+            for c in batch.columns]
     return ColumnarBatch(cols, out_rows)
 
 
@@ -334,9 +409,53 @@ def filter_batch(batch: ColumnarBatch, mask: jax.Array) -> ColumnarBatch:
     return gather_batch(batch, idx, count)
 
 
+def mask_filter_batch(batch: ColumnarBatch, pred_mask: jax.Array) -> ColumnarBatch:
+    """The hot-path filter: NO gather, NO host sync. Survivors are marked in
+    a selection mask (row_mask); the count stays on device as a
+    LazyRowCount. The reference's GpuFilterExec compacts eagerly with a
+    cudf kernel and a stream sync — on TPU a full-size gather costs more
+    than every downstream op combined, while a mask fuses into them."""
+    live = batch.live_mask() & pred_mask
+    count = jnp.sum(live.astype(jnp.int32))
+    return ColumnarBatch(batch.columns, LazyRowCount(count), live)
+
+
+def compact_batch(batch: ColumnarBatch) -> ColumnarBatch:
+    """Gather live rows to the front and drop the selection mask (for
+    consumers that need contiguous rows: sort output, host hand-off,
+    not-yet-mask-aware operators). Costs one count sync + one gather."""
+    if batch.row_mask is None:
+        return shrink_batch(batch)
+    n = int(batch.num_rows)
+    out_cap = round_capacity(n)
+    idx = _compact_indices(batch.row_mask, batch.capacity, out_cap)
+    out = gather_batch(batch, idx, n)
+    return ColumnarBatch(out.columns, n)
+
+
 # ---------------------------------------------------------------------------
 # Slice / concat (reference cudf Table.concatenate / contiguous split)
 # ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(1,))
+def _shrink_gather(batch, new_cap: int):
+    n = traced_rows(batch.num_rows)
+    idx = jnp.arange(new_cap, dtype=jnp.int32)
+    idx = jnp.where(idx < n, idx, -1)
+    return gather_batch(batch, idx, batch.num_rows)
+
+
+def shrink_batch(batch: ColumnarBatch) -> ColumnarBatch:
+    """Compact a batch whose capacity far exceeds its row count (the shrink
+    point for deferred-count operators). Materializes a lazy count (one
+    round trip) — call once per stage output, never per input batch."""
+    n = int(batch.num_rows)
+    new_cap = round_capacity(n)
+    if new_cap >= batch.capacity:
+        return ColumnarBatch(batch.columns, n)
+    out = _shrink_gather(batch, new_cap)
+    return ColumnarBatch(out.columns, n)
+
 
 def slice_batch(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:
     out_cap = round_capacity(max(length, 1))
@@ -345,28 +464,128 @@ def slice_batch(batch: ColumnarBatch, start: int, length: int) -> ColumnarBatch:
     return gather_batch(batch, idx, length)
 
 
+def flatten_dict_column(col: ColumnVector, num_rows) -> ColumnVector:
+    """Dict-encoded string -> flat offsets+bytes. The payload EXPANDS
+    (repeated codes repeat their vocab entry), so the output byte plane is
+    sized by the expansion: exactly when called eagerly (one scalar sync),
+    by the static bound rows*vocab_bytes inside a trace."""
+    voff = col.data["dict_offsets"]
+    vraw = col.data["dict_bytes"]
+    codes = col.data["codes"].astype(jnp.int32)
+    valid = col.validity
+    cap = int(codes.shape[0])
+    vlens = voff[1:] - voff[:-1]
+    lens = vlens[jnp.clip(codes, 0, vlens.shape[0] - 1)]
+    if valid is not None:
+        lens = jnp.where(valid, lens, 0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    import jax.core as _core
+    if isinstance(new_off, jax.Array) and not isinstance(new_off, _core.Tracer):
+        out_cap = round_capacity(max(int(new_off[-1]), 1))
+    else:
+        out_cap = cap * int(vraw.shape[0])
+        if out_cap > (1 << 28):
+            raise NotImplementedError(
+                "flattening a large dict string column inside a traced "
+                "kernel (bound > 256MB); restructure via the vocab lift")
+    starts = voff[jnp.clip(codes, 0, vlens.shape[0] - 1)]
+    b = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off, b, side="right").astype(jnp.int32) - 1,
+                   0, cap - 1)
+    src = jnp.clip(starts[row] + (b - new_off[row]), 0, int(vraw.shape[0]) - 1)
+    out_bytes = jnp.where(b < new_off[-1], vraw[src], 0).astype(jnp.uint8)
+    return ColumnVector(col.dtype, {"offsets": new_off, "bytes": out_bytes},
+                        col.validity)
+
+
+def _same_array(a, b) -> bool:
+    return a is b
+
+
 def concat_batches(batches: List[ColumnarBatch]) -> ColumnarBatch:
+    materialize_counts(batches)  # one bulk fetch, not one sync per batch
+    masked = any(b.row_mask is not None for b in batches)
     nonempty = [b for b in batches if b.num_rows > 0]
     if not nonempty:
         return batches[0]
     if len(nonempty) == 1:
         return nonempty[0]
-    total = sum(b.num_rows for b in nonempty)
-    cap = round_capacity(total)
+    total = sum(int(b.num_rows) for b in nonempty)
+    if masked:
+        # Selection-mask mode: stack FULL planes and concatenate masks — no
+        # gather, no per-row work. Capacity grows to the sum of inputs; the
+        # consumer (or an explicit compact) shrinks when worthwhile.
+        mask = jnp.concatenate([b.live_mask() for b in nonempty])
+        out_cols = []
+        for ci in range(nonempty[0].num_cols):
+            cols = [b.columns[ci] for b in nonempty]
+            caps = [b.capacity for b in nonempty]
+            out_cols.append(_concat_columns(cols, caps, sum(caps)))
+        return ColumnarBatch(out_cols, total, mask)
     out_cols = []
     for ci in range(nonempty[0].num_cols):
         cols = [b.columns[ci] for b in nonempty]
-        rows = [b.num_rows for b in nonempty]
-        out_cols.append(_concat_columns(cols, rows, cap))
+        rows = [int(b.num_rows) for b in nonempty]
+        out_cols.append(_concat_columns(cols, rows, round_capacity(total)))
     return ColumnarBatch(out_cols, total)
 
 
 def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> ColumnVector:
     dtype = cols[0].dtype
+    if any(c.is_dict for c in cols) and not all(c.is_dict for c in cols):
+        cols = [flatten_dict_column(c, r) if c.is_dict else c
+                for c, r in zip(cols, rows)]
     validity = jnp.concatenate([c.validity_or_default(r)[:r] for c, r in zip(cols, rows)])
     pad = cap - validity.shape[0]
     if pad > 0:
         validity = jnp.concatenate([validity, jnp.zeros(pad, jnp.bool_)])
+
+    if all(c.is_dict for c in cols):
+        shared = all(_same_array(c.data["dict_offsets"], cols[0].data["dict_offsets"])
+                     and _same_array(c.data["dict_bytes"], cols[0].data["dict_bytes"])
+                     for c in cols[1:])
+        if shared:
+            codes = jnp.concatenate([c.data["codes"][:r] for c, r in zip(cols, rows)])
+            if pad > 0:
+                codes = jnp.concatenate([codes, jnp.zeros(pad, codes.dtype)])
+            return ColumnVector(dtype, {"codes": codes,
+                                        "dict_offsets": cols[0].data["dict_offsets"],
+                                        "dict_bytes": cols[0].data["dict_bytes"]},
+                                validity,
+                                dict_unique=all(c.dict_unique for c in cols))
+        # Distinct vocab objects: UNIFY host-side (vocabs are small; this
+        # runs at eager concat boundaries only). Equal strings must map to
+        # one code — duplicated vocab entries would make "unique bucket"
+        # reasoning (bucketed agg, merge-skip) silently wrong.
+        vocab_planes = []
+        for c in cols:
+            vocab_planes.extend([c.data["dict_offsets"], c.data["dict_bytes"]])
+        host = jax.device_get(vocab_planes)
+        union: dict = {}
+        remaps = []
+        for i in range(len(cols)):
+            off, by = np.asarray(host[2 * i]), np.asarray(host[2 * i + 1])
+            remap = np.zeros(len(off) - 1, np.int32)
+            for k in range(len(off) - 1):
+                s = bytes(by[off[k]: off[k + 1]])
+                if s not in union:
+                    union[s] = len(union)
+                remap[k] = union[s]
+            remaps.append(remap)
+        ub = b"".join(union.keys())
+        uoff = np.zeros(len(union) + 1, np.int32)
+        uoff[1:] = np.cumsum([len(s) for s in union.keys()])
+        ubytes = np.frombuffer(ub, np.uint8) if ub else np.zeros(1, np.uint8)
+        code_parts = [jnp.asarray(remap)[c.data["codes"][:r]]
+                      for c, r, remap in zip(cols, rows, remaps)]
+        codes = jnp.concatenate(code_parts)
+        if pad > 0:
+            codes = jnp.concatenate([codes, jnp.zeros(pad, codes.dtype)])
+        return ColumnVector(dtype, {"codes": codes,
+                                    "dict_offsets": jnp.asarray(uoff),
+                                    "dict_bytes": jnp.asarray(np.ascontiguousarray(ubytes))},
+                            validity)
 
     if isinstance(dtype, T.StringType):
         # Host readback of per-part byte lengths keeps destination offsets

@@ -1,0 +1,14 @@
+"""Distributed execution over a TPU device mesh.
+
+Reference parity: SURVEY.md §2.7 / §5.8 — the reference's shuffle subsystem
+(RapidsShuffleInternalManagerBase + UCX transport, peer-to-peer fetch with
+bounce buffers) is replaced TPU-natively by XLA collectives over ICI:
+
+- hash exchange  -> `lax.all_to_all` over the mesh (exchange.py)
+- broadcast      -> `lax.all_gather` (replicate the build side)
+- reduction aggs -> `lax.psum`
+
+No transport code, no bounce buffers, no heartbeat registry: XLA compiles
+the collective into the program and the ICI fabric moves the bytes.
+"""
+from spark_rapids_tpu.parallel.mesh import make_mesh, mesh_devices  # noqa: F401

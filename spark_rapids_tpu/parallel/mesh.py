@@ -1,0 +1,42 @@
+"""Device mesh construction.
+
+The framework's parallelism axes (SURVEY.md §2.11 mapping):
+
+- ``part`` — partition parallelism: rows are hash/range/round-robin
+  partitioned across this axis; the shuffle collective (all_to_all) rides
+  it. This is the analog of Spark's task/partition data parallelism.
+- ``dp``  — batch parallelism *within* a partition: long scans split their
+  row ranges across this axis; reduction-style merges use psum over it.
+
+A 1-D mesh (dp=1) is the common case — one device per Spark-partition
+shard. Both axes participate in the shuffle exchange (the mesh is flattened
+for hash partitioning), so grouped aggregation lands every key on exactly
+one device.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def mesh_devices(n: Optional[int] = None) -> Sequence:
+    devs = jax.devices()
+    if n is None:
+        return devs
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return devs[:n]
+
+
+def make_mesh(n_devices: Optional[int] = None, dp: int = 1,
+              axis_names=("dp", "part")) -> Mesh:
+    devs = list(mesh_devices(n_devices))
+    n = len(devs)
+    if n % dp != 0:
+        raise ValueError(f"dp={dp} does not divide device count {n}")
+    arr = np.asarray(devs).reshape(dp, n // dp)
+    return Mesh(arr, axis_names=axis_names)

@@ -86,6 +86,26 @@ class InMemorySource(PlanNode):
         return f"InMemorySource[{self.table.num_rows} rows, {self.num_partitions} parts]"
 
 
+class CachedRelation(PlanNode):
+    """`df.cache()` analog (reference ParquetCachedBatchSerializer,
+    SURVEY.md §2.6 — there df.cache() stores compressed parquet blobs; the
+    TPU-first answer keeps the materialized result resident in HBM, where
+    repeated queries pay zero upload). The exec node materializes the child
+    once and every later collect reuses the device batches."""
+
+    def __init__(self, child: PlanNode):
+        self.children = [child]
+        self.materialized = None  # List[List[ColumnarBatch]] set by the exec
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def describe(self):
+        state = "hot" if self.materialized is not None else "cold"
+        return f"CachedRelation[{state}]"
+
+
 class ParquetScan(PlanNode):
     """Parquet file scan (reference GpuParquetScan). Filter pushdown happens
     in the overrides pass; `pushed_filters` prune row groups host-side."""

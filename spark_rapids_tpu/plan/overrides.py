@@ -321,6 +321,8 @@ class SparkPlanMeta:
             return X.InMemoryScanExec(p, [], conf)
         if isinstance(p, P.ParquetScan):
             return X.ParquetScanExec(p, [], conf)
+        if isinstance(p, P.CachedRelation):
+            return X.CachedScanExec(p, child_execs, conf)
         if isinstance(p, P.Range):
             return X.RangeExec(p, [], conf)
         if isinstance(p, P.Project):
@@ -351,9 +353,17 @@ class SparkPlanMeta:
     def _convert_aggregate(self, p, child_execs, conf):
         from spark_rapids_tpu.exec import tpu_nodes as X
         child = child_execs[0]
+        pre_filter = None
+        if isinstance(child, X.FilterExec):
+            # predicate fusion: the filter disappears into the agg's update
+            # kernel (one dispatch for scan-filter-partial-agg)
+            pre_filter = child.plan.condition
+            child = child.children[0]
         if child.num_partitions == 1:
-            return X.HashAggregateExec(p, [child], conf, mode="complete")
-        partial = X.HashAggregateExec(p, [child], conf, mode="partial")
+            return X.HashAggregateExec(p, [child], conf, mode="complete",
+                                       pre_filter=pre_filter)
+        partial = X.HashAggregateExec(p, [child], conf, mode="partial",
+                                      pre_filter=pre_filter)
         nkeys = len(p.group_exprs)
         if nkeys:
             keys = [E.BoundRef(i, e.data_type(), n) for i, (e, n) in

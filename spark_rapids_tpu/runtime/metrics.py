@@ -38,25 +38,47 @@ PARTITION_TIME = "partitionTime"
 
 
 class GpuMetric:
-    __slots__ = ("name", "level", "_value", "_lock")
+    __slots__ = ("name", "level", "_value", "_lock", "_deferred")
 
     def __init__(self, name: str, level: int = MODERATE):
         self.name = name
         self.level = level
         self._value = 0
         self._lock = threading.Lock()
+        self._deferred = []
 
-    def add(self, v: int) -> None:
+    def add(self, v) -> None:
+        """Accepts ints or LazyRowCount; lazy counts are NOT synchronized
+        here — they resolve when the metric is read (metrics must never
+        add device round trips to the hot path)."""
+        from spark_rapids_tpu.columnar.batch import LazyRowCount
+        if isinstance(v, LazyRowCount) and not v.is_materialized:
+            with self._lock:
+                self._deferred.append(v)
+            return
         with self._lock:
             self._value += int(v)
 
     def set(self, v: int) -> None:
         with self._lock:
             self._value = int(v)
+            self._deferred = []
 
     @property
     def value(self) -> int:
-        return self._value
+        with self._lock:
+            if self._deferred:
+                from spark_rapids_tpu.columnar.batch import LazyRowCount
+                import jax as _jax
+                pending = [v for v in self._deferred
+                           if isinstance(v, LazyRowCount) and not v.is_materialized]
+                if pending:  # ONE bulk fetch, not one round trip per count
+                    for lz, val in zip(pending,
+                                       _jax.device_get([p._dev for p in pending])):
+                        lz._val = int(val)
+                self._value += sum(int(v) for v in self._deferred)
+                self._deferred = []
+            return self._value
 
     def ns(self):
         """Context manager timing a block in nanoseconds."""

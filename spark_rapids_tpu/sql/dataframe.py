@@ -61,6 +61,13 @@ class DataFrame:
 
     unionAll = union
 
+    def cache(self) -> "DataFrame":
+        """Pin this DataFrame's result in device HBM; repeated queries over
+        it skip the scan + upload entirely."""
+        return DataFrame(P.CachedRelation(self.plan), self.session)
+
+    persist = cache
+
     def distinct(self) -> "DataFrame":
         keys = [E.col(n) for n in self.plan.schema.names]
         return DataFrame(P.Aggregate(keys, [], self.plan), self.session)
