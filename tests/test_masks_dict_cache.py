@@ -1,0 +1,194 @@
+"""Coverage for the TPU-first execution machinery: selection masks,
+dictionary-encoded strings, bucketed aggregation, df.cache(), and the
+distributed mesh exchange (reference behaviors: GpuFilterExec,
+GpuAggregateExec, ParquetCachedBatchSerializer, shuffle §2.7)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import Cast, col, lit
+from spark_rapids_tpu import types as T
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _table(n=64, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(np.array(["a", "b", "c", None], object)[rng.integers(0, 4, n)]),
+        "v": pa.array([None if rng.random() < 0.15
+                       else round(float(x), 3)
+                       for x in rng.uniform(-10, 10, n)]),
+        "n": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+    })
+
+
+def test_chained_filters_masked(session):
+    # Second filter runs over a masked batch with survivors at scattered
+    # positions — validity must come from the live mask, not arange<count.
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_table())
+        .filter(col("n") > lit(20)).filter(col("n") < lit(80)),
+        session, ignore_order=True)
+
+
+def test_filter_then_project_masked(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_table())
+        .filter(col("n") >= lit(50))
+        .select((col("n") * lit(2)).alias("n2"), col("k")),
+        session, ignore_order=True)
+
+
+def test_empty_filter_result(session):
+    df = session.create_dataframe(_table()).filter(col("n") > lit(1000))
+    assert df.count() == 0
+
+
+def test_fused_prefilter_groupby_dict_keys(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_table(256))
+        .filter(col("n") > lit(10))
+        .group_by("k")
+        .agg(F.sum(col("v")), F.count(col("v")), F.min(col("v")),
+             F.max(col("v")), F.avg(col("v"))),
+        session, ignore_order=True, approx_float=1e-9)
+
+
+def test_groupby_transformed_vocab_not_bucketed(session):
+    # upper() can merge vocab entries ('a' vs 'A'): bucket-by-code must
+    # NOT be used; groups must still collapse by content.
+    from spark_rapids_tpu.expr.strings import Upper
+    t = pa.table({"s": ["a", "A", "b", "a", "B", None], "x": [1, 2, 3, 4, 5, 6]})
+    df = session.create_dataframe(t)
+    q = (df.select(Upper(col("s")).alias("u"), col("x"))
+         .group_by("u").agg(F.sum(col("x"))))
+    got = {r["u"]: r["sum(x)"] for r in q.collect().to_pylist()}
+    assert got == {"A": 7, "B": 8, None: 6}
+
+
+def test_cache_reuse_and_correctness(session):
+    df = session.create_dataframe(_table(128)).cache()
+    assert df.count() == 128
+    a = df.filter(col("n") > lit(30)).count()
+    b = df.filter(col("n") > lit(30)).count()
+    assert a == b
+    tpu = df.group_by("k").agg(F.sum(col("n"))).collect().to_pylist()
+    got = {r["k"]: r["sum(n)"] for r in tpu}
+    t = _table(128)
+    exp = {}
+    for k, n in zip(t["k"].to_pylist(), t["n"].to_pylist()):
+        exp[k] = exp.get(k, 0) + n
+    assert got == exp
+
+
+def test_multi_chunk_cache_unifies_vocabs():
+    # Source chunking gives each chunk its own dictionary; the cache
+    # concat must unify vocabs or equal keys split into several groups.
+    s = TpuSession({"spark.rapids.sql.reader.batchSizeRows": 16})
+    df = s.create_dataframe(_table(64)).cache()
+    rows = df.group_by("k").count().collect().to_pylist()
+    assert len(rows) == len({r["k"] for r in rows})
+    assert sum(r["count"] for r in rows) == 64
+
+
+def test_distinct_and_limit_over_masked(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_table())
+        .filter(col("n") > lit(40)).select(col("k")).distinct(),
+        session, ignore_order=True)
+    out = session.create_dataframe(_table()).filter(col("n") > lit(40)).limit(5)
+    assert out.collect().num_rows <= 5
+
+
+def test_join_over_masked_inputs(session):
+    right_t = pa.table({"k": ["a", "b", "z"], "w": [1.0, 2.0, 3.0]})
+    for how in ("inner", "left", "left_semi", "left_anti"):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.create_dataframe(_table(48, seed=1))
+            .filter(col("n") > lit(25))
+            .join(s.create_dataframe(right_t), on="k", how=how),
+            session, ignore_order=True)
+
+
+def test_string_ops_on_dict_columns(session):
+    from spark_rapids_tpu.expr.strings import (
+        Contains, Like, StringLength, Substring, Upper,
+    )
+    t = pa.table({"s": ["apple", "banana", None, "cherry", "apple", "date"]})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            StringLength(col("s")).alias("len"),
+            Upper(col("s")).alias("up"),
+            Substring(col("s"), 2, 3).alias("sub"),
+            Contains(col("s"), "an").alias("has_an")),
+        session)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).filter(Like(col("s"), "a%")),
+        session, ignore_order=True)
+
+
+def test_concat_mixed_dict_flat(session):
+    # concat of a dict child with a rendered (flat) string child
+    from spark_rapids_tpu.expr.strings import ConcatStrings
+    t = pa.table({"s": ["x", "y", "x"], "n": [1, 2, 3]})
+    q = session.create_dataframe(t).select(
+        ConcatStrings(col("s"), Cast(col("n"), T.STRING)).alias("c"))
+    assert q.to_pydict()["c"] == ["x1", "y2", "x3"]
+
+
+def test_nan_inf_aggregation(session):
+    t = pa.table({"g": ["a", "a", "b", "b", "b"],
+                  "v": [1.0, float("nan"), float("inf"), 2.0, None]})
+    df = session.create_dataframe(t)
+    got = {r["g"]: r for r in
+           df.group_by("g").agg(F.sum(col("v")), F.min(col("v")),
+                                F.max(col("v"))).collect().to_pylist()}
+    assert np.isnan(got["a"]["sum(v)"]) and np.isnan(got["a"]["max(v)"])
+    assert got["a"]["min(v)"] == 1.0  # NaN sorts above +inf (Spark order)
+    assert got["b"]["sum(v)"] == float("inf")
+    assert got["b"]["min(v)"] == 2.0
+    assert got["b"]["max(v)"] == float("inf")
+
+
+def test_f64_bits_reconstruction_matches_bitcast():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.kernels import _bitcast_f64_u64
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([
+        rng.uniform(-1e300, 1e300, 500),
+        [0.0, 1.0, -1.0, np.inf, -np.inf, np.nan,
+         2.2250738585072014e-308, 1.7976931348623157e308]])
+    got = np.asarray(_bitcast_f64_u64(jnp.asarray(vals)))
+    exp = vals.view(np.uint64)
+    exp = np.where(np.isnan(vals), np.uint64(0x7FF8000000000000), exp)
+    assert (got == exp).all()
+
+
+def test_mesh_distributed_groupby():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.parallel import distributed as D
+    mesh = make_mesh(8, dp=2)
+    n = 8 * 32
+    rng = np.random.default_rng(3)
+    key = rng.integers(0, 11, n).astype(np.uint64)
+    valid = rng.random(n) > 0.2
+    v = rng.uniform(0, 10, n)
+    out = D.make_distributed_groupby_sum(
+        mesh, lambda valid, values: values["v"] > 2.0, ["v"])(
+        D.shard_global(mesh, jnp.asarray(key)),
+        D.shard_global(mesh, jnp.asarray(valid)),
+        {"v": D.shard_global(mesh, jnp.asarray(v))})
+    mask = valid & (v > 2.0)
+    assert int(jnp.sum(out["groups"])) == len(np.unique(key[mask]))
+    np.testing.assert_allclose(
+        float(jnp.sum(jnp.where(out["groups"], out["sum_v"], 0.0))),
+        v[mask].sum(), rtol=1e-9)
