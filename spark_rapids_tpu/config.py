@@ -107,6 +107,12 @@ DEVICE_MEMORY_FRACTION = conf_float(
     "Fraction of per-chip HBM the arena budget may use "
     "(reference rmm.pool allocFraction).", startup_only=True)
 
+DEVICE_MEMORY_BUDGET = conf_int(
+    "spark.rapids.memory.tpu.budgetBytes", 12 << 30,
+    "Cooperative HBM budget in bytes for registered (spillable) batches; "
+    "reservations beyond it drain the spill stores "
+    "(reference rmm pool size; XLA owns the physical allocator).")
+
 HOST_SPILL_LIMIT = conf_int(
     "spark.rapids.memory.host.spillStorageSize", 4 << 30,
     "Bytes of host memory for spilled device data before overflowing to disk "

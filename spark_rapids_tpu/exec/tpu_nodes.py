@@ -164,6 +164,7 @@ class CachedScanExec(TpuExec):
         return self.children[0].num_partitions
 
     def _materialize(self):
+        from spark_rapids_tpu.runtime.memory import SpillableColumnarBatch
         with CachedScanExec._lock:
             if self.plan.materialized is None:
                 child = self.children[0]
@@ -175,13 +176,17 @@ class CachedScanExec(TpuExec):
                         # ONE device batch per partition: every query over
                         # the cache then costs a fixed handful of fused
                         # dispatches instead of one chain per source chunk.
-                        batches = [K.compact_batch(K.concat_batches(batches))]
+                        # Registered spillable: under HBM pressure the
+                        # cache pages out to host/disk instead of OOMing.
+                        merged = K.compact_batch(K.concat_batches(batches))
+                        batches = [SpillableColumnarBatch(merged)]
                     out.append(batches)
                 self.plan.materialized = out
         return self.plan.materialized
 
     def execute_partition(self, ctx, pidx):
-        yield from self._materialize()[pidx]
+        for sb in self._materialize()[pidx]:
+            yield sb.get_batch()
 
 
 class RangeExec(TpuExec):
@@ -728,15 +733,18 @@ class HashAggregateExec(TpuExec):
             ansi = self.conf.get(C.ANSI_ENABLED)
             update_fn = fuse.fused(self._sig("update", ansi),
                                    lambda: self.kern._build_update(ansi))
+            from spark_rapids_tpu.runtime.retry import with_retry
             partials = []
             for batch in child_batches:
                 self._acquire(ctx)
                 with agg_t.ns():
-                    out, errs = update_fn(batch)
-                    compiled.raise_errors(errs)
-                    if nkeys == 0:
-                        out = ColumnarBatch(out.columns, 1)
-                    partials.append(out)
+                    # update is idempotent over its input batch: retried
+                    # after a spill drain, or split in half, on OOM
+                    for out, errs in with_retry(update_fn, batch):
+                        compiled.raise_errors(errs)
+                        if nkeys == 0:
+                            out = ColumnarBatch(out.columns, 1)
+                        partials.append(out)
             if not partials:
                 if nkeys == 0:
                     partials = [self._empty_state_batch()]

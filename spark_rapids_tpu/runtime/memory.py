@@ -1,0 +1,321 @@
+"""Device & memory runtime: HBM budget, three-tier spill, spillable batches.
+
+Reference parity: SURVEY.md §2.3 —
+- spill/SpillFramework.scala (device -> host -> disk stores with handles,
+  spill-on-alloc-failure cascade, per-handle disk files),
+- SpillableColumnarBatch.scala (the currency operators hold between steps),
+- GpuDeviceManager.scala (pool sizing / budget),
+- DeviceMemoryEventHandler.scala (alloc-failed -> drain spill stores).
+
+TPU-first divergences:
+- XLA owns the physical HBM allocator and exposes no alloc-failed
+  callback, so the budget is COOPERATIVE: operators register their
+  held batches; `reserve()` is called before materializing a large batch
+  and synchronously drains the spill stores (device->host->disk) until
+  the reservation fits. A real XLA RESOURCE_EXHAUSTED is also translated
+  into a drain + TpuRetryOOM (runtime/retry.py) as a second line of
+  defense.
+- Spilling a batch is `jax.device_get` of its planes (host numpy tier)
+  and `np.save` per plane for the disk tier; rematerialization is a
+  single `jax.device_put` per plane. No pinned-buffer machinery: PJRT
+  stages transfers itself.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+DEVICE, HOST, DISK = "device", "host", "disk"
+
+
+class SpillableHandle:
+    """One registered batch. State machine: device -> host -> disk,
+    rematerialized back to device on demand (`get`). Priority: larger
+    batches spill first (reference SpillFramework spills biggest-first to
+    minimize handle churn)."""
+
+    def __init__(self, framework: "SpillFramework", batch: ColumnarBatch):
+        self.fw = framework
+        self.handle_id = uuid.uuid4().hex
+        self.size = batch.device_memory_size()
+        self._lock = threading.Lock()
+        self._tier = DEVICE
+        self._device: Optional[ColumnarBatch] = batch
+        self._host = None  # leaves (host numpy)
+        self._disk_paths: Optional[List[str]] = None
+        self._treedef = None
+        self._closed = False
+        self._pinned = False  # mid-rematerialization: not a spill victim
+
+    @property
+    def tier(self) -> str:
+        return self._tier
+
+    def spillable(self) -> bool:
+        return self._tier == DEVICE and not self._closed and not self._pinned
+
+    # -- transitions -------------------------------------------------------
+
+    def spill_to_host(self) -> int:
+        """device -> host. Returns bytes freed from the device tier."""
+        with self._lock:
+            if self._tier != DEVICE or self._closed or self._pinned:
+                return 0
+            leaves, treedef = jax.tree_util.tree_flatten(self._device)
+            self._host = jax.device_get(leaves)
+            self._treedef = treedef
+            self._device = None
+            self._tier = HOST
+            return self.size
+
+    def spill_to_disk(self) -> int:
+        """host -> disk. Returns bytes freed from the host tier."""
+        with self._lock:
+            if self._tier != HOST or self._closed or self._pinned:
+                return 0
+            paths = []
+            for i, leaf in enumerate(self._host):
+                path = os.path.join(self.fw.spill_dir,
+                                    f"{self.handle_id}_{i}.npy")
+                np.save(path, np.asarray(leaf), allow_pickle=False)
+                paths.append(path)
+            self._disk_paths = paths
+            self._host = None
+            self._tier = DISK
+            return self.size
+
+    def get(self) -> ColumnarBatch:
+        """Rematerialize on device. NEVER calls into the framework while
+        holding the handle lock (reserve may pick other handles — possibly
+        themselves rematerializing — as victims; holding the lock across
+        that is an ABBA deadlock). The handle is pinned for the duration so
+        concurrent spills skip it."""
+        with self._lock:
+            if self._closed:
+                raise ValueError("handle closed")
+            if self._tier == DEVICE:
+                return self._device
+            self._pinned = True
+            if self._tier == DISK:
+                self._host = [np.load(p) for p in self._disk_paths]
+                for p in self._disk_paths:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+                self._disk_paths = None
+                self._tier = HOST
+        try:
+            # best-effort: an over-budget handle was admitted once and must
+            # remain rematerializable (drain everything else, then load)
+            self.fw.reserve(self.size, exclude=self, best_effort=True)
+            with self._lock:
+                if self._tier == HOST:
+                    leaves = [jax.device_put(x) if isinstance(x, np.ndarray)
+                              else x for x in self._host]
+                    batch = jax.tree_util.tree_unflatten(self._treedef, leaves)
+                    self._device = ColumnarBatch(
+                        batch.columns, int(batch.num_rows), batch.row_mask)
+                    self._host = None
+                    self._tier = DEVICE
+                return self._device
+        finally:
+            with self._lock:
+                self._pinned = False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._disk_paths:
+                for p in self._disk_paths:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            self._device = None
+            self._host = None
+        self.fw.unregister(self)
+
+
+class SpillFramework:
+    """Cooperative HBM budget + the spill cascade."""
+
+    def __init__(self, device_budget_bytes: int, host_budget_bytes: int,
+                 spill_dir: Optional[str] = None):
+        self.device_budget = device_budget_bytes
+        self.host_budget = host_budget_bytes
+        self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="srt_spill_")
+        self._lock = threading.Lock()
+        self._handles: Dict[str, SpillableHandle] = {}
+        self.metrics = {"spill_to_host_bytes": 0, "spill_to_disk_bytes": 0,
+                        "spill_count": 0, "oom_drains": 0}
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, batch: ColumnarBatch) -> SpillableHandle:
+        """Register a device-resident batch. Enforces the budget by
+        spilling OTHER handles; a single batch larger than the whole
+        budget is admitted anyway (it already exists on device — the
+        cooperative budget cannot un-allocate it) after draining."""
+        h = SpillableHandle(self, batch)
+        from spark_rapids_tpu.runtime.retry import TpuRetryOOM
+        try:
+            self.reserve(h.size)
+        except TpuRetryOOM:
+            self.drain_all()
+        with self._lock:
+            self._handles[h.handle_id] = h
+        return h
+
+    def unregister(self, h: SpillableHandle) -> None:
+        with self._lock:
+            self._handles.pop(h.handle_id, None)
+
+    # -- accounting --------------------------------------------------------
+
+    def device_bytes_held(self) -> int:
+        with self._lock:
+            return sum(h.size for h in self._handles.values()
+                       if h.tier == DEVICE)
+
+    def host_bytes_held(self) -> int:
+        with self._lock:
+            return sum(h.size for h in self._handles.values()
+                       if h.tier == HOST)
+
+    def reserve(self, nbytes: int, exclude: Optional[SpillableHandle] = None,
+                best_effort: bool = False) -> None:
+        """Make room for an nbytes device materialization, spilling
+        registered device handles (largest first) as needed. Raises
+        TpuRetryOOM when even a full drain cannot fit the reservation —
+        the retry framework then splits the work. best_effort=True drains
+        what it can and returns instead of raising (used to rematerialize
+        handles that were admitted over-budget)."""
+        from spark_rapids_tpu.runtime.retry import TpuRetryOOM
+        if nbytes > self.device_budget:
+            if best_effort:
+                self.drain_all()
+                return
+            raise TpuRetryOOM(
+                f"reservation {nbytes}B exceeds device budget "
+                f"{self.device_budget}B")
+        while self.device_bytes_held() + nbytes > self.device_budget:
+            victim = self._pick_victim(exclude)
+            if victim is None:
+                if best_effort:
+                    return
+                raise TpuRetryOOM(
+                    f"cannot reserve {nbytes}B: "
+                    f"{self.device_bytes_held()}B held, nothing spillable")
+            freed = victim.spill_to_host()
+            if freed:
+                self.metrics["spill_to_host_bytes"] += freed
+                self.metrics["spill_count"] += 1
+                self._enforce_host_budget()
+            elif best_effort:
+                return
+
+    def _pick_victim(self, exclude) -> Optional[SpillableHandle]:
+        with self._lock:
+            cands = [h for h in self._handles.values()
+                     if h.spillable() and h is not exclude]
+        if not cands:
+            return None
+        return max(cands, key=lambda h: h.size)
+
+    def _enforce_host_budget(self) -> None:
+        while self.host_bytes_held() > self.host_budget:
+            with self._lock:
+                cands = [h for h in self._handles.values() if h.tier == HOST]
+            if not cands:
+                return
+            victim = max(cands, key=lambda h: h.size)
+            freed = victim.spill_to_disk()
+            if freed:
+                self.metrics["spill_to_disk_bytes"] += freed
+            else:
+                return
+
+    def drain_all(self) -> int:
+        """Emergency drain (the DeviceMemoryEventHandler analog, called
+        when XLA itself reports RESOURCE_EXHAUSTED)."""
+        self.metrics["oom_drains"] += 1
+        freed = 0
+        while True:
+            victim = self._pick_victim(None)
+            if victim is None:
+                return freed
+            got = victim.spill_to_host()
+            freed += got
+            if got:
+                self._enforce_host_budget()
+
+
+class SpillableColumnarBatch:
+    """Operator currency: hold this between pipeline steps instead of a raw
+    batch so OTHER tasks' reservations can evict it (reference
+    SpillableColumnarBatch.scala)."""
+
+    def __init__(self, batch: ColumnarBatch, fw: Optional["SpillFramework"] = None):
+        self.fw = fw or get_spill_framework()
+        self.handle = self.fw.register(batch)
+
+    def get_batch(self) -> ColumnarBatch:
+        return self.handle.get()
+
+    @property
+    def size(self) -> int:
+        return self.handle.size
+
+    def close(self) -> None:
+        self.handle.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_GLOBAL: Optional[SpillFramework] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_spill_framework(conf=None) -> SpillFramework:
+    """Process-wide framework. When a conf is passed (each session collect
+    does), the budgets are re-synced so a later session's settings are not
+    silently ignored."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if conf is None and _GLOBAL is not None:
+            return _GLOBAL
+        if conf is None:
+            from spark_rapids_tpu.config import conf as _active
+            conf = _active()
+        if _GLOBAL is None:
+            _GLOBAL = SpillFramework(
+                conf.get(C.DEVICE_MEMORY_BUDGET),
+                conf.get(C.HOST_SPILL_LIMIT),
+                spill_dir=None)
+        else:
+            _GLOBAL.device_budget = conf.get(C.DEVICE_MEMORY_BUDGET)
+            _GLOBAL.host_budget = conf.get(C.HOST_SPILL_LIMIT)
+        return _GLOBAL
+
+
+def reset_spill_framework() -> None:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = None

@@ -1,0 +1,162 @@
+"""Retry-on-OOM framework.
+
+Reference parity: RmmRapidsRetryIterator.scala (withRetry /
+withRetryNoSplit / split policies) + the jni.RmmSpark state machine
+(GpuRetryOOM / GpuSplitAndRetryOOM) + the injection grammar of
+spark.rapids.sql.test.injectRetryOOM (RapidsConf.scala:1627).
+
+TPU-first divergence: there is no allocator state machine blocking
+threads. OOM arises two ways —
+1. cooperatively, when SpillFramework.reserve() cannot fit a reservation
+   (TpuRetryOOM raised synchronously), and
+2. physically, when XLA raises RESOURCE_EXHAUSTED from a kernel; the
+   wrapper translates that into a spill-store drain plus a retry.
+Work wrapped in with_retry must be idempotent and its inputs spillable
+(same contract as the reference). On TpuSplitAndRetryOOM the input batch
+is split in half and each half retried — the split cascades recursively
+down to a single row.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterator, List, Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.ops import kernels as K
+
+
+class TpuOOM(RuntimeError):
+    pass
+
+
+class TpuRetryOOM(TpuOOM):
+    """Retry the same work after memory has been freed."""
+
+
+class TpuSplitAndRetryOOM(TpuOOM):
+    """The work itself is too large: split the input and retry halves."""
+
+
+def is_device_oom(exc: BaseException) -> bool:
+    s = str(exc)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s \
+        or "Resource exhausted" in s
+
+
+class OomInjector:
+    """Test fault injection: force the next N with_retry attempts to OOM
+    (reference RmmSpark.forceRetryOOM / the injectRetryOOM conf). State is
+    process-global: exec partitions run on pool worker threads, so
+    thread-local counters configured on the driver thread would never
+    fire where the retries actually happen."""
+
+    _lock = threading.Lock()
+    _num = 0
+    _skip = 0
+    _split = False
+
+    @classmethod
+    def configure(cls, num_ooms: int = 0, skip: int = 0,
+                  split: bool = False) -> None:
+        with cls._lock:
+            cls._num = num_ooms
+            cls._skip = skip
+            cls._split = split
+
+    @classmethod
+    def from_conf(cls, conf) -> None:
+        from spark_rapids_tpu import config as C
+        spec = conf.get(C.RETRY_OOM_INJECT)
+        if not spec:
+            cls.configure(0)  # a session without injection clears leftovers
+            return
+        try:
+            parts = [p.strip() for p in str(spec).split(",")]
+            num = int(parts[0]) if parts[0] else 0
+            skip = int(parts[1]) if len(parts) > 1 and parts[1] else 0
+            split = len(parts) > 2 and parts[2].lower() == "split"
+        except ValueError as e:
+            raise ValueError(
+                f"invalid {C.RETRY_OOM_INJECT.key} spec {spec!r}: expected "
+                f"'count[,skip[,split]]'") from e
+        cls.configure(num, skip, split)
+
+    @classmethod
+    def maybe_throw(cls) -> None:
+        with cls._lock:
+            if cls._num <= 0:
+                return
+            if cls._skip > 0:
+                cls._skip -= 1
+                return
+            cls._num -= 1
+            split = cls._split
+        if split:
+            raise TpuSplitAndRetryOOM("injected split-retry OOM")
+        raise TpuRetryOOM("injected retry OOM")
+
+
+def split_in_half(batch: ColumnarBatch) -> List[ColumnarBatch]:
+    """Default split policy (reference splitSpillableInHalfByRows)."""
+    n = int(batch.num_rows)
+    if n <= 1:
+        raise TpuSplitAndRetryOOM("cannot split a single-row batch further")
+    if batch.row_mask is not None:
+        batch = K.compact_batch(batch)
+        n = int(batch.num_rows)
+    half = n // 2
+    return [K.slice_batch(batch, 0, half), K.slice_batch(batch, half, n - half)]
+
+
+class _Split(Exception):
+    pass
+
+
+def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
+                        splittable: bool) -> object:
+    """Shared retry loop: injection check, OOM translation, spill drain.
+    Raises _Split when the caller should split the input instead."""
+    from spark_rapids_tpu.runtime.memory import get_spill_framework
+
+    retries = 0
+    while True:
+        try:
+            OomInjector.maybe_throw()
+            return attempt()
+        except TpuSplitAndRetryOOM:
+            if splittable:
+                raise _Split()
+            raise
+        except Exception as e:  # noqa: BLE001 - translate device OOM too
+            if not isinstance(e, TpuRetryOOM) and not is_device_oom(e):
+                raise
+            retries += 1
+            if retries > max_retries:
+                raise
+            get_spill_framework().drain_all()
+
+
+def with_retry(attempt: Callable[[ColumnarBatch], object],
+               batch: ColumnarBatch,
+               split_policy: Callable[[ColumnarBatch], List[ColumnarBatch]]
+               = split_in_half,
+               max_retries: int = 8) -> Iterator[object]:
+    """Run `attempt(batch)`, retrying on OOM. Yields one result per
+    (sub-)batch — a split produces several results, which the caller
+    treats exactly like extra input batches (the reference's withRetry
+    returns an iterator for the same reason)."""
+    stack = [batch]
+    while stack:
+        b = stack.pop(0)
+        try:
+            yield _attempt_with_drain(lambda: attempt(b), max_retries,
+                                      splittable=True)
+        except _Split:
+            stack = split_policy(b) + stack
+
+
+def with_retry_no_split(attempt: Callable[[], object],
+                        max_retries: int = 8) -> object:
+    """Retry-only wrapper for non-splittable work (reference
+    withRetryNoSplit)."""
+    return _attempt_with_drain(attempt, max_retries, splittable=False)

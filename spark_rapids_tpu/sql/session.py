@@ -56,7 +56,11 @@ class TpuSession:
     def collect(self, plan: P.PlanNode) -> pa.Table:
         from spark_rapids_tpu.config import set_session_conf
         from spark_rapids_tpu.plan.overrides import convert_plan
+        from spark_rapids_tpu.runtime.memory import get_spill_framework
+        from spark_rapids_tpu.runtime.retry import OomInjector
         set_session_conf(self.conf)
+        OomInjector.from_conf(self.conf)
+        get_spill_framework(self.conf)  # sync budgets to this session
         exec_root, meta = convert_plan(plan, self.conf)
         self._last_meta = meta
         explain_mode = self.conf.get(C.SQL_EXPLAIN).upper()

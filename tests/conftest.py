@@ -28,4 +28,8 @@ import pytest  # noqa: E402
 def _reset_runtime():
     yield
     from spark_rapids_tpu.runtime.semaphore import reset_semaphore
+    from spark_rapids_tpu.runtime.memory import reset_spill_framework
+    from spark_rapids_tpu.runtime.retry import OomInjector
     reset_semaphore()
+    reset_spill_framework()
+    OomInjector.configure(0)

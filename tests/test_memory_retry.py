@@ -1,0 +1,138 @@
+"""Memory runtime tests: spill cascade, retry-OOM, split-retry, injection.
+
+Reference parity: tests/.../RmmSparkRetrySuiteBase + WithRetrySuite +
+HashAggregateRetrySuite + spill/SpillFrameworkSuite (SURVEY.md §4.2) —
+the OOM-injection fixture pattern, adapted to the cooperative budget.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.batch import from_pydict
+from spark_rapids_tpu.runtime.memory import (
+    SpillFramework, SpillableColumnarBatch, reset_spill_framework,
+)
+from spark_rapids_tpu.runtime.retry import (
+    OomInjector, TpuRetryOOM, TpuSplitAndRetryOOM, with_retry,
+    with_retry_no_split,
+)
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+
+def _batch(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return from_pydict({"a": rng.integers(0, 50, n),
+                        "b": rng.uniform(0, 1, n)})
+
+
+def test_spill_handle_roundtrip_tiers():
+    fw = SpillFramework(1 << 30, 1 << 30)
+    b = _batch(64)
+    h = fw.register(b)
+    expect = b.columns[0].data.copy()
+    assert h.tier == "device"
+    assert h.spill_to_host() == h.size
+    assert h.tier == "host"
+    assert h.spill_to_disk() == h.size
+    assert h.tier == "disk"
+    back = h.get()
+    assert h.tier == "device"
+    np.testing.assert_array_equal(np.asarray(back.columns[0].data),
+                                  np.asarray(expect))
+    h.close()
+
+
+def test_reserve_spills_largest_first():
+    big, small = _batch(4096, 1), _batch(64, 2)
+    fw = SpillFramework(big.device_memory_size()
+                        + small.device_memory_size() + 1024, 1 << 30)
+    hb, hs = fw.register(big), fw.register(small)
+    fw.reserve(2048)  # must evict someone; biggest first
+    assert hb.tier == "host"
+    assert hs.tier == "device"
+    assert fw.metrics["spill_count"] == 1
+
+
+def test_reserve_cascades_to_disk():
+    b1, b2 = _batch(1024, 1), _batch(1024, 2)
+    host_budget = b1.device_memory_size() // 2  # host can't hold a batch
+    fw = SpillFramework(b1.device_memory_size() + 512, host_budget)
+    h1 = fw.register(b1)
+    h2 = fw.register(b2)  # over budget already; reserve forces the drain
+    fw.reserve(1024)
+    tiers = sorted([h1.tier, h2.tier])
+    assert "disk" in tiers  # spilled through host to disk
+    assert fw.metrics["spill_to_disk_bytes"] > 0
+
+
+def test_reserve_raises_when_nothing_spillable():
+    fw = SpillFramework(1 << 20, 1 << 30)
+    with pytest.raises(TpuRetryOOM):
+        fw.reserve(1 << 21)  # larger than the whole budget
+
+
+def test_with_retry_injected_retry_succeeds():
+    OomInjector.configure(num_ooms=2)
+    calls = []
+
+    def attempt(b):
+        calls.append(1)
+        return int(b.num_rows)
+
+    out = list(with_retry(attempt, _batch(10)))
+    assert out == [10]
+    assert len(calls) == 1  # injector fired before the attempt ran
+
+
+def test_with_retry_split_produces_partials():
+    OomInjector.configure(num_ooms=1, split=True)
+    seen = []
+
+    def attempt(b):
+        seen.append(int(b.num_rows))
+        return int(b.num_rows)
+
+    out = list(with_retry(attempt, _batch(10)))
+    assert sum(out) == 10
+    assert len(out) == 2  # split in half, both halves processed
+
+
+def test_with_retry_split_cascades_to_single_row_limit():
+    OomInjector.configure(num_ooms=100, split=True)
+    with pytest.raises(TpuSplitAndRetryOOM):
+        list(with_retry(lambda b: 1, _batch(2)))
+
+
+def test_with_retry_no_split():
+    OomInjector.configure(num_ooms=1)
+    assert with_retry_no_split(lambda: 42) == 42
+
+
+def test_agg_with_injected_split_retry_correct():
+    # end-to-end: injected split-retry inside the aggregate update must not
+    # change results (reference HashAggregateRetrySuite + inject_oom mark)
+    t = pa.table({"k": ["a", "b"] * 32, "v": list(range(64))})
+    plain = TpuSession().create_dataframe(t).group_by("k") \
+        .agg(F.sum(col("v"))).collect().to_pylist()
+    s = TpuSession({"spark.rapids.sql.test.injectRetryOOM": "1,0,split"})
+    injected = s.create_dataframe(t).group_by("k") \
+        .agg(F.sum(col("v"))).collect().to_pylist()
+    assert sorted(map(tuple, (r.items() for r in injected))) == \
+        sorted(map(tuple, (r.items() for r in plain)))
+
+
+def test_cache_pages_out_under_tiny_budget():
+    # a budget smaller than two cached partitions forces the cache to page
+    reset_spill_framework()
+    t = pa.table({"x": np.arange(20000, dtype=np.int64),
+                  "y": np.random.default_rng(0).uniform(0, 1, 20000)})
+    s = TpuSession({"spark.rapids.memory.tpu.budgetBytes": 400_000})
+    df = s.create_dataframe(t).cache()
+    assert df.count() == 20000
+    # run several queries; each rematerialization may evict the other
+    assert df.filter(col("x") > lit(10000)).count() == 9999
+    got = df.agg(F.sum(col("x"))).to_pydict()
+    assert list(got.values())[0][0] == 20000 * 19999 // 2
+    reset_spill_framework()
