@@ -185,6 +185,8 @@ def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
         return _gather_cols(child, np.arange(min(plan.n, n)))
     if isinstance(plan, P.Union):
         return _exec_union(plan, children)
+    if isinstance(plan, P.WindowNode):
+        return _exec_window(plan, children[0], ansi)
     if isinstance(plan, P.Join):
         return _exec_join(plan, children[0], children[1], ansi)
     if isinstance(plan, P.Expand):
@@ -215,6 +217,148 @@ def _exec_union(plan: P.Union, parts: List[List[CpuCol]]) -> List[CpuCol]:
         valid = np.concatenate([p[i].valid for p in parts])
         out.append(CpuCol(f.dtype, vals, valid))
     return out
+
+
+def _exec_window(plan: "P.WindowNode", child: List[CpuCol], ansi: bool
+                 ) -> List[CpuCol]:
+    """Reference semantics for window functions, evaluated row-by-row per
+    sorted partition (test-scale interpreter)."""
+    from spark_rapids_tpu.expr import window as WE
+    from spark_rapids_tpu.expr import aggregates as A
+    n = len(child[0].values) if child else 0
+    spec = plan.window_exprs[0].spec
+    pc = [_norm_key_np(e.eval_cpu(child, ansi)) for e in spec.partition_exprs]
+    oc = [_norm_key_np(o.expr.eval_cpu(child, ansi)) for o in spec.order_specs]
+    # sort by (partition, order) with spark null ordering; lexsort's last
+    # key is primary, so push order keys first then partition keys
+    keys = []
+    for (code, nulls), o in zip(reversed(oc), reversed(spec.order_specs)):
+        nf = o.resolved_nulls_first()
+        keys.append(code if o.ascending else ~code)
+        keys.append(np.where(nulls, 0 if nf else 1, 1 if nf else 0).astype(np.uint8))
+    for code, nulls in reversed(pc):
+        keys.append(code)
+        keys.append(nulls.astype(np.uint8))
+    perm = np.lexsort(keys) if keys else np.arange(n)
+    out = _gather_cols(child, perm)
+
+    def boundary(cols_codes):
+        b = np.zeros(n, np.bool_)
+        if n:
+            b[0] = True
+        for code, nulls in cols_codes:
+            cs, ns = code[perm], nulls[perm]
+            b[1:] |= (cs[1:] != cs[:-1]) | (ns[1:] != ns[:-1])
+        return b
+
+    segb = boundary(pc)
+    peerb = (segb | boundary(oc)) if oc else segb.copy()
+    for w, name in zip(plan.window_exprs, plan.names):
+        out.append(_one_window_cpu(w, child, perm, segb, peerb, n, ansi))
+    return out
+
+
+def _one_window_cpu(w, child, perm, segb, peerb, n, ansi) -> CpuCol:
+    from spark_rapids_tpu.expr import window as WE
+    from spark_rapids_tpu.expr import aggregates as A
+    fn = w.fn
+    rt = fn.result_type()
+    frame = w.spec.resolved_frame()
+    starts = np.flatnonzero(segb)
+    bounds = list(starts) + [n]
+    vals = np.zeros(n, object)
+    valid = np.ones(n, np.bool_)
+    src = None
+    if fn.children:
+        src = fn.children[0].eval_cpu(child, ansi)
+        src = CpuCol(src.dtype, src.values[perm], src.valid[perm])
+    for gi in range(len(starts)):
+        lo, hi = bounds[gi], bounds[gi + 1]
+        rows = range(lo, hi)
+        if isinstance(fn, WE.RowNumber):
+            for i in rows:
+                vals[i] = i - lo + 1
+        elif isinstance(fn, (WE.Rank, WE.DenseRank)):
+            r = d = 0
+            for i in rows:
+                if peerb[i] or i == lo:
+                    r = i - lo + 1
+                    d += 1
+                vals[i] = r if isinstance(fn, WE.Rank) else d
+        elif isinstance(fn, WE.NTile):
+            size = hi - lo
+            base, rem = divmod(size, fn.n)
+            for i in rows:
+                pos = i - lo
+                cut = (base + 1) * rem
+                vals[i] = (pos // (base + 1) if pos < cut
+                           else rem + (pos - cut) // max(base, 1)) + 1
+        elif isinstance(fn, WE.LeadLag):
+            off = fn.offset if fn.is_lead else -fn.offset
+            for i in rows:
+                j = i + off
+                if lo <= j < hi:
+                    vals[i] = src.values[j]
+                    valid[i] = bool(src.valid[j])
+                elif fn.default is not None:
+                    vals[i] = fn.default
+                else:
+                    valid[i] = False
+        elif isinstance(fn, WE.WindowAgg):
+            agg = fn.fn
+            for i in rows:
+                if frame.kind == "range" and frame.upper == 0:
+                    e = i
+                    while e + 1 < hi and not peerb[e + 1]:
+                        e += 1
+                    a, b = lo, e
+                elif frame.lower is None and frame.upper is None:
+                    a, b = lo, hi - 1
+                elif frame.kind == "rows":
+                    a = lo if frame.lower is None else max(i + frame.lower, lo)
+                    b = hi - 1 if frame.upper is None else min(i + frame.upper, hi - 1)
+                else:
+                    a, b = lo, i
+                if isinstance(agg, A.CountAll):
+                    vals[i] = max(b - a + 1, 0)
+                    continue
+                window_vals = [src.values[j] for j in range(a, b + 1)
+                               if src.valid[j]] if b >= a else []
+                if isinstance(agg, A.Count):
+                    vals[i] = len(window_vals)
+                elif not window_vals:
+                    valid[i] = False
+                elif isinstance(agg, A.Sum):
+                    vals[i] = sum(window_vals)
+                elif isinstance(agg, A.Average):
+                    vals[i] = float(sum(window_vals)) / len(window_vals)
+                elif isinstance(agg, (A.Min, A.Max)):
+                    import math
+                    key = (lambda x: (isinstance(x, float) and math.isnan(x), x))
+                    vals[i] = (min if isinstance(agg, A.Min) else max)(
+                        window_vals, key=key)
+                elif isinstance(agg, (A.First, A.Last)):
+                    vals[i] = window_vals[-1 if isinstance(agg, A.Last) else 0]
+                elif isinstance(agg, A._MomentAgg):
+                    arr = np.asarray(window_vals, np.float64)
+                    ddof = 1 if isinstance(agg, (A.StddevSamp, A.VarianceSamp)) else 0
+                    if len(arr) <= ddof:
+                        valid[i] = False
+                    elif isinstance(agg, (A.StddevSamp, A.StddevPop)):
+                        vals[i] = float(np.std(arr, ddof=ddof))
+                    else:
+                        vals[i] = float(np.var(arr, ddof=ddof))
+                else:
+                    raise NotImplementedError(type(agg).__name__)
+        else:
+            raise NotImplementedError(type(fn).__name__)
+    if isinstance(rt, T.StringType):
+        np_vals = np.array([v if valid[i] else None
+                            for i, v in enumerate(vals)], object)
+    else:
+        np_vals = np.array([v if valid[i] else 0 for i, v in enumerate(vals)]
+                           ).astype(rt.np_dtype)
+    return CpuCol(rt, np_vals, valid)
 
 
 def _exec_sort(plan: P.Sort, child: List[CpuCol], ansi: bool) -> List[CpuCol]:

@@ -680,6 +680,179 @@ class _AggKernels:
         return ColumnarBatch(out_cols, state.num_rows, state.row_mask)
 
 
+class WindowExec(TpuExec):
+    """Window evaluation: one sort by (partition, order) keys, then every
+    window function as fused segmented scans (reference GpuWindowExec /
+    GpuRunningWindowExec; the whole node is ONE device dispatch)."""
+
+    def execute_partition(self, ctx, pidx):
+        from spark_rapids_tpu.ops import window as W
+        from spark_rapids_tpu.expr import window as WE
+        win_t = self.metrics.metric(M.OP_TIME)
+        batches = list(self.children[0].execute_partition(ctx, pidx))
+        if not batches:
+            return
+        self._acquire(ctx)
+        batch = K.concat_batches(batches) if len(batches) > 1 else batches[0]
+        if batch.row_mask is not None:
+            batch = K.compact_batch(batch)
+        exprs = self.plan.window_exprs
+        spec = exprs[0].spec  # one spec per node (planner groups)
+
+        def build():
+            def fn(batch):
+                nr = traced_rows(batch.num_rows)
+                ectx = EvalCtx(batch.columns, nr, batch.capacity, False)
+                pkeys = [e.eval_tpu(ectx) for e in spec.partition_exprs]
+                okeys = [o.expr.eval_tpu(ectx) for o in spec.order_specs]
+                pnorm = [K.normalize_key(c, nr) for c in pkeys]
+                onorm = [K.normalize_key(c, nr) for c in okeys]
+                sort_keys = [(k, nl, True, True) for k, nl in pnorm]
+                sort_keys += [(k, nl, o.ascending, o.resolved_nulls_first())
+                              for (k, nl), o in zip(onorm, spec.order_specs)]
+                if not sort_keys:
+                    perm = jnp.arange(batch.capacity, dtype=jnp.int32)
+                else:
+                    perm = K.lexsort_indices(sort_keys, nr)
+                sorted_batch = K.gather_batch(batch, perm, batch.num_rows)
+                cap = batch.capacity
+                first = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+                segb = first
+                for k, nl in pnorm:
+                    ks, ns = k[perm], nl[perm]
+                    segb = segb | jnp.concatenate(
+                        [jnp.zeros(1, jnp.bool_),
+                         (ks[1:] != ks[:-1]) | (ns[1:] != ns[:-1])])
+                peerb = segb
+                for k, nl in onorm:
+                    ks, ns = k[perm], nl[perm]
+                    peerb = peerb | jnp.concatenate(
+                        [jnp.zeros(1, jnp.bool_),
+                         (ks[1:] != ks[:-1]) | (ns[1:] != ns[:-1])])
+                seg_start, seg_end, peer_start, peer_end = \
+                    W.segment_layout(segb, peerb)
+                live = jnp.arange(cap) < nr
+                seg_end = jnp.minimum(seg_end,
+                                      jnp.maximum(nr - 1, 0).astype(seg_end.dtype))
+                peer_end = jnp.minimum(peer_end, seg_end)
+                seg_id = jnp.cumsum(segb.astype(jnp.int32))
+                idx = jnp.arange(cap, dtype=jnp.int32)
+                sctx = EvalCtx(sorted_batch.columns, nr, cap, False)
+                out_cols = list(sorted_batch.columns)
+                for w in exprs:
+                    out_cols.append(self._eval_window_fn(
+                        w, sctx, seg_start, seg_end, peer_start, peer_end,
+                        seg_id, segb, peerb, idx, live))
+                return ColumnarBatch(out_cols, batch.num_rows)
+            return fn
+
+        key = ("window", tuple(w.fingerprint() for w in exprs))
+        fn = fuse.fused(key, build)
+        with win_t.ns():
+            yield fn(batch)
+
+    def _eval_window_fn(self, w, sctx, seg_start, seg_end, peer_start,
+                        peer_end, seg_id, segb, peerb, idx, live):
+        from spark_rapids_tpu.ops import window as W
+        from spark_rapids_tpu.expr import window as WE
+        fn = w.fn
+        frame = w.spec.resolved_frame()
+        rt = fn.result_type()
+        if isinstance(fn, WE.RowNumber):
+            return ColumnVector(rt, W.row_number(seg_start), live)
+        if isinstance(fn, WE.Rank):
+            return ColumnVector(rt, W.rank(seg_start, peer_start), live)
+        if isinstance(fn, WE.DenseRank):
+            return ColumnVector(rt, W.dense_rank(segb, peerb, seg_start), live)
+        if isinstance(fn, WE.NTile):
+            return ColumnVector(rt, W.ntile(fn.n, seg_start, seg_end), live)
+        if isinstance(fn, WE.LeadLag):
+            src = fn.children[0].eval_tpu(sctx)
+            off = fn.offset if fn.is_lead else -fn.offset
+            svalid = src.validity if src.validity is not None else live
+            vals, valid = W.lead_lag(src.data, svalid, seg_id, off)
+            if fn.default is not None:
+                in_seg = (idx + off >= seg_start) & (idx + off <= seg_end)
+                dv = jnp.asarray(fn.default, src.data.dtype)
+                vals = jnp.where(~in_seg, dv, vals)
+                valid = valid | ~in_seg
+            return ColumnVector(src.dtype, vals, valid & live)
+        if isinstance(fn, WE.WindowAgg):
+            return self._eval_window_agg(fn, frame, sctx, seg_start, seg_end,
+                                         peer_end, seg_id, idx, live)
+        raise NotImplementedError(type(fn).__name__)
+
+    def _eval_window_agg(self, fn, frame, sctx, seg_start, seg_end,
+                         peer_end, seg_id, idx, live):
+        from spark_rapids_tpu.ops import window as W
+        from spark_rapids_tpu.expr import aggregates as A
+        agg = fn.fn
+        rt = agg.result_type()
+        if agg.children:
+            src = agg.children[0].eval_tpu(sctx)
+            vals = src.data
+            svalid = (src.validity if src.validity is not None else live) & live
+        else:  # count(*)
+            vals = jnp.ones(idx.shape[0], jnp.int64)
+            svalid = live
+        # frame end per row
+        if frame.kind == "range":
+            frame_end = peer_end if frame.upper == 0 else seg_end
+        else:
+            frame_end = idx if frame.upper == 0 else seg_end
+        unbounded = frame.lower is None and frame.upper is None
+        bounded_rows = frame.kind == "rows" and not (
+            frame.lower is None and frame.upper == 0) and not unbounded
+
+        def sum_count():
+            if bounded_rows:
+                v = vals
+                if isinstance(agg, A.Average):
+                    v = v.astype(jnp.float64)
+                elif not jnp.issubdtype(v.dtype, jnp.floating):
+                    v = v.astype(jnp.int64)
+                return W.bounded_sum_count(v, svalid, seg_start, seg_end,
+                                           frame.lower, frame.upper)
+            fe = seg_end if unbounded else frame_end
+            v = vals
+            if isinstance(agg, (A.Sum, A.Average)) and \
+                    not jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(jnp.int64)
+            if isinstance(agg, A.Average):
+                v = v.astype(jnp.float64)
+            return W.running_sum_count(v, svalid, seg_start, fe)
+
+        if isinstance(agg, A.Average):
+            s, c = sum_count()
+            return ColumnVector(rt, s / jnp.maximum(c, 1), (c > 0) & live)
+        if isinstance(agg, A.Sum):
+            s, c = sum_count()
+            return ColumnVector(rt, s.astype(rt.np_dtype), (c > 0) & live)
+        if isinstance(agg, (A.Count, A.CountAll)):
+            s, c = sum_count()
+            cnt = c if isinstance(agg, A.Count) else None
+            if isinstance(agg, A.CountAll):
+                # count(*) counts rows regardless of validity
+                if bounded_rows:
+                    ones = jnp.ones(idx.shape[0], jnp.int64)
+                    s2, _ = W.bounded_sum_count(ones, live, seg_start, seg_end,
+                                                frame.lower, frame.upper)
+                    cnt = s2
+                else:
+                    fe = seg_end if unbounded else frame_end
+                    s2, _ = W.running_sum_count(
+                        jnp.ones(idx.shape[0], jnp.int64), live, seg_start, fe)
+                    cnt = s2
+            return ColumnVector(T.INT64, cnt.astype(jnp.int64),
+                                jnp.ones_like(live) & live)
+        if isinstance(agg, (A.Min, A.Max)):
+            op = "min" if isinstance(agg, A.Min) else "max"
+            fe = seg_end if unbounded else frame_end
+            v, c = W.running_minmax(op, vals, svalid, seg_id, seg_start, fe)
+            return ColumnVector(rt, v.astype(rt.np_dtype), (c > 0) & live)
+        raise NotImplementedError(type(agg).__name__)
+
+
 class HashAggregateExec(TpuExec):
     """Sort-based segmented aggregation in three phases (reference
     GpuAggregateExec.scala three-pass design §2.4):
@@ -734,14 +907,25 @@ class HashAggregateExec(TpuExec):
             update_fn = fuse.fused(self._sig("update", ansi),
                                    lambda: self.kern._build_update(ansi))
             from spark_rapids_tpu.runtime.retry import with_retry
+
+            def attempt(b):
+                # raise_errors inside the attempt so ANSI-mode syncs (and
+                # any device OOM they surface) are seen by the retry loop.
+                # Note: under async dispatch a physical RESOURCE_EXHAUSTED
+                # can still surface at a LATER sync point; the cooperative
+                # budget (SpillFramework.reserve) is the primary defense,
+                # this translation is best-effort.
+                out, errs = update_fn(b)
+                compiled.raise_errors(errs)
+                return out
+
             partials = []
             for batch in child_batches:
                 self._acquire(ctx)
                 with agg_t.ns():
                     # update is idempotent over its input batch: retried
                     # after a spill drain, or split in half, on OOM
-                    for out, errs in with_retry(update_fn, batch):
-                        compiled.raise_errors(errs)
+                    for out in with_retry(attempt, batch):
                         if nkeys == 0:
                             out = ColumnarBatch(out.columns, 1)
                         partials.append(out)

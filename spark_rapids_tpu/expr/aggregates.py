@@ -59,6 +59,11 @@ class AggFunction:
         kids = ",".join(c.fingerprint() for c in self.children)
         return f"{type(self).__name__}({kids})"
 
+    def over(self, spec):
+        """agg OVER window-spec -> WindowExpr (pyspark F.sum(c).over(w))."""
+        from spark_rapids_tpu.expr.window import over as _over
+        return _over(self, spec)
+
     def transform(self, fn):
         clone = type(self)(*[c.transform(fn) for c in self.children])
         return clone

@@ -1,0 +1,172 @@
+"""Segmented-scan window kernels.
+
+Reference parity: the device algorithms behind GpuRunningWindowExec /
+GpuBatchedBoundedWindowExec / rank-family expressions (SURVEY.md §2.4) —
+re-designed as whole-plane prefix scans instead of cuDF rolling-window
+kernels: after ONE sort by (partition, order) keys, every window function
+is O(n) cumulative ops (cumsum / associative_scan with a segment-reset
+combiner), which XLA fuses into the surrounding stage.
+
+All kernels run over the SORTED row order. Inputs:
+  seg_start[i]  — index of the first row of i's partition
+  peer_start[i] — index of the first row of i's peer group (same partition
+                  AND equal order keys; rank/range-frame semantics)
+  live[i]       — rows beyond num_rows are dead (sorted to the tail)
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def segment_layout(seg_boundary: jax.Array, peer_boundary: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """From boundary masks to (seg_start, seg_end, peer_start, peer_end),
+    all inclusive row indices in sorted order."""
+    n = seg_boundary.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    seg_start = lax.cummax(jnp.where(seg_boundary, idx, 0))
+    peer_start = lax.cummax(jnp.where(peer_boundary, idx, 0))
+    # end = (next boundary) - 1, scanning from the right
+    big = jnp.int32(n - 1)
+    nxt_seg = jnp.where(seg_boundary, idx, n)
+    seg_end = jnp.minimum(
+        jnp.flip(lax.cummin(jnp.flip(jnp.roll(nxt_seg, -1).at[-1].set(n)))) - 1, big)
+    nxt_peer = jnp.where(peer_boundary, idx, n)
+    peer_end = jnp.minimum(
+        jnp.flip(lax.cummin(jnp.flip(jnp.roll(nxt_peer, -1).at[-1].set(n)))) - 1, big)
+    return seg_start, seg_end, peer_start, peer_end
+
+
+def row_number(seg_start: jax.Array) -> jax.Array:
+    n = seg_start.shape[0]
+    return (jnp.arange(n, dtype=jnp.int32) - seg_start + 1).astype(jnp.int32)
+
+
+def rank(seg_start: jax.Array, peer_start: jax.Array) -> jax.Array:
+    return (peer_start - seg_start + 1).astype(jnp.int32)
+
+
+def dense_rank(seg_boundary: jax.Array, peer_boundary: jax.Array,
+               seg_start: jax.Array) -> jax.Array:
+    peers_before = jnp.cumsum(peer_boundary.astype(jnp.int32))
+    return (peers_before - peers_before[seg_start] + 1).astype(jnp.int32)
+
+
+def _seg_cumsum(x: jax.Array, seg_start: jax.Array) -> jax.Array:
+    """Inclusive cumulative sum reset at segment starts."""
+    cs = jnp.cumsum(x)
+    return cs - cs[seg_start] + x[seg_start]
+
+
+def running_sum_count(vals: jax.Array, valid: jax.Array, seg_start: jax.Array,
+                      frame_end: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """sum/count over [segment start, frame_end[i]] (frame_end = i for ROWS
+    current-row, peer_end for RANGE current-row). Returns (sum, nvalid)."""
+    masked = jnp.where(valid, vals, jnp.zeros_like(vals))
+    cs = _seg_cumsum(masked, seg_start)
+    cnt = _seg_cumsum(valid.astype(jnp.int64), seg_start)
+    return cs[frame_end], cnt[frame_end]
+
+
+def bounded_sum_count(vals: jax.Array, valid: jax.Array, seg_start: jax.Array,
+                      seg_end: jax.Array, lower: Optional[int],
+                      upper: Optional[int]
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """sum/count over ROWS BETWEEN lower AND upper (offsets; None =
+    unbounded). Prefix-difference over the segment-reset cumsum."""
+    n = vals.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    lo = seg_start if lower is None else jnp.maximum(idx + lower, seg_start)
+    hi = seg_end if upper is None else jnp.minimum(idx + upper, seg_end)
+    masked = jnp.where(valid, vals, jnp.zeros_like(vals))
+    cs = _seg_cumsum(masked, seg_start)
+    cnt = _seg_cumsum(valid.astype(jnp.int64), seg_start)
+    empty = hi < lo
+    lo_c = jnp.clip(lo, 0, n - 1)
+    hi_c = jnp.clip(hi, 0, n - 1)
+    # sum over [lo, hi] = cs[hi] - cs[lo] + x[lo]
+    s = cs[hi_c] - cs[lo_c] + masked[lo_c]
+    c = cnt[hi_c] - cnt[lo_c] + jnp.where(valid[lo_c], 1, 0)
+    return jnp.where(empty, jnp.zeros_like(s), s), jnp.where(empty, 0, c)
+
+
+def _seg_scan(op, x: jax.Array, seg_id: jax.Array) -> jax.Array:
+    """Inclusive segmented scan with combiner `op` (max/min), reset at
+    segment changes, via associative_scan over (seg_id, value) pairs."""
+
+    def combine(a, b):
+        sa, va = a
+        sb, vb = b
+        same = sa == sb
+        return sb, jnp.where(same, op(va, vb), vb)
+
+    _, out = lax.associative_scan(combine, (seg_id, x))
+    return out
+
+
+def running_minmax(op: str, vals: jax.Array, valid: jax.Array,
+                   seg_id: jax.Array, seg_start: jax.Array,
+                   frame_end: jax.Array,
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """min/max over [segment start, frame_end[i]]; NaN handled by Spark
+    total order (NaN > +inf) via where-substitution."""
+    vdt = vals.dtype
+    is_float = np.dtype(vdt) in (np.dtype(np.float32), np.dtype(np.float64))
+    nvalid = _seg_cumsum(valid.astype(jnp.int32), seg_start)
+    if is_float:
+        nanmask = jnp.isnan(vals)
+        sentinel = jnp.array(np.inf if op == "min" else -np.inf, vdt)
+        clean = jnp.where(valid & ~nanmask, vals, jnp.full_like(vals, sentinel))
+        red = _seg_scan(jnp.minimum if op == "min" else jnp.maximum, clean, seg_id)
+        any_nan = _seg_scan(jnp.maximum, (valid & nanmask).astype(jnp.int32),
+                            seg_id) > 0
+        any_nonnan = _seg_scan(jnp.maximum, (valid & ~nanmask).astype(jnp.int32),
+                               seg_id) > 0
+        if op == "max":
+            out = jnp.where(any_nan, jnp.array(np.nan, vdt), red)
+        else:
+            out = jnp.where(any_nonnan, red, jnp.array(np.nan, vdt))
+        return out[frame_end], nvalid[frame_end]
+    if np.dtype(vdt) == np.dtype(np.bool_):
+        ident = jnp.array(True if op == "min" else False)
+        neutral = jnp.where(valid, vals, ident)
+        red = _seg_scan(jnp.logical_and if op == "min" else jnp.logical_or,
+                        neutral, seg_id)
+        return red[frame_end], nvalid[frame_end]
+    info = np.iinfo(np.dtype(vdt))
+    ident = jnp.array(info.max if op == "min" else info.min, vdt)
+    neutral = jnp.where(valid, vals, jnp.full_like(vals, ident))
+    red = _seg_scan(jnp.minimum if op == "min" else jnp.maximum, neutral, seg_id)
+    return red[frame_end], nvalid[frame_end]
+
+
+def lead_lag(vals: jax.Array, valid: jax.Array, seg_id: jax.Array,
+             offset: int) -> Tuple[jax.Array, jax.Array]:
+    """value at row i+offset if still in the same partition, else null.
+    (lag = negative offset)."""
+    n = vals.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32) + offset
+    in_range = (idx >= 0) & (idx < n)
+    safe = jnp.clip(idx, 0, n - 1)
+    same = in_range & (seg_id[safe] == seg_id)
+    return vals[safe], same & valid[safe]
+
+
+def ntile(n_tiles: int, seg_start: jax.Array, seg_end: jax.Array) -> jax.Array:
+    """Spark ntile: first (size % n) tiles get one extra row."""
+    size = (seg_end - seg_start + 1).astype(jnp.int64)
+    pos = (jnp.arange(seg_start.shape[0], dtype=jnp.int64) - seg_start)
+    base = size // n_tiles
+    rem = size % n_tiles
+    cut = (base + 1) * rem  # rows covered by the bigger tiles
+    in_big = pos < cut
+    tile_big = pos // jnp.maximum(base + 1, 1)
+    tile_small = rem + (pos - cut) // jnp.maximum(base, 1)
+    return (jnp.where(in_big, tile_big, tile_small) + 1).astype(jnp.int32)

@@ -44,9 +44,7 @@ class PlanNode:
         return self.name()
 
 
-def bind_expr(e: Expression, schema: T.Schema, case_sensitive: bool = False) -> Expression:
-    """Resolve Col names to BoundRefs against a child schema."""
-
+def make_binder(schema: T.Schema, case_sensitive: bool = False):
     def binder(node):
         if isinstance(node, Col):
             name = node.name
@@ -55,8 +53,12 @@ def bind_expr(e: Expression, schema: T.Schema, case_sensitive: bool = False) -> 
                     return BoundRef(i, f.dtype, f.name)
             raise KeyError(f"column {name!r} not found in {schema.names}")
         return node
+    return binder
 
-    return e.transform(binder)
+
+def bind_expr(e: Expression, schema: T.Schema, case_sensitive: bool = False) -> Expression:
+    """Resolve Col names to BoundRefs against a child schema."""
+    return e.transform(make_binder(schema, case_sensitive))
 
 
 def expr_name(e: Expression, idx: int) -> str:
@@ -240,6 +242,45 @@ class Sort(PlanNode):
     def describe(self):
         parts = [f"{o.expr!r} {'ASC' if o.ascending else 'DESC'}" for o in self.orders]
         return f"Sort[{', '.join(parts)}]"
+
+
+class WindowNode(PlanNode):
+    """Window evaluation: appends one output column per WindowExpr to the
+    child's schema (reference GpuWindowExec; SURVEY.md §2.4 Window). All
+    exprs in one node share the same partition/order spec — the planner
+    groups by spec and chains nodes."""
+
+    def __init__(self, window_exprs, names: List[str], child: PlanNode):
+        from spark_rapids_tpu.expr.window import WindowExpr, WindowSpec
+        self.children = [child]
+        self.names = names
+        bound = []
+        for w in window_exprs:
+            spec = w.spec
+            if getattr(w.fn, "needs_order", False) and not spec.order_specs:
+                # Spark raises AnalysisException for these; silently
+                # computing over arbitrary order would be garbage
+                raise ValueError(
+                    f"{type(w.fn).__name__} requires the window to be "
+                    f"ordered (add ORDER BY to the window spec)")
+            bspec = WindowSpec(
+                [bind_expr(e, child.schema) for e in spec.partition_exprs],
+                [SortOrder(bind_expr(o.expr, child.schema), o.ascending,
+                           o.nulls_first) for o in spec.order_specs],
+                spec.frame)
+            bfn = w.fn.transform(make_binder(child.schema))
+            bound.append(WindowExpr(bfn, bspec))
+        self.window_exprs = bound
+
+    @property
+    def schema(self) -> T.Schema:
+        fields = list(self.children[0].schema.fields)
+        for w, n in zip(self.window_exprs, self.names):
+            fields.append(T.StructField(n, w.fn.result_type()))
+        return T.Schema(tuple(fields))
+
+    def describe(self):
+        return f"Window[{', '.join(self.names)}]"
 
 
 class Limit(PlanNode):

@@ -304,6 +304,48 @@ class SparkPlanMeta:
             for proj in p.projections:
                 for e in proj:
                     tag_expression(e, self.conf, self.reasons, name)
+        elif isinstance(p, P.WindowNode):
+            self._tag_window(p, name)
+
+    def _tag_window(self, p, name) -> None:
+        from spark_rapids_tpu.expr import window as WE
+        from spark_rapids_tpu.expr import aggregates as A
+        for w in p.window_exprs:
+            spec = w.spec
+            for e in spec.partition_exprs:
+                tag_expression(e, self.conf, self.reasons, name)
+            for o in spec.order_specs:
+                tag_expression(o.expr, self.conf, self.reasons, name)
+                if isinstance(o.expr.data_type(), T.StringType):
+                    self.reasons.append(
+                        f"{name}: window ORDER BY on strings needs host sort")
+            for c in w.fn.children:
+                tag_expression(c, self.conf, self.reasons, name)
+                if isinstance(c.data_type(), T.StringType):
+                    self.reasons.append(
+                        f"{name}: string-typed window operands run on CPU "
+                        f"(device window kernels are fixed-width planes)")
+            fn = w.fn
+            if isinstance(fn, (WE.RowNumber, WE.Rank, WE.DenseRank, WE.NTile,
+                               WE.LeadLag)):
+                pass  # needs_order enforced at plan build (AnalysisException)
+            elif isinstance(fn, WE.WindowAgg):
+                frame = spec.resolved_frame()
+                ok = (A.Sum, A.Count, A.CountAll, A.Min, A.Max, A.Average)
+                if not isinstance(fn.fn, ok):
+                    self.reasons.append(
+                        f"{name}: {type(fn.fn).__name__} not supported in "
+                        f"window frames on device")
+                bounded_rows = (frame.kind == "rows"
+                                and not (frame.lower is None and frame.upper in (0, None)))
+                if bounded_rows and isinstance(fn.fn, (A.Min, A.Max)):
+                    self.reasons.append(
+                        f"{name}: bounded-rows min/max window not yet on "
+                        f"device (needs a sliding-extrema kernel)")
+            else:
+                self.reasons.append(
+                    f"{name}: window function {type(fn).__name__} "
+                    f"not supported")
 
     @property
     def can_run_on_tpu(self) -> bool:
@@ -344,6 +386,17 @@ class SparkPlanMeta:
             if child.num_partitions > 1 and p.global_sort:
                 child = X.CollectExchangeExec(p, [child], conf)
             return X.SortExec(p, [child], conf)
+        if isinstance(p, P.WindowNode):
+            child = child_execs[0]
+            if child.num_partitions > 1:
+                spec = p.window_exprs[0].spec
+                if spec.partition_exprs:
+                    child = X.ShuffleExchangeExec(
+                        p, [child], conf, spec.partition_exprs,
+                        n_out=child.num_partitions)
+                else:
+                    child = X.CollectExchangeExec(p, [child], conf)
+            return X.WindowExec(p, [child], conf)
         if isinstance(p, P.Aggregate):
             return self._convert_aggregate(p, child_execs, conf)
         if isinstance(p, P.Join):

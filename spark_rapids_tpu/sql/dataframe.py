@@ -21,7 +21,57 @@ class DataFrame:
         self.session = session
 
     # -- transformations ---------------------------------------------------
+    def _extract_windows(self, exprs):
+        """Hoist WindowExprs out of a projection into WindowNode(s) below
+        it (reference: Catalyst's ExtractWindowExpressions)."""
+        from spark_rapids_tpu.expr import window as WE
+        found = []
+
+        def extract(e):
+            def repl(node):
+                if isinstance(node, WE.WindowExpr):
+                    name = f"__w{len(found)}"
+                    found.append((node, name))
+                    return E.col(name)
+                return node
+            return e.transform(repl)
+
+        new_exprs = []
+        for e in exprs:
+            if isinstance(e, WE.WindowExpr):
+                name = f"__w{len(found)}"
+                found.append((e, name))
+                new_exprs.append(E.Alias(E.col(name),
+                                         type(e.fn).__name__.lower()))
+            else:
+                new_exprs.append(extract(e))
+        if not found:
+            return exprs, self.plan
+        # group by spec so each WindowNode sorts once
+        plan = self.plan
+        groups = {}
+        for w, name in found:
+            groups.setdefault(w.spec.fingerprint(), []).append((w, name))
+        for items in groups.values():
+            plan = P.WindowNode([w for w, _ in items],
+                                [n for _, n in items], plan)
+        return new_exprs, plan
+
     def select(self, *exprs) -> "DataFrame":
+        es = [_e(x) for x in exprs]
+        from spark_rapids_tpu.expr import window as WE
+
+        def has_window(e):
+            if isinstance(e, WE.WindowExpr):
+                return True
+            return any(has_window(c) for c in e.children)
+
+        if any(has_window(e) for e in es):
+            new_es, plan = self._extract_windows(es)
+            return DataFrame(P.Project(new_es, plan), self.session)
+        return self._select_plain(*exprs)
+
+    def _select_plain(self, *exprs) -> "DataFrame":
         bound = [_e(x) for x in exprs]
         return DataFrame(P.Project(bound, self.plan), self.session)
 

@@ -74,6 +74,36 @@ def var_pop(c):
 
 
 # scalar ---------------------------------------------------------------------
+def row_number():
+    from spark_rapids_tpu.expr.window import RowNumber
+    return RowNumber()
+
+
+def rank():
+    from spark_rapids_tpu.expr.window import Rank
+    return Rank()
+
+
+def dense_rank():
+    from spark_rapids_tpu.expr.window import DenseRank
+    return DenseRank()
+
+
+def ntile(n: int):
+    from spark_rapids_tpu.expr.window import NTile
+    return NTile(n)
+
+
+def lead(c, offset: int = 1, default=None):
+    from spark_rapids_tpu.expr.window import Lead
+    return Lead(_e(c), offset, default)
+
+
+def lag(c, offset: int = 1, default=None):
+    from spark_rapids_tpu.expr.window import Lag
+    return Lag(_e(c), offset, default)
+
+
 def coalesce(*cs):
     return E.Coalesce(*[_e(c) for c in cs])
 

@@ -1,0 +1,136 @@
+"""Window function differential tests (reference window_function_test.py
+style — CPU vs TPU result diff per function/frame)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.window import Window
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _t(n=60, seed=11):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "g": pa.array(np.array(["x", "y", "z"], object)[rng.integers(0, 3, n)]),
+        "o": pa.array(rng.integers(0, 10, n).astype(np.int64)),
+        "v": pa.array([None if rng.random() < 0.2 else float(x)
+                       for x in rng.integers(-5, 20, n)]),
+    })
+
+
+W_GO = Window.partition_by("g").order_by("o")
+
+
+def test_row_number_rank_dense_rank(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), col("o"),
+            F.row_number().over(W_GO).alias("rn"),
+            F.rank().over(W_GO).alias("rk"),
+            F.dense_rank().over(W_GO).alias("dr")),
+        session, ignore_order=True)
+
+
+def test_ntile(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), col("o"), F.ntile(4).over(W_GO).alias("nt")),
+        session, ignore_order=True)
+
+
+def test_lead_lag(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), col("o"), col("v"),
+            F.lead(col("v")).over(W_GO).alias("ld"),
+            F.lag(col("v"), 2).over(W_GO).alias("lg"),
+            F.lead(col("o"), 1, -1).over(W_GO).alias("ld_def")),
+        session, ignore_order=True)
+
+
+def test_running_aggs_default_range_frame(session):
+    # default frame with ORDER BY = RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    # (includes peer rows — the tie semantics)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), col("o"), col("v"),
+            F.sum(col("v")).over(W_GO).alias("rsum"),
+            F.count(col("v")).over(W_GO).alias("rcnt"),
+            F.min(col("v")).over(W_GO).alias("rmin"),
+            F.max(col("v")).over(W_GO).alias("rmax"),
+            F.avg(col("v")).over(W_GO).alias("ravg")),
+        session, ignore_order=True, approx_float=1e-9)
+
+
+def test_whole_partition_frame(session):
+    w = Window.partition_by("g")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), col("v"),
+            F.sum(col("v")).over(w).alias("psum"),
+            F.count("*").over(w).alias("pcnt")),
+        session, ignore_order=True, approx_float=1e-9)
+
+
+def test_bounded_rows_frame(session):
+    w = W_GO.rows_between(-2, 1)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), col("o"), col("v"),
+            F.sum(col("v")).over(w).alias("bsum"),
+            F.count(col("v")).over(w).alias("bcnt"),
+            F.avg(col("v")).over(w).alias("bavg")),
+        session, ignore_order=True, approx_float=1e-9)
+
+
+def test_window_no_partition(session):
+    w = Window.order_by("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t(30)).select(
+            col("o"), F.row_number().over(w).alias("rn"),
+            F.sum(col("v")).over(w).alias("rs")),
+        session, ignore_order=True, approx_float=1e-9)
+
+
+def test_window_multi_partition_input(session):
+    # forces a hash exchange on the partition keys below the window
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t(80), num_partitions=3).select(
+            col("g"), col("o"),
+            F.row_number().over(W_GO).alias("rn"),
+            F.sum(col("v")).over(W_GO).alias("rs")),
+        session, ignore_order=True, approx_float=1e-9)
+
+
+def test_window_over_filtered_masked_input(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).filter(col("o") > lit(2)).select(
+            col("g"), col("o"),
+            F.row_number().over(W_GO).alias("rn")),
+        session, ignore_order=True)
+
+
+def test_window_expr_arithmetic(session):
+    # window expr nested inside arithmetic in the projection
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), (F.row_number().over(W_GO) * lit(10)).alias("rn10")),
+        session, ignore_order=True)
+
+
+def test_unsupported_window_falls_back(session):
+    # stddev in a window frame -> whole node falls back to CPU, results equal
+    from asserts import assert_fallback_collect
+    assert_fallback_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), F.stddev(col("v")).over(W_GO).alias("sd")),
+        session, "WindowNode", ignore_order=True)
