@@ -107,6 +107,22 @@ DEVICE_MEMORY_FRACTION = conf_float(
     "Fraction of per-chip HBM the arena budget may use "
     "(reference rmm.pool allocFraction).", startup_only=True)
 
+SORT_OOC_BYTES = conf_int(
+    "spark.rapids.sql.sort.outOfCoreBytes", 2 << 30,
+    "Sorts over inputs larger than this run out-of-core: the device "
+    "computes only the key permutation while row data stages through host "
+    "memory (reference GpuSortExec out-of-core merge path).")
+
+JOIN_SUBPARTITION_ROWS = conf_int(
+    "spark.rapids.sql.join.subPartitionRows", 8 << 20,
+    "Build sides larger than this many rows hash-split into buckets joined "
+    "pairwise (skew/no-fit handling; reference GpuSubPartitionHashJoin).")
+
+BROADCAST_JOIN_ROW_THRESHOLD = conf_int(
+    "spark.rapids.sql.join.broadcastRowThreshold", 1 << 22,
+    "Estimated build-side row count below which joins broadcast instead of "
+    "shuffling both sides (reference: Spark autoBroadcastJoinThreshold).")
+
 DEVICE_MEMORY_BUDGET = conf_int(
     "spark.rapids.memory.tpu.budgetBytes", 12 << 30,
     "Cooperative HBM budget in bytes for registered (spillable) batches; "

@@ -384,19 +384,70 @@ class SortExec(TpuExec):
         if not batches:
             return
         self._acquire(ctx)
+        total = sum(b.device_memory_size() for b in batches)
+        if total > self.conf.get(C.SORT_OOC_BYTES):
+            with sort_t.ns():
+                yield from self._out_of_core(batches)
+            return
         batch = K.concat_batches(batches) if len(batches) > 1 else batches[0]
         if batch.row_mask is not None:
             batch = K.compact_batch(batch)
         with sort_t.ns():
-            key_exprs = [o.expr for o in self.plan.orders]
-            key_cols = compiled.run_stage(key_exprs, batch)
-            keys = []
-            for o, kc in zip(self.plan.orders, key_cols):
-                k, nulls = K.normalize_key(kc, batch.num_rows,
-                                           for_order=isinstance(kc.dtype, T.StringType))
-                keys.append((k, nulls, o.ascending, o.resolved_nulls_first()))
-            perm = K.lexsort_indices(keys, batch.num_rows)
+            perm = self._sort_perm(batch)
             yield K.gather_batch(batch, perm, batch.num_rows)
+
+    def _sort_perm(self, batch):
+        key_exprs = [o.expr for o in self.plan.orders]
+        key_cols = compiled.run_stage(key_exprs, batch)
+        keys = []
+        for o, kc in zip(self.plan.orders, key_cols):
+            k, nulls = K.normalize_key(kc, batch.num_rows,
+                                       for_order=isinstance(kc.dtype, T.StringType),
+                                       live=batch.live_mask())
+            keys.append((k, nulls, o.ascending, o.resolved_nulls_first()))
+        return K.lexsort_indices(keys, traced_rows(batch.num_rows),
+                                 live=batch.live_mask())
+
+    def _out_of_core(self, batches):
+        """Out-of-core sort (reference GpuSortExec.scala:281 merge path,
+        TPU-shaped): only the u64 key planes live on device — per-chunk
+        keys are computed and the row data immediately staged to host
+        (pyarrow); one global argsort of the keys yields the permutation,
+        and pyarrow assembles the sorted output host-side, re-uploaded in
+        reader-sized slices."""
+        import pyarrow as pa
+        key_planes, tables = [], []
+        names = self.schema.names
+        for b in batches:
+            if b.row_mask is not None:
+                b = K.compact_batch(b)
+            if int(b.num_rows) == 0:
+                continue
+            key_cols = compiled.run_stage([o.expr for o in self.plan.orders], b)
+            per_col = []
+            for o, kc in zip(self.plan.orders, key_cols):
+                k, nulls = K.normalize_key(
+                    kc, b.num_rows,
+                    for_order=isinstance(kc.dtype, T.StringType))
+                per_col.append((k[: int(b.num_rows)], nulls[: int(b.num_rows)]))
+            key_planes.append(per_col)
+            tables.append(to_arrow(b, names))  # stages the data off-device
+        if not tables:
+            return
+        ncols = len(self.plan.orders)
+        keys = []
+        for ci in range(ncols):
+            k = jnp.concatenate([kp[ci][0] for kp in key_planes])
+            nl = jnp.concatenate([kp[ci][1] for kp in key_planes])
+            o = self.plan.orders[ci]
+            keys.append((k, nl, o.ascending, o.resolved_nulls_first()))
+        n = int(keys[0][0].shape[0])
+        perm = np.asarray(K.lexsort_indices(keys, n))[:n]
+        table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        sorted_table = table.take(perm)
+        step = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
+        for off in range(0, n, step):
+            yield from_arrow(sorted_table.slice(off, min(step, n - off)))
 
 
 
@@ -1162,7 +1213,131 @@ class RoundRobinExchangeExec(ExchangeExec):
 # Joins
 # ---------------------------------------------------------------------------
 
-class BroadcastHashJoinExec(TpuExec):
+class _HashJoinBase(TpuExec):
+    """Shared probe loop for the hash-join family (reference GpuHashJoin /
+    JoinGatherer assembly). Skew handling: when the build side exceeds the
+    sub-partition threshold, both sides mask-split by key hash into k
+    buckets (zero-copy: shared planes, different selection masks) and join
+    pairwise — reference GpuSubPartitionHashJoin.scala:32,156-180."""
+
+    def _sub_parts(self, build_rows: int) -> int:
+        thr = self.conf.get(C.JOIN_SUBPARTITION_ROWS)
+        if build_rows <= thr:
+            return 1
+        return min(-(-build_rows // thr), 64)
+
+    #: width-normalized (lkeys, rkeys) for hashing; set by the planner on
+    #: the shuffled path, defaults to the plan's keys
+    part_keys = None
+
+    def _hash_keys(self, side: int):
+        if self.part_keys is not None:
+            return self.part_keys[side]
+        return self.plan.left_keys if side == 0 else self.plan.right_keys
+
+    def _bucket_split(self, batch, keys, k, seed=107):
+        """Mask-partition a batch into k hash buckets of its join keys
+        (seed 107 — the reference's agg-repartition seed)."""
+        key_cols = compiled.run_stage(keys, batch)
+        live = batch.live_mask()
+        h = K.spark_murmur3_batch(key_cols, batch.num_rows, seed=seed, live=live)
+        b = _pmod(h, k)
+        out = []
+        for i in range(k):
+            m = live & (b == i)
+            out.append(ColumnarBatch(batch.columns,
+                                     LazyRowCount(jnp.sum(m.astype(jnp.int32))), m))
+        return out
+
+    def _probe_stream(self, ctx, probe_iter, build, build_keys, join_t,
+                      track_build_matches: bool):
+        """Yields joined batches; returns via StopIteration the build-side
+        matched mask (for right/full outer)."""
+        how = self.plan.how
+        matched_build = (jnp.zeros(build.capacity, jnp.bool_)
+                         if track_build_matches else None)
+        # sub-partitioning applies to inner/left/semi/anti; right/full track
+        # a build-global matched mask that bucket-local indices would
+        # corrupt, so they stay on the single-pass path
+        k = self._sub_parts(int(build.num_rows)) \
+            if how in ("inner", "left", "left_semi", "left_anti") else 1
+        build_parts = None
+        if k > 1:
+            # loop-invariant: split/compact the build side ONCE
+            build_parts = []
+            for bp in self._bucket_split(build, self._hash_keys(1), k):
+                bpc = K.compact_batch(bp)
+                build_parts.append(
+                    (bpc, compiled.run_stage(self.plan.right_keys, bpc)))
+        for probe in probe_iter:
+            self._acquire(ctx)
+            if probe.row_mask is not None:
+                probe = K.compact_batch(probe)
+            with join_t.ns():
+                if build_parts is not None:
+                    probe_parts = self._bucket_split(probe, self._hash_keys(0), k)
+                    for pp, (bpc, bkeys) in zip(probe_parts, build_parts):
+                        ppc = K.compact_batch(pp)
+                        _, out = self._probe_one(ppc, bpc, bkeys, None)
+                        if out is not None:
+                            yield out
+                    continue
+                matched_build, out = self._probe_one(probe, build, build_keys,
+                                                     matched_build)
+                if out is not None:
+                    yield out
+        if track_build_matches:
+            un_idx, n_un = J.unmatched_indices(matched_build, build.num_rows)
+            if n_un:
+                from spark_rapids_tpu.columnar.batch import empty_like_schema
+                dummy = empty_like_schema(self.children[0].schema, capacity=8)
+                pi = jnp.full(un_idx.shape, -1, jnp.int32)
+                yield self._emit(dummy, build, pi, un_idx, n_un)
+
+    def _probe_one(self, probe, build, build_keys, matched_build):
+        how = self.plan.how
+        probe_keys = compiled.run_stage(self.plan.left_keys, probe)
+        pi, bi, nmatch = J.join_pairs(build_keys, build.num_rows,
+                                      probe_keys, probe.num_rows)
+        pi, bi, nmatch = self._apply_condition(probe, build, pi, bi, nmatch)
+        if how in ("left_semi", "left_anti"):
+            mask = J.probe_matched_mask(pi, probe.num_rows, probe.capacity)
+            if how == "left_anti":
+                mask = ~mask
+            return matched_build, K.mask_filter_batch(probe, mask)
+        if how in ("left", "full"):
+            mask = J.probe_matched_mask(pi, probe.num_rows, probe.capacity)
+            un_idx, n_un = J.unmatched_indices(mask, probe.num_rows)
+            if n_un:
+                tot = nmatch + n_un
+                cap = round_capacity(max(tot, 1))
+                pi = _concat_idx(pi, nmatch, un_idx, n_un, cap)
+                bi = _concat_idx(bi, nmatch,
+                                 jnp.full(un_idx.shape, -1, jnp.int32),
+                                 n_un, cap)
+                nmatch = tot
+        if matched_build is not None:
+            matched_build = matched_build | J.probe_matched_mask(
+                bi, build.num_rows, build.capacity)
+        return matched_build, self._emit(probe, build, pi, bi, nmatch)
+
+    def _apply_condition(self, probe, build, pi, bi, nmatch):
+        if self.plan.condition is None or nmatch == 0:
+            return pi, bi, nmatch
+        pair_batch = _pair_batch(probe, build, pi, bi, nmatch)
+        [pred] = compiled.run_stage([self.plan.condition], pair_batch)
+        keep = pred.data.astype(jnp.bool_) & pred.validity_or_default(nmatch)
+        keep = keep & (jnp.arange(pi.shape[0]) < nmatch)
+        idx, cnt = K.filter_indices(keep, pi.shape[0])
+        sel = jnp.clip(idx, 0, pi.shape[0] - 1)
+        return (jnp.where(idx >= 0, pi[sel], -1),
+                jnp.where(idx >= 0, bi[sel], -1), cnt)
+
+    def _emit(self, probe, build, pi, bi, n):
+        return _pair_batch(probe, build, pi, bi, n)
+
+
+class BroadcastHashJoinExec(_HashJoinBase):
     """Build side fully materialized (broadcast analog), probe side streamed
     per partition (reference GpuBroadcastHashJoinExecBase). Build side =
     RIGHT child. right/full outer joins are planned through a collect
@@ -1200,66 +1375,42 @@ class BroadcastHashJoinExec(TpuExec):
     def execute_partition(self, ctx, pidx):
         join_t = self.metrics.metric(M.JOIN_TIME)
         build = self._build_side()
-        how = self.plan.how
-        matched_build = None
-        if how in ("right", "full"):
-            matched_build = jnp.zeros(build.capacity, jnp.bool_)
-        for probe in self.children[0].execute_partition(ctx, pidx):
-            self._acquire(ctx)
-            if probe.row_mask is not None:
-                probe = K.compact_batch(probe)
-            with join_t.ns():
-                probe_keys = compiled.run_stage(self.plan.left_keys, probe)
-                pi, bi, nmatch = J.join_pairs(self._build_keys, build.num_rows,
-                                              probe_keys, probe.num_rows)
-                pi, bi, nmatch = self._apply_condition(probe, build, pi, bi, nmatch)
-                if how in ("left_semi", "left_anti"):
-                    mask = J.probe_matched_mask(pi, probe.num_rows, probe.capacity)
-                    if how == "left_anti":
-                        mask = ~mask
-                    yield K.mask_filter_batch(probe, mask)
-                    continue
-                if how in ("left", "full"):
-                    mask = J.probe_matched_mask(pi, probe.num_rows, probe.capacity)
-                    un_idx, n_un = J.unmatched_indices(mask, probe.num_rows)
-                    if n_un:
-                        tot = nmatch + n_un
-                        cap = round_capacity(max(tot, 1))
-                        pi = _concat_idx(pi, nmatch, un_idx, n_un, cap)
-                        bi = _concat_idx(bi, nmatch,
-                                         jnp.full(un_idx.shape, -1, jnp.int32),
-                                         n_un, cap)
-                        nmatch = tot
-                if how in ("right", "full"):
-                    matched_build = matched_build | J.probe_matched_mask(
-                        bi, build.num_rows, build.capacity)
-                out = self._emit(probe, build, pi, bi, nmatch)
-                if out.num_rows or probe.num_rows == 0:
-                    yield out
-        if how in ("right", "full"):
-            # single probe partition guaranteed by planning
-            un_idx, n_un = J.unmatched_indices(matched_build, build.num_rows)
-            if n_un:
-                probe_schema = self.children[0].schema
+        track = self.plan.how in ("right", "full")
+        probe_iter = self.children[0].execute_partition(ctx, pidx)
+        yield from self._probe_stream(ctx, probe_iter, build,
+                                      self._build_keys, join_t, track)
+
+
+class ShuffledHashJoinExec(_HashJoinBase):
+    """Both sides hash-exchanged on the join keys; each partition builds
+    from its slice of the right side and probes its slice of the left
+    (reference GpuShuffledHashJoinExec:125). Unlike the broadcast path,
+    right/full outer joins work per partition with NO collect: the
+    exchange guarantees equal keys co-locate."""
+
+    def __init__(self, plan, children, conf, part_keys=None):
+        super().__init__(plan, children, conf)
+        self.part_keys = part_keys
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def execute_partition(self, ctx, pidx):
+        join_t = self.metrics.metric(M.JOIN_TIME)
+        build_t = self.metrics.metric(M.BUILD_TIME)
+        with build_t.ns():
+            batches = list(self.children[1].execute_partition(ctx, pidx))
+            if batches:
+                build = K.compact_batch(K.concat_batches(batches))
+            else:
                 from spark_rapids_tpu.columnar.batch import empty_like_schema
-                dummy = empty_like_schema(probe_schema, capacity=8)
-                pi = jnp.full(un_idx.shape, -1, jnp.int32)
-                yield self._emit(dummy, build, pi, un_idx, n_un)
-
-    def _apply_condition(self, probe, build, pi, bi, nmatch):
-        if self.plan.condition is None or nmatch == 0:
-            return pi, bi, nmatch
-        pair_batch = _pair_batch(probe, build, pi, bi, nmatch)
-        [pred] = compiled.run_stage([self.plan.condition], pair_batch)
-        keep = pred.data.astype(jnp.bool_) & pred.validity_or_default(nmatch)
-        keep = keep & (jnp.arange(pi.shape[0]) < nmatch)
-        idx, cnt = K.filter_indices(keep, pi.shape[0])
-        sel = jnp.clip(idx, 0, pi.shape[0] - 1)
-        return (jnp.where(idx >= 0, pi[sel], -1),
-                jnp.where(idx >= 0, bi[sel], -1), cnt)
-
-    def _emit(self, probe, build, pi, bi, n):
-        return _pair_batch(probe, build, pi, bi, n)
+                build = empty_like_schema(self.children[1].schema)
+            build_keys = compiled.run_stage(self.plan.right_keys, build)
+        track = self.plan.how in ("right", "full")
+        probe_iter = self.children[0].execute_partition(ctx, pidx)
+        yield from self._probe_stream(ctx, probe_iter, build, build_keys,
+                                      join_t, track)
 
 
 def _pair_batch(left: ColumnarBatch, right: ColumnarBatch, li, ri, n: int

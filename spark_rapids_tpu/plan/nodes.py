@@ -43,6 +43,38 @@ class PlanNode:
     def describe(self) -> str:
         return self.name()
 
+    def estimated_rows(self) -> Optional[int]:
+        """Best-effort row-count estimate for physical planning (the
+        reference consults Spark statistics; CostBasedOptimizer.scala).
+        None = unknown."""
+        if isinstance(self, InMemorySource):
+            return self.table.num_rows
+        if isinstance(self, ParquetScan):
+            if getattr(self, "_est_rows", None) is None:
+                try:
+                    import pyarrow.parquet as pq
+                    self._est_rows = sum(pq.ParquetFile(p).metadata.num_rows
+                                         for p in self.paths)
+                except Exception:  # noqa: BLE001 - stats are advisory
+                    self._est_rows = -1
+            return None if self._est_rows < 0 else self._est_rows
+        if isinstance(self, Range):
+            return max(0, -(-(self.end - self.start) // self.step))
+        if isinstance(self, Filter):
+            c = self.children[0].estimated_rows()
+            return None if c is None else max(c // 2, 1)
+        if isinstance(self, Limit):
+            c = self.children[0].estimated_rows()
+            return self.n if c is None else min(self.n, c)
+        if isinstance(self, Union):
+            parts = [c.estimated_rows() for c in self.children]
+            return None if any(p is None for p in parts) else sum(parts)
+        if isinstance(self, Aggregate) and not self.group_exprs:
+            return 1
+        if self.children:
+            return self.children[0].estimated_rows()
+        return None
+
 
 def make_binder(schema: T.Schema, case_sensitive: bool = False):
     def binder(node):

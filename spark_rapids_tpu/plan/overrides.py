@@ -432,7 +432,26 @@ class SparkPlanMeta:
         left, right = child_execs
         if p.how == "cross":
             return X.CartesianProductExec(p, [left, right], conf)
-        if p.how in ("right", "full") and left.num_partitions > 1:
+        # strategy: broadcast the (right) build side when it is estimated
+        # small, else hash-exchange both sides and join per partition
+        est = p.children[1].estimated_rows()
+        small = est is not None and est <= conf.get(C.BROADCAST_JOIN_ROW_THRESHOLD)
+        multi = left.num_partitions > 1
+        if multi and not small:
+            # Hash-partitioning must agree ACROSS sides: Spark murmur3 is
+            # width-sensitive (int32 vs int64 hash differently), so keys
+            # cast to the common type before the exchange hash.
+            lkeys, rkeys = [], []
+            for lk, rk in zip(p.left_keys, p.right_keys):
+                ct = T.common_type(lk.data_type(), rk.data_type())
+                lkeys.append(lk if lk.data_type() == ct else E.Cast(lk, ct))
+                rkeys.append(rk if rk.data_type() == ct else E.Cast(rk, ct))
+            n_out = left.num_partitions
+            left = X.ShuffleExchangeExec(p, [left], conf, lkeys, n_out)
+            right = X.ShuffleExchangeExec(p, [right], conf, rkeys, n_out)
+            return X.ShuffledHashJoinExec(p, [left, right], conf,
+                                          part_keys=(lkeys, rkeys))
+        if p.how in ("right", "full") and multi:
             left = X.CollectExchangeExec(p, [left], conf)
         return X.BroadcastHashJoinExec(p, [left, right], conf)
 

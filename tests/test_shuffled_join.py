@@ -1,0 +1,108 @@
+"""Shuffled hash join + skew sub-partitioning differential tests
+(reference GpuShuffledHashJoinExec / GpuSubPartitionHashJoin)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+SHUFFLE_CONF = {"spark.rapids.sql.join.broadcastRowThreshold": 1}
+SUBPART_CONF = {"spark.rapids.sql.join.broadcastRowThreshold": 1,
+                "spark.rapids.sql.join.subPartitionRows": 8}
+
+
+def _sides(n=60, seed=5):
+    rng = np.random.default_rng(seed)
+    left = pa.table({
+        "k": pa.array([None if rng.random() < 0.1 else int(x)
+                       for x in rng.integers(0, 12, n)], pa.int64()),
+        "lv": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+    })
+    right = pa.table({
+        "k": pa.array([None if rng.random() < 0.1 else int(x)
+                       for x in rng.integers(0, 15, n // 2)], pa.int64()),
+        "rv": pa.array(rng.uniform(0, 1, n // 2)),
+    })
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_shuffled_join_all_kinds(how):
+    left_t, right_t = _sides()
+    session = TpuSession(SHUFFLE_CONF)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(left_t, num_partitions=3)
+        .join(s.create_dataframe(right_t, num_partitions=2), on="k", how=how),
+        session, ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_subpartitioned_join_skewed(how):
+    # heavily skewed: key 0 dominates; tiny subPartitionRows forces the
+    # hash-bucket pairwise join path
+    rng = np.random.default_rng(9)
+    left_t = pa.table({"k": pa.array(np.where(rng.random(80) < 0.7, 0,
+                                              rng.integers(0, 6, 80)).astype(np.int64)),
+                       "lv": pa.array(np.arange(80, dtype=np.int64))})
+    right_t = pa.table({"k": pa.array(rng.integers(0, 6, 40).astype(np.int64)),
+                        "rv": pa.array(np.arange(40, dtype=np.int64))})
+    session = TpuSession(SUBPART_CONF)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(left_t)
+        .join(s.create_dataframe(right_t), on="k", how=how),
+        session, ignore_order=True)
+
+
+def test_shuffled_join_string_keys():
+    rng = np.random.default_rng(2)
+    left_t = pa.table({"k": pa.array(np.array(["a", "b", "c", "d"], object)[
+        rng.integers(0, 4, 50)]), "lv": pa.array(np.arange(50, dtype=np.int64))})
+    right_t = pa.table({"k": ["a", "c", "e"], "rv": [1.0, 2.0, 3.0]})
+    session = TpuSession(SHUFFLE_CONF)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(left_t, num_partitions=2)
+        .join(s.create_dataframe(right_t, num_partitions=2), on="k", how="inner"),
+        session, ignore_order=True)
+
+
+def test_shuffled_join_with_condition():
+    left_t, right_t = _sides(40)
+    session = TpuSession(SHUFFLE_CONF)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(left_t, num_partitions=2)
+        .join(s.create_dataframe(right_t, num_partitions=2), on="k", how="inner")
+        .filter(col("lv") > lit(20)),
+        session, ignore_order=True)
+
+
+def test_out_of_core_sort():
+    # tiny threshold forces the host-staged out-of-core sort path
+    import pyarrow as pa
+    rng = np.random.default_rng(4)
+    t = pa.table({"k": pa.array(rng.integers(0, 1000, 500).astype(np.int64)),
+                  "s": pa.array(np.array(["aa", "bb", "cc"], object)[
+                      rng.integers(0, 3, 500)]),
+                  "v": pa.array(rng.uniform(-5, 5, 500))})
+    session = TpuSession({"spark.rapids.sql.sort.outOfCoreBytes": 1024,
+                          "spark.rapids.sql.reader.batchSizeRows": 64})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).order_by(col("k"), col("v")),
+        session)
+
+
+def test_out_of_core_sort_descending_nulls():
+    import pyarrow as pa
+    from spark_rapids_tpu.plan.nodes import SortOrder
+    rng = np.random.default_rng(6)
+    t = pa.table({"k": pa.array([None if rng.random() < 0.2 else int(x)
+                                 for x in rng.integers(0, 50, 300)], pa.int64())})
+    session = TpuSession({"spark.rapids.sql.sort.outOfCoreBytes": 256,
+                          "spark.rapids.sql.reader.batchSizeRows": 50})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).order_by(
+            SortOrder(col("k"), ascending=False, nulls_first=False)),
+        session)
