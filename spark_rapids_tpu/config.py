@@ -107,6 +107,11 @@ DEVICE_MEMORY_FRACTION = conf_float(
     "Fraction of per-chip HBM the arena budget may use "
     "(reference rmm.pool allocFraction).", startup_only=True)
 
+WRITER_THREADS = conf_int(
+    "spark.rapids.sql.asyncWrite.numThreads", 4,
+    "Background threads encoding+writing output files (reference "
+    "io/async ThrottlingExecutor).")
+
 SORT_OOC_BYTES = conf_int(
     "spark.rapids.sql.sort.outOfCoreBytes", 2 << 30,
     "Sorts over inputs larger than this run out-of-core: the device "

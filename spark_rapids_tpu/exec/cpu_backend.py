@@ -166,6 +166,13 @@ def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
         table = pa.concat_tables(tables, promote_options="permissive") \
             if len(tables) > 1 else tables[0]
         return table_to_cols(table)
+    if isinstance(plan, P.TextScan):
+        tables = [plan.read_host(p) for p in plan.paths]
+        table = pa.concat_tables(tables, promote_options="permissive") \
+            if len(tables) > 1 else tables[0]
+        return table_to_cols(table)
+    if isinstance(plan, P.CachedRelation):
+        return children[0]
     if isinstance(plan, P.Range):
         vals = np.arange(plan.start, plan.end, plan.step, np.int64)
         return [CpuCol(T.INT64, vals, np.ones(len(vals), np.bool_))]

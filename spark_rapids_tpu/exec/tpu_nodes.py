@@ -133,17 +133,84 @@ class ParquetScanExec(TpuExec):
         decode_t = self.metrics.metric(M.DECODE_TIME)
         copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
         out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
-        pf = pq.ParquetFile(path)
         cols = self.plan.columns
-        batch_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
-        for rb in pf.iter_batches(batch_size=batch_rows, columns=cols):
-            import pyarrow as pa
+        threads = self.conf.get(C.MULTIFILE_READER_THREADS)
+        groups = list(range(pq.ParquetFile(path).metadata.num_row_groups))
+        if not groups:
+            groups = [-1]
+
+        def load(g):
+            # one ParquetFile per call: parquet-cpp FileReader is NOT
+            # thread-safe and loads run on prefetch workers
             with decode_t.ns():
-                tbl = pa.Table.from_batches([rb])
+                f = pq.ParquetFile(path)
+                if g < 0:
+                    return f.read(columns=cols)
+                return f.read_row_group(g, columns=cols)
+
+        # host decode of row group g+1.. overlaps device upload of g
+        for tbl in _prefetched(groups, load, threads):
             self._acquire(ctx)
             with copy_t.ns():
                 yield from_arrow(tbl)
-            out_rows.add(rb.num_rows)
+            out_rows.add(tbl.num_rows)
+
+
+def _prefetched(items, load_fn, n_threads: int):
+    """Iterator over load_fn(item) with BOUNDED background lookahead
+    (reference GpuMultiFileReader's host thread pool: host parse overlaps
+    device upload/compute; lookahead is capped so a large input cannot
+    buffer itself entirely into host memory)."""
+    if n_threads <= 1 or len(items) <= 1:
+        for it in items:
+            yield load_fn(it)
+        return
+    from collections import deque
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=n_threads) as pool:
+        pending = deque()
+        it = iter(items)
+        for _ in range(n_threads):
+            try:
+                pending.append(pool.submit(load_fn, next(it)))
+            except StopIteration:
+                break
+        while pending:
+            f = pending.popleft()
+            try:
+                pending.append(pool.submit(load_fn, next(it)))
+            except StopIteration:
+                pass
+            yield f.result()
+
+
+class TextScanExec(TpuExec):
+    """CSV/JSON/ORC scan: prefetched host parse, chunked device upload
+    (reference GpuCSVScan / GpuJsonScan / GpuOrcScan MULTITHREADED)."""
+
+    @property
+    def num_partitions(self):
+        return max(1, len(self.plan.paths))
+
+    def execute_partition(self, ctx, pidx):
+        decode_t = self.metrics.metric(M.DECODE_TIME)
+        copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
+        out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        with decode_t.ns():
+            table = self.plan.read_host(self.plan.paths[pidx])
+        batch_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
+        n = table.num_rows
+        off = 0
+        while off < n or (n == 0 and off == 0):
+            take = min(batch_rows, n - off)
+            chunk = table.slice(off, take)
+            self._acquire(ctx)
+            with copy_t.ns():
+                yield from_arrow(chunk)
+            out_rows.add(take)
+            off += max(take, 1)
+            if n == 0:
+                break
 
 
 class CachedScanExec(TpuExec):
@@ -1226,14 +1293,40 @@ class _HashJoinBase(TpuExec):
             return 1
         return min(-(-build_rows // thr), 64)
 
-    #: width-normalized (lkeys, rkeys) for hashing; set by the planner on
-    #: the shuffled path, defaults to the plan's keys
-    part_keys = None
+    def __init__(self, plan, children, conf):
+        super().__init__(plan, children, conf)
+        #: width-normalized (lkeys, rkeys) for hashing; set by the planner
+        #: on the shuffled path, derived lazily elsewhere
+        self.part_keys = None
+        self._split_lock = threading.Lock()
+        self._split_cache = None
 
     def _hash_keys(self, side: int):
-        if self.part_keys is not None:
-            return self.part_keys[side]
-        return self.plan.left_keys if side == 0 else self.plan.right_keys
+        if self.part_keys is None:
+            # Spark murmur3 is width-sensitive (int32 and int64 hash
+            # differently): bucket hashing must use a common key type on
+            # both sides or equal values split across buckets.
+            lks, rks = [], []
+            for lk, rk in zip(self.plan.left_keys, self.plan.right_keys):
+                ct = T.common_type(lk.data_type(), rk.data_type())
+                lks.append(lk if lk.data_type() == ct else Cast(lk, ct))
+                rks.append(rk if rk.data_type() == ct else Cast(rk, ct))
+            self.part_keys = (lks, rks)
+        return self.part_keys[side]
+
+    def _split_build(self, build, k):
+        """Split/compact the build side into k key-hash buckets ONCE per
+        exec (the broadcast path probes the same build from every
+        partition; compaction gathers are the expensive part)."""
+        with self._split_lock:
+            if self._split_cache is None or self._split_cache[0] is not build:
+                parts = []
+                for bp in self._bucket_split(build, self._hash_keys(1), k):
+                    bpc = K.compact_batch(bp)
+                    parts.append(
+                        (bpc, compiled.run_stage(self.plan.right_keys, bpc)))
+                self._split_cache = (build, parts)
+            return self._split_cache[1]
 
     def _bucket_split(self, batch, keys, k, seed=107):
         """Mask-partition a batch into k hash buckets of its join keys
@@ -1261,14 +1354,7 @@ class _HashJoinBase(TpuExec):
         # corrupt, so they stay on the single-pass path
         k = self._sub_parts(int(build.num_rows)) \
             if how in ("inner", "left", "left_semi", "left_anti") else 1
-        build_parts = None
-        if k > 1:
-            # loop-invariant: split/compact the build side ONCE
-            build_parts = []
-            for bp in self._bucket_split(build, self._hash_keys(1), k):
-                bpc = K.compact_batch(bp)
-                build_parts.append(
-                    (bpc, compiled.run_stage(self.plan.right_keys, bpc)))
+        build_parts = self._split_build(build, k) if k > 1 else None
         for probe in probe_iter:
             self._acquire(ctx)
             if probe.row_mask is not None:

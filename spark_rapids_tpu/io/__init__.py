@@ -1,0 +1,6 @@
+"""I/O subsystem: format scans, columnar writers, async throttling.
+
+Reference parity: SURVEY.md §2.6 — GpuParquetScan/GpuOrcScan/GpuCSVScan
+multi-file reading, ColumnarOutputWriter, io/async/{AsyncOutputStream,
+ThrottlingExecutor,TrafficController}.
+"""

@@ -1,0 +1,61 @@
+"""Async write throttling.
+
+Reference parity: io/async/{ThrottlingExecutor,TrafficController}.scala —
+writes run on a background pool, but an executor-wide controller caps the
+bytes in flight so a burst of tasks cannot exhaust host memory buffering
+output files (TrafficController initialized in Plugin.scala:558).
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional
+
+
+class TrafficController:
+    """Blocks producers while more than max_in_flight_bytes of writes are
+    buffered/unfinished."""
+
+    def __init__(self, max_in_flight_bytes: int):
+        self.limit = max_in_flight_bytes
+        self._inflight = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, nbytes: int) -> None:
+        with self._cv:
+            while self._inflight > 0 and self._inflight + nbytes > self.limit:
+                self._cv.wait()
+            self._inflight += nbytes
+
+    def release(self, nbytes: int) -> None:
+        with self._cv:
+            self._inflight -= nbytes
+            self._cv.notify_all()
+
+    @property
+    def in_flight(self) -> int:
+        with self._cv:
+            return self._inflight
+
+
+class ThrottlingExecutor:
+    """Thread pool + TrafficController: submit(task_bytes, fn) blocks until
+    the controller admits the bytes; completion releases them."""
+
+    def __init__(self, max_threads: int, controller: TrafficController):
+        self.pool = ThreadPoolExecutor(max_workers=max_threads)
+        self.controller = controller
+
+    def submit(self, nbytes: int, fn: Callable, *args) -> Future:
+        self.controller.acquire(nbytes)
+
+        def run():
+            try:
+                return fn(*args)
+            finally:
+                self.controller.release(nbytes)
+
+        return self.pool.submit(run)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self.pool.shutdown(wait=wait)

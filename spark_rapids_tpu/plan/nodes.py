@@ -120,6 +120,87 @@ class InMemorySource(PlanNode):
         return f"InMemorySource[{self.table.num_rows} rows, {self.num_partitions} parts]"
 
 
+class TextScan(PlanNode):
+    """CSV / JSON-lines / ORC file scan: host-side parse (pyarrow readers
+    play the role of the reference's host line-splitting before the cudf
+    parse kernels; GpuCSVScan.scala / GpuJsonScan.scala / GpuOrcScan.scala),
+    then the standard Arrow-plane device upload."""
+
+    FORMATS = ("csv", "json", "orc")
+
+    def __init__(self, fmt: str, paths: Sequence[str],
+                 schema: Optional[T.Schema] = None,
+                 columns: Optional[List[str]] = None,
+                 options: Optional[dict] = None):
+        assert fmt in self.FORMATS, fmt
+        self.fmt = fmt
+        self.paths = list(paths)
+        self._schema = schema
+        self.columns = columns
+        self.options = options or {}
+        self.children = []
+
+    def read_host(self, path: str):
+        """One file -> pyarrow Table (host parse)."""
+        import pyarrow as pa
+        if self.fmt == "csv":
+            import pyarrow.csv as pcsv
+            opts = self.options
+            read_opts = pcsv.ReadOptions(
+                column_names=opts.get("column_names"),
+                autogenerate_column_names=not opts.get("header", True)
+                and not opts.get("column_names"))
+            parse_opts = pcsv.ParseOptions(delimiter=opts.get("sep", ","))
+            conv = pcsv.ConvertOptions(include_columns=self.columns or None)
+            t = pcsv.read_csv(path, read_options=read_opts,
+                              parse_options=parse_opts, convert_options=conv)
+        elif self.fmt == "json":
+            import pyarrow.json as pjson
+            t = pjson.read_json(path)
+            if self.columns:
+                t = t.select(self.columns)
+        else:
+            import pyarrow.orc as porc
+            t = porc.ORCFile(path).read(columns=self.columns)
+        return t
+
+    @property
+    def schema(self) -> T.Schema:
+        if self._schema is None:
+            if not self.paths:
+                raise FileNotFoundError("TextScan: no input files")
+            if self.fmt == "orc":
+                import pyarrow.orc as porc
+                pa_schema = porc.ORCFile(self.paths[0]).schema
+            elif self.fmt == "csv":
+                import pyarrow.csv as pcsv
+                opts = self.options
+                read_opts = pcsv.ReadOptions(
+                    column_names=opts.get("column_names"),
+                    autogenerate_column_names=not opts.get("header", True)
+                    and not opts.get("column_names"),
+                    block_size=1 << 20)  # schema from the first block only
+                with pcsv.open_csv(
+                        self.paths[0], read_options=read_opts,
+                        parse_options=pcsv.ParseOptions(
+                            delimiter=opts.get("sep", ","))) as r:
+                    pa_schema = r.schema
+            else:  # json: no streaming schema API; parse the first file
+                pa_schema = self.read_host(self.paths[0]).schema
+            fields = [T.StructField(f.name, T.from_arrow(f.type))
+                      for f in pa_schema]
+            if self.columns:
+                fields = [f for f in fields if f.name in self.columns]
+            self._schema = T.Schema(tuple(fields))
+        return self._schema
+
+    def estimated_rows(self):
+        return None
+
+    def describe(self):
+        return f"TextScan[{self.fmt}, {len(self.paths)} files]"
+
+
 class CachedRelation(PlanNode):
     """`df.cache()` analog (reference ParquetCachedBatchSerializer,
     SURVEY.md §2.6 — there df.cache() stores compressed parquet blobs; the

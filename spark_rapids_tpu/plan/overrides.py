@@ -363,6 +363,8 @@ class SparkPlanMeta:
             return X.InMemoryScanExec(p, [], conf)
         if isinstance(p, P.ParquetScan):
             return X.ParquetScanExec(p, [], conf)
+        if isinstance(p, P.TextScan):
+            return X.TextScanExec(p, [], conf)
         if isinstance(p, P.CachedRelation):
             return X.CachedScanExec(p, child_execs, conf)
         if isinstance(p, P.Range):

@@ -111,6 +111,12 @@ class DataFrame:
 
     unionAll = union
 
+    @property
+    def write(self):
+        """df.write.mode(...).partition_by(...).parquet(path)."""
+        from spark_rapids_tpu.io.writer import DataFrameWriter
+        return DataFrameWriter(self)
+
     def cache(self) -> "DataFrame":
         """Pin this DataFrame's result in device HBM; repeated queries over
         it skip the scan + upload entirely."""

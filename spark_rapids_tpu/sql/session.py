@@ -34,17 +34,41 @@ class TpuSession:
     createDataFrame = create_dataframe
 
     def read_parquet(self, *paths, columns=None) -> DataFrame:
+        return DataFrame(P.ParquetScan(
+            self._expand_paths(paths, suffix=".parquet"), columns=columns),
+            self)
+
+    def _expand_paths(self, paths, suffix: str = ""):
         import glob as _glob
         import os
         expanded: List[str] = []
         for p in paths:
             if os.path.isdir(p):
-                expanded.extend(sorted(_glob.glob(os.path.join(p, "*.parquet"))))
+                expanded.extend(sorted(
+                    f for f in _glob.glob(os.path.join(p, "*" + suffix))
+                    if os.path.isfile(f) and not os.path.basename(f).startswith("_")))
             elif any(ch in p for ch in "*?["):
                 expanded.extend(sorted(_glob.glob(p)))
             else:
                 expanded.append(p)
-        return DataFrame(P.ParquetScan(expanded, columns=columns), self)
+        if not expanded:
+            raise FileNotFoundError(f"no input files matched {list(paths)!r}")
+        return expanded
+
+    def read_csv(self, *paths, header: bool = True, sep: str = ",",
+                 columns=None) -> DataFrame:
+        return DataFrame(P.TextScan("csv", self._expand_paths(paths),
+                                    columns=columns,
+                                    options={"header": header, "sep": sep}),
+                         self)
+
+    def read_json(self, *paths, columns=None) -> DataFrame:
+        return DataFrame(P.TextScan("json", self._expand_paths(paths),
+                                    columns=columns), self)
+
+    def read_orc(self, *paths, columns=None) -> DataFrame:
+        return DataFrame(P.TextScan("orc", self._expand_paths(paths),
+                                    columns=columns), self)
 
     def range(self, start: int, end: Optional[int] = None, step: int = 1,
               num_partitions: int = 1) -> DataFrame:
