@@ -162,7 +162,9 @@ def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
         return table_to_cols(plan.table)
     if isinstance(plan, P.ParquetScan):
         import pyarrow.parquet as pq
-        tables = [pq.read_table(p, columns=plan.columns) for p in plan.paths]
+        tables = [plan.with_partition_cols(
+            pq.read_table(p, columns=plan.columns), i)
+            for i, p in enumerate(plan.paths)]
         table = pa.concat_tables(tables, promote_options="permissive") \
             if len(tables) > 1 else tables[0]
         return table_to_cols(table)

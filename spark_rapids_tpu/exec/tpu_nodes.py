@@ -149,11 +149,19 @@ class ParquetScanExec(TpuExec):
                 return f.read_row_group(g, columns=cols)
 
         # host decode of row group g+1.. overlaps device upload of g
+        batch_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
         for tbl in _prefetched(groups, load, threads):
-            self._acquire(ctx)
-            with copy_t.ns():
-                yield from_arrow(tbl)
-            out_rows.add(tbl.num_rows)
+            tbl = self.plan.with_partition_cols(tbl, pidx)
+            off = 0
+            while off < tbl.num_rows or (tbl.num_rows == 0 and off == 0):
+                chunk = tbl.slice(off, batch_rows)
+                self._acquire(ctx)
+                with copy_t.ns():
+                    yield from_arrow(chunk)
+                out_rows.add(chunk.num_rows)
+                off += max(chunk.num_rows, 1)
+                if tbl.num_rows == 0:
+                    break
 
 
 def _prefetched(items, load_fn, n_threads: int):
@@ -1300,6 +1308,10 @@ class _HashJoinBase(TpuExec):
         self.part_keys = None
         self._split_lock = threading.Lock()
         self._split_cache = None
+        #: caching the split only pays when partitions share ONE build (the
+        #: broadcast path); shuffled joins have per-partition builds and a
+        #: shared lock would serialize them
+        self._cache_build_split = False
 
     def _hash_keys(self, side: int):
         if self.part_keys is None:
@@ -1315,17 +1327,21 @@ class _HashJoinBase(TpuExec):
         return self.part_keys[side]
 
     def _split_build(self, build, k):
-        """Split/compact the build side into k key-hash buckets ONCE per
-        exec (the broadcast path probes the same build from every
-        partition; compaction gathers are the expensive part)."""
+        """Split/compact the build side into k key-hash buckets; cached
+        only when the exec shares one build across partitions."""
+        def compute():
+            parts = []
+            for bp in self._bucket_split(build, self._hash_keys(1), k):
+                bpc = K.compact_batch(bp)
+                parts.append(
+                    (bpc, compiled.run_stage(self.plan.right_keys, bpc)))
+            return parts
+
+        if not self._cache_build_split:
+            return compute()
         with self._split_lock:
             if self._split_cache is None or self._split_cache[0] is not build:
-                parts = []
-                for bp in self._bucket_split(build, self._hash_keys(1), k):
-                    bpc = K.compact_batch(bp)
-                    parts.append(
-                        (bpc, compiled.run_stage(self.plan.right_keys, bpc)))
-                self._split_cache = (build, parts)
+                self._split_cache = (build, compute())
             return self._split_cache[1]
 
     def _bucket_split(self, batch, keys, k, seed=107):
@@ -1434,6 +1450,7 @@ class BroadcastHashJoinExec(_HashJoinBase):
         self._build_lock = threading.Lock()
         self._build: Optional[ColumnarBatch] = None
         self._build_keys = None
+        self._cache_build_split = True  # one shared build for all partitions
 
     @property
     def num_partitions(self):

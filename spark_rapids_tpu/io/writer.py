@@ -59,8 +59,9 @@ def _partition_dirs(table: pa.Table, partition_by: List[str]):
             e = pc.is_null(table[k]) if v is None else pc.equal(table[k], v)
             mask = e if mask is None else pc.and_(mask, e)
         sub = table.filter(mask).select(rest)
+        from urllib.parse import quote
         subdir = "/".join(
-            f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
+            f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else quote(str(v), safe='')}"
             for k, v in row.items())
         yield subdir, sub
 
@@ -117,37 +118,45 @@ class DataFrameWriter:
         df = self._df
         session = df.session
         conf = session.conf
-        from spark_rapids_tpu.config import set_session_conf
-        from spark_rapids_tpu.plan.overrides import convert_plan
         from spark_rapids_tpu.columnar.batch import to_arrow
         from spark_rapids_tpu.runtime.task import TaskContext
-        set_session_conf(conf)
-        exec_root, _ = convert_plan(df.plan, conf)
+        exec_root, _ = session.prepare_execution(df.plan)
         names = df.plan.schema.names
         controller = TrafficController(conf.get(C.ASYNC_WRITE_MAX_INFLIGHT))
         pool = ThrottlingExecutor(conf.get(C.WRITER_THREADS), controller)
         ext = {"parquet": "parquet", "orc": "orc", "csv": "csv",
                "json": "json"}[fmt]
         futures = []
+        futures_lock = __import__("threading").Lock()
         # unique suffix per write so append mode never collides
         import uuid
         job = uuid.uuid4().hex[:8]
-        try:
-            for p in range(exec_root.num_partitions):
-                with TaskContext(partition_id=p) as tctx:
-                    tables = [to_arrow(b, names)
-                              for b in exec_root.execute_partition(tctx, p)]
-                if not tables:
-                    continue
-                table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
-                if table.num_rows == 0:
-                    continue
-                for subdir, sub in _partition_dirs(table, self._partition_by):
-                    d = os.path.join(path, subdir) if subdir else path
-                    os.makedirs(d, exist_ok=True)
-                    fpath = os.path.join(d, f"part-{p:05d}-{job}.{ext}")
+
+        def run_partition(p: int) -> None:
+            with TaskContext(partition_id=p) as tctx:
+                tables = [to_arrow(b, names)
+                          for b in exec_root.execute_partition(tctx, p)]
+            if not tables:
+                return
+            table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+            if table.num_rows == 0:
+                return
+            for subdir, sub in _partition_dirs(table, self._partition_by):
+                d = os.path.join(path, subdir) if subdir else path
+                os.makedirs(d, exist_ok=True)
+                fpath = os.path.join(d, f"part-{p:05d}-{job}.{ext}")
+                with futures_lock:
                     futures.append(pool.submit(
                         sub.nbytes, _write_one, sub, fpath, fmt, self._options))
+
+        try:
+            nparts = exec_root.num_partitions
+            if nparts == 1:
+                run_partition(0)
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(max_workers=min(nparts, 16)) as tp:
+                    list(tp.map(run_partition, range(nparts)))
             for f in futures:
                 f.result()
             with open(os.path.join(path, "_SUCCESS"), "w"):

@@ -151,7 +151,15 @@ class TextScan(PlanNode):
                 autogenerate_column_names=not opts.get("header", True)
                 and not opts.get("column_names"))
             parse_opts = pcsv.ParseOptions(delimiter=opts.get("sep", ","))
-            conv = pcsv.ConvertOptions(include_columns=self.columns or None)
+            # pin column types to the PLAN schema (inferred from the first
+            # block): full-file re-inference could disagree with what the
+            # kernels were planned for
+            column_types = None
+            if self._schema is not None:
+                column_types = {f.name: T.to_arrow(f.dtype)
+                                for f in self._schema.fields}
+            conv = pcsv.ConvertOptions(include_columns=self.columns or None,
+                                       column_types=column_types)
             t = pcsv.read_csv(path, read_options=read_opts,
                               parse_options=parse_opts, convert_options=conv)
         elif self.fmt == "json":
@@ -190,7 +198,10 @@ class TextScan(PlanNode):
             fields = [T.StructField(f.name, T.from_arrow(f.type))
                       for f in pa_schema]
             if self.columns:
-                fields = [f for f in fields if f.name in self.columns]
+                # data columns come back in REQUESTED order — the schema
+                # must match positionally or names bind to the wrong data
+                by_name = {f.name: f for f in fields}
+                fields = [by_name[c] for c in self.columns]
             self._schema = T.Schema(tuple(fields))
         return self._schema
 
@@ -227,12 +238,56 @@ class ParquetScan(PlanNode):
 
     def __init__(self, paths: Sequence[str], schema: Optional[T.Schema] = None,
                  columns: Optional[List[str]] = None,
-                 pushed_filters: Optional[List[Expression]] = None):
+                 pushed_filters: Optional[List[Expression]] = None,
+                 partition_values: Optional[List[dict]] = None):
         self.paths = list(paths)
         self._schema = schema
         self.columns = columns
         self.pushed_filters = pushed_filters or []
+        #: hive-layout partition values per file (k -> str|None), appended
+        #: as constant columns (reference: partition-value columns,
+        #: BatchWithPartitionData)
+        self.partition_values = partition_values
         self.children = []
+
+    def partition_fields(self) -> List[T.StructField]:
+        if not self.partition_values:
+            return []
+        keys: List[str] = []
+        for vals in self.partition_values:
+            for k in vals:
+                if k not in keys:
+                    keys.append(k)
+        fields = []
+        for k in keys:
+            non_null = [v.get(k) for v in self.partition_values
+                        if v.get(k) is not None]
+            dt = T.STRING
+            if non_null:
+                try:
+                    for v in non_null:
+                        int(v)
+                    dt = T.INT64
+                except ValueError:
+                    pass
+            fields.append(T.StructField(k, dt))
+        return fields
+
+    def with_partition_cols(self, table, file_idx: int):
+        """Append this file's constant partition-value columns to a host
+        table (reference BatchWithPartitionData: lazily materialized
+        partition columns)."""
+        if not self.partition_values:
+            return table
+        import pyarrow as pa
+        vals = self.partition_values[file_idx]
+        for f in self.partition_fields():
+            v = vals.get(f.name)
+            if v is not None and f.dtype == T.INT64:
+                v = int(v)
+            arr = pa.array([v] * table.num_rows, type=T.to_arrow(f.dtype))
+            table = table.append_column(f.name, arr)
+        return table
 
     @property
     def schema(self) -> T.Schema:
@@ -241,7 +296,9 @@ class ParquetScan(PlanNode):
             s = pq.read_schema(self.paths[0])
             fields = [T.StructField(f.name, T.from_arrow(f.type)) for f in s]
             if self.columns:
-                fields = [f for f in fields if f.name in self.columns]
+                by_name = {f.name: f for f in fields}
+                fields = [by_name[c] for c in self.columns if c in by_name]
+            fields += self.partition_fields()
             self._schema = T.Schema(tuple(fields))
         return self._schema
 

@@ -16,6 +16,40 @@ from spark_rapids_tpu.runtime.task import TaskContext
 from spark_rapids_tpu.sql.dataframe import DataFrame
 
 
+def _discover_hive(root: str):
+    """Walk a directory for hive-layout partitions (k=v subdirs). Returns
+    (files, per_file_partition_values) or (files, None) when the layout is
+    flat (reference: Spark's PartitioningAwareFileIndex)."""
+    import os
+    from urllib.parse import unquote
+    files, vals = [], []
+    found_parts = False
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        rel = os.path.relpath(dirpath, root)
+        parts = {}
+        ok = True
+        if rel != ".":
+            for seg in rel.split(os.sep):
+                if "=" not in seg:
+                    ok = False
+                    break
+                k, _, v = seg.partition("=")
+                parts[k] = (None if v == "__HIVE_DEFAULT_PARTITION__"
+                            else unquote(v))
+            if parts:
+                found_parts = True
+        if not ok:
+            continue
+        for f in sorted(filenames):
+            if f.endswith(".parquet") and not f.startswith("_"):
+                files.append(os.path.join(dirpath, f))
+                vals.append(parts)
+    if not files:
+        raise FileNotFoundError(f"no parquet files under {root!r}")
+    return files, (vals if found_parts else None)
+
+
 class TpuSession:
     def __init__(self, conf_overrides: Optional[Dict] = None):
         self.conf = C.RapidsConf(conf_overrides)
@@ -34,6 +68,15 @@ class TpuSession:
     createDataFrame = create_dataframe
 
     def read_parquet(self, *paths, columns=None) -> DataFrame:
+        import os
+        # hive-style partition discovery: dir of k=v subdirs -> recursive
+        # file walk with the partition column reconstructed from the path
+        if len(paths) == 1 and os.path.isdir(paths[0]):
+            files, part_vals = _discover_hive(paths[0])
+            if part_vals is not None:
+                return DataFrame(P.ParquetScan(files, columns=columns,
+                                               partition_values=part_vals),
+                                 self)
         return DataFrame(P.ParquetScan(
             self._expand_paths(paths, suffix=".parquet"), columns=columns),
             self)
@@ -77,7 +120,10 @@ class TpuSession:
         return DataFrame(P.Range(start, end, step, num_partitions), self)
 
     # -- execution ---------------------------------------------------------
-    def collect(self, plan: P.PlanNode) -> pa.Table:
+    def prepare_execution(self, plan: P.PlanNode):
+        """Session preamble shared by every action (collect, write):
+        activate this session's conf, sync the spill budgets, arm OOM
+        injection, convert the plan. Returns (exec_root, meta)."""
         from spark_rapids_tpu.config import set_session_conf
         from spark_rapids_tpu.plan.overrides import convert_plan
         from spark_rapids_tpu.runtime.memory import get_spill_framework
@@ -87,6 +133,10 @@ class TpuSession:
         get_spill_framework(self.conf)  # sync budgets to this session
         exec_root, meta = convert_plan(plan, self.conf)
         self._last_meta = meta
+        return exec_root, meta
+
+    def collect(self, plan: P.PlanNode) -> pa.Table:
+        exec_root, meta = self.prepare_execution(plan)
         explain_mode = self.conf.get(C.SQL_EXPLAIN).upper()
         if explain_mode in ("NOT_ON_TPU", "ALL"):
             text = meta.explain(all_ops=explain_mode == "ALL")

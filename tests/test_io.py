@@ -131,3 +131,42 @@ def test_traffic_controller_bounds_inflight():
     ex.shutdown()
     assert max(peak) <= 100  # never two 60-byte writes in flight
     assert tc.in_flight == 0
+
+
+def test_partitioned_roundtrip_with_discovery(session, tmp_path):
+    # write partition_by then read the ROOT back: hive discovery must
+    # reconstruct the partition column (README quick-start pattern)
+    t = _t()
+    path = str(tmp_path / "disc")
+    session.create_dataframe(t).write.partition_by("k").parquet(path)
+    df = session.read_parquet(path)
+    assert set(df.columns) >= {"i", "f", "k"}
+    assert df.count() == t.num_rows
+    got = {r["k"]: r["count"] for r in
+           df.group_by("k").count().collect().to_pylist()}
+    from collections import Counter
+    assert got == dict(Counter(t["k"].to_pylist()))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path).group_by("k").agg(F.sum(col("i"))),
+        session, ignore_order=True)
+
+
+def test_partition_value_escaping(session, tmp_path):
+    t = pa.table({"k": ["a/b", "c=d", "plain"], "v": [1, 2, 3]})
+    path = str(tmp_path / "esc")
+    session.create_dataframe(t).write.partition_by("k").parquet(path)
+    import os
+    dirs = sorted(d for d in os.listdir(path) if d.startswith("k="))
+    assert all("/" not in d[2:] for d in dirs)
+    back = session.read_parquet(path)
+    assert sorted(back.select(col("k")).to_pydict()["k"]) == ["a/b", "c=d", "plain"]
+
+
+def test_read_columns_reordered(session, tmp_path):
+    # columns in non-file order must bind names to the right data
+    path = str(tmp_path / "ord")
+    session.create_dataframe(_t(10)).write.parquet(path)
+    d = session.read_parquet(path, columns=["f", "k"])
+    got = d.to_pydict()
+    assert isinstance(got["f"][0], float)
+    assert isinstance(got["k"][0], str)
