@@ -163,7 +163,7 @@ def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
     if isinstance(plan, P.ParquetScan):
         import pyarrow.parquet as pq
         tables = [plan.with_partition_cols(
-            pq.read_table(p, columns=plan.columns), i)
+            pq.read_table(p, columns=getattr(plan, "file_columns", plan.columns)), i)
             for i, p in enumerate(plan.paths)]
         table = pa.concat_tables(tables, promote_options="permissive") \
             if len(tables) > 1 else tables[0]

@@ -133,7 +133,7 @@ class ParquetScanExec(TpuExec):
         decode_t = self.metrics.metric(M.DECODE_TIME)
         copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
         out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
-        cols = self.plan.columns
+        cols = getattr(self.plan, "file_columns", self.plan.columns)
         threads = self.conf.get(C.MULTIFILE_READER_THREADS)
         groups = list(range(pq.ParquetFile(path).metadata.num_row_groups))
         if not groups:

@@ -1,0 +1,508 @@
+"""Device regex: a Java-regex subset compiled to a bit-parallel NFA.
+
+Reference parity: RegexParser.scala (2151 LoC) — the reference parses Java
+regex and TRANSPILES to cudf's regex engine, rejecting unsupported
+constructs so the expression falls back to CPU. This module keeps the
+transpile-or-reject contract but targets a TPU-shaped execution model:
+
+- Parse a subset: literals, escapes, char classes (incl. \\d \\w \\s and
+  negations), ``.``, alternation, groups, greedy quantifiers * + ? {m,n},
+  anchors ^ $. Rejected (-> CPU fallback): backreferences, lookaround,
+  lazy/possessive quantifiers, flags, named groups, unicode classes.
+- Compile via the Glushkov construction to a <=32-state NFA whose step
+  factorizes as ``next = reach(S) & B[byte]`` with reach(S) a fold over
+  HOST-CONSTANT follow masks — the whole match is a `lax.fori_loop` of
+  pure vector ops over the byte planes, no gather tables, no branching.
+  (The reference's RegexComplexityEstimator analog: patterns with more
+  than 32 positions are rejected.)
+- ``.`` and negated classes expand to proper UTF-8 char alternations so
+  multibyte characters count as ONE character.
+
+Matching modes: "find" (Spark RLIKE: pattern matches anywhere) and
+"match" (full-string, Java matches()).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MAX_STATES = 32
+
+
+class RegexUnsupported(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RxNode:
+    pass
+
+
+@dataclasses.dataclass
+class Atom(RxNode):
+    """One byte-class position (bitset over byte values 0..255)."""
+    bits: np.ndarray  # bool[256]
+
+
+@dataclasses.dataclass
+class Concat(RxNode):
+    parts: List[RxNode]
+
+
+@dataclasses.dataclass
+class Alt(RxNode):
+    parts: List[RxNode]
+
+
+@dataclasses.dataclass
+class Repeat(RxNode):
+    child: RxNode
+    min: int
+    max: Optional[int]  # None = unbounded
+
+
+@dataclasses.dataclass
+class Empty(RxNode):
+    pass
+
+
+def _bits_of(chars: str) -> np.ndarray:
+    b = np.zeros(256, np.bool_)
+    for ch in chars:
+        for byte in ch.encode("utf-8"):
+            if ord(ch) > 127:
+                raise RegexUnsupported("non-ASCII literal in pattern")
+        b[ord(ch)] = True
+    return b
+
+
+def _range_bits(lo: str, hi: str) -> np.ndarray:
+    if ord(lo) > 127 or ord(hi) > 127:
+        raise RegexUnsupported("non-ASCII class range")
+    b = np.zeros(256, np.bool_)
+    b[ord(lo): ord(hi) + 1] = True
+    return b
+
+
+_DIGIT = _range_bits("0", "9")
+_WORD = _range_bits("a", "z") | _range_bits("A", "Z") | _DIGIT | _bits_of("_")
+_SPACE = np.zeros(256, np.bool_)
+for _c in " \t\n\x0b\f\r":
+    _SPACE[ord(_c)] = True
+
+_ASCII = np.zeros(256, np.bool_)
+_ASCII[:128] = True
+_LEAD2 = np.zeros(256, np.bool_)
+_LEAD2[0xC0:0xE0] = True
+_LEAD3 = np.zeros(256, np.bool_)
+_LEAD3[0xE0:0xF0] = True
+_LEAD4 = np.zeros(256, np.bool_)
+_LEAD4[0xF0:0xF8] = True
+_CONT = np.zeros(256, np.bool_)
+_CONT[0x80:0xC0] = True
+
+
+def _one_char(ascii_bits: np.ndarray) -> RxNode:
+    """A class over CHARACTERS: the given ASCII bytes, or (for inclusive
+    classes like ``.`` and negations) any multibyte UTF-8 character."""
+    return Alt([Atom(ascii_bits & _ASCII),
+                Concat([Atom(_LEAD2), Atom(_CONT)]),
+                Concat([Atom(_LEAD3), Atom(_CONT), Atom(_CONT)]),
+                Concat([Atom(_LEAD4), Atom(_CONT), Atom(_CONT), Atom(_CONT)])])
+
+
+_ESCAPES = {
+    "d": _DIGIT, "D": None, "w": _WORD, "W": None, "s": _SPACE, "S": None,
+    "n": _bits_of("\n"), "t": _bits_of("\t"), "r": _bits_of("\r"),
+}
+_META = set(".^$*+?()[]{}|\\")
+
+
+class _Parser:
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        self.anchored_start = False
+        self.anchored_end = False
+
+    def peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def take(self) -> str:
+        ch = self.p[self.i]
+        self.i += 1
+        return ch
+
+    # pattern := alt ; alt := concat ('|' concat)*
+    def parse(self) -> RxNode:
+        node = self.alt(top=True)
+        if self.i != len(self.p):
+            raise RegexUnsupported(f"unexpected {self.p[self.i]!r}")
+        return node
+
+    def alt(self, top: bool = False) -> RxNode:
+        before = (self.anchored_start, self.anchored_end)
+        parts = [self.concat(top)]
+        while self.peek() == "|":
+            self.take()
+            parts.append(self.concat(top))
+        if len(parts) > 1 and (self.anchored_start, self.anchored_end) != before:
+            # an anchor inside ONE branch must not anchor the others; the
+            # flag model can't express per-branch anchors -> reject
+            # (write ^(a|b) instead of ^a|b)
+            raise RegexUnsupported("anchor inside alternation branch")
+        return parts[0] if len(parts) == 1 else Alt(parts)
+
+    def concat(self, top: bool) -> RxNode:
+        parts: List[RxNode] = []
+        first = True
+        while True:
+            ch = self.peek()
+            if ch is None or ch in ")|":
+                break
+            if ch == "^":
+                if not (top and first):
+                    raise RegexUnsupported("interior ^")
+                self.take()
+                self.anchored_start = True
+                first = False
+                continue
+            if ch == "$":
+                self.take()
+                if self.peek() not in (None, "|"):
+                    raise RegexUnsupported("interior $")
+                self.anchored_end = True
+                continue
+            parts.append(self.quantified())
+            first = False
+        return Concat(parts) if parts else Empty()
+
+    def quantified(self) -> RxNode:
+        atom = self.atom()
+        ch = self.peek()
+        if ch in ("*", "+", "?"):
+            self.take()
+            if self.peek() in ("?", "+"):
+                raise RegexUnsupported("lazy/possessive quantifier")
+            lo, hi = {"*": (0, None), "+": (1, None), "?": (0, 1)}[ch]
+            return Repeat(atom, lo, hi)
+        if ch == "{":
+            j = self.p.find("}", self.i)
+            if j < 0:
+                raise RegexUnsupported("unterminated {")
+            body = self.p[self.i + 1: j]
+            self.i = j + 1
+            if self.peek() in ("?", "+"):
+                raise RegexUnsupported("lazy/possessive quantifier")
+            if "," in body:
+                lo_s, hi_s = body.split(",", 1)
+                lo = int(lo_s) if lo_s else 0
+                hi = int(hi_s) if hi_s else None
+            else:
+                lo = hi = int(body)
+            if hi is not None and hi < lo:
+                raise RegexUnsupported("bad {m,n}")
+            if (hi or lo) > 16:
+                raise RegexUnsupported("{m,n} too large for device NFA")
+            return Repeat(atom, lo, hi)
+        return atom
+
+    def atom(self) -> RxNode:
+        ch = self.take()
+        if ch == "(":
+            if self.peek() == "?":
+                raise RegexUnsupported("(?...) group")
+            inner = self.alt()
+            if self.peek() != ")":
+                raise RegexUnsupported("unterminated (")
+            self.take()
+            return inner
+        if ch == "[":
+            return self.char_class()
+        if ch == ".":
+            nl = np.zeros(256, np.bool_)
+            nl[ord("\n")] = True
+            return _one_char(_ASCII & ~nl)
+        if ch == "\\":
+            return self.escape()
+        if ch in _META:
+            raise RegexUnsupported(f"meta {ch!r}")
+        if ord(ch) > 127:
+            raise RegexUnsupported("non-ASCII literal")
+        return Atom(_bits_of(ch))
+
+    def escape(self) -> RxNode:
+        ch = self.take()
+        if ch in "\\.^$*+?()[]{}|/-":
+            return Atom(_bits_of(ch))
+        if ch in _ESCAPES:
+            if ch == "D":
+                return _one_char(_ASCII & ~_DIGIT)
+            if ch == "W":
+                return _one_char(_ASCII & ~_WORD)
+            if ch == "S":
+                return _one_char(_ASCII & ~_SPACE)
+            return Atom(_ESCAPES[ch])
+        raise RegexUnsupported(f"escape \\{ch}")
+
+    def char_class(self) -> RxNode:
+        neg = False
+        if self.peek() == "^":
+            self.take()
+            neg = True
+        bits = np.zeros(256, np.bool_)
+        first = True
+        while True:
+            ch = self.peek()
+            if ch is None:
+                raise RegexUnsupported("unterminated [")
+            if ch == "]" and not first:
+                self.take()
+                break
+            self.take()
+            first = False
+            if ch == "\\":
+                e = self.take()
+                if e in _ESCAPES and _ESCAPES[e] is not None:
+                    bits |= _ESCAPES[e]
+                    continue
+                if e in "\\.^$*+?()[]{}|/-":
+                    ch = e
+                else:
+                    raise RegexUnsupported(f"class escape \\{e}")
+            if ord(ch) > 127:
+                raise RegexUnsupported("non-ASCII in class")
+            if self.peek() == "-" and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != "]":
+                self.take()
+                hi = self.take()
+                if hi == "\\":
+                    hi = self.take()
+                bits |= _range_bits(ch, hi)
+            else:
+                bits[ord(ch)] = True
+        if neg:
+            return _one_char(_ASCII & ~bits)
+        return Atom(bits)
+
+
+# ---------------------------------------------------------------------------
+# Glushkov construction -> bit-parallel NFA
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NFA:
+    n: int                      # number of positions (states 1..n; 0 = start)
+    byte_classes: np.ndarray    # bool[n, 256]
+    first: int                  # bitmask of initial positions
+    last: int                   # bitmask of accepting positions
+    follow: List[int]           # per position, bitmask of successors
+    nullable: bool
+    anchored_start: bool
+    anchored_end: bool
+    #: Java matches() semantics: the WHOLE input must match, and the
+    #: find-mode `$`-before-trailing-newline concession does NOT apply
+    full_match: bool = False
+
+
+def _expand_repeat(node: RxNode) -> RxNode:
+    """{m,n} -> explicit concatenation (Glushkov needs *,+,? only)."""
+    if isinstance(node, Repeat):
+        c = _expand_repeat(node.child)
+        if (node.min, node.max) in ((0, None), (1, None), (0, 1)):
+            return Repeat(c, node.min, node.max)
+        parts = [c] * node.min
+        if node.max is None:
+            parts.append(Repeat(c, 0, None))
+        else:
+            parts += [Repeat(c, 0, 1)] * (node.max - node.min)
+        return Concat([_clone(p) for p in parts])
+    if isinstance(node, Concat):
+        return Concat([_expand_repeat(p) for p in node.parts])
+    if isinstance(node, Alt):
+        return Alt([_expand_repeat(p) for p in node.parts])
+    return node
+
+
+def _clone(node: RxNode) -> RxNode:
+    if isinstance(node, Atom):
+        return Atom(node.bits.copy())
+    if isinstance(node, Concat):
+        return Concat([_clone(p) for p in node.parts])
+    if isinstance(node, Alt):
+        return Alt([_clone(p) for p in node.parts])
+    if isinstance(node, Repeat):
+        return Repeat(_clone(node.child), node.min, node.max)
+    return Empty()
+
+
+def glushkov(ast: RxNode, anchored_start: bool, anchored_end: bool) -> NFA:
+    ast = _expand_repeat(ast)
+    atoms: List[Atom] = []
+
+    def number(node):
+        if isinstance(node, Atom):
+            atoms.append(node)
+            if len(atoms) > MAX_STATES - 1:
+                raise RegexUnsupported(
+                    f"pattern needs > {MAX_STATES - 1} NFA positions")
+            return
+        if isinstance(node, (Concat, Alt)):
+            for p in node.parts:
+                number(p)
+        elif isinstance(node, Repeat):
+            number(node.child)
+
+    number(ast)
+    pos_of = {id(a): i + 1 for i, a in enumerate(atoms)}
+
+    def analyze(node) -> Tuple[int, int, bool]:
+        """returns (first_mask, last_mask, nullable); fills follow."""
+        if isinstance(node, Empty):
+            return 0, 0, True
+        if isinstance(node, Atom):
+            m = 1 << pos_of[id(node)]
+            return m, m, False
+        if isinstance(node, Alt):
+            f = l = 0
+            nul = False
+            for p in node.parts:
+                pf, pl, pn = analyze(p)
+                f |= pf
+                l |= pl
+                nul = nul or pn
+            return f, l, nul
+        if isinstance(node, Concat):
+            f = l = 0
+            nul = True
+            for p in node.parts:
+                pf, pl, pn = analyze(p)
+                # follow: every last of the prefix connects to first of p
+                for i in range(1, len(atoms) + 1):
+                    if l & (1 << i):
+                        follow[i] |= pf
+                if nul:
+                    f |= pf
+                l = pl | (l if pn else 0)
+                nul = nul and pn
+            return f, l, nul
+        if isinstance(node, Repeat):
+            cf, cl, cn = analyze(node.child)
+            if node.max is None:  # * or +
+                for i in range(1, len(atoms) + 1):
+                    if cl & (1 << i):
+                        follow[i] |= cf
+            nul = cn or node.min == 0
+            return cf, cl, nul
+        raise RegexUnsupported(type(node).__name__)
+
+    follow = [0] * (len(atoms) + 1)
+    first, last, nullable = analyze(ast)
+    bc = np.zeros((len(atoms) + 1, 256), np.bool_)
+    for a, i in ((a, pos_of[id(a)]) for a in atoms):
+        bc[i] = a.bits
+    return NFA(len(atoms), bc, first, last, follow, nullable,
+               anchored_start, anchored_end)
+
+
+def compile_pattern(pattern: str, mode: str = "find") -> NFA:
+    """Parse + compile, raising RegexUnsupported for constructs outside the
+    device subset. mode='find' (RLIKE semantics) treats the pattern as
+    unanchored unless ^/$ appear."""
+    p = _Parser(pattern)
+    ast = p.parse()
+    nfa = glushkov(ast, p.anchored_start, p.anchored_end)
+    if mode == "match":
+        nfa.anchored_start = True
+        nfa.anchored_end = True
+        nfa.full_match = True
+    return nfa
+
+
+# ---------------------------------------------------------------------------
+# Device evaluation over flat string planes
+# ---------------------------------------------------------------------------
+
+def nfa_eval(nfa: NFA, offsets: jax.Array, raw: jax.Array, valid
+             ) -> jax.Array:
+    """bool[n_rows]: does each row's string match? One fori_loop over the
+    max row length; each step is reach(S) & B[byte] in u32 lanes."""
+    n = offsets.shape[0] - 1
+    starts = offsets[:-1]
+    lens = offsets[1:] - offsets[:-1]
+    maxlen = jnp.max(jnp.where(valid, lens, 0)) if valid is not None \
+        else jnp.max(lens)
+    B = jnp.asarray(_byte_table(nfa))          # u32[256]
+    follow = [int(f) for f in nfa.follow]
+    first = jnp.uint32(nfa.first)
+    last = jnp.uint32(nfa.last)
+    seed = not nfa.anchored_start
+    nbytes = int(raw.shape[0])
+
+    def step(pos, carry):
+        S, done, pre_nl = carry
+        idx = jnp.clip(starts + pos, 0, nbytes - 1)
+        byte = raw[idx].astype(jnp.int32)
+        active = pos < lens
+        if not nfa.full_match:
+            # Java `$` (find mode) also matches just before a single
+            # trailing newline: hit when the un-consumed suffix is "\n"
+            pre_nl = pre_nl | (active & (pos == lens - 1) & (byte == 10)
+                               & ((S & last) != 0))
+        reach = jnp.zeros_like(S)
+        # start state: active while unanchored (find) or at pos 0
+        s0 = S & jnp.uint32(1)
+        reach = jnp.where(s0 != 0, reach | jnp.uint32(nfa.first), reach)
+        for i in range(1, nfa.n + 1):
+            if follow[i]:
+                reach = jnp.where((S >> jnp.uint32(i)) & jnp.uint32(1) != 0,
+                                  reach | jnp.uint32(follow[i]), reach)
+        nxt = reach & B[byte]
+        keep_start = jnp.uint32(1) if seed else jnp.uint32(0)
+        nxt = nxt | (S & keep_start)
+        S = jnp.where(active, nxt, S)
+        hit = (S & last) != 0
+        if not nfa.anchored_end:
+            done = done | (hit & active)
+        return S, done, pre_nl
+
+    S0 = jnp.full(n, 1, jnp.uint32)  # start state only
+    done0 = jnp.zeros(n, jnp.bool_)
+    S, done, pre_nl = lax.fori_loop(0, maxlen.astype(jnp.int32),
+                                    step, (S0, done0, done0))
+    if nfa.anchored_end:
+        res = ((S & last) != 0) | pre_nl
+    else:
+        res = done | ((S & last) != 0)
+    if nfa.nullable:
+        if nfa.anchored_start and nfa.anchored_end:
+            # full-string semantics: the empty match covers "" (and, in
+            # find mode's ^...$ form, a lone line terminator)
+            res = res | (lens == 0)
+            if not nfa.full_match:
+                first_byte = raw[jnp.clip(starts, 0, nbytes - 1)]
+                res = res | ((lens == 1) & (first_byte == 10))
+        else:
+            # an unanchored side means the empty match fits anywhere
+            res = jnp.ones_like(res)
+    if valid is not None:
+        res = res & valid
+    return res
+
+
+def _byte_table(nfa: NFA) -> np.ndarray:
+    """u32[256]: for each byte value, the set of positions matching it."""
+    tbl = np.zeros(256, np.uint32)
+    for i in range(1, nfa.n + 1):
+        tbl |= np.where(nfa.byte_classes[i], np.uint32(1 << i), np.uint32(0))
+    return tbl

@@ -439,18 +439,61 @@ class Like(Expression):
             return Contains(child, runs[1])
         return None
 
+    def _nfa(self):
+        from spark_rapids_tpu.expr import regex as RX
+        if not hasattr(self, "_nfa_cache"):
+            try:
+                # LIKE wildcards match newlines too (CPU path uses
+                # re.DOTALL): translate via (.|\n), not bare `.`
+                out = []
+                i = 0
+                p, esc = self.pattern, self.escape
+                while i < len(p):
+                    ch = p[i]
+                    if ch == esc and i + 1 < len(p):
+                        ch = p[i + 1]
+                        i += 2
+                    elif ch == "%":
+                        out.append("(.|\n)*")
+                        i += 1
+                        continue
+                    elif ch == "_":
+                        out.append("(.|\n)")
+                        i += 1
+                        continue
+                    else:
+                        i += 1
+                    out.append("\\" + ch if ch in ".^$*+?()[]{}|\\/-" else ch)
+                self._nfa_cache = RX.compile_pattern("".join(out), mode="match")
+            except RX.RegexUnsupported:
+                self._nfa_cache = None
+        return self._nfa_cache
+
     def supported_on_tpu(self):
-        return self._transpile() is not None or self.pattern.replace("%", "") == ""
+        return (self._transpile() is not None
+                or self.pattern.replace("%", "") == ""
+                or self._nfa() is not None)
 
     def eval_tpu(self, ctx):
         t = self._transpile()
-        if t is None:
-            if self.pattern.replace("%", "") == "":
-                c = self.children[0].eval_tpu(ctx)
-                return ColumnVector(T.BOOLEAN, jnp.ones(ctx.capacity, jnp.bool_),
-                                    _valid_of(c, ctx))
+        if t is not None:
+            return t.eval_tpu(ctx)
+        if self.pattern.replace("%", "") == "":
+            c = self.children[0].eval_tpu(ctx)
+            return ColumnVector(T.BOOLEAN, jnp.ones(ctx.capacity, jnp.bool_),
+                                _valid_of(c, ctx))
+        # general LIKE (e.g. '_' wildcards): full-match device NFA
+        from spark_rapids_tpu.expr import regex as RX
+        nfa = self._nfa()
+        if nfa is None:
             raise NotImplementedError(f"LIKE pattern {self.pattern!r} on device")
-        return t.eval_tpu(ctx)
+        c = self.children[0].eval_tpu(ctx)
+
+        def compute(flat, cap):
+            res = RX.nfa_eval(nfa, flat.data["offsets"], flat.data["bytes"], None)
+            return ColumnVector(T.BOOLEAN, res, None)
+
+        return _lift_unary(ctx, c, compute)
 
     def eval_cpu(self, cols, ansi=False):
         import re
@@ -481,6 +524,132 @@ def _like_to_regex(pattern: str, esc: str) -> str:
             out.append(re.escape(ch))
             i += 1
     return "".join(out)
+
+
+class RLike(Expression):
+    """Spark RLIKE: Java regex, match-anywhere. Patterns inside the device
+    subset run as a bit-parallel NFA over byte planes (expr/regex.py);
+    others fall back to CPU `re` — the reference's RegexParser
+    transpile-or-reject contract."""
+
+    def __init__(self, child, pattern: str):
+        self.children = [child]
+        self.pattern = pattern
+        self._nfa = None
+        self._nfa_err = None
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    def _params(self):
+        return repr(self.pattern)
+
+    def with_children(self, children):
+        return RLike(children[0], self.pattern)
+
+    def _compiled(self):
+        from spark_rapids_tpu.expr import regex as RX
+        if self._nfa is None and self._nfa_err is None:
+            try:
+                self._nfa = RX.compile_pattern(self.pattern, mode="find")
+            except RX.RegexUnsupported as e:
+                self._nfa_err = str(e)
+        return self._nfa
+
+    def supported_on_tpu(self):
+        return self._compiled() is not None
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expr import regex as RX
+        nfa = self._compiled()
+        if nfa is None:
+            raise NotImplementedError(
+                f"regex {self.pattern!r} on device: {self._nfa_err}")
+        c = self.children[0].eval_tpu(ctx)
+
+        def compute(flat, cap):
+            res = RX.nfa_eval(nfa, flat.data["offsets"], flat.data["bytes"],
+                              None)
+            return ColumnVector(T.BOOLEAN, res, None)
+
+        return _lift_unary(ctx, c, compute)
+
+    def eval_cpu(self, cols, ansi=False):
+        import re
+        c = self.children[0].eval_cpu(cols, ansi)
+        prog = re.compile(self.pattern)
+        vals = np.array([bool(prog.search(s)) if isinstance(s, str) else False
+                         for s in c.values], np.bool_)
+        return CpuCol(T.BOOLEAN, vals, c.valid)
+
+
+class _RegexCpuBase(Expression):
+    """regexp_extract / regexp_replace: capture-group semantics need a
+    backtracking engine — CPU-only (tagged unsupported on device so the
+    enclosing exec falls back, reference behavior for unsupported regex)."""
+
+    def data_type(self):
+        return T.STRING
+
+    def supported_on_tpu(self):
+        return False
+
+    def eval_tpu(self, ctx):
+        raise NotImplementedError("capture-group regex runs on CPU")
+
+
+class RegexpExtract(_RegexCpuBase):
+    def __init__(self, child, pattern: str, group: int = 1):
+        self.children = [child]
+        self.pattern = pattern
+        self.group = group
+
+    def _params(self):
+        return f"{self.pattern!r},{self.group}"
+
+    def with_children(self, children):
+        return RegexpExtract(children[0], self.pattern, self.group)
+
+    def eval_cpu(self, cols, ansi=False):
+        import re
+        c = self.children[0].eval_cpu(cols, ansi)
+        prog = re.compile(self.pattern)
+        if self.group > prog.groups or self.group < 0:
+            raise ValueError(
+                f"regexp_extract group {self.group} out of range for "
+                f"{self.pattern!r} ({prog.groups} groups)")
+        out = []
+        for s in c.values:
+            if not isinstance(s, str):
+                out.append(None)
+                continue
+            m = prog.search(s)
+            # Spark: "" for no match AND for a non-participating group
+            out.append((m.group(self.group) or "") if m else "")
+        return CpuCol(T.STRING, np.array(out, object), c.valid)
+
+
+class RegexpReplace(_RegexCpuBase):
+    def __init__(self, child, pattern: str, replacement: str):
+        self.children = [child]
+        self.pattern = pattern
+        self.replacement = replacement
+
+    def _params(self):
+        return f"{self.pattern!r},{self.replacement!r}"
+
+    def with_children(self, children):
+        return RegexpReplace(children[0], self.pattern, self.replacement)
+
+    def eval_cpu(self, cols, ansi=False):
+        import re
+        c = self.children[0].eval_cpu(cols, ansi)
+        prog = re.compile(self.pattern)
+        # Java $1 -> python \1 backrefs
+        repl = re.sub(r"\$(\d)", r"\\\1", self.replacement)
+        vals = np.array([prog.sub(repl, s) if isinstance(s, str) else s
+                         for s in c.values], object)
+        return CpuCol(T.STRING, vals, c.valid)
 
 
 class _StringEquals(Expression):

@@ -248,6 +248,12 @@ class ParquetScan(PlanNode):
         #: as constant columns (reference: partition-value columns,
         #: BatchWithPartitionData)
         self.partition_values = partition_values
+        #: columns to request from the FILES: partition columns never live
+        #: in the data files
+        self.file_columns = columns
+        if columns and partition_values:
+            pkeys = {k for v in partition_values for k in v}
+            self.file_columns = [c for c in columns if c not in pkeys]
         self.children = []
 
     def partition_fields(self) -> List[T.StructField]:
@@ -258,6 +264,8 @@ class ParquetScan(PlanNode):
             for k in vals:
                 if k not in keys:
                     keys.append(k)
+        if self.columns:
+            keys = [k for k in keys if k in self.columns]
         fields = []
         for k in keys:
             non_null = [v.get(k) for v in self.partition_values

@@ -141,6 +141,23 @@ expr_rule(S.Like, Sigs.COMMON, Sigs.COMMON, "SQL LIKE", extra=_like_check)
 expr_rule(S._StringEquals, Sigs.COMMON, Sigs.COMMON, "string equality")
 expr_rule(S._AndExpr, Sigs.COMMON, Sigs.COMMON, "internal AND")
 
+
+def _rlike_check(e):
+    if not e.supported_on_tpu():
+        return (f"regex {e.pattern!r} outside the device NFA subset: "
+                f"{e._nfa_err} (reference RegexParser reject strategy)")
+    return None
+
+
+expr_rule(S.RLike, Sigs.COMMON, Sigs.COMMON,
+          "Java regex match (bit-parallel device NFA)", extra=_rlike_check)
+expr_rule(S.RegexpExtract, Sigs.COMMON, Sigs.COMMON,
+          "regex capture extract (CPU: needs backtracking groups)",
+          extra=lambda e: "capture-group regex runs on CPU")
+expr_rule(S.RegexpReplace, Sigs.COMMON, Sigs.COMMON,
+          "regex replace (CPU: needs backtracking groups)",
+          extra=lambda e: "capture-group regex runs on CPU")
+
 # math
 for _cls in (MA.Sqrt, MA.Exp, MA.Log, MA.Log10, MA.Log2, MA.Sin, MA.Cos,
              MA.Tan, MA.Asin, MA.Acos, MA.Atan, MA.Sinh, MA.Cosh, MA.Tanh,

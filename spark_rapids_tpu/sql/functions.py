@@ -104,6 +104,21 @@ def lag(c, offset: int = 1, default=None):
     return Lag(_e(c), offset, default)
 
 
+def rlike(c, pattern: str):
+    from spark_rapids_tpu.expr.strings import RLike
+    return RLike(_e(c), pattern)
+
+
+def regexp_extract(c, pattern: str, group: int = 1):
+    from spark_rapids_tpu.expr.strings import RegexpExtract
+    return RegexpExtract(_e(c), pattern, group)
+
+
+def regexp_replace(c, pattern: str, replacement: str):
+    from spark_rapids_tpu.expr.strings import RegexpReplace
+    return RegexpReplace(_e(c), pattern, replacement)
+
+
 def coalesce(*cs):
     return E.Coalesce(*[_e(c) for c in cs])
 

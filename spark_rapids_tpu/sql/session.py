@@ -28,18 +28,21 @@ def _discover_hive(root: str):
         dirnames.sort()
         rel = os.path.relpath(dirpath, root)
         parts = {}
-        ok = True
         if rel != ".":
             for seg in rel.split(os.sep):
                 if "=" not in seg:
-                    ok = False
+                    if any(f.endswith(".parquet") for f in filenames):
+                        raise ValueError(
+                            f"mixed layout under {root!r}: parquet files in "
+                            f"non-partition directory {dirpath!r}")
+                    parts = None
                     break
                 k, _, v = seg.partition("=")
                 parts[k] = (None if v == "__HIVE_DEFAULT_PARTITION__"
                             else unquote(v))
             if parts:
                 found_parts = True
-        if not ok:
+        if parts is None:
             continue
         for f in sorted(filenames):
             if f.endswith(".parquet") and not f.startswith("_"):

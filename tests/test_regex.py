@@ -1,0 +1,109 @@
+"""Device regex NFA tests: differential against Python `re` over a corpus
+(reference RegexParser/fuzz strategy, SURVEY.md §2.5 regex transpiler)."""
+import re
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col
+
+from asserts import assert_tpu_and_cpu_are_equal_collect, assert_fallback_collect
+
+CORPUS = ["", "a", "abc", "aabbb", "hello world", "123", "a1b2", "  pad  ",
+          "ABC", "abcabc", "xyz", "a.b", "[x]", "über", "日本語abc", "\n",
+          "line1\nline2", "aaaa", "zzz9", "foo_bar", "a-b", "3.14", "-42"]
+
+SUPPORTED_PATTERNS = [
+    "abc", "^abc", "abc$", "^abc$", "a+b*c?", "[abc]+", "[^abc]+",
+    "[a-z0-9]+", r"\d+", r"\w+", r"\s", r"\d{2,3}", "a{2}", "(ab)+c",
+    "ab|cd|ef", "^(foo|bar)_", "a.c", ".*", "x?yz", r"[-+]?\d+",
+    r"\d+\.\d+", "(a|b)(c|d)", "^$",
+]
+
+UNSUPPORTED_PATTERNS = [
+    r"(?i)abc", r"a(?=b)", r"(a)\1", r"a*?", r"a*+", r"\p{L}", "日本",
+]
+
+
+def _nfa_matches(pattern, corpus):
+    import jax.numpy as jnp
+    from spark_rapids_tpu.expr import regex as RX
+    nfa = RX.compile_pattern(pattern, mode="find")
+    data = "".join(corpus).encode("utf-8")
+    offs = [0]
+    for s in corpus:
+        offs.append(offs[-1] + len(s.encode("utf-8")))
+    res = RX.nfa_eval(nfa, jnp.asarray(np.array(offs, np.int32)),
+                      jnp.asarray(np.frombuffer(data, np.uint8))
+                      if data else jnp.zeros(1, jnp.uint8), None)
+    return [bool(x) for x in np.asarray(res)]
+
+
+@pytest.mark.parametrize("pattern", SUPPORTED_PATTERNS)
+def test_nfa_vs_python_re(pattern):
+    got = _nfa_matches(pattern, CORPUS)
+    prog = re.compile(pattern)
+    expect = [bool(prog.search(s)) for s in CORPUS]
+    assert got == expect, (pattern,
+                           [(s, g, e) for s, g, e in zip(CORPUS, got, expect)
+                            if g != e])
+
+
+@pytest.mark.parametrize("pattern", UNSUPPORTED_PATTERNS)
+def test_unsupported_patterns_reject(pattern):
+    from spark_rapids_tpu.expr import regex as RX
+    with pytest.raises(RX.RegexUnsupported):
+        RX.compile_pattern(pattern)
+
+
+def test_rlike_end_to_end():
+    session = TpuSession()
+    t = pa.table({"s": CORPUS})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).filter(F.rlike(col("s"), r"^[a-z]+\d*$")),
+        session, ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            col("s"), F.rlike(col("s"), r"\d+\.\d+").alias("m")),
+        session)
+
+
+def test_rlike_unsupported_falls_back():
+    session = TpuSession()
+    t = pa.table({"s": ["abc", "ABC"]})
+    assert_fallback_collect(
+        lambda s: s.create_dataframe(t).filter(F.rlike(col("s"), r"(?i)abc")),
+        session, "Filter", ignore_order=True)
+
+
+def test_regexp_extract_replace_cpu():
+    session = TpuSession()
+    t = pa.table({"s": ["a12b", "xy", None, "c345"]})
+    df = session.create_dataframe(t)
+    got = df.select(F.regexp_extract(col("s"), r"([a-z])(\d+)", 2).alias("d"),
+                    F.regexp_replace(col("s"), r"\d+", "#").alias("r")).to_pydict()
+    assert got["d"] == ["12", "", None, "345"]
+    assert got["r"] == ["a#b", "xy", None, "c#"]
+
+
+def test_like_underscore_via_nfa():
+    session = TpuSession()
+    from spark_rapids_tpu.expr.strings import Like
+    t = pa.table({"s": ["cat", "cut", "cart", "ct", None]})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            col("s"), Like(col("s"), "c_t").alias("m")),
+        session)
+
+
+def test_nfa_on_dict_strings_vocab_lift():
+    # low-cardinality strings: regex runs over the vocab, not the rows
+    session = TpuSession()
+    vals = ["alpha", "beta", "gamma42"] * 50
+    t = pa.table({"s": vals})
+    out = session.create_dataframe(t).filter(
+        F.rlike(col("s"), r"\d")).count()
+    assert out == 50

@@ -130,9 +130,18 @@ def test_like_transpiled(session):
         session)
 
 
-def test_like_complex_falls_back(session):
-    assert_fallback_collect(
+def test_like_underscore_on_device(session):
+    # '_' wildcards now compile to the device NFA (upgrade over the simple
+    # starts/ends/contains transpile)
+    assert_tpu_and_cpu_are_equal_collect(
         lambda s: make_df(s).select(F.like(col("s"), "a_b%c").alias("p")),
+        session)
+
+
+def test_like_complex_falls_back(session):
+    # non-ASCII literal + '_' needs the NFA, which rejects non-ASCII
+    assert_fallback_collect(
+        lambda s: make_df(s).select(F.like(col("s"), "a_日%").alias("p")),
         session, "Project")
 
 
