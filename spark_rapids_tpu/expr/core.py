@@ -311,6 +311,30 @@ class Literal(Expression):
                       np.ones(n, np.bool_))
 
 
+class NullOf(Expression):
+    """An all-null column with the (post-binding) type of its child — used
+    by rewrites like nullif that need a typed null before names resolve."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type()
+
+    def with_children(self, children):
+        return NullOf(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        if isinstance(c.data, dict):
+            return ColumnVector(c.dtype, c.data, jnp.zeros(ctx.capacity, jnp.bool_))
+        return ColumnVector(c.dtype, c.data, jnp.zeros(ctx.capacity, jnp.bool_))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        return CpuCol(c.dtype, c.values, np.zeros(len(c.values), np.bool_))
+
+
 class Alias(Expression):
     def __init__(self, child: Expression, name: str):
         self.children = [child]

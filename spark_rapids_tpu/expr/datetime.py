@@ -273,3 +273,239 @@ class LastDay(Expression):
                 last = calendar.monthrange(d.year, d.month)[1]
                 out[i] = (d.replace(day=last) - datetime.date(1970, 1, 1)).days
         return CpuCol(T.DATE, out, c.valid)
+
+
+def _days_from_civil(y, m, d):
+    """(year, month, day) -> days since epoch (inverse of _civil_from_days,
+    same Hinnant algorithm, branch-free)."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.mod(m + 9, 12)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+class Quarter(_DatePart):
+    part = "quarter"
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        is_ts = isinstance(c.dtype, T.TimestampType)
+        _, m, _ = _civil_from_days(_days_of(c.data.astype(jnp.int64), is_ts))
+        return ColumnVector(T.INT32, ((m - 1) // 3 + 1).astype(jnp.int32),
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        _, m, _ = _civil_from_days_np(c.values.astype(np.int64))
+        return CpuCol(T.INT32, ((m - 1) // 3 + 1).astype(np.int32), c.valid)
+
+
+class DayOfYear(_DatePart):
+    part = "doy"
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        is_ts = isinstance(c.dtype, T.TimestampType)
+        days = _days_of(c.data.astype(jnp.int64), is_ts)
+        y, _, _ = _civil_from_days(days)
+        jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+        return ColumnVector(T.INT32, (days - jan1 + 1).astype(jnp.int32),
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        import datetime
+        c = self.children[0].eval_cpu(cols, ansi)
+        out = np.zeros(len(c.values), np.int32)
+        for i, v in enumerate(c.values):
+            if c.valid[i]:
+                d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+                out[i] = d.timetuple().tm_yday
+        return CpuCol(T.INT32, out, c.valid)
+
+
+class WeekOfYear(_DatePart):
+    """ISO-8601 week number (Spark weekofyear)."""
+
+    part = "week"
+
+    @staticmethod
+    def _iso_week(days):
+        # ISO week: Thursday of the current week determines the year;
+        # 1970-01-01 was a Thursday -> dow (Mon=0) = (days + 3) % 7
+        dow = jnp.mod(days + 3, 7)
+        thursday = days - dow + 3
+        y, _, _ = _civil_from_days(thursday)
+        jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+        return (jnp.floor_divide(thursday - jan1, 7) + 1).astype(jnp.int32)
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        is_ts = isinstance(c.dtype, T.TimestampType)
+        days = _days_of(c.data.astype(jnp.int64), is_ts)
+        return ColumnVector(T.INT32, self._iso_week(days), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        import datetime
+        c = self.children[0].eval_cpu(cols, ansi)
+        out = np.zeros(len(c.values), np.int32)
+        for i, v in enumerate(c.values):
+            if c.valid[i]:
+                d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
+                out[i] = d.isocalendar()[1]
+        return CpuCol(T.INT32, out, c.valid)
+
+
+class AddMonths(Expression):
+    """add_months(date, n): day-of-month clamps to the target month's end
+    (Spark semantics)."""
+
+    def __init__(self, child, months):
+        self.children = [child, months]
+
+    def data_type(self):
+        return T.DATE
+
+    def with_children(self, children):
+        return AddMonths(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        n = self.children[1].eval_tpu(ctx)
+        days = c.data.astype(jnp.int64)
+        y, m, d = _civil_from_days(days)
+        tot = y * 12 + (m - 1) + n.data.astype(jnp.int64)
+        ny = jnp.floor_divide(tot, 12)
+        nm = jnp.mod(tot, 12) + 1
+        nd = jnp.minimum(d, LastDay._month_len(ny, nm))
+        out = _days_from_civil(ny, nm, nd).astype(jnp.int32)
+        valid = _valid_of(c, ctx) & _valid_of(n, ctx)
+        return ColumnVector(T.DATE, out, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        import calendar
+        import datetime
+        c = self.children[0].eval_cpu(cols, ansi)
+        n = self.children[1].eval_cpu(cols, ansi)
+        out = np.zeros(len(c.values), np.int32)
+        valid = c.valid & n.valid
+        for i in range(len(out)):
+            if valid[i]:
+                d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(c.values[i]))
+                tot = d.year * 12 + d.month - 1 + int(n.values[i])
+                ny, nm = tot // 12, tot % 12 + 1
+                nd = min(d.day, calendar.monthrange(ny, nm)[1])
+                out[i] = (datetime.date(ny, nm, nd) - datetime.date(1970, 1, 1)).days
+        return CpuCol(T.DATE, out, valid)
+
+
+class TruncDate(Expression):
+    """trunc(date, fmt) for fmt in year/yyyy/yy/month/mon/mm/quarter/week."""
+
+    _FMTS = {"year": "y", "yyyy": "y", "yy": "y", "month": "m", "mon": "m",
+             "mm": "m", "quarter": "q", "week": "w"}
+
+    def __init__(self, child, fmt: str):
+        self.children = [child]
+        self.fmt = fmt.lower()
+
+    def _params(self):
+        return self.fmt
+
+    def data_type(self):
+        return T.DATE
+
+    def with_children(self, children):
+        return TruncDate(children[0], self.fmt)
+
+    def supported_on_tpu(self):
+        return self.fmt in self._FMTS
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        days = c.data.astype(jnp.int64)
+        kind = self._FMTS[self.fmt]
+        y, m, d = _civil_from_days(days)
+        if kind == "y":
+            out = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        elif kind == "m":
+            out = _days_from_civil(y, m, jnp.ones_like(d))
+        elif kind == "q":
+            qm = ((m - 1) // 3) * 3 + 1
+            out = _days_from_civil(y, qm, jnp.ones_like(d))
+        else:  # week: Monday
+            out = days - jnp.mod(days + 3, 7)
+        return ColumnVector(T.DATE, out.astype(jnp.int32), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        import datetime
+        c = self.children[0].eval_cpu(cols, ansi)
+        out = np.zeros(len(c.values), np.int32)
+        valid = c.valid.copy()
+        kind = self._FMTS.get(self.fmt)
+        epoch = datetime.date(1970, 1, 1)
+        for i, v in enumerate(c.values):
+            if not c.valid[i]:
+                continue
+            if kind is None:
+                valid[i] = False
+                continue
+            d = epoch + datetime.timedelta(days=int(v))
+            if kind == "y":
+                d = d.replace(month=1, day=1)
+            elif kind == "m":
+                d = d.replace(day=1)
+            elif kind == "q":
+                d = d.replace(month=(d.month - 1) // 3 * 3 + 1, day=1)
+            else:
+                d = d - datetime.timedelta(days=d.weekday())
+            out[i] = (d - epoch).days
+        return CpuCol(T.DATE, out, valid)
+
+
+class UnixTimestampFromTs(Expression):
+    """unix_timestamp(ts): seconds since epoch (floor division)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT64
+
+    def with_children(self, children):
+        return UnixTimestampFromTs(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        return ColumnVector(T.INT64,
+                            jnp.floor_divide(c.data.astype(jnp.int64), 1_000_000),
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        return CpuCol(T.INT64, np.floor_divide(c.values.astype(np.int64), 1_000_000),
+                      c.valid)
+
+
+class TimestampSeconds(Expression):
+    """timestamp_seconds(long) -> timestamp."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def with_children(self, children):
+        return TimestampSeconds(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        return ColumnVector(T.TIMESTAMP, c.data.astype(jnp.int64) * 1_000_000,
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        return CpuCol(T.TIMESTAMP, c.values.astype(np.int64) * 1_000_000, c.valid)

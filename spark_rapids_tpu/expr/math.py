@@ -356,3 +356,169 @@ class Greatest(Expression):
 
 class Least(Greatest):
     largest = False
+
+
+class _Bitwise(Expression):
+    """Bitwise binary ops over integral types (reference bitwise exprs)."""
+
+    op = "and"
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self):
+        from spark_rapids_tpu.types import common_type
+        return common_type(self.children[0].data_type(),
+                           self.children[1].data_type())
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def _apply(self, a, b):
+        import operator
+        return {"and": operator.and_, "or": operator.or_,
+                "xor": operator.xor}[self.op](a, b)
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expr.core import _valid_of
+        l = self.children[0].eval_tpu(ctx)
+        r = self.children[1].eval_tpu(ctx)
+        dt = self.data_type()
+        out = self._apply(l.data.astype(dt.np_dtype), r.data.astype(dt.np_dtype))
+        return ColumnVector(dt, out, _valid_of(l, ctx) & _valid_of(r, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        l = self.children[0].eval_cpu(cols, ansi)
+        r = self.children[1].eval_cpu(cols, ansi)
+        dt = self.data_type()
+        out = self._apply(l.values.astype(dt.np_dtype),
+                          r.values.astype(dt.np_dtype))
+        return CpuCol(dt, out, l.valid & r.valid)
+
+
+class BitwiseAnd(_Bitwise):
+    op = "and"
+
+
+class BitwiseOr(_Bitwise):
+    op = "or"
+
+
+class BitwiseXor(_Bitwise):
+    op = "xor"
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def with_children(self, children):
+        return BitwiseNot(children[0])
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expr.core import _valid_of
+        c = self.children[0].eval_tpu(ctx)
+        return ColumnVector(c.dtype, ~c.data, _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        return CpuCol(c.dtype, ~c.values, c.valid)
+
+
+class _Shift(Expression):
+    """shiftleft/shiftright: Java semantics — the shift distance wraps mod
+    the value's bit width."""
+
+    left = True
+    arithmetic = True
+
+    def __init__(self, value, amount):
+        self.children = [value, amount]
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def _shift(self, xp, v, n):
+        width = v.dtype.itemsize * 8
+        n = n % width
+        if self.left:
+            return v << n
+        if self.arithmetic:
+            return v >> n
+        # logical right shift: through the unsigned view
+        udt = {1: xp.uint8, 2: xp.uint16, 4: xp.uint32, 8: xp.uint64}[v.dtype.itemsize]
+        return (v.astype(udt) >> n.astype(udt)).astype(v.dtype)
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expr.core import _valid_of
+        v = self.children[0].eval_tpu(ctx)
+        n = self.children[1].eval_tpu(ctx)
+        out = self._shift(jnp, v.data, n.data.astype(v.data.dtype))
+        return ColumnVector(v.dtype, out, _valid_of(v, ctx) & _valid_of(n, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        v = self.children[0].eval_cpu(cols, ansi)
+        n = self.children[1].eval_cpu(cols, ansi)
+        out = self._shift(np, v.values, n.values.astype(v.values.dtype))
+        return CpuCol(v.dtype, out, v.valid & n.valid)
+
+
+class ShiftLeft(_Shift):
+    left = True
+
+
+class ShiftRight(_Shift):
+    left = False
+    arithmetic = True
+
+
+class ShiftRightUnsigned(_Shift):
+    left = False
+    arithmetic = False
+
+
+class Murmur3Hash(Expression):
+    """hash(...): Spark Murmur3 (seed 42) over any number of columns —
+    bit-parity with the reference's GPU murmur3 (HashFunctions.scala)."""
+
+    def __init__(self, *children):
+        self.children = list(children)
+
+    def data_type(self):
+        from spark_rapids_tpu import types as TT
+        return TT.INT32
+
+    def with_children(self, children):
+        return Murmur3Hash(*children)
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu import types as TT
+        from spark_rapids_tpu.ops import kernels as K
+        cols = [c.eval_tpu(ctx) for c in self.children]
+        h = K.spark_murmur3_batch(cols, ctx.num_rows, live=ctx.row_mask)
+        import jax.numpy as jnp2
+        return ColumnVector(TT.INT32, h.astype(jnp2.int32), None)
+
+    def eval_cpu(self, cols, ansi=False):
+        # reuse the device kernel on the CPU backend for bit parity
+        import jax.numpy as jnp2
+        from spark_rapids_tpu import types as TT
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch, from_pydict
+        from spark_rapids_tpu.ops import kernels as K
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values)
+        import pyarrow as pa
+        arrays = {}
+        from spark_rapids_tpu.exec.cpu_backend import cols_to_table
+        table = cols_to_table(ins, [f"c{i}" for i in range(len(ins))])
+        from spark_rapids_tpu.columnar.batch import from_arrow
+        batch = from_arrow(table)
+        h = K.spark_murmur3_batch(batch.columns, batch.num_rows)
+        vals = np.asarray(h).astype(np.int32)[:n]
+        return CpuCol(TT.INT32, vals, np.ones(n, np.bool_))

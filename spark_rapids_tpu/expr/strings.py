@@ -944,3 +944,270 @@ def _spark_float_str(v: float) -> str:
     if v == int(v) and abs(v) < 1e16:
         return f"{int(v)}.0"
     return repr(v)
+
+
+# ---------------------------------------------------------------------------
+# String function breadth (reference stringFunctions.scala): all unary ops
+# ride the vocab lift, so dict-encoded columns pay O(vocab) byte work.
+# ---------------------------------------------------------------------------
+
+def _row_of_byte(offsets, nbytes, cap):
+    b = jnp.arange(nbytes, dtype=jnp.int32)
+    return jnp.clip(jnp.searchsorted(offsets, b, side="right").astype(jnp.int32) - 1,
+                    0, cap - 1)
+
+
+def _slice_rows(raw, new_start, lens, cap):
+    """Assemble a string column taking lens[i] bytes from new_start[i]."""
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    nb = raw.shape[0]
+    b = jnp.arange(nb, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off, b, side="right").astype(jnp.int32) - 1,
+                   0, cap - 1)
+    src = jnp.clip(new_start[row] + (b - new_off[row]), 0, nb - 1)
+    out = jnp.where(b < new_off[-1], raw[src], 0).astype(jnp.uint8)
+    return {"offsets": new_off, "bytes": out}
+
+
+class _TrimBase(Expression):
+    """trim/ltrim/rtrim of ASCII spaces (Spark default trims ' ')."""
+
+    lead = True
+    tail = True
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+
+        def compute(flat, cap):
+            o = flat.data["offsets"]
+            raw = flat.data["bytes"]
+            nb = raw.shape[0]
+            row = _row_of_byte(o, nb, cap)
+            pos = jnp.arange(nb, dtype=jnp.int32)
+            in_row = (pos >= o[row]) & (pos < o[row + 1])
+            nonspace = in_row & (raw != 32)
+            first_ns = jax.ops.segment_min(
+                jnp.where(nonspace, pos, nb), row, num_segments=cap)
+            last_ns = jax.ops.segment_max(
+                jnp.where(nonspace, pos, -1), row, num_segments=cap)
+            has = last_ns >= 0
+            start = jnp.where(self.lead, jnp.where(has, first_ns, o[1:]),
+                              o[:-1]).astype(jnp.int32)
+            end = jnp.where(self.tail, jnp.where(has, last_ns + 1, start),
+                            o[1:]).astype(jnp.int32)
+            end = jnp.maximum(end, start)
+            return ColumnVector(T.STRING,
+                                _slice_rows(raw, start, end - start, cap), None)
+
+        return _lift_unary(ctx, c, compute)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        def f(s):
+            if self.lead and self.tail:
+                return s.strip(" ")
+            return s.lstrip(" ") if self.lead else s.rstrip(" ")
+        vals = np.array([f(s) if isinstance(s, str) else s for s in c.values],
+                        object)
+        return CpuCol(T.STRING, vals, c.valid)
+
+
+class Trim(_TrimBase):
+    lead = tail = True
+
+
+class LTrim(_TrimBase):
+    lead, tail = True, False
+
+
+class RTrim(_TrimBase):
+    lead, tail = False, True
+
+
+class InitCap(Expression):
+    """initcap: uppercase after a space / row start, lowercase elsewhere
+    (ASCII mapping; reference documents the same non-ASCII incompat)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return InitCap(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+
+        def compute(flat, cap):
+            o = flat.data["offsets"]
+            raw = flat.data["bytes"]
+            nb = raw.shape[0]
+            row = _row_of_byte(o, nb, cap)
+            pos = jnp.arange(nb, dtype=jnp.int32)
+            at_start = pos == o[row]
+            prev = jnp.where(at_start, jnp.uint8(32), jnp.roll(raw, 1))
+            after_sep = prev == 32
+            lower = jnp.where((raw >= 65) & (raw <= 90), raw + 32, raw)
+            upper = jnp.where((raw >= 97) & (raw <= 122), raw - 32, raw)
+            out = jnp.where(after_sep, upper, lower)
+            return ColumnVector(T.STRING, {"offsets": o, "bytes": out}, None)
+
+        return _lift_unary(ctx, c, compute)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+
+        def f(s):
+            return " ".join(w[:1].upper() + w[1:].lower() for w in s.split(" "))
+
+        vals = np.array([f(s) if isinstance(s, str) else s for s in c.values],
+                        object)
+        return CpuCol(T.STRING, vals, c.valid)
+
+
+class Ascii(Expression):
+    """ascii(s): code of the first character (ASCII subset on device)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return Ascii(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+
+        def compute(flat, cap):
+            o = flat.data["offsets"]
+            raw = flat.data["bytes"]
+            nb = raw.shape[0]
+            first = raw[jnp.clip(o[:-1], 0, nb - 1)].astype(jnp.int32)
+            lens = o[1:] - o[:-1]
+            return ColumnVector(T.INT32, jnp.where(lens > 0, first, 0), None)
+
+        return _lift_unary(ctx, c, compute)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        vals = np.array([ord(s[0]) if isinstance(s, str) and s else 0
+                         for s in c.values], np.int32)
+        return CpuCol(T.INT32, vals, c.valid)
+
+
+class InStr(Expression):
+    """instr(str, substr-literal): 1-based CHAR position of the first
+    occurrence, 0 if absent."""
+
+    def __init__(self, child, substr: str):
+        self.children = [child]
+        self.substr = substr
+
+    def _params(self):
+        return repr(self.substr)
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return InStr(children[0], self.substr)
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        pat = np.frombuffer(self.substr.encode("utf-8"), np.uint8)
+        m = len(pat)
+
+        def compute(flat, cap):
+            o = flat.data["offsets"]
+            raw = flat.data["bytes"]
+            nb = raw.shape[0]
+            if m == 0:
+                return ColumnVector(T.INT32, jnp.ones(cap, jnp.int32), None)
+            pos = jnp.arange(nb, dtype=jnp.int32)
+            row = _row_of_byte(o, nb, cap)
+            eq = jnp.ones(nb, jnp.bool_)
+            for k in range(m):
+                eq = eq & (raw[jnp.clip(pos + k, 0, nb - 1)] == pat[k])
+            fits = (pos + m) <= o[row + 1]
+            hit = eq & fits
+            first_hit = jax.ops.segment_min(jnp.where(hit, pos, nb), row,
+                                            num_segments=cap)
+            found = first_hit < nb
+            # byte position -> 1-based char index
+            is_start = ((raw & 0xC0) != 0x80).astype(jnp.int32)
+            csum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                    jnp.cumsum(is_start)])
+            char_idx = csum[jnp.clip(first_hit, 0, nb)] - csum[o[:-1]] + 1
+            return ColumnVector(T.INT32,
+                                jnp.where(found, char_idx, 0).astype(jnp.int32),
+                                None)
+
+        return _lift_unary(ctx, c, compute)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        vals = np.array([s.find(self.substr) + 1 if isinstance(s, str) else 0
+                         for s in c.values], np.int32)
+        return CpuCol(T.INT32, vals, c.valid)
+
+
+class StringRepeat(Expression):
+    """repeat(str, n-literal)."""
+
+    def __init__(self, child, n: int):
+        self.children = [child]
+        self.n = max(int(n), 0)
+
+    def _params(self):
+        return str(self.n)
+
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return StringRepeat(children[0], self.n)
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        n = self.n
+
+        def compute(flat, cap):
+            o = flat.data["offsets"]
+            raw = flat.data["bytes"]
+            nb = int(raw.shape[0])
+            lens = o[1:] - o[:-1]
+            out_lens = lens * n
+            new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                       jnp.cumsum(out_lens).astype(jnp.int32)])
+            out_cap = nb * max(n, 1)
+            b = jnp.arange(out_cap, dtype=jnp.int32)
+            row = jnp.clip(jnp.searchsorted(new_off, b, side="right")
+                           .astype(jnp.int32) - 1, 0, cap - 1)
+            off_in = b - new_off[row]
+            src = jnp.clip(o[row] + jnp.mod(off_in, jnp.maximum(lens[row], 1)),
+                           0, nb - 1)
+            out = jnp.where(b < new_off[-1], raw[src], 0).astype(jnp.uint8)
+            return ColumnVector(T.STRING, {"offsets": new_off, "bytes": out},
+                                None)
+
+        return _lift_unary(ctx, c, compute)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        vals = np.array([s * self.n if isinstance(s, str) else s
+                         for s in c.values], object)
+        return CpuCol(T.STRING, vals, c.valid)

@@ -57,6 +57,7 @@ _NUMDT = _NUM + TypeSig(["DATE", "TIMESTAMP", "BOOLEAN"])
 expr_rule(E.BoundRef, Sigs.COMMON, Sigs.COMMON, "column reference")
 expr_rule(E.Literal, Sigs.COMMON, Sigs.COMMON, "literal value")
 expr_rule(E.Alias, Sigs.COMMON, Sigs.COMMON, "named expression")
+expr_rule(E.NullOf, Sigs.COMMON, Sigs.COMMON, "typed null")
 expr_rule(E.Add, _NUM, _NUM, "addition")
 expr_rule(E.Subtract, _NUM, _NUM, "subtraction")
 expr_rule(E.Multiply, _NUM, _NUM, "multiplication")
@@ -167,8 +168,31 @@ for _cls in (MA.Sqrt, MA.Exp, MA.Log, MA.Log10, MA.Log2, MA.Sin, MA.Cos,
 
 # datetime
 for _cls in (DT.Year, DT.Month, DT.DayOfMonth, DT.Hour, DT.Minute, DT.Second,
-             DT.DayOfWeek, DT.DateAdd, DT.DateSub, DT.DateDiff, DT.LastDay):
+             DT.DayOfWeek, DT.DateAdd, DT.DateSub, DT.DateDiff, DT.LastDay,
+             DT.Quarter, DT.DayOfYear, DT.WeekOfYear, DT.AddMonths,
+             DT.UnixTimestampFromTs, DT.TimestampSeconds):
     expr_rule(_cls, _NUMDT, _NUMDT, _cls.__name__.lower())
+
+
+def _trunc_check(e):
+    if not e.supported_on_tpu():
+        return f"trunc format {e.fmt!r} not supported on device"
+    return None
+
+
+expr_rule(DT.TruncDate, _NUMDT, _NUMDT, "trunc(date, fmt)", extra=_trunc_check)
+
+# bitwise / shifts / hash
+for _cls in (MA.BitwiseAnd, MA.BitwiseOr, MA.BitwiseXor, MA.BitwiseNot,
+             MA.ShiftLeft, MA.ShiftRight, MA.ShiftRightUnsigned):
+    expr_rule(_cls, _NUM, _NUM, _cls.__name__.lower())
+expr_rule(MA.Murmur3Hash, Sigs.COMMON, Sigs.COMMON,
+          "Spark murmur3 hash (seed 42), bit-parity with CPU Spark")
+
+# string breadth
+for _cls in (S.Trim, S.LTrim, S.RTrim, S.InitCap, S.Ascii, S.InStr,
+             S.StringRepeat):
+    expr_rule(_cls, Sigs.COMMON, Sigs.COMMON, _cls.__name__.lower())
 
 
 # Aggregate function rules

@@ -104,6 +104,96 @@ def lag(c, offset: int = 1, default=None):
     return Lag(_e(c), offset, default)
 
 
+def trim(c):
+    return S.Trim(_e(c))
+
+
+def ltrim(c):
+    return S.LTrim(_e(c))
+
+
+def rtrim(c):
+    return S.RTrim(_e(c))
+
+
+def initcap(c):
+    return S.InitCap(_e(c))
+
+
+def ascii(c):  # noqa: A001
+    return S.Ascii(_e(c))
+
+
+def instr(c, substr: str):
+    return S.InStr(_e(c), substr)
+
+
+def locate(substr: str, c):
+    return S.InStr(_e(c), substr)
+
+
+def repeat(c, n: int):
+    return S.StringRepeat(_e(c), n)
+
+
+def quarter(c):
+    return DT.Quarter(_e(c))
+
+
+def dayofyear(c):
+    return DT.DayOfYear(_e(c))
+
+
+def weekofyear(c):
+    return DT.WeekOfYear(_e(c))
+
+
+def add_months(c, n):
+    return DT.AddMonths(_e(c), _e(n))
+
+
+def trunc(c, fmt: str):
+    return DT.TruncDate(_e(c), fmt)
+
+
+def unix_timestamp(c):
+    return DT.UnixTimestampFromTs(_e(c))
+
+
+def timestamp_seconds(c):
+    return DT.TimestampSeconds(_e(c))
+
+
+def bitwise_not(c):
+    return MA.BitwiseNot(_e(c))
+
+
+def shiftleft(c, n):
+    return MA.ShiftLeft(_e(c), _e(n))
+
+
+def shiftright(c, n):
+    return MA.ShiftRight(_e(c), _e(n))
+
+
+def shiftrightunsigned(c, n):
+    return MA.ShiftRightUnsigned(_e(c), _e(n))
+
+
+def hash(*cs):  # noqa: A001
+    return MA.Murmur3Hash(*[_e(c) for c in cs])
+
+
+def nvl(c, default):
+    return coalesce(c, default)
+
+
+def nullif(a, b):
+    from spark_rapids_tpu.expr.core import EqualTo, If, NullOf
+    ea, eb = _e(a), _e(b)
+    return If(EqualTo(ea, eb), NullOf(ea), ea)
+
+
 def rlike(c, pattern: str):
     from spark_rapids_tpu.expr.strings import RLike
     return RLike(_e(c), pattern)

@@ -1,0 +1,119 @@
+"""Differential tests for the expression-breadth pass: datetime parts,
+bitwise/shift/hash, trim family, initcap/ascii/instr/repeat."""
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _dates(n=80, seed=5):
+    rng = np.random.default_rng(seed)
+    days = rng.integers(-25000, 25000, n)  # ~1901..2038
+    vals = [None if rng.random() < 0.1 else
+            datetime.date(1970, 1, 1) + datetime.timedelta(days=int(d))
+            for d in days]
+    ts = [None if v is None else
+          datetime.datetime(v.year, v.month, v.day, 13, 7, 9)
+          for v in vals]
+    return pa.table({"d": pa.array(vals, pa.date32()),
+                     "t": pa.array(ts, pa.timestamp("us")),
+                     "n": pa.array(rng.integers(-30, 30, n).astype(np.int32))})
+
+
+def test_datetime_parts(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_dates()).select(
+            F.quarter(col("d")).alias("q"),
+            F.dayofyear(col("d")).alias("doy"),
+            F.weekofyear(col("d")).alias("woy")),
+        session)
+
+
+def test_add_months_and_trunc(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_dates()).select(
+            F.add_months(col("d"), col("n")).alias("am"),
+            F.trunc(col("d"), "month").alias("tm"),
+            F.trunc(col("d"), "year").alias("ty"),
+            F.trunc(col("d"), "quarter").alias("tq"),
+            F.trunc(col("d"), "week").alias("tw")),
+        session)
+
+
+def test_unix_timestamp_roundtrip(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_dates()).select(
+            F.unix_timestamp(col("t")).alias("u"),
+            F.timestamp_seconds(F.unix_timestamp(col("t"))).alias("rt")),
+        session)
+
+
+def test_bitwise_and_shifts(session):
+    rng = np.random.default_rng(1)
+    t = pa.table({"a": pa.array(rng.integers(-1000, 1000, 60).astype(np.int64)),
+                  "b": pa.array(rng.integers(0, 100, 60).astype(np.int64)),
+                  "s": pa.array(rng.integers(0, 70, 60).astype(np.int32))})
+    from spark_rapids_tpu.expr.math import BitwiseAnd, BitwiseOr, BitwiseXor
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            BitwiseAnd(col("a"), col("b")).alias("ba"),
+            BitwiseOr(col("a"), col("b")).alias("bo"),
+            BitwiseXor(col("a"), col("b")).alias("bx"),
+            F.bitwise_not(col("a")).alias("bn"),
+            F.shiftleft(col("a"), col("s")).alias("sl"),
+            F.shiftright(col("a"), col("s")).alias("sr")),
+        session)
+
+
+def test_hash_parity_with_cpu(session):
+    t = pa.table({"i": pa.array([1, 2, None, -5], pa.int64()),
+                  "s": pa.array(["a", "bc", None, ""]),
+                  "f": pa.array([1.5, -0.0, float("nan"), None])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.hash(col("i"), col("s"), col("f")).alias("h")),
+        session)
+
+
+def test_trim_family(session):
+    t = pa.table({"s": ["  ab  ", "x", "", "   ", None, "a b", "\tkeep\t"]})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.trim(col("s")).alias("t"),
+            F.ltrim(col("s")).alias("l"),
+            F.rtrim(col("s")).alias("r")),
+        session)
+
+
+def test_initcap_ascii_instr_repeat(session):
+    t = pa.table({"s": ["hello world", "FOO bar", "", None, "a  b", "xyzxyz"]})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.initcap(col("s")).alias("ic"),
+            F.ascii(col("s")).alias("a"),
+            F.instr(col("s"), "o").alias("i"),
+            F.repeat(col("s"), 2).alias("r2"),
+            F.repeat(col("s"), 0).alias("r0")),
+        session)
+
+
+def test_nvl_nullif(session):
+    t = pa.table({"a": pa.array([1, None, 3], pa.int64()),
+                  "b": pa.array([1, 2, None], pa.int64())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.nvl(col("a"), lit(0)).alias("n"),
+            F.nullif(col("a"), col("b")).alias("ni")),
+        session)
