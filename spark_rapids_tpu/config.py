@@ -112,6 +112,24 @@ WRITER_THREADS = conf_int(
     "Background threads encoding+writing output files (reference "
     "io/async ThrottlingExecutor).")
 
+OPTIMIZER_ENABLED = conf_bool(
+    "spark.rapids.sql.optimizer.enabled", False,
+    "Cost-based reversion of TPU subtrees whose estimated device cost "
+    "(incl. transfer + dispatch) exceeds the CPU cost "
+    "(reference CostBasedOptimizer.scala, off by default).")
+
+PROFILE_DIR = conf_str(
+    "spark.rapids.profile.dir", "",
+    "When set, each collect() runs under a jax.profiler trace written to "
+    "this directory (XProf/TensorBoard-viewable; the reference's "
+    "CUPTI-based Profiler + NVTX analog).")
+
+LORE_DUMP_DIR = conf_str(
+    "spark.rapids.sql.lore.dumpPath", "",
+    "When set, every exec's input batches dump as parquet under "
+    "<dir>/<loreId>/ for local operator replay "
+    "(reference LORE, lore/GpuLore.scala).")
+
 SORT_OOC_BYTES = conf_int(
     "spark.rapids.sql.sort.outOfCoreBytes", 2 << 30,
     "Sorts over inputs larger than this run out-of-core: the device "

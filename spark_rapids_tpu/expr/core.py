@@ -326,8 +326,6 @@ class NullOf(Expression):
 
     def eval_tpu(self, ctx):
         c = self.children[0].eval_tpu(ctx)
-        if isinstance(c.data, dict):
-            return ColumnVector(c.dtype, c.data, jnp.zeros(ctx.capacity, jnp.bool_))
         return ColumnVector(c.dtype, c.data, jnp.zeros(ctx.capacity, jnp.bool_))
 
     def eval_cpu(self, cols, ansi=False):

@@ -56,6 +56,14 @@ def _days_of(col, is_ts: bool):
     return col
 
 
+def _days_of_np(c) -> np.ndarray:
+    """CPU twin: days-since-epoch from a date OR timestamp CpuCol."""
+    v = c.values.astype(np.int64)
+    if isinstance(c.dtype, T.TimestampType):
+        return np.floor_divide(v, 86_400_000_000)
+    return v
+
+
 class _DatePart(Expression):
     part = "year"
 
@@ -299,7 +307,8 @@ class Quarter(_DatePart):
 
     def eval_cpu(self, cols, ansi=False):
         c = self.children[0].eval_cpu(cols, ansi)
-        _, m, _ = _civil_from_days_np(c.values.astype(np.int64))
+        days = _days_of_np(c)
+        _, m, _ = _civil_from_days_np(days)
         return CpuCol(T.INT32, ((m - 1) // 3 + 1).astype(np.int32), c.valid)
 
 
@@ -318,8 +327,9 @@ class DayOfYear(_DatePart):
     def eval_cpu(self, cols, ansi=False):
         import datetime
         c = self.children[0].eval_cpu(cols, ansi)
+        days = _days_of_np(c)
         out = np.zeros(len(c.values), np.int32)
-        for i, v in enumerate(c.values):
+        for i, v in enumerate(days):
             if c.valid[i]:
                 d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
                 out[i] = d.timetuple().tm_yday
@@ -350,8 +360,9 @@ class WeekOfYear(_DatePart):
     def eval_cpu(self, cols, ansi=False):
         import datetime
         c = self.children[0].eval_cpu(cols, ansi)
+        days = _days_of_np(c)
         out = np.zeros(len(c.values), np.int32)
-        for i, v in enumerate(c.values):
+        for i, v in enumerate(days):
             if c.valid[i]:
                 d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))
                 out[i] = d.isocalendar()[1]
@@ -374,7 +385,8 @@ class AddMonths(Expression):
     def eval_tpu(self, ctx):
         c = self.children[0].eval_tpu(ctx)
         n = self.children[1].eval_tpu(ctx)
-        days = c.data.astype(jnp.int64)
+        days = _days_of(c.data.astype(jnp.int64),
+                        isinstance(c.dtype, T.TimestampType))
         y, m, d = _civil_from_days(days)
         tot = y * 12 + (m - 1) + n.data.astype(jnp.int64)
         ny = jnp.floor_divide(tot, 12)
@@ -391,9 +403,10 @@ class AddMonths(Expression):
         n = self.children[1].eval_cpu(cols, ansi)
         out = np.zeros(len(c.values), np.int32)
         valid = c.valid & n.valid
+        cdays = _days_of_np(c)
         for i in range(len(out)):
             if valid[i]:
-                d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(c.values[i]))
+                d = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(cdays[i]))
                 tot = d.year * 12 + d.month - 1 + int(n.values[i])
                 ny, nm = tot // 12, tot % 12 + 1
                 nd = min(d.day, calendar.monthrange(ny, nm)[1])
@@ -425,7 +438,8 @@ class TruncDate(Expression):
 
     def eval_tpu(self, ctx):
         c = self.children[0].eval_tpu(ctx)
-        days = c.data.astype(jnp.int64)
+        days = _days_of(c.data.astype(jnp.int64),
+                        isinstance(c.dtype, T.TimestampType))
         kind = self._FMTS[self.fmt]
         y, m, d = _civil_from_days(days)
         if kind == "y":
@@ -446,7 +460,7 @@ class TruncDate(Expression):
         valid = c.valid.copy()
         kind = self._FMTS.get(self.fmt)
         epoch = datetime.date(1970, 1, 1)
-        for i, v in enumerate(c.values):
+        for i, v in enumerate(_days_of_np(c)):
             if not c.valid[i]:
                 continue
             if kind is None:
@@ -479,14 +493,21 @@ class UnixTimestampFromTs(Expression):
 
     def eval_tpu(self, ctx):
         c = self.children[0].eval_tpu(ctx)
-        return ColumnVector(T.INT64,
-                            jnp.floor_divide(c.data.astype(jnp.int64), 1_000_000),
-                            _valid_of(c, ctx))
+        v = c.data.astype(jnp.int64)
+        if isinstance(c.dtype, T.DateType):
+            out = v * 86_400
+        else:
+            out = jnp.floor_divide(v, 1_000_000)
+        return ColumnVector(T.INT64, out, _valid_of(c, ctx))
 
     def eval_cpu(self, cols, ansi=False):
         c = self.children[0].eval_cpu(cols, ansi)
-        return CpuCol(T.INT64, np.floor_divide(c.values.astype(np.int64), 1_000_000),
-                      c.valid)
+        v = c.values.astype(np.int64)
+        if isinstance(c.dtype, T.DateType):
+            out = v * 86_400
+        else:
+            out = np.floor_divide(v, 1_000_000)
+        return CpuCol(T.INT64, out, c.valid)
 
 
 class TimestampSeconds(Expression):

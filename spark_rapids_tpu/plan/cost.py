@@ -1,0 +1,87 @@
+"""Cost-based optimizer: revert TPU subtrees not worth the transfer.
+
+Reference parity: CostBasedOptimizer.scala (:54 — optional, off by
+default; CpuCostModel :284 / GpuCostModel :334 estimate per-operator cost
+and revert subtrees where the accelerated plan plus its transfer overhead
+loses to staying on CPU). Here the dominant term is the host->device
+boundary: a tiny scan feeding one cheap operator is faster on the CPU
+backend than paying upload + dispatch round trips.
+
+Enabled by spark.rapids.sql.optimizer.enabled. The model is deliberately
+coarse (row estimates x per-op scores, like the reference's
+operatorsScore.csv); it only ever REVERTS, never forces, so correctness
+is unaffected.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.plan import nodes as P
+
+#: relative cost to evaluate one row on each side (the operatorsScore.csv
+#: analog); transfer_cost models upload + fixed dispatch round trips
+OP_SCORES = {
+    "Project": (1.0, 0.02),   # (cpu_per_row, tpu_per_row)
+    "Filter": (1.0, 0.02),
+    "Aggregate": (4.0, 0.05),
+    "Join": (6.0, 0.1),
+    "Sort": (5.0, 1.0),
+    "WindowNode": (6.0, 0.2),
+}
+TRANSFER_PER_ROW = 0.5
+FIXED_DISPATCH = 50_000.0  # ~round-trip latency expressed in row-costs
+
+
+def _plan_costs(plan: P.PlanNode, inherited_rows: int) -> tuple:
+    """Returns (cpu_cost, device_cost) where device_cost covers compute +
+    per-operator dispatch only.
+    Transfer cost is the caller's concern (added once at the boundary).
+    Nodes without statistics inherit the nearest ancestor's estimate so one
+    stat-less child cannot skew the decision."""
+    rows = plan.estimated_rows()
+    rows = inherited_rows if rows is None else rows
+    name = type(plan).__name__
+    cpu_score, tpu_score = OP_SCORES.get(name, (1.0, 0.05))
+    cpu = rows * cpu_score
+    tpu = rows * tpu_score + FIXED_DISPATCH
+    for c in plan.children:
+        ccpu, ctpu = _plan_costs(c, rows)
+        cpu += ccpu
+        tpu += ctpu
+    return cpu, tpu
+
+
+def apply_cost_optimizer(meta, conf) -> None:
+    """Walk the tagged meta tree; where the whole subtree's TPU cost
+    (including the input transfer) exceeds the CPU cost, add a reason so
+    conversion falls back (reference getOptimizations / revert pass)."""
+    if not conf.get(C.OPTIMIZER_ENABLED):
+        return
+    _visit(meta)
+
+
+def _visit(meta) -> None:
+    if meta.can_run_on_tpu:
+        rows = meta.plan.estimated_rows()
+        if rows is not None:
+            cpu, tpu = _plan_costs(meta.plan, rows)
+            transfer = rows * TRANSFER_PER_ROW
+            if tpu + transfer > cpu:
+                reason = (
+                    f"cost model: est. TPU cost {tpu + transfer:.0f} > "
+                    f"CPU cost {cpu:.0f} for ~{rows} rows "
+                    f"(spark.rapids.sql.optimizer.enabled)")
+                _revert_all(meta, reason)
+                return
+    for c in meta.children:
+        _visit(c)
+
+
+def _revert_all(meta, reason: str) -> None:
+    """Mark the WHOLE subtree: a reverted root over device children would
+    still upload/download every batch, which is exactly the transfer the
+    reversion exists to avoid."""
+    meta.reasons.append(reason)
+    for c in meta.children:
+        _revert_all(c, reason)

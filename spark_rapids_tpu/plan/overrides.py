@@ -528,7 +528,13 @@ def convert_plan(plan: P.PlanNode, conf):
     """Returns (root_exec, meta). In explainOnly mode no device is required
     by conversion since nothing executes until iteration."""
     meta = wrap_and_tag(plan, conf)
+    from spark_rapids_tpu.plan.cost import apply_cost_optimizer
+    apply_cost_optimizer(meta, conf)
     exec_root = meta.convert()
+    lore_dir = conf.get(C.LORE_DUMP_DIR)
+    if lore_dir:
+        from spark_rapids_tpu.runtime.lore import LoreDumper
+        LoreDumper(lore_dir).install(exec_root)
     if conf.get(C.TEST_MODE):
         allowed = {s.strip() for s in
                    str(conf.get(C.ALLOW_NON_TPU) or "").split(",") if s.strip()}
@@ -547,4 +553,6 @@ def _assert_on_tpu(meta: SparkPlanMeta, allowed: set) -> None:
 
 def explain_plan(plan: P.PlanNode, conf, all_ops: bool = False) -> str:
     meta = wrap_and_tag(plan, conf)
+    from spark_rapids_tpu.plan.cost import apply_cost_optimizer
+    apply_cost_optimizer(meta, conf)  # explain must show cost reversions
     return meta.explain(all_ops=all_ops)

@@ -139,6 +139,15 @@ class TpuSession:
         return exec_root, meta
 
     def collect(self, plan: P.PlanNode) -> pa.Table:
+        prof_dir = self.conf.get(C.PROFILE_DIR)
+        if prof_dir:
+            # XProf trace per action (reference ProfilerOnExecutor / NVTX)
+            import jax
+            with jax.profiler.trace(prof_dir):
+                return self._collect_inner(plan)
+        return self._collect_inner(plan)
+
+    def _collect_inner(self, plan: P.PlanNode) -> pa.Table:
         exec_root, meta = self.prepare_execution(plan)
         explain_mode = self.conf.get(C.SQL_EXPLAIN).upper()
         if explain_mode in ("NOT_ON_TPU", "ALL"):
