@@ -1196,10 +1196,19 @@ class CollectExchangeExec(ExchangeExec):
 
 
 class ShuffleExchangeExec(ExchangeExec):
-    """Hash-partitioned exchange: murmur3(keys) pmod n on device, then slice
-    each batch into per-target sub-batches (reference
-    GpuShuffleExchangeExecBase.prepareBatchShuffleDependency +
-    GpuHashPartitioningBase)."""
+    """Hash-partitioned exchange. Two modes (spark.rapids.shuffle.mode):
+
+    MULTITHREADED (default, any device count): murmur3(keys) pmod n on
+    device, then zero-copy mask slicing into per-target sub-batches
+    (reference GpuShuffleExchangeExecBase + GpuHashPartitioningBase).
+
+    ICI (requires >= n_out jax devices): one partition shard per device;
+    the ENTIRE exchange is a single shard_map-ped XLA program whose
+    lax.all_to_all moves rows over the interconnect — the engine-level
+    realization of the reference's UCX transport replacement (SURVEY.md
+    §2.7 "TPU-native equivalent"). Falls back to MULTITHREADED when the
+    device count or column layout doesn't fit (flat strings / differing
+    vocabs can't ride a fixed-width collective)."""
 
     def __init__(self, plan, children, conf, keys: List[Expression], n_out: int):
         super().__init__(plan, children, conf)
@@ -1211,6 +1220,155 @@ class ShuffleExchangeExec(ExchangeExec):
         return self.n_out
 
     def _repartition(self, child_results):
+        if self.conf.get(C.SHUFFLE_MODE).upper() == "ICI":
+            with self.metrics.metric(M.PARTITION_TIME).ns():
+                out = self._repartition_ici(child_results)
+            if out is not None:
+                return out
+        return self._repartition_masked(child_results)
+
+    def _ici_eligible(self, child_results):
+        import jax as _jax
+        # the shard math assumes exactly one cap-sized shard per device:
+        # source partition count must equal the output count
+        if len(child_results) != self.n_out or self.n_out < 2:
+            return False
+        if len(_jax.devices()) < self.n_out:
+            return False
+        first_batches = [part[0] for part in child_results if part]
+        for part in child_results:
+            for b in part:
+                for ci, c in enumerate(b.columns):
+                    if c.is_string and not c.is_dict:
+                        return False  # variable-length payloads
+                    if c.is_dict and first_batches:
+                        # vocab identity checked BEFORE any compaction work
+                        f = first_batches[0].columns[ci]
+                        if not (K._same_array(c.data["dict_offsets"],
+                                              f.data["dict_offsets"])
+                                and K._same_array(c.data["dict_bytes"],
+                                                  f.data["dict_bytes"])):
+                            return False
+        return True
+
+    def _repartition_ici(self, child_results):
+        """One shard per device, rows moved by lax.all_to_all inside a
+        single shard_map program (parallel/exchange.py)."""
+        if not self._ici_eligible(child_results):
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+        from spark_rapids_tpu.parallel import exchange as X
+        from spark_rapids_tpu.parallel.mesh import make_mesh
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        import jax as _jax
+
+        n = self.n_out
+        # one compacted batch per source partition, padded to one capacity
+        batches = []
+        for part in child_results:
+            b = K.compact_batch(K.concat_batches(part)) if part else None
+            batches.append(b)
+        live_parts = [b for b in batches if b is not None]
+        if not live_parts:
+            return [[] for _ in range(n)]
+        schema_cols = live_parts[0].columns
+        cap = max(round_capacity(max(int(b.num_rows), 1)) for b in live_parts)
+        mesh = make_mesh(n, axis_names=("part",))
+
+        # build global [n*cap] planes sharded over the mesh
+        def pad_plane(arr, fill, dtype):
+            out = jnp.full(cap, fill, dtype)
+            return out.at[: arr.shape[0]].set(arr[:cap].astype(dtype))
+
+        planes = {}
+        per_col_meta = []
+        for ci, c in enumerate(schema_cols):
+            key = f"c{ci}"
+            if c.is_dict:
+                per_col_meta.append(("dict", c.dtype, c.data["dict_offsets"],
+                                     c.data["dict_bytes"], c.dict_unique))
+                shards = [pad_plane(b.columns[ci].data["codes"], 0, jnp.int32)
+                          if b is not None else jnp.zeros(cap, jnp.int32)
+                          for b in batches]
+            else:
+                dt = c.data.dtype
+                per_col_meta.append(("fixed", c.dtype, None, None, True))
+                shards = [pad_plane(b.columns[ci].data, 0, dt)
+                          if b is not None else jnp.zeros(cap, dt)
+                          for b in batches]
+            planes[key] = jnp.concatenate(shards)
+            vshards = []
+            for b in batches:
+                if b is None:
+                    vshards.append(jnp.zeros(cap, jnp.bool_))
+                else:
+                    col = b.columns[ci]
+                    v = col.validity if col.validity is not None else \
+                        (jnp.arange(col.capacity) < traced_rows(b.num_rows))
+                    vshards.append(pad_plane(v, False, jnp.bool_))
+            planes[key + "_v"] = jnp.concatenate(vshards)
+        live = jnp.concatenate([
+            pad_plane(b.live_mask(), False, jnp.bool_) if b is not None
+            else jnp.zeros(cap, jnp.bool_) for b in batches])
+
+        # target partition ids from the key hash, computed globally
+        tgt_parts = []
+        for b in batches:
+            if b is None:
+                tgt_parts.append(jnp.zeros(cap, jnp.int32))
+                continue
+            ectx = EvalCtx(b.columns, traced_rows(b.num_rows), b.capacity,
+                           False, live=b.live_mask())
+            key_cols = [e.eval_tpu(ectx) for e in self.keys]
+            h = K.spark_murmur3_batch(key_cols, b.num_rows, live=b.live_mask())
+            tgt_parts.append(pad_plane(_pmod(h, n), 0, jnp.int32))
+        target = jnp.concatenate(tgt_parts)
+
+        spec = PS("part")
+        sh = NamedSharding(mesh, spec)
+        planes = {k: _jax.device_put(v, sh) for k, v in planes.items()}
+        live = _jax.device_put(live, sh)
+        target = _jax.device_put(target, sh)
+
+        def shard_fn(planes, live, target):
+            return X.all_to_all_exchange(planes, live, target, ("part",))
+
+        fn = _jax.jit(shard_map(shard_fn, mesh=mesh,
+                                in_specs=(spec, spec, spec),
+                                out_specs=({k: spec for k in planes}, spec)))
+        out_planes, out_live = fn(planes, live, target)
+
+        # slice the global result back into per-partition, PER-SENDER
+        # batches (consumers like the aggregate merge rely on "one batch =
+        # rows from one upstream partial" for their unique-key reasoning)
+        out: List[List[ColumnarBatch]] = []
+        shard_rows = n * cap  # each device receives up to n*cap slots
+        for p in range(n):
+            subs = []
+            for src in range(n):
+                base = p * shard_rows + src * cap
+                sl = slice(base, base + cap)
+                cols = []
+                for ci, (kind, dtype, doff, dby, uniq) in enumerate(per_col_meta):
+                    data = out_planes[f"c{ci}"][sl]
+                    valid = out_planes[f"c{ci}_v"][sl]
+                    if kind == "dict":
+                        cols.append(ColumnVector(
+                            dtype, {"codes": data, "dict_offsets": doff,
+                                    "dict_bytes": dby}, valid,
+                            dict_unique=uniq))
+                    else:
+                        cols.append(ColumnVector(dtype, data, valid))
+                mask = out_live[sl]
+                subs.append(ColumnarBatch(
+                    cols, LazyRowCount(jnp.sum(mask.astype(jnp.int32))), mask))
+            out.append(subs)
+        return out
+
+    def _repartition_masked(self, child_results):
         part_t = self.metrics.metric(M.PARTITION_TIME)
         keys, n_out = self.keys, self.n_out
 

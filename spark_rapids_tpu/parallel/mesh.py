@@ -38,5 +38,10 @@ def make_mesh(n_devices: Optional[int] = None, dp: int = 1,
     n = len(devs)
     if n % dp != 0:
         raise ValueError(f"dp={dp} does not divide device count {n}")
-    arr = np.asarray(devs).reshape(dp, n // dp)
+    if len(axis_names) == 1:
+        if dp != 1:
+            raise ValueError("dp > 1 needs a two-axis mesh (dp, part)")
+        arr = np.asarray(devs)
+    else:
+        arr = np.asarray(devs).reshape(dp, n // dp)
     return Mesh(arr, axis_names=axis_names)

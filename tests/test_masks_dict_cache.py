@@ -192,3 +192,50 @@ def test_mesh_distributed_groupby():
     np.testing.assert_allclose(
         float(jnp.sum(jnp.where(out["groups"], out["sum_v"], 0.0))),
         v[mask].sum(), rtol=1e-9)
+
+
+def test_ici_shuffle_mode_groupby():
+    # SHUFFLE_MODE=ICI: the exchange runs as lax.all_to_all over the
+    # 8-virtual-device mesh inside one shard_map program
+    s = TpuSession({"spark.rapids.shuffle.mode": "ICI"})
+    rng = np.random.default_rng(8)
+    t = pa.table({"k": pa.array(rng.integers(0, 13, 200).astype(np.int64)),
+                  "v": pa.array(rng.uniform(0, 10, 200))})
+    got = (s.create_dataframe(t, num_partitions=4).group_by("k")
+           .agg(F.sum(col("v"))).collect().to_pylist())
+    expect = {}
+    for k, v in zip(t["k"].to_pylist(), t["v"].to_pylist()):
+        expect[k] = expect.get(k, 0.0) + v
+    gd = {r["k"]: r["sum(v)"] for r in got}
+    assert set(gd) == set(expect)
+    for k in expect:
+        assert abs(gd[k] - expect[k]) < 1e-9
+
+
+def test_ici_shuffle_falls_back_for_flat_strings():
+    # high-cardinality (flat) strings can't ride the fixed-width
+    # collective; the exchange silently uses the masked path instead
+    s = TpuSession({"spark.rapids.shuffle.mode": "ICI"})
+    vals = [f"id_{i}" for i in range(120)]  # unique -> flat layout
+    t = pa.table({"k": vals, "v": list(range(120))})
+    got = (s.create_dataframe(t, num_partitions=4).group_by("k")
+           .agg(F.sum(col("v"))).count())
+    assert got == 120
+
+
+def test_ici_shuffle_mismatched_partition_counts():
+    # join with unequal source partition counts: the ICI shard math needs
+    # sources == n_out, so this must take the fallback path, not drop rows
+    s = TpuSession({"spark.rapids.shuffle.mode": "ICI",
+                    "spark.rapids.sql.join.broadcastRowThreshold": 1})
+    rng = np.random.default_rng(3)
+    left = pa.table({"k": rng.integers(0, 8, 100).astype(np.int64),
+                     "lv": np.arange(100, dtype=np.int64)})
+    right = pa.table({"k": rng.integers(0, 8, 40).astype(np.int64),
+                      "rv": np.arange(40, dtype=np.int64)})
+    got = (s.create_dataframe(left, num_partitions=4)
+           .join(s.create_dataframe(right, num_partitions=2), on="k").count())
+    s2 = TpuSession()
+    expect = (s2.create_dataframe(left).join(
+        s2.create_dataframe(right), on="k").count())
+    assert got == expect
