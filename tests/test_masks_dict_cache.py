@@ -239,3 +239,17 @@ def test_ici_shuffle_mismatched_partition_counts():
     expect = (s2.create_dataframe(left).join(
         s2.create_dataframe(right), on="k").count())
     assert got == expect
+
+
+def test_to_device_batches_ml_handoff(session):
+    # ColumnarRdd analog: device arrays usable directly in jax code
+    import jax.numpy as jnp
+    df = session.create_dataframe(_table(32)).filter(col("n") > lit(10))
+    parts = df.to_device_batches()
+    total = sum(int(b.num_rows) for part in parts for b in part)
+    assert total == df.count()
+    b = parts[0][0]
+    n_col = [c for c, f in zip(b.columns, df.plan.schema.fields)
+             if f.name == "n"][0]
+    assert float(jnp.sum(jnp.where(
+        n_col.validity_or_default(b.num_rows), n_col.data, 0))) > 0
