@@ -136,7 +136,27 @@ class TpuSession:
         get_spill_framework(self.conf)  # sync budgets to this session
         exec_root, meta = convert_plan(plan, self.conf)
         self._last_meta = meta
+        self._last_exec = exec_root
         return exec_root, meta
+
+    def last_metrics(self):
+        """Per-exec metrics of the most recent action (the SQL-UI metrics
+        surface; reference GpuMetric / GpuTaskMetrics §5.5). Returns
+        {exec_name#i: {metric: value}} in plan order."""
+        out = {}
+
+        def walk(node, idx=[0]):
+            snap = node.metrics.snapshot()
+            key = f"{type(node).__name__}#{idx[0]}"
+            idx[0] += 1
+            if snap:
+                out[key] = snap
+            for c in node.children:
+                walk(c, idx)
+
+        if getattr(self, "_last_exec", None) is not None:
+            walk(self._last_exec)
+        return out
 
     def collect(self, plan: P.PlanNode) -> pa.Table:
         prof_dir = self.conf.get(C.PROFILE_DIR)

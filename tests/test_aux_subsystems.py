@@ -72,3 +72,13 @@ def test_profiler_trace_written(tmp_path):
     for root, dirs, files in os.walk(d):
         found.extend(files)
     assert found, "no profiler artifacts written"
+
+
+def test_last_metrics_surface():
+    s = TpuSession()
+    df = s.create_dataframe(_t(30))
+    df.group_by("k").agg(F.sum(col("v"))).collect()
+    m = s.last_metrics()
+    assert any(k.startswith("HashAggregateExec") for k in m)
+    scan = next(v for k, v in m.items() if k.startswith("InMemoryScanExec"))
+    assert scan.get("numOutputRows") == 30
