@@ -313,20 +313,23 @@ class ProjectExec(TpuExec):
             return
 
         def build():
-            def fn(batch):
+            def fn(batch, pid, row_base):
                 ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
-                               batch.capacity, ansi, live=batch.live_mask())
+                               batch.capacity, ansi, live=batch.live_mask(),
+                               partition_id=pid, row_base=row_base)
                 cols = [e.eval_tpu(ectx) for e in exprs]
+                live_count = jnp.sum(batch.live_mask().astype(jnp.int64))
                 return (ColumnarBatch(cols, batch.num_rows, batch.row_mask),
-                        dict(ectx.errors))
+                        dict(ectx.errors), row_base + live_count)
             return fn
 
         key = ("project", tuple(e.fingerprint() for e in exprs), ansi)
         fn = fuse.fused(key, build)
+        row_base = jnp.int64(0)
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
             with op_t.ns():
-                out, errs = fn(batch)
+                out, errs, row_base = fn(batch, jnp.int32(pidx), row_base)
             compiled.raise_errors(errs)
             yield out
 

@@ -64,12 +64,16 @@ class EvalCtx:
     """
 
     def __init__(self, columns: Sequence[ColumnVector], num_rows, capacity: int,
-                 ansi: bool = False, live=None):
+                 ansi: bool = False, live=None, partition_id=0, row_base=0):
         self.columns = list(columns)
         self.num_rows = num_rows
         self.capacity = capacity
         self.ansi = ansi
         self.live = live  # selection mask; dead rows never raise ANSI errors
+        #: traced scalars for partition-aware expressions
+        #: (spark_partition_id, monotonically_increasing_id)
+        self.partition_id = partition_id
+        self.row_base = row_base
         self.errors: List[Tuple[str, jax.Array]] = []
 
     @property
@@ -308,6 +312,55 @@ class Literal(Expression):
             return CpuCol(self.dtype, np.array([self.value] * n, object),
                           np.ones(n, np.bool_))
         return CpuCol(self.dtype, np.full(n, self._scalar(), self.dtype.np_dtype),
+                      np.ones(n, np.bool_))
+
+
+class SparkPartitionID(Expression):
+    """spark_partition_id() (reference GpuSparkPartitionID)."""
+
+    def __init__(self):
+        self.children = []
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return self
+
+    def eval_tpu(self, ctx):
+        v = jnp.full(ctx.capacity, 0, jnp.int32) + jnp.asarray(
+            ctx.partition_id, jnp.int32)
+        return ColumnVector(T.INT32, v, None)
+
+    def eval_cpu(self, cols, ansi=False):
+        n = len(cols[0].values) if cols else 0
+        return CpuCol(T.INT32, np.zeros(n, np.int32), np.ones(n, np.bool_))
+
+
+class MonotonicallyIncreasingID(Expression):
+    """monotonically_increasing_id(): (partition_id << 33) + row index
+    within the partition (reference GpuMonotonicallyIncreasingID; same
+    layout as Spark's)."""
+
+    def __init__(self):
+        self.children = []
+
+    def data_type(self):
+        return T.INT64
+
+    def with_children(self, children):
+        return self
+
+    def eval_tpu(self, ctx):
+        base = (jnp.asarray(ctx.partition_id, jnp.int64) << jnp.int64(33)) \
+            + jnp.asarray(ctx.row_base, jnp.int64)
+        # ids count LIVE rows (dead rows get garbage, masked downstream)
+        idx = jnp.cumsum(ctx.row_mask.astype(jnp.int64)) - 1
+        return ColumnVector(T.INT64, base + idx, None)
+
+    def eval_cpu(self, cols, ansi=False):
+        n = len(cols[0].values) if cols else 0
+        return CpuCol(T.INT64, np.arange(n, dtype=np.int64),
                       np.ones(n, np.bool_))
 
 

@@ -58,6 +58,9 @@ expr_rule(E.BoundRef, Sigs.COMMON, Sigs.COMMON, "column reference")
 expr_rule(E.Literal, Sigs.COMMON, Sigs.COMMON, "literal value")
 expr_rule(E.Alias, Sigs.COMMON, Sigs.COMMON, "named expression")
 expr_rule(E.NullOf, Sigs.COMMON, Sigs.COMMON, "typed null")
+expr_rule(E.SparkPartitionID, Sigs.COMMON, Sigs.COMMON, "spark_partition_id()")
+expr_rule(E.MonotonicallyIncreasingID, Sigs.COMMON, Sigs.COMMON,
+          "monotonically_increasing_id()")
 expr_rule(E.Add, _NUM, _NUM, "addition")
 expr_rule(E.Subtract, _NUM, _NUM, "subtraction")
 expr_rule(E.Multiply, _NUM, _NUM, "multiplication")

@@ -184,6 +184,14 @@ def hash(*cs):  # noqa: A001
     return MA.Murmur3Hash(*[_e(c) for c in cs])
 
 
+def spark_partition_id():
+    return E.SparkPartitionID()
+
+
+def monotonically_increasing_id():
+    return E.MonotonicallyIncreasingID()
+
+
 def nvl(c, default):
     return coalesce(c, default)
 

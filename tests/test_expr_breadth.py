@@ -117,3 +117,25 @@ def test_nvl_nullif(session):
             F.nvl(col("a"), lit(0)).alias("n"),
             F.nullif(col("a"), col("b")).alias("ni")),
         session)
+
+
+def test_partition_id_and_monotonic_id(session):
+    t = pa.table({"v": list(range(50))})
+    df = session.create_dataframe(t, num_partitions=3).select(
+        col("v"), F.spark_partition_id().alias("pid"),
+        F.monotonically_increasing_id().alias("mid"))
+    rows = df.collect().to_pylist()
+    assert {r["pid"] for r in rows} == {0, 1, 2}
+    # ids unique and ordered within each partition
+    assert len({r["mid"] for r in rows}) == 50
+    by_pid = {}
+    for r in rows:
+        by_pid.setdefault(r["pid"], []).append(r["mid"])
+    for pid, ids in by_pid.items():
+        assert ids == sorted(ids)
+        assert ids[0] == pid << 33
+    # survives a preceding filter (masked batches count live rows)
+    df2 = session.create_dataframe(t).filter(col("v") >= lit(10)).select(
+        F.monotonically_increasing_id().alias("mid"))
+    ids = df2.to_pydict()["mid"]
+    assert ids == list(range(40))
