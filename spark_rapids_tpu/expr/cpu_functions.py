@@ -1,0 +1,243 @@
+"""CPU-only expressions: functions without device kernels yet.
+
+Reference parity: the reference's per-operator fallback keeps queries
+running when an expression has no GPU implementation (RapidsMeta tagging
+-> CPU operator). These expressions declare supported_on_tpu() = False so
+the enclosing exec falls back to the CPU interpreter; each is a
+row-function over python values. Device implementations graduate out of
+this module as kernels land (the reference moved ops from CPU to cuDF the
+same way, version by version).
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr.core import CpuCol, Expression
+
+
+class CpuRowFunction(Expression):
+    """An expression evaluated row-wise on host (CPU backend only)."""
+
+    #: subclasses set these
+    name = "cpu_fn"
+    result = T.STRING
+
+    def __init__(self, *children, params=()):
+        self.children = list(children)
+        self.params = tuple(params)
+
+    def data_type(self):
+        return self.result
+
+    def _params(self):
+        return repr(self.params)
+
+    def with_children(self, children):
+        return type(self)(*children, params=self.params)
+
+    def supported_on_tpu(self):
+        return False
+
+    def eval_tpu(self, ctx):
+        raise NotImplementedError(f"{self.name} has no device kernel yet")
+
+    def row_fn(self, *vals):
+        raise NotImplementedError
+
+    def eval_cpu(self, cols, ansi=False):
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values)
+        valid = np.ones(n, np.bool_)
+        for c in ins:
+            valid = valid & c.valid
+        out: List = []
+        out_valid = valid.copy()
+        for i in range(n):
+            if not valid[i]:
+                out.append(None)
+                continue
+            r = self.row_fn(*(c.values[i] for c in ins))
+            if r is None:
+                out_valid[i] = False
+            out.append(r)
+        if isinstance(self.result, T.StringType):
+            vals = np.array(out, object)
+        else:
+            vals = np.array([0 if v is None else v for v in out]
+                            ).astype(self.result.np_dtype)
+        return CpuCol(self.result, vals, out_valid)
+
+
+class Reverse(CpuRowFunction):
+    name = "reverse"
+    result = T.STRING
+
+    def row_fn(self, s):
+        return s[::-1] if isinstance(s, str) else s
+
+
+class ConcatWs(CpuRowFunction):
+    """concat_ws(sep, ...): null inputs are SKIPPED (unlike concat)."""
+
+    name = "concat_ws"
+    result = T.STRING
+
+    def eval_cpu(self, cols, ansi=False):
+        sep = self.params[0]
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values)
+        out = []
+        for i in range(n):
+            parts = [str(c.values[i]) for c in ins
+                     if c.valid[i] and c.values[i] is not None]
+            out.append(sep.join(parts))
+        return CpuCol(T.STRING, np.array(out, object), np.ones(n, np.bool_))
+
+
+class LPad(CpuRowFunction):
+    name = "lpad"
+    result = T.STRING
+
+    def row_fn(self, s):
+        ln, pad = self.params
+        if not isinstance(s, str):
+            return s
+        if len(s) >= ln:
+            return s[:ln]
+        fill = (pad * ln)[: ln - len(s)]
+        return fill + s
+
+
+class RPad(LPad):
+    name = "rpad"
+
+    def row_fn(self, s):
+        ln, pad = self.params
+        if not isinstance(s, str):
+            return s
+        if len(s) >= ln:
+            return s[:ln]
+        return s + (pad * ln)[: ln - len(s)]
+
+
+class Translate(CpuRowFunction):
+    name = "translate"
+    result = T.STRING
+
+    def row_fn(self, s):
+        src, dst = self.params
+        table = {ord(a): (dst[i] if i < len(dst) else None)
+                 for i, a in enumerate(src)}
+        return s.translate(table) if isinstance(s, str) else s
+
+
+class SubstringIndex(CpuRowFunction):
+    """substring_index(str, delim, count) (reference
+    GpuSubstringIndexUtils JNI)."""
+
+    name = "substring_index"
+    result = T.STRING
+
+    def row_fn(self, s):
+        delim, count = self.params
+        if not isinstance(s, str) or not delim:
+            return ""
+        parts = s.split(delim)
+        if count > 0:
+            return delim.join(parts[:count])
+        if count < 0:
+            return delim.join(parts[count:])
+        return ""
+
+
+class Md5(CpuRowFunction):
+    name = "md5"
+    result = T.STRING
+
+    def row_fn(self, s):
+        import hashlib
+        b = s.encode() if isinstance(s, str) else bytes(s)
+        return hashlib.md5(b).hexdigest()
+
+
+class Sha2(CpuRowFunction):
+    name = "sha2"
+    result = T.STRING
+
+    def row_fn(self, s):
+        import hashlib
+        bits = self.params[0] or 256
+        b = s.encode() if isinstance(s, str) else bytes(s)
+        return {224: hashlib.sha224, 256: hashlib.sha256,
+                384: hashlib.sha384, 512: hashlib.sha512}[bits](b).hexdigest()
+
+
+class DateFormat(CpuRowFunction):
+    """date_format(date/ts, java-pattern-subset)."""
+
+    name = "date_format"
+    result = T.STRING
+
+    _JAVA_TO_PY = [("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+                   ("mm", "%M"), ("ss", "%S"), ("yy", "%y")]
+
+    def row_fn(self, v):
+        fmt = self.params[0]
+        src = self.children[0].data_type()
+        if isinstance(src, T.TimestampType):
+            d = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(v))
+        else:
+            d = _dt.datetime(1970, 1, 1) + _dt.timedelta(days=int(v))
+        py = fmt
+        for j, p in self._JAVA_TO_PY:
+            py = py.replace(j, p)
+        return d.strftime(py)
+
+
+class ToDateFmt(CpuRowFunction):
+    """to_date(str, fmt): parse failures yield null (non-ANSI Spark)."""
+
+    name = "to_date"
+    result = T.DATE
+
+    def row_fn(self, s):
+        fmt = self.params[0]
+        py = fmt
+        for j, p in DateFormat._JAVA_TO_PY:
+            py = py.replace(j, p)
+        try:
+            d = _dt.datetime.strptime(s, py).date()
+        except (ValueError, TypeError):
+            return None
+        return (d - _dt.date(1970, 1, 1)).days
+
+
+class FromUnixtime(CpuRowFunction):
+    name = "from_unixtime"
+    result = T.STRING
+
+    def row_fn(self, v):
+        fmt = self.params[0] if self.params else "yyyy-MM-dd HH:mm:ss"
+        py = fmt
+        for j, p in DateFormat._JAVA_TO_PY:
+            py = py.replace(j, p)
+        return (_dt.datetime(1970, 1, 1)
+                + _dt.timedelta(seconds=int(v))).strftime(py)
+
+
+class FormatNumber(CpuRowFunction):
+    name = "format_number"
+    result = T.STRING
+
+    def row_fn(self, v):
+        d = self.params[0]
+        return f"{float(v):,.{d}f}"
+
+
+ALL_CPU_FUNCTIONS = [Reverse, ConcatWs, LPad, RPad, Translate,
+                     SubstringIndex, Md5, Sha2, DateFormat, ToDateFmt,
+                     FromUnixtime, FormatNumber]

@@ -162,6 +162,15 @@ expr_rule(S.RegexpReplace, Sigs.COMMON, Sigs.COMMON,
           "regex replace (CPU: needs backtracking groups)",
           extra=lambda e: "capture-group regex runs on CPU")
 
+# CPU-only row functions: registered so tagging gives a clear reason and
+# the enclosing exec falls back (reference: ops without GPU impls)
+from spark_rapids_tpu.expr import cpu_functions as CF  # noqa: E402
+
+for _cls in CF.ALL_CPU_FUNCTIONS:
+    expr_rule(_cls, Sigs.COMMON, Sigs.COMMON,
+              f"{_cls.name} (CPU; no device kernel yet)",
+              extra=lambda e: f"{e.name} runs on CPU (no device kernel yet)")
+
 # math
 for _cls in (MA.Sqrt, MA.Exp, MA.Log, MA.Log10, MA.Log2, MA.Sin, MA.Cos,
              MA.Tan, MA.Asin, MA.Acos, MA.Atan, MA.Sinh, MA.Cosh, MA.Tanh,

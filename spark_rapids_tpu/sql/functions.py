@@ -192,6 +192,66 @@ def monotonically_increasing_id():
     return E.MonotonicallyIncreasingID()
 
 
+def reverse(c):
+    from spark_rapids_tpu.expr.cpu_functions import Reverse
+    return Reverse(_e(c))
+
+
+def concat_ws(sep, *cs):
+    from spark_rapids_tpu.expr.cpu_functions import ConcatWs
+    return ConcatWs(*[_e(c) for c in cs], params=(sep,))
+
+
+def lpad(c, ln, pad=" "):
+    from spark_rapids_tpu.expr.cpu_functions import LPad
+    return LPad(_e(c), params=(ln, pad))
+
+
+def rpad(c, ln, pad=" "):
+    from spark_rapids_tpu.expr.cpu_functions import RPad
+    return RPad(_e(c), params=(ln, pad))
+
+
+def translate(c, src, dst):
+    from spark_rapids_tpu.expr.cpu_functions import Translate
+    return Translate(_e(c), params=(src, dst))
+
+
+def substring_index(c, delim, count):
+    from spark_rapids_tpu.expr.cpu_functions import SubstringIndex
+    return SubstringIndex(_e(c), params=(delim, count))
+
+
+def md5(c):
+    from spark_rapids_tpu.expr.cpu_functions import Md5
+    return Md5(_e(c))
+
+
+def sha2(c, bits=256):
+    from spark_rapids_tpu.expr.cpu_functions import Sha2
+    return Sha2(_e(c), params=(bits,))
+
+
+def date_format(c, fmt):
+    from spark_rapids_tpu.expr.cpu_functions import DateFormat
+    return DateFormat(_e(c), params=(fmt,))
+
+
+def to_date(c, fmt="yyyy-MM-dd"):
+    from spark_rapids_tpu.expr.cpu_functions import ToDateFmt
+    return ToDateFmt(_e(c), params=(fmt,))
+
+
+def from_unixtime(c, fmt="yyyy-MM-dd HH:mm:ss"):
+    from spark_rapids_tpu.expr.cpu_functions import FromUnixtime
+    return FromUnixtime(_e(c), params=(fmt,))
+
+
+def format_number(c, d):
+    from spark_rapids_tpu.expr.cpu_functions import FormatNumber
+    return FormatNumber(_e(c), params=(d,))
+
+
 def nvl(c, default):
     return coalesce(c, default)
 

@@ -139,3 +139,39 @@ def test_partition_id_and_monotonic_id(session):
         F.monotonically_increasing_id().alias("mid"))
     ids = df2.to_pydict()["mid"]
     assert ids == list(range(40))
+
+
+def test_cpu_only_functions_fall_back_and_work(session):
+    import datetime as dtm
+    t = pa.table({
+        "s": ["hello", "a,b,c", None, ""],
+        "n": pa.array([1234567.891, 0.5, None, -3.25]),
+        "d": pa.array([dtm.date(2024, 3, 7)] * 4, pa.date32()),
+        "ds": ["2024-03-07", "bad", None, "1999-12-31"],
+        "u": pa.array([86400, 0, 3600, None], pa.int64()),
+    })
+    df = session.create_dataframe(t)
+    got = df.select(
+        F.reverse(col("s")).alias("rev"),
+        F.concat_ws("-", col("s"), col("ds")).alias("cw"),
+        F.lpad(col("s"), 8, "*").alias("lp"),
+        F.substring_index(col("s"), ",", 2).alias("si"),
+        F.md5(col("s")).alias("m"),
+        F.date_format(col("d"), "yyyy/MM/dd").alias("dfm"),
+        F.to_date(col("ds"), "yyyy-MM-dd").alias("td"),
+        F.from_unixtime(col("u")).alias("fu"),
+        F.format_number(col("n"), 2).alias("fn"),
+    ).to_pydict()
+    assert got["rev"] == ["olleh", "c,b,a", None, ""]
+    assert got["cw"][0] == "hello-2024-03-07"
+    assert got["cw"][2] == ""  # nulls skipped, not nulling
+    assert got["lp"][0] == "***hello"
+    assert got["si"][1] == "a,b"
+    assert got["m"][0] == __import__("hashlib").md5(b"hello").hexdigest()
+    assert got["dfm"][0] == "2024/03/07"
+    assert got["td"] == [dtm.date(2024, 3, 7), None, None, dtm.date(1999, 12, 31)]
+    assert got["fu"][0] == "1970-01-02 00:00:00"
+    assert got["fn"][0] == "1,234,567.89"
+    # the plan shows the fallback reason
+    exp = df.select(F.reverse(col("s"))).explain("all")
+    assert "runs on CPU" in exp
