@@ -312,18 +312,24 @@ class ProjectExec(TpuExec):
                                     batch.num_rows, batch.row_mask)
             return
 
+        from spark_rapids_tpu.plan.overrides import _contains_project_only
+        needs_part_ctx = any(_contains_project_only(e) for e in exprs)
+
         def build():
             def fn(batch, pid, row_base):
                 ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
                                batch.capacity, ansi, live=batch.live_mask(),
                                partition_id=pid, row_base=row_base)
                 cols = [e.eval_tpu(ectx) for e in exprs]
-                live_count = jnp.sum(batch.live_mask().astype(jnp.int64))
+                if needs_part_ctx:  # only pay the count when ids need it
+                    row_base = row_base + jnp.sum(
+                        batch.live_mask().astype(jnp.int64))
                 return (ColumnarBatch(cols, batch.num_rows, batch.row_mask),
-                        dict(ectx.errors), row_base + live_count)
+                        dict(ectx.errors), row_base)
             return fn
 
-        key = ("project", tuple(e.fingerprint() for e in exprs), ansi)
+        key = ("project", tuple(e.fingerprint() for e in exprs), ansi,
+               needs_part_ctx)
         fn = fuse.fused(key, build)
         row_base = jnp.int64(0)
         for batch in self.children[0].execute_partition(ctx, pidx):

@@ -11,6 +11,7 @@ same way, version by version).
 from __future__ import annotations
 
 import datetime as _dt
+import hashlib
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -87,12 +88,19 @@ class ConcatWs(CpuRowFunction):
     result = T.STRING
 
     def eval_cpu(self, cols, ansi=False):
+        from spark_rapids_tpu.expr.strings import cast_string_cpu
         sep = self.params[0]
-        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        ins = []
+        for c in self.children:
+            cc = c.eval_cpu(cols, ansi)
+            if not isinstance(cc.dtype, T.StringType):
+                # Spark-faithful rendering (true/false, float formatting)
+                cc = cast_string_cpu(cc, T.STRING, ansi)
+            ins.append(cc)
         n = len(ins[0].values)
         out = []
         for i in range(n):
-            parts = [str(c.values[i]) for c in ins
+            parts = [c.values[i] for c in ins
                      if c.valid[i] and c.values[i] is not None]
             out.append(sep.join(parts))
         return CpuCol(T.STRING, np.array(out, object), np.ones(n, np.bool_))
@@ -106,6 +114,8 @@ class LPad(CpuRowFunction):
         ln, pad = self.params
         if not isinstance(s, str):
             return s
+        if ln <= 0:
+            return ""  # Spark: non-positive length pads to empty
         if len(s) >= ln:
             return s[:ln]
         fill = (pad * ln)[: ln - len(s)]
@@ -119,6 +129,8 @@ class RPad(LPad):
         ln, pad = self.params
         if not isinstance(s, str):
             return s
+        if ln <= 0:
+            return ""
         if len(s) >= ln:
             return s[:ln]
         return s + (pad * ln)[: ln - len(s)]
@@ -129,10 +141,11 @@ class Translate(CpuRowFunction):
     result = T.STRING
 
     def row_fn(self, s):
-        src, dst = self.params
-        table = {ord(a): (dst[i] if i < len(dst) else None)
-                 for i, a in enumerate(src)}
-        return s.translate(table) if isinstance(s, str) else s
+        if not hasattr(self, "_table"):
+            src, dst = self.params
+            self._table = {ord(a): (dst[i] if i < len(dst) else None)
+                           for i, a in enumerate(src)}
+        return s.translate(self._table) if isinstance(s, str) else s
 
 
 class SubstringIndex(CpuRowFunction):
@@ -159,7 +172,6 @@ class Md5(CpuRowFunction):
     result = T.STRING
 
     def row_fn(self, s):
-        import hashlib
         b = s.encode() if isinstance(s, str) else bytes(s)
         return hashlib.md5(b).hexdigest()
 
@@ -168,12 +180,15 @@ class Sha2(CpuRowFunction):
     name = "sha2"
     result = T.STRING
 
+    _ALGOS = {0: hashlib.sha256, 224: hashlib.sha224, 256: hashlib.sha256,
+              384: hashlib.sha384, 512: hashlib.sha512}
+
     def row_fn(self, s):
-        import hashlib
-        bits = self.params[0] or 256
+        algo = self._ALGOS.get(self.params[0])
+        if algo is None:
+            return None  # Spark: NULL for unsupported bit lengths
         b = s.encode() if isinstance(s, str) else bytes(s)
-        return {224: hashlib.sha224, 256: hashlib.sha256,
-                384: hashlib.sha384, 512: hashlib.sha512}[bits](b).hexdigest()
+        return algo(b).hexdigest()
 
 
 class DateFormat(CpuRowFunction):
@@ -185,17 +200,21 @@ class DateFormat(CpuRowFunction):
     _JAVA_TO_PY = [("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
                    ("mm", "%M"), ("ss", "%S"), ("yy", "%y")]
 
+    def _py_fmt(self):
+        if not hasattr(self, "_py"):
+            py = self.params[0]
+            for j, p in self._JAVA_TO_PY:
+                py = py.replace(j, p)
+            self._py = py
+        return self._py
+
     def row_fn(self, v):
-        fmt = self.params[0]
         src = self.children[0].data_type()
         if isinstance(src, T.TimestampType):
             d = _dt.datetime(1970, 1, 1) + _dt.timedelta(microseconds=int(v))
         else:
             d = _dt.datetime(1970, 1, 1) + _dt.timedelta(days=int(v))
-        py = fmt
-        for j, p in self._JAVA_TO_PY:
-            py = py.replace(j, p)
-        return d.strftime(py)
+        return d.strftime(self._py_fmt())
 
 
 class ToDateFmt(CpuRowFunction):
@@ -205,12 +224,13 @@ class ToDateFmt(CpuRowFunction):
     result = T.DATE
 
     def row_fn(self, s):
-        fmt = self.params[0]
-        py = fmt
-        for j, p in DateFormat._JAVA_TO_PY:
-            py = py.replace(j, p)
+        if not hasattr(self, "_py"):
+            py = self.params[0]
+            for j, p in DateFormat._JAVA_TO_PY:
+                py = py.replace(j, p)
+            self._py = py
         try:
-            d = _dt.datetime.strptime(s, py).date()
+            d = _dt.datetime.strptime(s, self._py).date()
         except (ValueError, TypeError):
             return None
         return (d - _dt.date(1970, 1, 1)).days
@@ -221,12 +241,13 @@ class FromUnixtime(CpuRowFunction):
     result = T.STRING
 
     def row_fn(self, v):
-        fmt = self.params[0] if self.params else "yyyy-MM-dd HH:mm:ss"
-        py = fmt
-        for j, p in DateFormat._JAVA_TO_PY:
-            py = py.replace(j, p)
+        if not hasattr(self, "_py"):
+            py = self.params[0] if self.params else "yyyy-MM-dd HH:mm:ss"
+            for j, p in DateFormat._JAVA_TO_PY:
+                py = py.replace(j, p)
+            self._py = py
         return (_dt.datetime(1970, 1, 1)
-                + _dt.timedelta(seconds=int(v))).strftime(py)
+                + _dt.timedelta(seconds=int(v))).strftime(self._py)
 
 
 class FormatNumber(CpuRowFunction):

@@ -240,12 +240,28 @@ agg_rule(A.VariancePop, _NUM, "var_pop")
 # Expression tagging
 # ---------------------------------------------------------------------------
 
+#: expressions whose evaluation needs the partition context that only the
+#: projection kernel threads (reference ExprChecks contexts,
+#: RapidsMeta.scala:945-971 — project vs groupby vs window contexts)
+PROJECT_ONLY_EXPRS = (E.SparkPartitionID, E.MonotonicallyIncreasingID)
+
+
+def _contains_project_only(e: E.Expression) -> bool:
+    if isinstance(e, PROJECT_ONLY_EXPRS):
+        return True
+    return any(_contains_project_only(c) for c in e.children)
+
+
 def tag_expression(e: E.Expression, conf, reasons: List[str], where: str) -> None:
     cls = type(e)
     rule = EXPR_RULES.get(cls)
     if rule is None:
         reasons.append(f"{where}: expression {cls.__name__} is not supported on TPU")
         return
+    if where != "Project" and isinstance(e, PROJECT_ONLY_EXPRS):
+        reasons.append(
+            f"{where}: {rule.name} only evaluates in projection context "
+            f"(partition id / row base are threaded by ProjectExec)")
     key = f"spark.rapids.sql.expression.{rule.name}"
     if not conf.is_op_enabled(key):
         reasons.append(f"{where}: expression {rule.name} disabled by {key}")

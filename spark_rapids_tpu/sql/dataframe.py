@@ -113,20 +113,14 @@ class DataFrame:
 
     def to_device_batches(self):
         """Zero-copy ML handoff: execute the plan and return the raw
-        device-resident ColumnarBatches per partition (reference
-        ColumnarRdd / InternalColumnarRddConverter — the XGBoost-style
-        hand-off of device tables without a host round trip). The arrays
-        inside are jax Arrays usable directly in downstream jax/flax code.
-        """
-        from spark_rapids_tpu.runtime.task import TaskContext
+        device-resident ColumnarBatches (flat list, partition order;
+        reference ColumnarRdd / InternalColumnarRddConverter — the
+        XGBoost-style hand-off of device tables without a host round
+        trip). The arrays inside are jax Arrays usable directly in
+        downstream jax/flax code."""
+        from spark_rapids_tpu.ops.kernels import compact_batch
         exec_root, _ = self.session.prepare_execution(self.plan)
-        out = []
-        for p in range(exec_root.num_partitions):
-            with TaskContext(partition_id=p) as ctx:
-                from spark_rapids_tpu.ops.kernels import compact_batch
-                out.append([compact_batch(b)
-                            for b in exec_root.execute_partition(ctx, p)])
-        return out
+        return self.session.run_partitions(exec_root, compact_batch)
 
     @property
     def write(self):

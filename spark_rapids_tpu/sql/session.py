@@ -167,6 +167,26 @@ class TpuSession:
                 return self._collect_inner(plan)
         return self._collect_inner(plan)
 
+    def run_partitions(self, exec_root, per_batch):
+        """Execute every partition of an exec tree (parallel tasks, up to
+        16 concurrent — the Spark task-scheduler role) applying per_batch
+        to each output batch. Returns the flat result list in partition
+        order. Shared by collect, writes, and the ML handoff."""
+        nparts = exec_root.num_partitions
+
+        def run(p: int) -> list:
+            with TaskContext(partition_id=p) as ctx:
+                return [per_batch(b)
+                        for b in exec_root.execute_partition(ctx, p)]
+
+        if nparts == 1:
+            return run(0)
+        out = []
+        with ThreadPoolExecutor(max_workers=min(nparts, 16)) as pool:
+            for res in pool.map(run, range(nparts)):
+                out.extend(res)
+        return out
+
     def _collect_inner(self, plan: P.PlanNode) -> pa.Table:
         exec_root, meta = self.prepare_execution(plan)
         explain_mode = self.conf.get(C.SQL_EXPLAIN).upper()
@@ -176,21 +196,8 @@ class TpuSession:
                 import logging
                 logging.getLogger("spark_rapids_tpu").info("\n%s", text)
         names = plan.schema.names
-        nparts = exec_root.num_partitions
-
-        def run(p: int) -> List[pa.Table]:
-            with TaskContext(partition_id=p) as ctx:
-                return [to_arrow(b, names)
-                        for b in exec_root.execute_partition(ctx, p)]
-
-        if nparts == 1:
-            tables = run(0)
-        else:
-            tables = []
-            workers = min(nparts, 16)
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                for res in pool.map(run, range(nparts)):
-                    tables.extend(res)
+        tables = self.run_partitions(exec_root,
+                                     lambda b: to_arrow(b, names))
         if not tables:
             fields = [pa.field(f.name, T.to_arrow(f.dtype))
                       for f in plan.schema.fields]

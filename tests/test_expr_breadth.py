@@ -175,3 +175,14 @@ def test_cpu_only_functions_fall_back_and_work(session):
     # the plan shows the fallback reason
     exp = df.select(F.reverse(col("s"))).explain("all")
     assert "runs on CPU" in exp
+
+
+def test_partition_exprs_outside_project_fall_back(session):
+    # spark_partition_id in a FILTER lacks the projection's partition
+    # context -> the planner must not run it on device
+    from asserts import assert_fallback_collect
+    t = pa.table({"v": list(range(10))})
+    assert_fallback_collect(
+        lambda s: s.create_dataframe(t)
+        .filter(F.spark_partition_id() == lit(0)),
+        session, "Filter", ignore_order=True)

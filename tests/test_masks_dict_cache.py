@@ -245,10 +245,10 @@ def test_to_device_batches_ml_handoff(session):
     # ColumnarRdd analog: device arrays usable directly in jax code
     import jax.numpy as jnp
     df = session.create_dataframe(_table(32)).filter(col("n") > lit(10))
-    parts = df.to_device_batches()
-    total = sum(int(b.num_rows) for part in parts for b in part)
+    batches = df.to_device_batches()
+    total = sum(int(b.num_rows) for b in batches)
     assert total == df.count()
-    b = parts[0][0]
+    b = batches[0]
     n_col = [c for c, f in zip(b.columns, df.plan.schema.fields)
              if f.name == "n"][0]
     assert float(jnp.sum(jnp.where(
