@@ -171,6 +171,16 @@ for _cls in CF.ALL_CPU_FUNCTIONS:
               f"{_cls.name} (CPU; no device kernel yet)",
               extra=lambda e: f"{e.name} runs on CPU (no device kernel yet)")
 
+# UDFs (reference RapidsUDF SPI / row-based UDF bridge / udf-compiler)
+from spark_rapids_tpu.sql import udf as UDF  # noqa: E402
+
+expr_rule(UDF.PythonRowUDF, Sigs.COMMON, Sigs.COMMON,
+          "opaque python row UDF (CPU)",
+          extra=lambda e: f"python UDF {e.name!r} runs on CPU "
+                          f"(use jax_udf for device execution)")
+expr_rule(UDF.JaxColumnarUDF, Sigs.COMMON, Sigs.COMMON,
+          "columnar jax UDF (fuses into the device stage)")
+
 # math
 for _cls in (MA.Sqrt, MA.Exp, MA.Log, MA.Log10, MA.Log2, MA.Sin, MA.Cos,
              MA.Tan, MA.Asin, MA.Acos, MA.Atan, MA.Sinh, MA.Cosh, MA.Tanh,

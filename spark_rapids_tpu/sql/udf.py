@@ -1,0 +1,158 @@
+"""User-defined functions.
+
+Reference parity, three tiers mirroring SURVEY.md §2.8:
+
+- `udf(fn, return_type)` — row-wise Python UDF. Like Spark UDFs it is
+  opaque; it executes on the CPU interpreter via per-operator fallback
+  (the reference's row-based UDF bridge).
+- `jax_udf(fn, return_type)` — the RapidsUDF.evaluateColumnar analog,
+  TPU-native: fn maps jnp value/validity planes to (values, validity) and
+  traces INTO the enclosing fused stage — zero dispatch overhead, full
+  XLA fusion. This is strictly stronger than the reference's udf-compiler
+  (which reverse-engineers JVM bytecode into Catalyst): here the user
+  writes the columnar form directly in jax.
+- `df_udf` style — because expressions are first-class Python objects,
+  any function composing Column expressions already IS a df_udf
+  (reference sql-plugin-api functions.scala / DF_UDF_README.md); no
+  bytecode translation layer is needed.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector
+from spark_rapids_tpu.expr.core import CpuCol, Expression, _valid_of
+
+
+class PythonRowUDF(Expression):
+    """Opaque row-wise UDF: CPU-only (per-operator fallback runs it)."""
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: List[Expression], name: str = ""):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = list(children)
+        self.name = name or getattr(fn, "__name__", "udf")
+
+    def data_type(self):
+        return self.return_type
+
+    def _params(self):
+        return f"{self.name}@{id(self.fn):x}"
+
+    def with_children(self, children):
+        return PythonRowUDF(self.fn, self.return_type, children, self.name)
+
+    def supported_on_tpu(self):
+        return False
+
+    def eval_tpu(self, ctx):
+        raise NotImplementedError(
+            f"python UDF {self.name!r} is opaque; runs on CPU "
+            f"(write a jax_udf for device execution)")
+
+    def eval_cpu(self, cols, ansi=False):
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values) if ins else 0
+        out, valid = [], np.ones(n, np.bool_)
+        for i in range(n):
+            args = [c.values[i] if c.valid[i] else None for c in ins]
+            r = self.fn(*args)
+            if r is None:
+                valid[i] = False
+            out.append(r)
+        if isinstance(self.return_type, T.StringType):
+            vals = np.array(out, object)
+        else:
+            vals = np.array([0 if v is None else v for v in out]
+                            ).astype(self.return_type.np_dtype)
+        return CpuCol(self.return_type, vals, valid)
+
+
+class JaxColumnarUDF(Expression):
+    """Columnar device UDF: fn((values, validity), ...) -> values or
+    (values, validity), traced into the fused stage. The TPU-native
+    answer to RapidsUDF.evaluateColumnar — and to the udf-compiler, since
+    the user writes the columnar computation directly."""
+
+    def __init__(self, fn: Callable, return_type: T.DataType,
+                 children: List[Expression], name: str = ""):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = list(children)
+        self.name = name or getattr(fn, "__name__", "jax_udf")
+
+    def data_type(self):
+        return self.return_type
+
+    def _params(self):
+        return f"{self.name}@{id(self.fn):x}"
+
+    def with_children(self, children):
+        return JaxColumnarUDF(self.fn, self.return_type, children, self.name)
+
+    def eval_tpu(self, ctx):
+        ins = [c.eval_tpu(ctx) for c in self.children]
+        args = [(c.data, _valid_of(c, ctx)) for c in ins]
+        res = self.fn(*args)
+        if isinstance(res, tuple):
+            vals, valid = res
+        else:
+            vals = res
+            valid = None
+            for c in ins:
+                v = _valid_of(c, ctx)
+                valid = v if valid is None else (valid & v)
+        vals = jnp.asarray(vals)
+        if vals.dtype != np.dtype(self.return_type.np_dtype):
+            vals = vals.astype(self.return_type.np_dtype)
+        return ColumnVector(self.return_type, vals, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        # run the SAME jax function on host arrays: one implementation,
+        # both backends (differential tests come for free)
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        args = [(jnp.asarray(c.values), jnp.asarray(c.valid)) for c in ins]
+        res = self.fn(*args)
+        if isinstance(res, tuple):
+            vals, valid = np.asarray(res[0]), np.asarray(res[1])
+        else:
+            vals = np.asarray(res)
+            valid = np.ones(len(vals), np.bool_)
+            for c in ins:
+                valid = valid & c.valid
+        return CpuCol(self.return_type,
+                      vals.astype(self.return_type.np_dtype), valid)
+
+
+def udf(fn: Callable = None, return_type: T.DataType = T.STRING):
+    """Row-wise Python UDF decorator/factory (CPU fallback execution)."""
+    def make(f):
+        def builder(*cols):
+            from spark_rapids_tpu.expr.core import Expression as _E, col as _c
+            es = [c if isinstance(c, _E) else _c(c) for c in cols]
+            return PythonRowUDF(f, return_type, es)
+        builder.__name__ = getattr(f, "__name__", "udf")
+        return builder
+    if fn is not None:
+        return make(fn)
+    return make
+
+
+def jax_udf(fn: Callable = None, return_type: T.DataType = T.FLOAT64):
+    """Columnar jax UDF decorator/factory: fuses into the device stage."""
+    def make(f):
+        def builder(*cols):
+            from spark_rapids_tpu.expr.core import Expression as _E, col as _c
+            es = [c if isinstance(c, _E) else _c(c) for c in cols]
+            return JaxColumnarUDF(f, return_type, es)
+        builder.__name__ = getattr(f, "__name__", "jax_udf")
+        return builder
+    if fn is not None:
+        return make(fn)
+    return make

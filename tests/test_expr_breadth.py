@@ -186,3 +186,41 @@ def test_partition_exprs_outside_project_fall_back(session):
         lambda s: s.create_dataframe(t)
         .filter(F.spark_partition_id() == lit(0)),
         session, "Filter", ignore_order=True)
+
+
+def test_row_udf_cpu_fallback(session):
+    from spark_rapids_tpu.sql.udf import udf
+    from spark_rapids_tpu import types as TT
+
+    @udf(return_type=TT.INT64)
+    def square_plus(a, b):
+        if a is None:
+            return None
+        return a * a + (b or 0)
+
+    t = pa.table({"a": pa.array([1, 2, None], pa.int64()),
+                  "b": pa.array([10, None, 30], pa.int64())})
+    df = session.create_dataframe(t)
+    got = df.select(square_plus(col("a"), col("b")).alias("r")).to_pydict()
+    assert got["r"] == [11, 4, None]
+    assert "runs on CPU" in df.select(square_plus(col("a"), col("b"))).explain("all")
+
+
+def test_jax_udf_fuses_on_device(session):
+    import jax.numpy as jnp
+    from spark_rapids_tpu.sql.udf import jax_udf
+    from spark_rapids_tpu import types as TT
+
+    @jax_udf(return_type=TT.FLOAT64)
+    def gelu_ish(x):
+        v, valid = x
+        return jnp.tanh(v) * v, valid
+
+    t = pa.table({"x": pa.array([0.0, 1.0, -2.0, None])})
+    df = session.create_dataframe(t)
+    q = df.select(gelu_ish(col("x")).alias("g"))
+    # on device (no fallback marker) and equal on both backends
+    assert "@ cannot run" not in q.explain("all")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(gelu_ish(col("x")).alias("g")),
+        session, approx_float=1e-12)
