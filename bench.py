@@ -23,8 +23,63 @@ import numpy as np
 
 ROWS = int(os.environ.get("BENCH_ROWS", 30_000_000))  # ~SF5 lineitem
 REPS = int(os.environ.get("BENCH_REPS", 5))
+BACKEND_TIMEOUT_S = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", 90))
 
 LO, HI = 8766, 9131  # [1994-01-01, 1995-01-01) in days since epoch
+
+
+def probe_backend(timeout_s: float) -> str | None:
+    """Initialize the jax backend with a bounded timeout.
+
+    A wedged TPU tunnel makes ``jax.devices()`` hang forever; probing in a
+    daemon thread lets us emit a structured one-line JSON skip instead of
+    dying on the driver's timeout with a stack trace.
+    Returns an error string, or None if the backend is usable.
+    """
+    import threading
+
+    box: dict = {}
+
+    def _probe():
+        try:
+            import jax
+            # The hosting site customization pins jax to its TPU plugin
+            # regardless of JAX_PLATFORMS; re-apply an explicit request so
+            # CPU-sim CI runs (JAX_PLATFORMS=cpu) actually get the CPU.
+            plat = os.environ.get("JAX_PLATFORMS")
+            if plat:
+                jax.config.update("jax_platforms", plat)
+            box["devices"] = [str(d) for d in jax.devices()]
+            # A live-looking backend can still wedge at first dispatch;
+            # force one tiny round trip through compile + fetch.
+            import jax.numpy as jnp
+            box["ok"] = float(jnp.arange(4.0).sum()) == 6.0
+        except Exception as e:  # noqa: BLE001
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=_probe, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        return f"backend init timed out after {timeout_s:.0f}s (tunnel wedged?)"
+    if "error" in box:
+        return box["error"]
+    if not box.get("ok"):
+        return "backend smoke computation returned wrong value"
+    return None
+
+
+METRIC = "hot_analytics_q6_q1_geomean_speedup_vs_pyarrow_cpu"
+
+
+def emit_error(error: str, *, skipped: bool) -> None:
+    """One-line JSON for both clean environment skips (tunnel down,
+    skipped=True) and genuine bench crashes (failed=True) so the driver
+    can tell them apart without parsing stderr."""
+    rec = {"metric": METRIC, "value": None, "unit": "x", "vs_baseline": None,
+           "error": error}
+    rec["skipped" if skipped else "failed"] = True
+    print(json.dumps(rec))
 
 
 def make_table():
@@ -75,9 +130,14 @@ def cpu_queries(t):
             ("l_quantity", "mean"), ("l_discount", "mean"),
             ("l_quantity", "count"),
         ])
-        return {tuple(k): v for *k, v in zip(
-            g["l_returnflag"].to_pylist(), g["l_linestatus"].to_pylist(),
-            g["l_quantity_sum"].to_pylist())}
+        return {(rf, ls): (sq, sp, mq, md, cnt) for rf, ls, sq, sp, mq, md, cnt
+                in zip(g["l_returnflag"].to_pylist(),
+                       g["l_linestatus"].to_pylist(),
+                       g["l_quantity_sum"].to_pylist(),
+                       g["l_extendedprice_sum"].to_pylist(),
+                       g["l_quantity_mean"].to_pylist(),
+                       g["l_discount_mean"].to_pylist(),
+                       g["l_quantity_count"].to_pylist())}
 
     return q6, q1
 
@@ -102,17 +162,25 @@ def tpu_queries(t):
     def q1():
         out = (cached.filter(col("l_shipdate") <= lit(10471))
                .group_by("l_returnflag", "l_linestatus")
-               .agg(F.sum(col("l_quantity")), F.sum(col("l_extendedprice")),
-                    F.avg(col("l_quantity")), F.avg(col("l_discount")),
-                    F.count(col("l_quantity"))))
+               .agg(F.sum(col("l_quantity")).alias("sq"),
+                    F.sum(col("l_extendedprice")).alias("sp"),
+                    F.avg(col("l_quantity")).alias("mq"),
+                    F.avg(col("l_discount")).alias("md"),
+                    F.count(col("l_quantity")).alias("cnt")))
         d = out.to_pydict()
-        return {(rf, ls): s for rf, ls, s in zip(
-            d["l_returnflag"], d["l_linestatus"], d["sum(l_quantity)"])}
+        return {(rf, ls): (sq, sp, mq, md, cnt) for rf, ls, sq, sp, mq, md, cnt
+                in zip(d["l_returnflag"], d["l_linestatus"], d["sq"], d["sp"],
+                       d["mq"], d["md"], d["cnt"])}
 
     return q6, q1
 
 
 def main():
+    err = probe_backend(BACKEND_TIMEOUT_S)
+    if err is not None:
+        emit_error(err, skipped=True)
+        return
+
     t = make_table()
     cq6, cq1 = cpu_queries(t)
     tq6, tq1 = tpu_queries(t)
@@ -125,8 +193,12 @@ def main():
         if name == "q6":
             ok = abs(tpu_val - cpu_val) <= 1e-6 * max(1.0, abs(cpu_val))
         else:
+            # tuples are (sum_qty, sum_price, mean_qty, mean_disc, count);
+            # counts are integers and must match exactly.
             ok = (set(tpu_val) == set(cpu_val) and all(
-                abs(tpu_val[k] - cpu_val[k]) <= 1e-6 * max(1.0, abs(cpu_val[k]))
+                all(abs(a - b) <= 1e-6 * max(1.0, abs(b))
+                    for a, b in zip(tpu_val[k][:4], cpu_val[k][:4]))
+                and int(tpu_val[k][4]) == int(cpu_val[k][4])
                 for k in cpu_val))
         if not ok:
             print(f"MISMATCH {name}: tpu={tpu_val} cpu={cpu_val}", file=sys.stderr)
@@ -137,7 +209,7 @@ def main():
 
     geo = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
     print(json.dumps({
-        "metric": "hot_analytics_q6_q1_geomean_speedup_vs_pyarrow_cpu",
+        "metric": METRIC,
         "value": round(geo, 4),
         "unit": "x",
         "vs_baseline": round(geo, 4),
@@ -146,4 +218,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        emit_error(f"{type(e).__name__}: {e}", skipped=False)
+        raise SystemExit(1)
