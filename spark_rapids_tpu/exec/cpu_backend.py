@@ -51,7 +51,8 @@ def table_to_cols(table: pa.Table) -> List[CpuCol]:
             vals = np.zeros(len(arr), np.int8)
             valid = np.zeros(len(arr), np.bool_)
         else:
-            vals = np.asarray(arr.fill_null(0)).astype(dtype.np_dtype)
+            fill = False if pa.types.is_boolean(arr.type) else 0
+            vals = np.asarray(arr.fill_null(fill)).astype(dtype.np_dtype)
         out.append(CpuCol(dtype, vals, valid))
     return out
 

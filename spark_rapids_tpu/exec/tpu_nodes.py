@@ -355,8 +355,13 @@ class FilterExec(TpuExec):
                 ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
                                batch.capacity, ansi, live=batch.live_mask())
                 pred = cond.eval_tpu(ectx)
-                mask = (pred.data.astype(jnp.bool_)
-                        & pred.validity_or_default(batch.num_rows))
+                # validity=None means "valid on every live row"; the live
+                # rows of a masked batch (chained filter, exchange output)
+                # sit at positions >= live_count, so arange<num_rows would
+                # silently drop them — use the live mask instead.
+                valid = (pred.validity if pred.validity is not None
+                         else ectx.row_mask)
+                mask = pred.data.astype(jnp.bool_) & valid
                 return K.mask_filter_batch(batch, mask), dict(ectx.errors)
             return fn
 
@@ -875,7 +880,7 @@ class WindowExec(TpuExec):
                 sctx = EvalCtx(sorted_batch.columns, nr, cap, False)
                 out_cols = list(sorted_batch.columns)
                 for w in exprs:
-                    out_cols.append(self._eval_window_fn(
+                    out_cols.append(_eval_window_fn(
                         w, sctx, seg_start, seg_end, peer_start, peer_end,
                         seg_id, segb, peerb, idx, live))
                 return ColumnarBatch(out_cols, batch.num_rows)
@@ -886,106 +891,113 @@ class WindowExec(TpuExec):
         with win_t.ns():
             yield fn(batch)
 
-    def _eval_window_fn(self, w, sctx, seg_start, seg_end, peer_start,
-                        peer_end, seg_id, segb, peerb, idx, live):
-        from spark_rapids_tpu.ops import window as W
-        from spark_rapids_tpu.expr import window as WE
-        fn = w.fn
-        frame = w.spec.resolved_frame()
-        rt = fn.result_type()
-        if isinstance(fn, WE.RowNumber):
-            return ColumnVector(rt, W.row_number(seg_start), live)
-        if isinstance(fn, WE.Rank):
-            return ColumnVector(rt, W.rank(seg_start, peer_start), live)
-        if isinstance(fn, WE.DenseRank):
-            return ColumnVector(rt, W.dense_rank(segb, peerb, seg_start), live)
-        if isinstance(fn, WE.NTile):
-            return ColumnVector(rt, W.ntile(fn.n, seg_start, seg_end), live)
-        if isinstance(fn, WE.LeadLag):
-            src = fn.children[0].eval_tpu(sctx)
-            off = fn.offset if fn.is_lead else -fn.offset
-            svalid = src.validity if src.validity is not None else live
-            vals, valid = W.lead_lag(src.data, svalid, seg_id, off)
-            if fn.default is not None:
-                in_seg = (idx + off >= seg_start) & (idx + off <= seg_end)
-                dv = jnp.asarray(fn.default, src.data.dtype)
-                vals = jnp.where(~in_seg, dv, vals)
-                valid = valid | ~in_seg
-            return ColumnVector(src.dtype, vals, valid & live)
-        if isinstance(fn, WE.WindowAgg):
-            return self._eval_window_agg(fn, frame, sctx, seg_start, seg_end,
-                                         peer_end, seg_id, idx, live)
-        raise NotImplementedError(type(fn).__name__)
 
-    def _eval_window_agg(self, fn, frame, sctx, seg_start, seg_end,
-                         peer_end, seg_id, idx, live):
-        from spark_rapids_tpu.ops import window as W
-        from spark_rapids_tpu.expr import aggregates as A
-        agg = fn.fn
-        rt = agg.result_type()
-        if agg.children:
-            src = agg.children[0].eval_tpu(sctx)
-            vals = src.data
-            svalid = (src.validity if src.validity is not None else live) & live
-        else:  # count(*)
-            vals = jnp.ones(idx.shape[0], jnp.int64)
-            svalid = live
-        # frame end per row
-        if frame.kind == "range":
-            frame_end = peer_end if frame.upper == 0 else seg_end
-        else:
-            frame_end = idx if frame.upper == 0 else seg_end
-        unbounded = frame.lower is None and frame.upper is None
-        bounded_rows = frame.kind == "rows" and not (
-            frame.lower is None and frame.upper == 0) and not unbounded
+# Module-level (state-free) window kernels: the fused builder closure is
+# cached process-global by expr fingerprint, so it must capture only the
+# bound window exprs/spec — never the exec node, whose child tree can pin
+# HBM-resident cached batches for the process lifetime (same hazard the
+# _AggKernels class exists to avoid).
+def _eval_window_fn(w, sctx, seg_start, seg_end, peer_start,
+                    peer_end, seg_id, segb, peerb, idx, live):
+    from spark_rapids_tpu.ops import window as W
+    from spark_rapids_tpu.expr import window as WE
+    fn = w.fn
+    frame = w.spec.resolved_frame()
+    rt = fn.result_type()
+    if isinstance(fn, WE.RowNumber):
+        return ColumnVector(rt, W.row_number(seg_start), live)
+    if isinstance(fn, WE.Rank):
+        return ColumnVector(rt, W.rank(seg_start, peer_start), live)
+    if isinstance(fn, WE.DenseRank):
+        return ColumnVector(rt, W.dense_rank(segb, peerb, seg_start), live)
+    if isinstance(fn, WE.NTile):
+        return ColumnVector(rt, W.ntile(fn.n, seg_start, seg_end), live)
+    if isinstance(fn, WE.LeadLag):
+        src = fn.children[0].eval_tpu(sctx)
+        off = fn.offset if fn.is_lead else -fn.offset
+        svalid = src.validity if src.validity is not None else live
+        vals, valid = W.lead_lag(src.data, svalid, seg_id, off)
+        if fn.default is not None:
+            in_seg = (idx + off >= seg_start) & (idx + off <= seg_end)
+            dv = jnp.asarray(fn.default, src.data.dtype)
+            vals = jnp.where(~in_seg, dv, vals)
+            valid = valid | ~in_seg
+        return ColumnVector(src.dtype, vals, valid & live)
+    if isinstance(fn, WE.WindowAgg):
+        return _eval_window_agg(fn, frame, sctx, seg_start, seg_end,
+                                peer_end, seg_id, idx, live)
+    raise NotImplementedError(type(fn).__name__)
 
-        def sum_count():
-            if bounded_rows:
-                v = vals
-                if isinstance(agg, A.Average):
-                    v = v.astype(jnp.float64)
-                elif not jnp.issubdtype(v.dtype, jnp.floating):
-                    v = v.astype(jnp.int64)
-                return W.bounded_sum_count(v, svalid, seg_start, seg_end,
-                                           frame.lower, frame.upper)
-            fe = seg_end if unbounded else frame_end
+
+def _eval_window_agg(fn, frame, sctx, seg_start, seg_end,
+                     peer_end, seg_id, idx, live):
+    from spark_rapids_tpu.ops import window as W
+    from spark_rapids_tpu.expr import aggregates as A
+    agg = fn.fn
+    rt = agg.result_type()
+    if agg.children:
+        src = agg.children[0].eval_tpu(sctx)
+        vals = src.data
+        svalid = (src.validity if src.validity is not None else live) & live
+    else:  # count(*)
+        vals = jnp.ones(idx.shape[0], jnp.int64)
+        svalid = live
+    # frame end per row
+    if frame.kind == "range":
+        frame_end = peer_end if frame.upper == 0 else seg_end
+    else:
+        frame_end = idx if frame.upper == 0 else seg_end
+    unbounded = frame.lower is None and frame.upper is None
+    bounded_rows = frame.kind == "rows" and not (
+        frame.lower is None and frame.upper == 0) and not unbounded
+
+    def sum_count():
+        if bounded_rows:
             v = vals
-            if isinstance(agg, (A.Sum, A.Average)) and \
-                    not jnp.issubdtype(v.dtype, jnp.floating):
-                v = v.astype(jnp.int64)
             if isinstance(agg, A.Average):
                 v = v.astype(jnp.float64)
-            return W.running_sum_count(v, svalid, seg_start, fe)
-
+            elif not jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(jnp.int64)
+            return W.bounded_sum_count(v, svalid, seg_start, seg_end,
+                                       frame.lower, frame.upper)
+        fe = seg_end if unbounded else frame_end
+        v = vals
+        if isinstance(agg, (A.Sum, A.Average)) and \
+                not jnp.issubdtype(v.dtype, jnp.floating):
+            v = v.astype(jnp.int64)
         if isinstance(agg, A.Average):
-            s, c = sum_count()
-            return ColumnVector(rt, s / jnp.maximum(c, 1), (c > 0) & live)
-        if isinstance(agg, A.Sum):
-            s, c = sum_count()
-            return ColumnVector(rt, s.astype(rt.np_dtype), (c > 0) & live)
-        if isinstance(agg, (A.Count, A.CountAll)):
-            s, c = sum_count()
-            cnt = c if isinstance(agg, A.Count) else None
-            if isinstance(agg, A.CountAll):
-                # count(*) counts rows regardless of validity
-                if bounded_rows:
-                    ones = jnp.ones(idx.shape[0], jnp.int64)
-                    s2, _ = W.bounded_sum_count(ones, live, seg_start, seg_end,
-                                                frame.lower, frame.upper)
-                    cnt = s2
-                else:
-                    fe = seg_end if unbounded else frame_end
-                    s2, _ = W.running_sum_count(
-                        jnp.ones(idx.shape[0], jnp.int64), live, seg_start, fe)
-                    cnt = s2
-            return ColumnVector(T.INT64, cnt.astype(jnp.int64),
-                                jnp.ones_like(live) & live)
-        if isinstance(agg, (A.Min, A.Max)):
-            op = "min" if isinstance(agg, A.Min) else "max"
-            fe = seg_end if unbounded else frame_end
-            v, c = W.running_minmax(op, vals, svalid, seg_id, seg_start, fe)
-            return ColumnVector(rt, v.astype(rt.np_dtype), (c > 0) & live)
-        raise NotImplementedError(type(agg).__name__)
+            v = v.astype(jnp.float64)
+        return W.running_sum_count(v, svalid, seg_start, fe)
+
+    if isinstance(agg, A.Average):
+        s, c = sum_count()
+        return ColumnVector(rt, s / jnp.maximum(c, 1), (c > 0) & live)
+    if isinstance(agg, A.Sum):
+        s, c = sum_count()
+        return ColumnVector(rt, s.astype(rt.np_dtype), (c > 0) & live)
+    if isinstance(agg, (A.Count, A.CountAll)):
+        s, c = sum_count()
+        cnt = c if isinstance(agg, A.Count) else None
+        if isinstance(agg, A.CountAll):
+            # count(*) counts rows regardless of validity
+            if bounded_rows:
+                ones = jnp.ones(idx.shape[0], jnp.int64)
+                s2, _ = W.bounded_sum_count(ones, live, seg_start, seg_end,
+                                            frame.lower, frame.upper)
+                cnt = s2
+            else:
+                fe = seg_end if unbounded else frame_end
+                s2, _ = W.running_sum_count(
+                    jnp.ones(idx.shape[0], jnp.int64), live, seg_start, fe)
+                cnt = s2
+        return ColumnVector(T.INT64, cnt.astype(jnp.int64),
+                            jnp.ones_like(live) & live)
+    if isinstance(agg, (A.Min, A.Max)):
+        op = "min" if isinstance(agg, A.Min) else "max"
+        fe = seg_end if unbounded else frame_end
+        v, c = W.running_minmax(op, vals, svalid, seg_id, seg_start, fe)
+        return ColumnVector(rt, v.astype(rt.np_dtype), (c > 0) & live)
+    raise NotImplementedError(type(agg).__name__)
 
 
 class HashAggregateExec(TpuExec):

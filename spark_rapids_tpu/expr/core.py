@@ -21,6 +21,7 @@ division-by-zero yields null, ANSI mode raises.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -36,6 +37,19 @@ from spark_rapids_tpu.columnar.batch import ColumnVector
 class SparkException(Exception):
     """Raised for ANSI-mode arithmetic/cast errors (host-side, after the
     jitted stage reports error flags)."""
+
+
+class _CpuEvalContext(threading.local):
+    """Partition context for the CPU interpreter (spark_partition_id,
+    monotonically_increasing_id). CpuFallbackExec collapses its input to a
+    single partition, so the defaults describe that execution; a caller
+    evaluating per-partition must set these to match the TPU path's
+    (pid << 33) + idx layout."""
+    partition_id = 0
+    row_base = 0
+
+
+CPU_EVAL_CTX = _CpuEvalContext()
 
 
 @dataclasses.dataclass
@@ -334,7 +348,8 @@ class SparkPartitionID(Expression):
 
     def eval_cpu(self, cols, ansi=False):
         n = len(cols[0].values) if cols else 0
-        return CpuCol(T.INT32, np.zeros(n, np.int32), np.ones(n, np.bool_))
+        pid = CPU_EVAL_CTX.partition_id
+        return CpuCol(T.INT32, np.full(n, pid, np.int32), np.ones(n, np.bool_))
 
 
 class MonotonicallyIncreasingID(Expression):
@@ -360,7 +375,9 @@ class MonotonicallyIncreasingID(Expression):
 
     def eval_cpu(self, cols, ansi=False):
         n = len(cols[0].values) if cols else 0
-        return CpuCol(T.INT64, np.arange(n, dtype=np.int64),
+        base = (np.int64(CPU_EVAL_CTX.partition_id) << np.int64(33)) \
+            + np.int64(CPU_EVAL_CTX.row_base)
+        return CpuCol(T.INT64, base + np.arange(n, dtype=np.int64),
                       np.ones(n, np.bool_))
 
 

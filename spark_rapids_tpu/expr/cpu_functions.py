@@ -17,7 +17,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.expr.core import CpuCol, Expression
+from spark_rapids_tpu.expr.core import CpuCol, Expression, SparkException
 
 
 class CpuRowFunction(Expression):
@@ -191,21 +191,46 @@ class Sha2(CpuRowFunction):
         return algo(b).hexdigest()
 
 
+def _java_fmt_to_py(pattern: str) -> str:
+    """Transpile the supported Java datetime-pattern subset to strftime,
+    rejecting anything unhandled (the transpile-or-reject contract the
+    regex layer uses): a pattern like 'd/M/yyyy' or 'EEE' must raise, not
+    silently emit literal 'd/M/2024'."""
+    tokens = [("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+              ("HH", "%H"), ("mm", "%M"), ("ss", "%S")]
+    out = []
+    i = 0
+    while i < len(pattern):
+        for j, p in tokens:
+            if pattern.startswith(j, i):
+                out.append(p)
+                i += len(j)
+                break
+        else:
+            ch = pattern[i]
+            if ch.isalpha() or ch in "%'":
+                raise SparkException(
+                    f"unsupported datetime pattern {pattern!r}: "
+                    f"unhandled character {ch!r}")
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
 class DateFormat(CpuRowFunction):
     """date_format(date/ts, java-pattern-subset)."""
 
     name = "date_format"
     result = T.STRING
 
-    _JAVA_TO_PY = [("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
-                   ("mm", "%M"), ("ss", "%S"), ("yy", "%y")]
+    def __init__(self, *children, params=()):
+        super().__init__(*children, params=params)
+        if params:
+            _java_fmt_to_py(params[0])  # reject bad patterns at construction
 
     def _py_fmt(self):
         if not hasattr(self, "_py"):
-            py = self.params[0]
-            for j, p in self._JAVA_TO_PY:
-                py = py.replace(j, p)
-            self._py = py
+            self._py = _java_fmt_to_py(self.params[0])
         return self._py
 
     def row_fn(self, v):
@@ -223,12 +248,14 @@ class ToDateFmt(CpuRowFunction):
     name = "to_date"
     result = T.DATE
 
+    def __init__(self, *children, params=()):
+        super().__init__(*children, params=params)
+        if params:
+            _java_fmt_to_py(params[0])
+
     def row_fn(self, s):
         if not hasattr(self, "_py"):
-            py = self.params[0]
-            for j, p in DateFormat._JAVA_TO_PY:
-                py = py.replace(j, p)
-            self._py = py
+            self._py = _java_fmt_to_py(self.params[0])
         try:
             d = _dt.datetime.strptime(s, self._py).date()
         except (ValueError, TypeError):
@@ -240,12 +267,15 @@ class FromUnixtime(CpuRowFunction):
     name = "from_unixtime"
     result = T.STRING
 
+    def __init__(self, *children, params=()):
+        super().__init__(*children, params=params)
+        if params:
+            _java_fmt_to_py(params[0])
+
     def row_fn(self, v):
         if not hasattr(self, "_py"):
-            py = self.params[0] if self.params else "yyyy-MM-dd HH:mm:ss"
-            for j, p in DateFormat._JAVA_TO_PY:
-                py = py.replace(j, p)
-            self._py = py
+            self._py = _java_fmt_to_py(
+                self.params[0] if self.params else "yyyy-MM-dd HH:mm:ss")
         return (_dt.datetime(1970, 1, 1)
                 + _dt.timedelta(seconds=int(v))).strftime(self._py)
 

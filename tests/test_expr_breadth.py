@@ -177,6 +177,18 @@ def test_cpu_only_functions_fall_back_and_work(session):
     assert "runs on CPU" in exp
 
 
+def test_date_format_rejects_unsupported_patterns(session):
+    # transpile-or-reject: 'd/M/yyyy' must raise at construction, never
+    # silently emit the literal characters 'd/M/2024'.
+    import pytest as _pt
+    from spark_rapids_tpu.expr.core import SparkException
+    for bad in ("d/M/yyyy", "EEE", "yyyy%"):
+        with _pt.raises(SparkException):
+            F.date_format(col("d"), bad)
+    with _pt.raises(SparkException):
+        F.to_date(col("ds"), "dd-MMM-yy")
+
+
 def test_partition_exprs_outside_project_fall_back(session):
     # spark_partition_id in a FILTER lacks the projection's partition
     # context -> the planner must not run it on device

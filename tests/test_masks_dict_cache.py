@@ -39,6 +39,23 @@ def test_chained_filters_masked(session):
         session, ignore_order=True)
 
 
+def test_chained_filter_validity_none_predicate(session):
+    # A bare boolean-column predicate on a null-free column has
+    # validity=None. After a first filter, live rows sit at scattered
+    # positions >= live_count; defaulting validity to arange<live_count
+    # silently dropped them (round-1 advisor finding, tpu_nodes FilterExec).
+    n = 64
+    rng = np.random.default_rng(3)
+    t = pa.table({
+        "n": pa.array(rng.integers(0, 100, n).astype(np.int64)),
+        "flag": pa.array(rng.random(n) > 0.3),  # null-free boolean
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t)
+        .filter(col("n") > lit(20)).filter(col("flag")),
+        session, ignore_order=True)
+
+
 def test_filter_then_project_masked(session):
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: s.create_dataframe(_table())
