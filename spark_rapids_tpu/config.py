@@ -168,7 +168,8 @@ RETRY_OOM_INJECT = conf_str(
 
 SHUFFLE_MODE = conf_str(
     "spark.rapids.shuffle.mode", "MULTITHREADED",
-    "MULTITHREADED: host-staged parallel serialization through local files; "
+    "MULTITHREADED: in-process exchange by zero-copy selection-mask "
+    "slicing on device (no files or serialization involved); "
     "ICI: device-resident exchange via XLA all-to-all collectives over the "
     "mesh (reference RapidsConf.scala:1767 UCX|CACHE_ONLY|MULTITHREADED).")
 
@@ -217,8 +218,10 @@ CASE_SENSITIVE = conf_bool(
 
 SESSION_TIMEZONE = conf_str(
     "spark.sql.session.timeZone", "UTC",
-    "Session timezone for timestamp expressions (reference TimeZoneDB; "
-    "non-UTC handled host-side in round 1).")
+    "Session timezone. This engine evaluates timestamps in UTC only: any "
+    "other value makes timezone-sensitive expressions raise at planning "
+    "instead of silently returning UTC answers (reference: GpuOverrides "
+    "tags non-UTC ops as unsupported).")
 
 TEST_MODE = conf_bool(
     "spark.rapids.sql.test.enabled", False,
@@ -237,22 +240,14 @@ CPU_RANGE_PARTITION_SAMPLE = conf_int(
 
 AGG_FORCE_SINGLE_PASS = conf_bool(
     "spark.rapids.sql.agg.forceSinglePassPartialSort", False,
-    "Internal agg testing knob (reference forceSinglePassPartialSortAgg).",
-    internal=True)
+    "Concat all input batches and run the partial aggregation as ONE update "
+    "pass instead of per-batch update + merge (testing knob, reference "
+    "forceSinglePassPartialSortAgg).", internal=True)
 
 SKIP_AGG_PASS_RATIO = conf_float(
     "spark.rapids.sql.agg.skipAggPassReductionRatio", 1.0,
     "Skip later agg passes when a pass reduces rows by less than this ratio "
     "(reference skipAggPassReductionRatio).")
-
-JOIN_TARGET_OUTPUT_ROWS = conf_int(
-    "spark.rapids.sql.join.targetOutputRows", 1 << 20,
-    "Bound on rows per join output chunk (reference JoinGatherer chunking).")
-
-SUBPARTITION_THRESHOLD_ROWS = conf_int(
-    "spark.rapids.sql.join.subPartitionThresholdRows", 4 << 20,
-    "Build sides above this get hash sub-partitioned and joined pairwise "
-    "(reference GpuSubPartitionHashJoin).")
 
 METRICS_LEVEL = conf_str(
     "spark.rapids.sql.metrics.level", "MODERATE",

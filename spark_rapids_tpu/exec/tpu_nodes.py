@@ -446,20 +446,26 @@ class CoalesceBatchesExec(TpuExec):
 
     def execute_partition(self, ctx, pidx):
         concat_t = self.metrics.metric(M.CONCAT_TIME)
+        n_coalesced = self.metrics.metric(M.NUM_INPUT_BATCHES)
         pending: List[ColumnarBatch] = []
         pending_bytes = 0
-        for batch in self.children[0].execute_partition(ctx, pidx):
-            pending.append(batch)
-            pending_bytes += batch.device_memory_size()
-            if not self.require_single and pending_bytes >= self.target_bytes:
-                self._acquire(ctx)
-                with concat_t.ns():
-                    yield K.concat_batches(pending)
-                pending, pending_bytes = [], 0
-        if pending:
+
+        def flush():
+            if len(pending) == 1:
+                return pending[0]
             self._acquire(ctx)
             with concat_t.ns():
-                yield K.concat_batches(pending)
+                return K.concat_batches(pending)
+
+        for batch in self.children[0].execute_partition(ctx, pidx):
+            pending.append(batch)
+            n_coalesced.add(1)
+            pending_bytes += batch.device_memory_size()
+            if not self.require_single and pending_bytes >= self.target_bytes:
+                yield flush()
+                pending, pending_bytes = [], 0
+        if pending:
+            yield flush()
 
 
 class SortExec(TpuExec):
@@ -1066,8 +1072,18 @@ class HashAggregateExec(TpuExec):
                 compiled.raise_errors(errs)
                 return out
 
+            if self.conf.get(C.AGG_FORCE_SINGLE_PASS) and nkeys > 0:
+                # Testing knob (reference forceSinglePassPartialSortAgg):
+                # concat every input batch and aggregate in ONE update pass
+                # instead of per-batch update + merge.
+                batches = list(child_batches)
+                child_batches = iter(
+                    [K.concat_batches(batches)] if len(batches) > 1 else batches)
+
+            skip_ratio = self.conf.get(C.SKIP_AGG_PASS_RATIO)
+            skip_merge = False
             partials = []
-            for batch in child_batches:
+            for bi, batch in enumerate(child_batches):
                 self._acquire(ctx)
                 with agg_t.ns():
                     # update is idempotent over its input batch: retried
@@ -1076,6 +1092,16 @@ class HashAggregateExec(TpuExec):
                         if nkeys == 0:
                             out = ColumnarBatch(out.columns, 1)
                         partials.append(out)
+                if bi == 0 and skip_ratio < 1.0 and nkeys > 0 \
+                        and self.mode == "partial":
+                    # Reference skipAggPassReductionRatio: when the first
+                    # batch's update barely reduced rows (groups/rows above
+                    # the ratio), skip the within-partition merge pass and
+                    # defer cross-batch merging to the post-exchange final
+                    # agg. Sampled on the first batch only — row counts
+                    # live on device and each fetch is a host sync.
+                    in_rows = max(int(batch.num_rows), 1)
+                    skip_merge = int(partials[0].num_rows) > skip_ratio * in_rows
             if not partials:
                 if nkeys == 0:
                     partials = [self._empty_state_batch()]
@@ -1084,6 +1110,7 @@ class HashAggregateExec(TpuExec):
                         return
                     return
         else:  # final: inputs are state batches
+            skip_merge = False
             partials = list(child_batches)
             if not partials:
                 if nkeys == 0:
@@ -1091,6 +1118,10 @@ class HashAggregateExec(TpuExec):
                 else:
                     return
         if partials:
+            if skip_merge and len(partials) > 1:
+                for p in partials:
+                    yield K.compact_batch(p)
+                return
             self._acquire(ctx)
             with agg_t.ns():
                 merged = self._merge(partials)

@@ -262,8 +262,50 @@ def _contains_project_only(e: E.Expression) -> bool:
     return any(_contains_project_only(c) for c in e.children)
 
 
+_UTC_NAMES = ("UTC", "Etc/UTC", "GMT", "Etc/GMT", "Z", "+00:00")
+
+#: Expressions whose result depends on the session timezone when any input
+#: (or output) is a TIMESTAMP. Date-typed inputs are timezone-free.
+_TZ_SENSITIVE = ()
+
+
+def _register_tz_sensitive():
+    global _TZ_SENSITIVE
+    from spark_rapids_tpu.expr import cpu_functions as CPUF
+    _TZ_SENSITIVE = (
+        DT.Year, DT.Month, DT.DayOfMonth, DT.Hour, DT.Minute, DT.Second,
+        DT.DayOfWeek, DT.LastDay, DT.Quarter, DT.DayOfYear, DT.WeekOfYear,
+        DT.AddMonths, DT.TruncDate, DT.UnixTimestampFromTs,
+        CPUF.DateFormat, CPUF.ToDateFmt, CPUF.FromUnixtime,
+    )
+
+
+def _check_session_timezone(e: E.Expression, conf, where: str) -> None:
+    """Reference discipline (GpuOverrides nonUTC tagging): a non-UTC session
+    timezone must never silently produce UTC answers. Our CPU interpreter is
+    also UTC-only, so unlike the reference (which can fall back to CPU
+    Spark) the only honest behavior is to refuse the plan outright."""
+    tz = conf.get(C.SESSION_TIMEZONE)
+    if tz in _UTC_NAMES:
+        return
+    if not _TZ_SENSITIVE:
+        _register_tz_sensitive()
+    if not isinstance(e, _TZ_SENSITIVE):
+        return
+    types = [e.data_type()] + [c.data_type() for c in e.children]
+    from spark_rapids_tpu.expr import cpu_functions as CPUF
+    always = isinstance(e, (DT.Hour, DT.Minute, DT.Second,
+                            CPUF.FromUnixtime, CPUF.ToDateFmt))
+    if always or any(isinstance(t, T.TimestampType) for t in types):
+        raise E.SparkException(
+            f"{where}: {type(e).__name__} with spark.sql.session.timeZone="
+            f"{tz!r} is not supported (this engine evaluates timestamps in "
+            f"UTC only); set the session timezone to UTC")
+
+
 def tag_expression(e: E.Expression, conf, reasons: List[str], where: str) -> None:
     cls = type(e)
+    _check_session_timezone(e, conf, where)
     rule = EXPR_RULES.get(cls)
     if rule is None:
         reasons.append(f"{where}: expression {cls.__name__} is not supported on TPU")
@@ -441,9 +483,15 @@ class SparkPlanMeta:
         if isinstance(p, P.InMemorySource):
             return X.InMemoryScanExec(p, [], conf)
         if isinstance(p, P.ParquetScan):
-            return X.ParquetScanExec(p, [], conf)
+            # insertCoalesce analog (GpuTransitionOverrides.scala): file
+            # scans emit one batch per row group / file split; coalesce to
+            # the target size so downstream fused stages see few big
+            # batches instead of many small dispatches.
+            return X.CoalesceBatchesExec(p, [X.ParquetScanExec(p, [], conf)],
+                                         conf)
         if isinstance(p, P.TextScan):
-            return X.TextScanExec(p, [], conf)
+            return X.CoalesceBatchesExec(p, [X.TextScanExec(p, [], conf)],
+                                         conf)
         if isinstance(p, P.CachedRelation):
             return X.CachedScanExec(p, child_execs, conf)
         if isinstance(p, P.Range):

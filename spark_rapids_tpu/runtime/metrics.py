@@ -19,6 +19,7 @@ DEBUG = 2
 # Standard metric names (reference GpuExec companion object)
 NUM_OUTPUT_ROWS = "numOutputRows"
 NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_BATCHES = "numInputBatches"
 OP_TIME = "opTime"
 SORT_TIME = "sortTime"
 AGG_TIME = "aggTime"

@@ -125,3 +125,36 @@ def test_count_star_groupby(session):
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: make_df(s, 2).group_by(col("k")).count(),
         session, ignore_order=True)
+
+
+def _wide_table(n=96):
+    import numpy as np
+    rng = np.random.default_rng(11)
+    return pa.table({
+        "k": pa.array(np.array(["a", "b", "c", "d"], object)[
+            rng.integers(0, 4, n)]),
+        "v": pa.array(rng.integers(0, 50, n).astype("int64")),
+    })
+
+
+def test_skip_agg_pass_reduction_ratio():
+    # ratio 0.0: the first batch never reduces "enough", so the partial
+    # merge pass is skipped and un-merged partials (overlapping keys
+    # across batches) flow to the final agg — results must be identical.
+    s = TpuSession({"spark.rapids.sql.agg.skipAggPassReductionRatio": 0.0,
+                    "spark.rapids.sql.reader.batchSizeRows": 8})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: ss.create_dataframe(_wide_table(), num_partitions=2)
+        .group_by(col("k")).agg(F.sum("v").alias("sv"),
+                                F.count("v").alias("cv")),
+        s, ignore_order=True)
+
+
+def test_agg_force_single_pass():
+    s = TpuSession({"spark.rapids.sql.agg.forceSinglePassPartialSort": True,
+                    "spark.rapids.sql.reader.batchSizeRows": 8})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: ss.create_dataframe(_wide_table(), num_partitions=2)
+        .group_by(col("k")).agg(F.sum("v").alias("sv"),
+                                F.count("v").alias("cv")),
+        s, ignore_order=True)

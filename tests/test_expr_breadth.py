@@ -236,3 +236,27 @@ def test_jax_udf_fuses_on_device(session):
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: s.create_dataframe(t).select(gelu_ish(col("x")).alias("g")),
         session, approx_float=1e-12)
+
+
+def test_non_utc_session_timezone_refused():
+    # Reading spark.sql.session.timeZone and silently answering in UTC is
+    # the failure mode the reference's non-UTC tagging prevents; since the
+    # CPU interpreter is UTC-only too, the engine must refuse outright.
+    import datetime as dtm
+    import pytest as _pt
+    from spark_rapids_tpu.expr.core import SparkException
+    s = TpuSession({"spark.sql.session.timeZone": "America/New_York"})
+    t = pa.table({
+        "ts": pa.array([dtm.datetime(2024, 3, 7, 12, 30)], pa.timestamp("us")),
+        "d": pa.array([dtm.date(2024, 3, 7)], pa.date32()),
+    })
+    df = s.create_dataframe(t)
+    with _pt.raises(SparkException, match="session.timeZone"):
+        df.select(F.hour(col("ts")).alias("h")).collect()
+    # date-typed inputs are timezone-free and must still work
+    assert s.create_dataframe(t).select(
+        F.year(col("d")).alias("y")).to_pydict()["y"] == [2024]
+    # UTC spellings are all accepted
+    s2 = TpuSession({"spark.sql.session.timeZone": "Etc/UTC"})
+    assert s2.create_dataframe(t).select(
+        F.hour(col("ts")).alias("h")).to_pydict()["h"] == [12]

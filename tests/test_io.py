@@ -170,3 +170,35 @@ def test_read_columns_reordered(session, tmp_path):
     got = d.to_pydict()
     assert isinstance(got["f"][0], float)
     assert isinstance(got["k"][0], str)
+
+
+def test_scan_coalesces_small_row_groups(session, tmp_path):
+    # The planner inserts CoalesceBatchesExec over file scans
+    # (insertCoalesce analog): 10 tiny row groups must reach the
+    # downstream exec as one coalesced batch.
+    import pyarrow.parquet as pq
+    t = _t(100)
+    path = str(tmp_path / "rg.parquet")
+    pq.write_table(t, path, row_group_size=10)
+    df = session.read_parquet(path)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path).filter(col("i") > lit(0)),
+        session, ignore_order=True)
+    # exec tree contains the coalesce node directly above the scan
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    root, _ = convert_plan(df.plan, session.conf)
+    nodes = []
+    def walk(e):
+        nodes.append(e)
+        for c in e.children:
+            walk(c)
+    walk(root)
+    co = [n for n in nodes if isinstance(n, X.CoalesceBatchesExec)]
+    assert co and isinstance(co[0].children[0], X.ParquetScanExec)
+    # and it actually coalesces: downstream sees 1 batch, not 10
+    from spark_rapids_tpu.runtime.task import TaskContext
+    with TaskContext(partition_id=0) as tctx:
+        out = list(co[0].execute_partition(tctx, 0))
+    assert len(out) == 1
+    assert co[0].metrics.metric("numInputBatches").value >= 10
