@@ -120,24 +120,61 @@ class InMemoryScanExec(TpuExec):
 
 class ParquetScanExec(TpuExec):
     """Parquet scan: host-side read (pyarrow footer+decode) then one device
-    upload per batch (reference GpuParquetScan PERFILE strategy; the
-    COALESCING/MULTITHREADED strategies land with io/multifile)."""
+    upload per batch. Pushed-down filters prune hive-partition files at
+    plan time and row groups by footer min/max statistics at execute time
+    (reference GpuParquetScan.scala:673 filterBlocks). Reader strategies
+    (reference MULTIFILE_READER_TYPE, GpuMultiFileReader):
+      PERFILE       sequential row-group loads, no lookahead
+      MULTITHREADED bounded prefetch pool overlapping decode with upload
+      COALESCING    prefetch + host-side concat of row groups up to the
+                    reader batch size, so each upload is one big batch
+      AUTO          COALESCING (local files; no cloud path distinction)
+    """
+
+    def __init__(self, plan, children, conf):
+        super().__init__(plan, children, conf)
+        from spark_rapids_tpu.io.parquet_pruning import prune_partition_file
+        pv = self.plan.partition_values
+        paths = list(self.plan.paths)
+        if pv and self.plan.pushed_filters:
+            kept = [i for i in range(len(paths)) if prune_partition_file(
+                pv[i], self.plan.schema, self.plan.pushed_filters)]
+        else:
+            kept = list(range(len(paths)))
+        self._kept_files = kept
 
     @property
     def num_partitions(self):
-        return max(1, len(self.plan.paths))
+        return max(1, len(self._kept_files))
 
     def execute_partition(self, ctx, pidx):
         import pyarrow.parquet as pq
-        path = self.plan.paths[pidx]
+        from spark_rapids_tpu.io.parquet_pruning import prune_row_groups
+        if not self._kept_files:
+            return
+        fidx = self._kept_files[pidx]
+        path = self.plan.paths[fidx]
         decode_t = self.metrics.metric(M.DECODE_TIME)
         copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
         out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        rg_total = self.metrics.metric(M.NUM_ROW_GROUPS)
+        rg_pruned = self.metrics.metric(M.NUM_ROW_GROUPS_PRUNED)
+        read_bytes = self.metrics.metric(M.READ_BYTES)
         cols = getattr(self.plan, "file_columns", self.plan.columns)
-        threads = self.conf.get(C.MULTIFILE_READER_THREADS)
-        groups = list(range(pq.ParquetFile(path).metadata.num_row_groups))
+        mode = str(self.conf.get(C.MULTIFILE_READER_TYPE)).upper()
+        threads = 1 if mode == "PERFILE" \
+            else self.conf.get(C.MULTIFILE_READER_THREADS)
+
+        metadata = pq.ParquetFile(path).metadata
+        groups, total = prune_row_groups(metadata, self.plan.pushed_filters)
+        rg_total.add(total)
+        rg_pruned.add(total - len(groups))
+        for g in groups:
+            read_bytes.add(metadata.row_group(g).total_byte_size)
         if not groups:
-            groups = [-1]
+            if total:
+                return  # every row group statically refuted
+            groups = [-1]  # row-group-less file: read whole
 
         def load(g):
             # one ParquetFile per call: parquet-cpp FileReader is NOT
@@ -150,8 +187,11 @@ class ParquetScanExec(TpuExec):
 
         # host decode of row group g+1.. overlaps device upload of g
         batch_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
-        for tbl in _prefetched(groups, load, threads):
-            tbl = self.plan.with_partition_cols(tbl, pidx)
+        tables = _prefetched(groups, load, threads)
+        if mode in ("COALESCING", "AUTO"):
+            tables = _host_coalesced(tables, batch_rows)
+        for tbl in tables:
+            tbl = self.plan.with_partition_cols(tbl, fidx)
             off = 0
             while off < tbl.num_rows or (tbl.num_rows == 0 and off == 0):
                 chunk = tbl.slice(off, batch_rows)
@@ -162,6 +202,21 @@ class ParquetScanExec(TpuExec):
                 off += max(chunk.num_rows, 1)
                 if tbl.num_rows == 0:
                     break
+
+
+def _host_coalesced(tables, target_rows: int):
+    """Concat host tables until the target row count is reached, so one
+    device upload carries many small row groups (COALESCING strategy)."""
+    import pyarrow as pa
+    pending, rows = [], 0
+    for t in tables:
+        pending.append(t)
+        rows += t.num_rows
+        if rows >= target_rows:
+            yield pa.concat_tables(pending) if len(pending) > 1 else pending[0]
+            pending, rows = [], 0
+    if pending:
+        yield pa.concat_tables(pending) if len(pending) > 1 else pending[0]
 
 
 def _prefetched(items, load_fn, n_threads: int):

@@ -604,7 +604,95 @@ class SparkPlanMeta:
 # Entry points (reference GpuOverrides.apply / ExplainPlan)
 # ---------------------------------------------------------------------------
 
+_PUSHABLE_LEAVES = (E.BoundRef, E.Literal)
+
+
+def _as_pushed(e: E.Expression, rename: Dict[str, str]) -> Optional[E.Expression]:
+    """Copy a conjunct into the pushdown-supported shape (comparisons,
+    In, IsNull/IsNotNull, And/Or over column refs + literals), applying
+    projection renames. None = not pushable."""
+    if isinstance(e, E.BoundRef):
+        name = rename.get(e.name) if rename else e.name
+        if name is None:
+            return None
+        return E.BoundRef(e.index, e.data_type(), name)
+    if isinstance(e, E.Literal):
+        return e
+    if isinstance(e, E.Not):
+        # only null-test negations have a sound pruning rewrite (negating
+        # an interval comparison is unsound under three-valued logic)
+        c = e.children[0]
+        if isinstance(c, E.IsNull):
+            return _as_pushed(E.IsNotNull(c.children[0]), rename)
+        if isinstance(c, E.IsNotNull):
+            return _as_pushed(E.IsNull(c.children[0]), rename)
+        return None
+    if isinstance(e, (E.And, E.Or, E.EqualTo, E.LessThan, E.LessThanOrEqual,
+                      E.GreaterThan, E.GreaterThanOrEqual, E.In,
+                      E.IsNull, E.IsNotNull)):
+        kids = [_as_pushed(c, rename) for c in e.children]
+        if any(k is None for k in kids):
+            return None
+        return e.with_children(kids)
+    return None
+
+
+def push_down_scan_filters(plan: P.PlanNode) -> None:
+    """Populate ParquetScan.pushed_filters from enclosing Filter nodes
+    (reference: ParquetFilters / GpuParquetScan pushedFilters). Filters
+    stay in the plan — pruning is a conservative row-group/file skip, the
+    exact predicate still runs on device. Idempotent: pushed lists are
+    reassigned, not extended, so explain + collect don't double-push."""
+    from spark_rapids_tpu.io.parquet_pruning import split_conjuncts
+
+    pushed: Dict[int, List[E.Expression]] = {}
+
+    def visit(node: P.PlanNode) -> None:
+        for c in node.children:
+            visit(c)
+        if not isinstance(node, P.Filter):
+            return
+        rename: Dict[str, str] = {}
+        cur = node.children[0]
+        while True:
+            if isinstance(cur, P.Filter):
+                cur = cur.children[0]
+                continue
+            if isinstance(cur, P.Project):
+                nmap: Dict[str, str] = {}
+                for name, ex in zip(cur.names, cur.exprs):
+                    inner = ex.children[0] if isinstance(ex, E.Alias) else ex
+                    if isinstance(inner, E.BoundRef):
+                        nmap[name] = inner.name
+                if len(nmap) != len(cur.names):
+                    return  # computed projection: stop the walk
+                if rename:
+                    # compose: condition-name -> this project's input name
+                    rename = {k: nmap.get(v) for k, v in rename.items()}
+                else:
+                    rename = dict(nmap)
+                cur = cur.children[0]
+                continue
+            break
+        if isinstance(cur, P.ParquetScan):
+            dest = pushed.setdefault(id(cur), [])
+            for conj in split_conjuncts(node.condition):
+                p = _as_pushed(conj, rename)
+                if p is not None:
+                    dest.append(p)
+
+    def assign(node: P.PlanNode) -> None:
+        for c in node.children:
+            assign(c)
+        if isinstance(node, P.ParquetScan):
+            node.pushed_filters = pushed.get(id(node), [])
+
+    visit(plan)
+    assign(plan)
+
+
 def wrap_and_tag(plan: P.PlanNode, conf) -> SparkPlanMeta:
+    push_down_scan_filters(plan)
     meta = SparkPlanMeta(plan, conf)
     meta.tag_for_tpu()
     return meta

@@ -20,6 +20,9 @@ DEBUG = 2
 NUM_OUTPUT_ROWS = "numOutputRows"
 NUM_OUTPUT_BATCHES = "numOutputBatches"
 NUM_INPUT_BATCHES = "numInputBatches"
+NUM_ROW_GROUPS = "numRowGroups"
+NUM_ROW_GROUPS_PRUNED = "numRowGroupsPruned"
+READ_BYTES = "readBytes"
 OP_TIME = "opTime"
 SORT_TIME = "sortTime"
 AGG_TIME = "aggTime"

@@ -180,6 +180,10 @@ def test_scan_coalesces_small_row_groups(session, tmp_path):
     t = _t(100)
     path = str(tmp_path / "rg.parquet")
     pq.write_table(t, path, row_group_size=10)
+    # PERFILE: no host-side coalescing, so the device coalesce node is
+    # what merges the 10 per-row-group batches
+    session = TpuSession(
+        {"spark.rapids.sql.format.parquet.reader.type": "PERFILE"})
     df = session.read_parquet(path)
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: s.read_parquet(path).filter(col("i") > lit(0)),
@@ -202,3 +206,119 @@ def test_scan_coalesces_small_row_groups(session, tmp_path):
         out = list(co[0].execute_partition(tctx, 0))
     assert len(out) == 1
     assert co[0].metrics.metric("numInputBatches").value >= 10
+
+
+def _rg_metrics(session):
+    m = session.last_metrics()
+    scan = next(v for k, v in m.items() if k.startswith("ParquetScanExec"))
+    return scan.get("numRowGroups", 0), scan.get("numRowGroupsPruned", 0)
+
+
+def test_parquet_row_group_pruning(session, tmp_path):
+    # A sorted column gives disjoint per-row-group [min,max] ranges; a
+    # selective filter must skip the refuted groups by footer stats alone
+    # (GpuParquetScan.scala filterBlocks analog) and still agree with the
+    # CPU baseline exactly.
+    import pyarrow.parquet as pq
+    n = 200
+    t = pa.table({
+        "i": pa.array(np.arange(n).astype(np.int64)),
+        "s": pa.array([f"key{j:04d}" for j in range(n)]),
+        "f": pa.array(np.linspace(-5.0, 5.0, n)),
+    })
+    path = str(tmp_path / "sorted.parquet")
+    pq.write_table(t, path, row_group_size=20)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path).filter(col("i") >= lit(150)),
+        session, ignore_order=True)
+    total, pruned = _rg_metrics(session)
+    assert total == 10 and pruned == 7  # groups 0..6 statically refuted
+
+    # conjunction narrows to one group; projection renames still push
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path)
+        .select(col("i").alias("j"), col("f"))
+        .filter((col("j") >= lit(40)) & (col("j") < lit(60))),
+        session, ignore_order=True)
+    total, pruned = _rg_metrics(session)
+    assert (total, pruned) == (10, 9)
+
+    # string stats prune too
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path).filter(col("s") == lit("key0105")),
+        session, ignore_order=True)
+    total, pruned = _rg_metrics(session)
+    assert (total, pruned) == (10, 9)
+
+    # disjunction keeps the union of candidate groups
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path)
+        .filter((col("i") < lit(20)) | (col("i") >= lit(180))),
+        session, ignore_order=True)
+    total, pruned = _rg_metrics(session)
+    assert (total, pruned) == (10, 8)
+
+
+def test_parquet_pruning_nulls_and_unpushable(session, tmp_path):
+    import pyarrow.parquet as pq
+    t = pa.table({
+        "a": pa.array([1, 2, 3, 4] * 5 + [None] * 20, pa.int64()),
+        "b": pa.array(list(range(40)), pa.int64()),
+    })
+    path = str(tmp_path / "nulls.parquet")
+    pq.write_table(t, path, row_group_size=20)
+    # IS NULL refutes the null-free first group
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path).filter(F.isnull(col("a"))),
+        session, ignore_order=True)
+    total, pruned = _rg_metrics(session)
+    assert (total, pruned) == (2, 1)
+    # IS NOT NULL refutes the all-null second group
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path).filter(~F.isnull(col("a"))),
+        session, ignore_order=True)
+    total, pruned = _rg_metrics(session)
+    assert (total, pruned) == (2, 1)
+    # an unpushable predicate (arithmetic) reads everything, correctly
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path).filter((col("b") % lit(7)) == lit(0)),
+        session, ignore_order=True)
+    total, pruned = _rg_metrics(session)
+    assert (total, pruned) == (2, 0)
+
+
+def test_parquet_partition_file_pruning(session, tmp_path):
+    # hive-layout partition values prune whole files before any footer read
+    path = str(tmp_path / "pt")
+    t = _t(60)
+    session.create_dataframe(t).write.partition_by("k").parquet(path)
+    df = session.read_parquet(path).filter(col("k") == lit("b"))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read_parquet(path).filter(col("k") == lit("b")),
+        session, ignore_order=True)
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    root, _ = convert_plan(df.plan, session.conf)
+    def find(e):
+        if isinstance(e, X.ParquetScanExec):
+            return e
+        for c in e.children:
+            r = find(c)
+            if r is not None:
+                return r
+    scan = find(root)
+    assert scan is not None
+    assert len(scan._kept_files) < len(scan.plan.paths)
+
+
+@pytest.mark.parametrize("mode", ["PERFILE", "MULTITHREADED", "COALESCING"])
+def test_parquet_reader_strategies(tmp_path, mode):
+    import pyarrow.parquet as pq
+    s = TpuSession({"spark.rapids.sql.format.parquet.reader.type": mode})
+    t = _t(120)
+    path = str(tmp_path / "modes.parquet")
+    pq.write_table(t, path, row_group_size=10)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: ss.read_parquet(path).filter(col("i") > lit(-50)),
+        s, ignore_order=True)
