@@ -483,7 +483,12 @@ def _agg_by_gid(a: NamedAgg, inp: Optional[CpuCol], gid: np.ndarray,
     if isinstance(rt, T.StringType):
         vals = res.to_numpy(dtype=object)
         return CpuCol(rt, vals, ~na)
-    filled = res.fillna(0).to_numpy(dtype=np.float64)
+    # extract without a float64 round trip — int64 sums/minima beyond 2^53
+    # must stay exact
+    if np.dtype(rt.np_dtype).kind in "iub":
+        filled = res.fillna(0).to_numpy(dtype=np.int64)
+    else:
+        filled = res.fillna(0).to_numpy(dtype=np.float64)
     return CpuCol(rt, filled.astype(rt.np_dtype), ~na)
 
 

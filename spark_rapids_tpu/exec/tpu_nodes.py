@@ -136,9 +136,13 @@ class ParquetScanExec(TpuExec):
         from spark_rapids_tpu.io.parquet_pruning import prune_partition_file
         pv = self.plan.partition_values
         paths = list(self.plan.paths)
-        if pv and self.plan.pushed_filters:
+        # snapshot: a later wrap_and_tag/explain of a sibling plan sharing
+        # this scan object must not rewrite the filters under a
+        # converted exec
+        self._pushed = list(self.plan.pushed_filters)
+        if pv and self._pushed:
             kept = [i for i in range(len(paths)) if prune_partition_file(
-                pv[i], self.plan.schema, self.plan.pushed_filters)]
+                pv[i], self.plan.schema, self._pushed)]
         else:
             kept = list(range(len(paths)))
         self._kept_files = kept
@@ -166,7 +170,7 @@ class ParquetScanExec(TpuExec):
             else self.conf.get(C.MULTIFILE_READER_THREADS)
 
         metadata = pq.ParquetFile(path).metadata
-        groups, total = prune_row_groups(metadata, self.plan.pushed_filters)
+        groups, total = prune_row_groups(metadata, self._pushed)
         rg_total.add(total)
         rg_pruned.add(total - len(groups))
         for g in groups:

@@ -175,6 +175,18 @@ class Expression:
     def cast(self, dtype): return Cast(self, dtype)
     def isin(self, *vals): return In(self, [_wrap(v) for v in vals])
 
+    # Sort-order sugar (Spark Column.asc/desc family).
+    def _order(self, ascending, nulls_first=None):
+        from spark_rapids_tpu.plan.nodes import SortOrder
+        return SortOrder(self, ascending, nulls_first)
+
+    def asc(self): return self._order(True)
+    def desc(self): return self._order(False)
+    def asc_nulls_first(self): return self._order(True, True)
+    def asc_nulls_last(self): return self._order(True, False)
+    def desc_nulls_first(self): return self._order(False, True)
+    def desc_nulls_last(self): return self._order(False, False)
+
 
 def _wrap(v) -> Expression:
     return v if isinstance(v, Expression) else Literal.infer(v)

@@ -36,7 +36,12 @@ class _ColStats:
 def _normalize(v):
     """Bring a stats/literal value into a directly comparable python form."""
     if isinstance(v, datetime.datetime):
-        return ("ts", v.replace(tzinfo=None))
+        # naive means UTC (Literal._scalar convention); tz-aware converts
+        # to UTC first — stripping tzinfo directly would compare wall-clock
+        # in the literal's zone against UTC footer stats
+        if v.tzinfo is not None:
+            v = v.astimezone(datetime.timezone.utc).replace(tzinfo=None)
+        return ("ts", v)
     if isinstance(v, datetime.date):
         return ("date", v)
     if isinstance(v, bool):
@@ -163,7 +168,13 @@ def _group_stats(md_rg) -> Dict[str, _ColStats]:
     out: Dict[str, _ColStats] = {}
     for ci in range(md_rg.num_columns):
         col = md_rg.column(ci)
-        name = col.path_in_schema.split(".")[0]
+        name = col.path_in_schema
+        if "." in name:
+            # nested leaf (struct field / list element): its value-level
+            # stats do not describe the root column's rows — attributing
+            # them to the root makes IsNull/IsNotNull pruning unsound.
+            # Unknown columns keep the group (the module's contract).
+            continue
         st = col.statistics
         if st is None:
             out[name] = _ColStats(None, None, None, None)

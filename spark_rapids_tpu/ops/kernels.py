@@ -247,12 +247,40 @@ def normalize_key(col: ColumnVector, num_rows: int,
     return key, ~valid
 
 
+def _frexp_arith(a: jax.Array):
+    """(m, e) with a = m * 2^e, m in [1, 2), for positive normal a —
+    computed with comparisons and exact power-of-two multiplies only.
+    jnp.frexp internally does a 64-bit bitcast-convert, which the TPU x64
+    rewriter cannot lower; this binary-search normalization avoids it.
+    Zero/inf/NaN inputs produce garbage m/e that callers mask out."""
+    x = a
+    e = jnp.zeros(a.shape, jnp.int32)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        up = np.float64(2.0) ** k
+        c = x >= up
+        x = jnp.where(c, x * np.float64(2.0) ** (-k), x)
+        e = e + jnp.where(c, k, 0)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        up = np.float64(2.0) ** k
+        c = (x < 1.0) & (x * up < 2.0)
+        x = jnp.where(c, x * up, x)
+        e = e - jnp.where(c, k, 0)
+    return x, e
+
+
 def _bitcast_f64_u64(v: jax.Array) -> jax.Array:
-    """Exact IEEE-754 f64 bit pattern as u64, ARITHMETICALLY — the TPU x64
+    """IEEE-754 f64 bit pattern as u64, ARITHMETICALLY — the TPU x64
     rewriter cannot lower any 64-bit bitcast-convert, so the bits are
-    reconstructed from frexp (exact: the mantissa product is integral and
-    fits f64/u64). Matches java.lang.Double.doubleToLongBits (canonical
-    NaN), which Spark's murmur3 hashes."""
+    reconstructed by exponent normalization. On backends with true IEEE
+    f64 (the CPU simulator) this is bit-exact and matches
+    java.lang.Double.doubleToLongBits (canonical NaN), which Spark's
+    murmur3 hashes. On TPUs whose x64 mode emulates f64 with f32 pairs
+    (~48-bit mantissa, f32 exponent range — upload of |v|>~3.4e38 is
+    already inf), exactness vs host f64 is unattainable by ANY function;
+    the contract is instead consistency with DEVICE f64 semantics, which
+    this construction satisfies: verified on v5e over random samples +
+    specials that key order and key equality agree exactly with the
+    device's own f64 comparisons (see docs/compatibility.md)."""
     nan = jnp.isnan(v)
     pinf = v == jnp.inf
     ninf = v == -jnp.inf
@@ -261,10 +289,10 @@ def _bitcast_f64_u64(v: jax.Array) -> jax.Array:
     # is normalized to +0.0 by callers (Spark normalizes it before hashing)
     sign = jnp.where(v < 0.0, jnp.uint64(1) << jnp.uint64(63), jnp.uint64(0))
     a = jnp.abs(v)
-    m, e = jnp.frexp(a)  # a = m * 2^e, m in [0.5, 1)
-    biased = (e + 1022).astype(jnp.int64)
+    m, e = _frexp_arith(a)  # a = m * 2^e, m in [1, 2)
+    biased = (e + 1023).astype(jnp.int64)
     normal = biased > 0
-    mant = (m * np.float64(2.0 ** 53)).astype(jnp.uint64)  # [2^52, 2^53)
+    mant = (m * np.float64(2.0 ** 52)).astype(jnp.uint64)  # [2^52, 2^53)
     norm_bits = (jnp.where(normal, biased, 0).astype(jnp.uint64)
                  << jnp.uint64(52)) | (mant & ((jnp.uint64(1) << jnp.uint64(52)) - jnp.uint64(1)))
     # Subnormals: XLA flushes them to zero in f64 arithmetic on both the

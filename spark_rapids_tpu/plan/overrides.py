@@ -607,15 +607,13 @@ class SparkPlanMeta:
 _PUSHABLE_LEAVES = (E.BoundRef, E.Literal)
 
 
-def _as_pushed(e: E.Expression, rename: Dict[str, str]) -> Optional[E.Expression]:
+def _as_pushed(e: E.Expression) -> Optional[E.Expression]:
     """Copy a conjunct into the pushdown-supported shape (comparisons,
-    In, IsNull/IsNotNull, And/Or over column refs + literals), applying
-    projection renames. None = not pushable."""
+    In, IsNull/IsNotNull, And/Or over column refs + literals). None = not
+    pushable. Projection renames are applied separately by _rename_refs
+    as the pushdown walk descends."""
     if isinstance(e, E.BoundRef):
-        name = rename.get(e.name) if rename else e.name
-        if name is None:
-            return None
-        return E.BoundRef(e.index, e.data_type(), name)
+        return E.BoundRef(e.index, e.data_type(), e.name)
     if isinstance(e, E.Literal):
         return e
     if isinstance(e, E.Not):
@@ -623,72 +621,94 @@ def _as_pushed(e: E.Expression, rename: Dict[str, str]) -> Optional[E.Expression
         # an interval comparison is unsound under three-valued logic)
         c = e.children[0]
         if isinstance(c, E.IsNull):
-            return _as_pushed(E.IsNotNull(c.children[0]), rename)
+            return _as_pushed(E.IsNotNull(c.children[0]))
         if isinstance(c, E.IsNotNull):
-            return _as_pushed(E.IsNull(c.children[0]), rename)
+            return _as_pushed(E.IsNull(c.children[0]))
         return None
     if isinstance(e, (E.And, E.Or, E.EqualTo, E.LessThan, E.LessThanOrEqual,
                       E.GreaterThan, E.GreaterThanOrEqual, E.In,
                       E.IsNull, E.IsNotNull)):
-        kids = [_as_pushed(c, rename) for c in e.children]
+        kids = [_as_pushed(c) for c in e.children]
         if any(k is None for k in kids):
             return None
         return e.with_children(kids)
     return None
 
 
+def _rename_refs(e: E.Expression, nmap: Dict[str, str]) -> Optional[E.Expression]:
+    """Rewrite column refs through a projection's output->input name map;
+    None when any ref does not map (computed column)."""
+    if isinstance(e, E.BoundRef):
+        t = nmap.get(e.name)
+        if t is None:
+            return None
+        return E.BoundRef(e.index, e.data_type(), t)
+    if not e.children:
+        return e
+    kids = [_rename_refs(c, nmap) for c in e.children]
+    if any(k is None for k in kids):
+        return None
+    return e.with_children(kids)
+
+
 def push_down_scan_filters(plan: P.PlanNode) -> None:
     """Populate ParquetScan.pushed_filters from enclosing Filter nodes
     (reference: ParquetFilters / GpuParquetScan pushedFilters). Filters
     stay in the plan — pruning is a conservative row-group/file skip, the
-    exact predicate still runs on device. Idempotent: pushed lists are
-    reassigned, not extended, so explain + collect don't double-push."""
+    exact predicate still runs on device.
+
+    Per-PATH collection: conjuncts accumulate walking top-down through
+    Filter/Project chains; a scan object reachable from several branches
+    of one plan (union/self-join of differently-filtered views over one
+    DataFrame) gets the OR of the branch conjunctions — conjoining them
+    would statically refute row groups each branch still needs. A branch
+    reaching the scan with no predicate disables pruning entirely.
+    Idempotent: pushed lists are reassigned, not extended."""
+    from functools import reduce
     from spark_rapids_tpu.io.parquet_pruning import split_conjuncts
 
-    pushed: Dict[int, List[E.Expression]] = {}
+    arrivals: Dict[int, List[List[E.Expression]]] = {}
+    scans: Dict[int, P.ParquetScan] = {}
 
-    def visit(node: P.PlanNode) -> None:
-        for c in node.children:
-            visit(c)
-        if not isinstance(node, P.Filter):
-            return
-        rename: Dict[str, str] = {}
-        cur = node.children[0]
-        while True:
-            if isinstance(cur, P.Filter):
-                cur = cur.children[0]
-                continue
-            if isinstance(cur, P.Project):
-                nmap: Dict[str, str] = {}
-                for name, ex in zip(cur.names, cur.exprs):
-                    inner = ex.children[0] if isinstance(ex, E.Alias) else ex
-                    if isinstance(inner, E.BoundRef):
-                        nmap[name] = inner.name
-                if len(nmap) != len(cur.names):
-                    return  # computed projection: stop the walk
-                if rename:
-                    # compose: condition-name -> this project's input name
-                    rename = {k: nmap.get(v) for k, v in rename.items()}
-                else:
-                    rename = dict(nmap)
-                cur = cur.children[0]
-                continue
-            break
-        if isinstance(cur, P.ParquetScan):
-            dest = pushed.setdefault(id(cur), [])
+    def walk(node: P.PlanNode, conjs: List[E.Expression]) -> None:
+        if isinstance(node, P.Filter):
+            add = []
             for conj in split_conjuncts(node.condition):
-                p = _as_pushed(conj, rename)
+                p = _as_pushed(conj)
                 if p is not None:
-                    dest.append(p)
-
-    def assign(node: P.PlanNode) -> None:
-        for c in node.children:
-            assign(c)
+                    add.append(p)
+            walk(node.children[0], conjs + add)
+            return
+        if isinstance(node, P.Project):
+            nmap: Dict[str, str] = {}
+            for name, ex in zip(node.names, node.exprs):
+                inner = ex.children[0] if isinstance(ex, E.Alias) else ex
+                if isinstance(inner, E.BoundRef):
+                    nmap[name] = inner.name
+            renamed = []
+            for c in conjs:
+                r = _rename_refs(c, nmap)
+                if r is not None:
+                    renamed.append(r)
+            walk(node.children[0], renamed)
+            return
         if isinstance(node, P.ParquetScan):
-            node.pushed_filters = pushed.get(id(node), [])
+            arrivals.setdefault(id(node), []).append(conjs)
+            scans[id(node)] = node
+            return
+        for c in node.children:
+            walk(c, [])
 
-    visit(plan)
-    assign(plan)
+    walk(plan, [])
+    for sid, paths in arrivals.items():
+        scan = scans[sid]
+        if any(not p for p in paths):
+            scan.pushed_filters = []
+        elif len(paths) == 1:
+            scan.pushed_filters = list(paths[0])
+        else:
+            ands = [reduce(E.And, p) for p in paths]
+            scan.pushed_filters = [reduce(E.Or, ands)]
 
 
 def wrap_and_tag(plan: P.PlanNode, conf) -> SparkPlanMeta:

@@ -260,6 +260,34 @@ def test_parquet_row_group_pruning(session, tmp_path):
     assert (total, pruned) == (10, 8)
 
 
+def test_parquet_pruning_shared_scan_branches(session, tmp_path):
+    # One ParquetScan object consumed by two differently-filtered branches
+    # (union of views over the same DataFrame): the branch predicates must
+    # NOT conjoin — that statically refutes groups each branch needs.
+    # Regression: pushdown keyed by id(scan) used to merge both branches.
+    import pyarrow.parquet as pq
+    n = 200
+    t = pa.table({"i": pa.array(np.arange(n).astype(np.int64))})
+    path = str(tmp_path / "shared.parquet")
+    pq.write_table(t, path, row_group_size=20)
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: (lambda df: df.filter(col("i") >= lit(150))
+                   .union(df.filter(col("i") < lit(20))))(s.read_parquet(path)),
+        session, ignore_order=True)
+    # the OR of the branches still prunes the middle groups
+    total, pruned = _rg_metrics(session)
+    assert total >= 10 and pruned >= total // 2
+
+    # a branch with no filter at all disables pruning for the shared scan
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: (lambda df: df.filter(col("i") >= lit(150)).union(df))(
+            s.read_parquet(path)),
+        session, ignore_order=True)
+    total, pruned = _rg_metrics(session)
+    assert pruned == 0
+
+
 def test_parquet_pruning_nulls_and_unpushable(session, tmp_path):
     import pyarrow.parquet as pq
     t = pa.table({
