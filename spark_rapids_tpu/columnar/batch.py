@@ -182,6 +182,8 @@ class ColumnVector:
         if isinstance(self.data, dict):
             if "codes" in self.data:
                 return int(self.data["codes"].shape[0])
+            if "children" in self.data:  # struct: first child's capacity
+                return self.data["children"][0].capacity
             return int(self.data["offsets"].shape[0]) - 1
         return int(self.data.shape[0])
 
@@ -192,6 +194,10 @@ class ColumnVector:
     @property
     def is_dict(self) -> bool:
         return isinstance(self.data, dict) and "codes" in self.data
+
+    @property
+    def is_nested(self) -> bool:
+        return isinstance(self.dtype, (T.ArrayType, T.StructType, T.MapType))
 
     @property
     def dict_size(self) -> int:
@@ -206,6 +212,10 @@ class ColumnVector:
 
     def device_memory_size(self) -> int:
         def sz(a):
+            if isinstance(a, ColumnVector):
+                return a.device_memory_size()
+            if isinstance(a, (list, tuple)):
+                return sum(sz(x) for x in a)
             return int(np.prod(a.shape)) * a.dtype.itemsize
         total = 0
         if isinstance(self.data, dict):
@@ -282,6 +292,13 @@ def _fixed_width_view(arr, np_dtype) -> np.ndarray:
     return out if out.dtype == np_dtype else out.astype(np_dtype)
 
 
+def _pad_offsets(offsets_np: np.ndarray, n: int, capacity: int) -> np.ndarray:
+    out = np.full(capacity + 1, offsets_np[n] if n < len(offsets_np)
+                  else offsets_np[-1], dtype=np.int32)
+    out[: n + 1] = offsets_np[: n + 1]
+    return out
+
+
 def column_from_arrow(arr, dtype: T.DataType, capacity: int) -> ColumnVector:
     """Build a device ColumnVector from a pyarrow Array (one chunk)."""
     import pyarrow as pa
@@ -289,6 +306,45 @@ def column_from_arrow(arr, dtype: T.DataType, capacity: int) -> ColumnVector:
 
     n = len(arr)
     valid_np = _np_valid_from_arrow(arr)
+
+    if isinstance(dtype, T.ArrayType):
+        arr = _normalize_null_slices(arr, pa.list_(T.to_arrow(dtype.element)))
+        off = np.asarray(arr.offsets, dtype=np.int64)
+        base = int(off[0])
+        values = arr.values[base: int(off[-1])]
+        offsets_np = (off - base).astype(np.int32)
+        child_cap = round_capacity(max(len(values), 1))
+        child = column_from_arrow(values, dtype.element, child_cap)
+        data = {"offsets": jnp.asarray(_pad_offsets(offsets_np, n, capacity)),
+                "child": child}
+        validity = None if valid_np is None else jnp.asarray(
+            _pad_to(valid_np.astype(np.bool_), capacity, fill=False))
+        return ColumnVector(dtype, data, validity)
+
+    if isinstance(dtype, T.MapType):
+        arr = _normalize_null_slices(
+            arr, pa.map_(T.to_arrow(dtype.key), T.to_arrow(dtype.value)))
+        off = np.asarray(arr.offsets, dtype=np.int64)
+        base = int(off[0])
+        keys = arr.keys[base: int(off[-1])]
+        items = arr.items[base: int(off[-1])]
+        offsets_np = (off - base).astype(np.int32)
+        child_cap = round_capacity(max(len(keys), 1))
+        data = {"offsets": jnp.asarray(_pad_offsets(offsets_np, n, capacity)),
+                "keys": column_from_arrow(keys, dtype.key, child_cap),
+                "values": column_from_arrow(items, dtype.value, child_cap)}
+        validity = None if valid_np is None else jnp.asarray(
+            _pad_to(valid_np.astype(np.bool_), capacity, fill=False))
+        return ColumnVector(dtype, data, validity)
+
+    if isinstance(dtype, T.StructType):
+        if not dtype.fields:
+            raise TypeError("empty struct columns are not supported")
+        kids = [column_from_arrow(arr.field(i), f.dtype, capacity)
+                for i, f in enumerate(dtype.fields)]
+        validity = None if valid_np is None else jnp.asarray(
+            _pad_to(valid_np.astype(np.bool_), capacity, fill=False))
+        return ColumnVector(dtype, {"children": kids}, validity)
 
     if isinstance(dtype, T.StringType):
         if pa.types.is_dictionary(arr.type):
@@ -389,6 +445,74 @@ def from_arrow(table) -> ColumnarBatch:
     return ColumnarBatch(cols, n)
 
 
+def _normalize_null_slices(arr, target_type):
+    """Cast a list/map array to the canonical layout and ensure null rows
+    own empty slices (so child planes carry no garbage elements). Arrow
+    permits null entries with non-empty ranges; the device layout does not."""
+    import pyarrow as pa
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if arr.type != target_type:
+        arr = arr.cast(target_type)
+    if arr.null_count:
+        off = np.asarray(arr.offsets, dtype=np.int64)
+        lengths = np.diff(off)
+        valid = np.asarray(arr.is_valid())
+        if (lengths[: len(valid)][~valid] != 0).any():
+            # pa.array rebuilds with zero-length slices under null entries
+            arr = pa.array(arr.to_pylist(), type=target_type)
+    return arr
+
+
+def _leaf_to_py(col: ColumnVector, vals, valid, i: int):
+    """One leaf value as an arrow-acceptable python object."""
+    if valid is not None and not valid[i]:
+        return None
+    v = vals[i]
+    if isinstance(col.dtype, T.DecimalType):
+        import decimal
+        return decimal.Decimal(int(v)).scaleb(-col.dtype.scale)
+    if isinstance(col.dtype, T.TimestampType):
+        return int(v)
+    if isinstance(col.dtype, T.DateType):
+        return int(v)
+    if isinstance(v, (np.generic,)):
+        return v.item()
+    return v
+
+
+def column_to_pylist(col: ColumnVector, n: int) -> list:
+    """Host materialization of the first n rows of a (possibly nested)
+    column as python values (None = null). Planes must already be host
+    arrays or cheap to fetch."""
+    if isinstance(col.dtype, T.ArrayType):
+        off = np.asarray(col.data["offsets"])
+        child_vals = column_to_pylist(col.data["child"], int(off[n]))
+        valid = None if col.validity is None else np.asarray(col.validity)
+        return [None if (valid is not None and not valid[i])
+                else child_vals[off[i]: off[i + 1]] for i in range(n)]
+    if isinstance(col.dtype, T.MapType):
+        off = np.asarray(col.data["offsets"])
+        keys = column_to_pylist(col.data["keys"], int(off[n]))
+        vals = column_to_pylist(col.data["values"], int(off[n]))
+        valid = None if col.validity is None else np.asarray(col.validity)
+        return [None if (valid is not None and not valid[i])
+                else list(zip(keys[off[i]: off[i + 1]],
+                              vals[off[i]: off[i + 1]]))
+                for i in range(n)]
+    if isinstance(col.dtype, T.StructType):
+        kids = [column_to_pylist(ch, n) for ch in col.data["children"]]
+        names = [f.name for f in col.dtype.fields]
+        valid = None if col.validity is None else np.asarray(col.validity)
+        return [None if (valid is not None and not valid[i])
+                else {nm: kid[i] for nm, kid in zip(names, kids)}
+                for i in range(n)]
+    vals, valid = column_to_numpy(col, n)
+    if col.is_string:
+        return vals
+    return [_leaf_to_py(col, vals, valid, i) for i in range(n)]
+
+
 def column_to_numpy(col: ColumnVector, num_rows: int, sel=None):
     """Device -> host materialization of one column as (values, validity).
     sel: optional host int array of live row positions (selection-mask
@@ -455,6 +579,13 @@ def to_arrow(batch: ColumnarBatch, names: Optional[Sequence[str]] = None):
     for i, col in enumerate(batch.columns):
         name = names[i] if names else f"c{i}"
         at = T.to_arrow(col.dtype)
+        if col.is_nested:
+            # sel holds raw capacity positions; materialize up to capacity
+            full = column_to_pylist(col, col.capacity if sel is not None else n)
+            vals = [full[i] for i in sel] if sel is not None else full
+            arrays.append(pa.array(vals, type=at))
+            fields.append(pa.field(name, at))
+            continue
         vals, valid = column_to_numpy(col, n, sel)
         if col.is_string:
             arr = pa.array(vals, type=at)
@@ -508,6 +639,15 @@ def _cv_flatten(c: ColumnVector):
             return ((c.data["codes"], c.data["dict_offsets"],
                      c.data["dict_bytes"], c.validity),
                     ("dict", c.dtype, c.dict_unique))
+        if "child" in c.data:  # array: offsets + nested child CV
+            return ((c.data["offsets"], c.data["child"], c.validity),
+                    ("array", c.dtype))
+        if "keys" in c.data:  # map: offsets + key/value child CVs
+            return ((c.data["offsets"], c.data["keys"], c.data["values"],
+                     c.validity), ("map", c.dtype))
+        if "children" in c.data:  # struct: per-field child CVs
+            return ((tuple(c.data["children"]), c.validity),
+                    ("struct", c.dtype))
         return (c.data["offsets"], c.data["bytes"], c.validity), ("str", c.dtype)
     return (c.data, c.validity), ("fixed", c.dtype)
 
@@ -519,6 +659,16 @@ def _cv_unflatten(aux, children):
         return ColumnVector(dtype, {"codes": codes, "dict_offsets": doff,
                                     "dict_bytes": dby}, validity,
                             dict_unique=aux[2])
+    if kind == "array":
+        off, child, validity = children
+        return ColumnVector(dtype, {"offsets": off, "child": child}, validity)
+    if kind == "map":
+        off, keys, values, validity = children
+        return ColumnVector(dtype, {"offsets": off, "keys": keys,
+                                    "values": values}, validity)
+    if kind == "struct":
+        kids, validity = children
+        return ColumnVector(dtype, {"children": list(kids)}, validity)
     if kind == "str":
         off, by, validity = children
         return ColumnVector(dtype, {"offsets": off, "bytes": by}, validity)

@@ -45,9 +45,20 @@ def _col_from_planes(planes, dtype: T.DataType) -> ColumnVector:
 
 def _layout_key(col: ColumnVector):
     if isinstance(col.data, dict):
-        kind = "dict" if "codes" in col.data else "str"
-        return (kind,) + tuple(sorted((k, v.shape) for k, v in col.data.items())) \
-            + (col.validity is None,)
+        kind = ("dict" if "codes" in col.data else
+                "arr" if "child" in col.data else
+                "map" if "keys" in col.data else
+                "struct" if "children" in col.data else "str")
+        parts = []
+        for k in sorted(col.data):
+            v = col.data[k]
+            if isinstance(v, ColumnVector):
+                parts.append((k, _layout_key(v)))
+            elif isinstance(v, list):
+                parts.append((k, tuple(_layout_key(x) for x in v)))
+            else:
+                parts.append((k, v.shape))
+        return (kind,) + tuple(parts) + (col.validity is None,)
     return (str(col.data.dtype), col.data.shape, col.validity is None)
 
 

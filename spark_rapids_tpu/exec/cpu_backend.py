@@ -37,8 +37,10 @@ def table_to_cols(table: pa.Table) -> List[CpuCol]:
         arr = table.column(i).combine_chunks()
         valid = np.ones(len(arr), np.bool_) if arr.null_count == 0 \
             else np.asarray(arr.is_valid())
-        if isinstance(dtype, T.StringType):
-            vals = np.array(arr.to_pylist(), object)
+        if isinstance(dtype, (T.StringType, T.ArrayType, T.StructType,
+                              T.MapType)):
+            vals = np.empty(len(arr), object)
+            vals[:] = arr.to_pylist()
         elif isinstance(dtype, T.DecimalType):
             vals = np.array([0 if v is None else int(v.scaleb(dtype.scale))
                              for v in arr.to_pylist()], np.int64)
@@ -66,6 +68,9 @@ def cols_to_table(cols: List[CpuCol], names: List[str]) -> pa.Table:
             vals = [v if (ok and isinstance(v, str)) else None
                     for v, ok in zip(c.values, c.valid)]
             arr = pa.array(vals, type=at)
+        elif isinstance(c.dtype, (T.ArrayType, T.StructType, T.MapType)):
+            vals = [v if ok else None for v, ok in zip(c.values, c.valid)]
+            arr = pa.array(vals, type=at)
         elif isinstance(c.dtype, T.NullType):
             arr = pa.nulls(len(c.values), type=at)
         elif isinstance(c.dtype, T.DecimalType):
@@ -91,13 +96,15 @@ def _gather_cols(cols: List[CpuCol], idx: np.ndarray) -> List[CpuCol]:
     oob = idx < 0
     safe = np.where(oob, 0, idx)
     for c in cols:
+        is_obj = isinstance(c.dtype, (T.StringType, T.ArrayType,
+                                      T.StructType, T.MapType))
         if len(c.values) == 0:
-            np_dt = object if isinstance(c.dtype, T.StringType) else c.dtype.np_dtype
+            np_dt = object if is_obj else c.dtype.np_dtype
             out.append(CpuCol(c.dtype, np.zeros(len(idx), np_dt),
                               np.zeros(len(idx), np.bool_)))
             continue
         vals = c.values[safe]
-        if isinstance(c.dtype, T.StringType):
+        if is_obj:
             vals = vals.copy()
             vals[oob] = None
         valid = c.valid[safe] & ~oob
@@ -130,8 +137,41 @@ def _norm_key_np(c: CpuCol, shared_dict: Optional[dict] = None
         neg = (bits >> np.uint64(63)) != 0
         key = np.where(neg, ~bits, bits | np.uint64(1 << 63))
         return np.where(nulls, np.uint64(0), key), nulls
+    if isinstance(c.dtype, (T.ArrayType, T.StructType)):
+        # Spark nested ordering: lexicographic, null element first, NaN
+        # greatest. Rank rows by a recursive tuple encoding.
+        keys = [(_encode_sortable(v, c.dtype) if ok else ())
+                for v, ok in zip(c.values, c.valid)]
+        order = sorted(range(len(keys)), key=lambda i: keys[i])
+        ranks = np.zeros(len(keys), np.uint64)
+        for pos, idx in enumerate(order):
+            ranks[idx] = pos
+        return np.where(nulls, np.uint64(0), ranks), nulls
+    if isinstance(c.dtype, T.MapType):
+        from spark_rapids_tpu.expr.core import SparkException
+        raise SparkException("map type cannot be used in ORDER BY or "
+                             "grouping keys")
     key = c.values.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
     return np.where(nulls, np.uint64(0), key), nulls
+
+
+def _encode_sortable(v, dt: T.DataType):
+    """Recursive tuple encoding whose python ordering matches Spark's
+    nested-type ordering (element null-first, NaN greatest)."""
+    if isinstance(dt, T.ArrayType):
+        return tuple((0,) if x is None else (1, _encode_sortable(x, dt.element))
+                     for x in v)
+    if isinstance(dt, T.StructType):
+        return tuple(
+            (0,) if v.get(f.name) is None
+            else (1, _encode_sortable(v[f.name], f.dtype))
+            for f in dt.fields)
+    if isinstance(dt, (T.Float32Type, T.Float64Type)):
+        fv = float(v)
+        if fv != fv:
+            return (2, 0.0)
+        return (1, 0.0 + fv)  # -0.0 -> +0.0 for total-order ties
+    return (1, v)
 
 
 def _shared_string_dict(*cols: CpuCol) -> dict:
@@ -199,6 +239,8 @@ def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
         return _exec_window(plan, children[0], ansi)
     if isinstance(plan, P.Join):
         return _exec_join(plan, children[0], children[1], ansi)
+    if isinstance(plan, P.Generate):
+        return _exec_generate(plan, children[0], ansi)
     if isinstance(plan, P.Expand):
         child = children[0]
         parts = []
@@ -215,9 +257,55 @@ def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
 
 
 def _cast_vals(c: CpuCol, dt: T.DataType):
-    if isinstance(dt, T.StringType):
+    if isinstance(dt, (T.StringType, T.ArrayType, T.StructType, T.MapType)):
         return c.values
     return c.values.astype(dt.np_dtype)
+
+
+def _exec_generate(plan: "P.Generate", child: List[CpuCol], ansi: bool
+                   ) -> List[CpuCol]:
+    gen = plan.generator
+    src = gen.children[0].eval_cpu(child, ansi)
+    is_map = isinstance(gen.children[0].data_type(), T.MapType)
+    position = bool(getattr(gen, "position", False))
+    outer = bool(gen.outer)
+    parent_idx: List[int] = []
+    pos_vals: List[int] = []
+    gen_vals: List[list] = [[] for _ in plan.gen_fields]
+    g_off = 1 if position else 0
+    for i, (v, ok) in enumerate(zip(src.values, src.valid)):
+        items = v if (ok and v is not None) else None
+        if not items:
+            if outer:
+                parent_idx.append(i)
+                pos_vals.append(None)
+                for g in gen_vals:
+                    g.append(None)
+            continue
+        for j, el in enumerate(items):
+            parent_idx.append(i)
+            pos_vals.append(j)
+            if is_map:
+                k, val = el
+                gen_vals[g_off].append(k)
+                gen_vals[g_off + 1].append(val)
+            else:
+                gen_vals[g_off].append(el)
+    if position:
+        gen_vals[0] = pos_vals
+    out = _gather_cols([child[i] for i in plan.required],
+                       np.asarray(parent_idx, np.int64))
+    for (name, dt), vals in zip(plan.gen_fields, gen_vals):
+        ok = [v is not None for v in vals]
+        if isinstance(dt, (T.StringType, T.ArrayType, T.StructType, T.MapType)):
+            arr = np.empty(len(vals), object)
+            arr[:] = vals
+            out.append(CpuCol(dt, arr, np.asarray(ok, np.bool_)))
+        else:
+            np_vals = np.array([0 if v is None else v for v in vals],
+                               dt.np_dtype)
+            out.append(CpuCol(dt, np_vals, np.asarray(ok, np.bool_)))
+    return out
 
 
 def _exec_union(plan: P.Union, parts: List[List[CpuCol]]) -> List[CpuCol]:

@@ -29,7 +29,7 @@ from spark_rapids_tpu import config as C
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import (
     ColumnVector, ColumnarBatch, LazyRowCount, from_arrow, to_arrow,
-    round_capacity, traced_rows,
+    round_capacity, rows_int, traced_rows,
 )
 from spark_rapids_tpu.exec import compiled
 from spark_rapids_tpu.exec import cpu_backend as CPU
@@ -491,6 +491,100 @@ class ExpandExec(TpuExec):
                 exprs = [e if e.data_type() == dt else Cast(e, dt)
                          for e, dt in zip(proj, out_types)]
                 yield compiled.run_projection(exprs, batch)
+
+
+class GenerateExec(TpuExec):
+    """explode / posexplode over array and map columns, incl. _outer
+    (reference GpuGenerateExec.scala).
+
+    TPU-first: the output stays at the CHILD planes' static capacity — the
+    generated column IS the child planes (zero copy), parent columns gather
+    by an element->row segment map, and liveness is a selection mask
+    (elements of dead/null parent rows are masked, not compacted). The
+    outer variant emits a second masked batch carrying one null-generated
+    row per empty/null input instead of rebuilding offsets."""
+
+    def execute_partition(self, ctx, pidx):
+        op_t = self.metrics.metric(M.OP_TIME)
+        out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        gen = self.plan.generator
+        src = gen.children[0]
+        is_map = isinstance(src.data_type(), T.MapType)
+        position = bool(getattr(gen, "position", False))
+        outer = bool(gen.outer)
+
+        def build():
+            def fn(batch):
+                ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                               batch.capacity, False, live=batch.live_mask())
+                arr = src.eval_tpu(ectx)
+                cap = batch.capacity
+                off = arr.data["offsets"][: cap + 1]
+                kids = ([arr.data["keys"], arr.data["values"]] if is_map
+                        else [arr.data["child"]])
+                child_cap = kids[0].capacity
+                e = jnp.arange(child_cap, dtype=jnp.int32)
+                seg = jnp.clip(
+                    jnp.searchsorted(off, e, side="right").astype(jnp.int32) - 1,
+                    0, cap - 1)
+                live = batch.live_mask()
+                arr_valid = (arr.validity if arr.validity is not None
+                             else jnp.ones(cap, jnp.bool_))
+                elem_live = (e < off[cap]) & live[seg] & arr_valid[seg]
+                req = [batch.columns[i] for i in self.plan.required]
+                if not outer:
+                    parent = [K.gather_column(c, seg, batch.num_rows,
+                                              src_live=live)
+                              for c in req]
+                    gen_cols = []
+                    if position:
+                        pos = (e - off[seg]).astype(jnp.int32)
+                        gen_cols.append(ColumnVector(T.INT32, pos, None))
+                    gen_cols.extend(kids)
+                    n_live = jnp.sum(elem_live.astype(jnp.int32))
+                    return ColumnarBatch(parent + gen_cols, n_live, elem_live)
+                # OUTER: null/empty rows still emit one row, in input
+                # order. One order-preserving scatter builds a combined
+                # source map: output slot off[i]+empties_before(i)+j for
+                # element j of row i, slot off[i]+empties_before(i) for an
+                # empty row i.
+                out_cap = round_capacity(child_cap + cap)
+                empty = live & (~arr_valid | ((off[1:] - off[:-1]) == 0))
+                cume = (jnp.cumsum(empty.astype(jnp.int32))
+                        - empty.astype(jnp.int32))
+                src_row = jnp.full(out_cap, -1, jnp.int32)
+                src_elem = jnp.full(out_cap, -1, jnp.int32)
+                dest_e = jnp.where(elem_live, e + cume[seg], out_cap)
+                src_row = src_row.at[dest_e].set(seg, mode="drop")
+                src_elem = src_elem.at[dest_e].set(e, mode="drop")
+                i = jnp.arange(cap, dtype=jnp.int32)
+                dest_r = jnp.where(empty, off[:cap] + cume, out_cap)
+                src_row = src_row.at[dest_r].set(i, mode="drop")
+                live_out = src_row >= 0
+                parent = [K.gather_column(c, src_row, batch.num_rows,
+                                          src_live=live)
+                          for c in req]
+                gen_cols = []
+                if position:
+                    safe_row = jnp.clip(src_row, 0, cap - 1)
+                    pos = (src_elem - off[safe_row]).astype(jnp.int32)
+                    gen_cols.append(ColumnVector(T.INT32, pos,
+                                                 src_elem >= 0))
+                for k in kids:
+                    gen_cols.append(K.gather_column(k, src_elem, child_cap))
+                n_live = jnp.sum(live_out.astype(jnp.int32))
+                return ColumnarBatch(parent + gen_cols, n_live, live_out)
+            return fn
+
+        key = ("generate", src.fingerprint(), is_map, position, outer,
+               tuple(self.plan.required))
+        fn = fuse.fused(key, build)
+        for batch in self.children[0].execute_partition(ctx, pidx):
+            self._acquire(ctx)
+            with op_t.ns():
+                out = fn(batch)
+            out_rows.add(rows_int(out.num_rows))
+            yield out
 
 
 class CoalesceBatchesExec(TpuExec):

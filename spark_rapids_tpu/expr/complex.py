@@ -1,0 +1,470 @@
+"""Complex-type expressions: arrays, structs, maps.
+
+Reference parity: sql-plugin complexTypeExtractors.scala (GetArrayItem,
+GetStructField, GetMapValue, ElementAt), complexTypeCreator.scala
+(CreateArray), collectionOperations.scala (Size, ArrayContains,
+SortArray...), GpuGenerateExec.scala expressions (Explode/PosExplode
+markers live here; the exec is exec/tpu_nodes.GenerateExec).
+
+TPU-first design: nested columns are offsets+child-plane pytrees
+(columnar/batch.py). Extraction ops are segment gathers over static
+capacities; per-row element reductions (contains, map lookup) are
+scatter-min/any over an element->row segment map — no per-row loops, no
+dynamic shapes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector
+from spark_rapids_tpu.expr.core import (
+    CpuCol, EvalCtx, Expression, SparkException, _valid_of, _wrap,
+)
+
+
+def _offsets_view(col: ColumnVector):
+    cap = col.capacity
+    off = col.data["offsets"]
+    return off[:cap], off[1: cap + 1] - off[:cap]
+
+
+def _element_segments(off: jax.Array, cap: int, child_cap: int) -> jax.Array:
+    """Element index -> owning row index (elements past the last offset
+    clip to the final row; callers mask them via an in-range check)."""
+    e = jnp.arange(child_cap, dtype=jnp.int32)
+    seg = jnp.searchsorted(off, e, side="right").astype(jnp.int32) - 1
+    return jnp.clip(seg, 0, cap - 1)
+
+
+def _gather_child(child: ColumnVector, pos: jax.Array) -> ColumnVector:
+    from spark_rapids_tpu.ops import kernels as K
+    return K.gather_column(child, pos, child.capacity)
+
+
+def _cmp_child_to_row(child: ColumnVector, row_col: ColumnVector,
+                      seg: jax.Array, ctx: EvalCtx):
+    """Per-element equality between child[e] and row_col[seg[e]].
+    Returns (eq bool plane, both-valid bool plane) over child capacity."""
+    from spark_rapids_tpu.ops import kernels as K
+    row_at_e = K.gather_column(row_col, seg, row_col.capacity)
+    cv = (child.validity if child.validity is not None
+          else jnp.ones(child.capacity, jnp.bool_))
+    rv = (row_at_e.validity if row_at_e.validity is not None
+          else jnp.ones(child.capacity, jnp.bool_))
+    if isinstance(child.dtype, T.StringType):
+        from spark_rapids_tpu.expr.core import _string_eq_tpu
+        eq = _string_eq_tpu(child, row_at_e)
+    else:
+        l = child.data
+        r = row_at_e.data
+        out = T.common_type(child.dtype, row_at_e.dtype)
+        eq = (l.astype(out.np_dtype) == r.astype(out.np_dtype))
+    return eq, cv & rv
+
+
+class Size(Expression):
+    """size(array|map). Modern Spark semantics (legacySizeOfNull=false):
+    null input -> null."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        c = self.children[0].eval_tpu(ctx)
+        _, lens = _offsets_view(c)
+        return ColumnVector(T.INT32, lens.astype(jnp.int32), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        vals = np.array([len(v) if ok and v is not None else 0
+                         for v, ok in zip(c.values, c.valid)], np.int32)
+        return CpuCol(T.INT32, vals, c.valid.copy())
+
+
+class GetArrayItem(Expression):
+    """arr[i]: 0-based; null when out of bounds (ANSI: error)."""
+
+    def __init__(self, child: Expression, ordinal: Expression):
+        self.children = [child, _wrap(ordinal)]
+
+    def data_type(self):
+        return self.children[0].data_type().element
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        idx = self.children[1].eval_tpu(ctx)
+        start, lens = _offsets_view(arr)
+        child = arr.data["child"]
+        i = idx.data.astype(jnp.int32)
+        both = _valid_of(arr, ctx) & _valid_of(idx, ctx)
+        in_b = (i >= 0) & (i < lens)
+        if ctx.ansi:
+            ctx.add_error("ArrayIndexOutOfBounds", both & ~in_b)
+        ok = both & in_b
+        pos = jnp.where(ok, jnp.clip(start + i, 0, child.capacity - 1), -1)
+        out = _gather_child(child, pos)
+        return ColumnVector(out.dtype, out.data, out.validity,
+                            dict_unique=out.dict_unique)
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        idx = self.children[1].eval_cpu(cols, ansi)
+        return _extract_cpu(self.data_type(), arr, idx, base=0, ansi=ansi)
+
+
+class ElementAt(Expression):
+    """element_at(array, i): 1-based, negative counts from the end, index 0
+    always errors. element_at(map, key): value or null."""
+
+    def __init__(self, child: Expression, key: Expression):
+        self.children = [child, _wrap(key)]
+
+    def data_type(self):
+        dt = self.children[0].data_type()
+        if isinstance(dt, T.MapType):
+            return dt.value
+        return dt.element
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        c = self.children[0].eval_tpu(ctx)
+        if isinstance(c.dtype, T.MapType):
+            return _map_lookup_tpu(c, self.children[1].eval_tpu(ctx), ctx)
+        idx = self.children[1].eval_tpu(ctx)
+        start, lens = _offsets_view(c)
+        child = c.data["child"]
+        i = idx.data.astype(jnp.int32)
+        both = _valid_of(c, ctx) & _valid_of(idx, ctx)
+        ctx.add_error("ElementAtIndexZero", both & (i == 0))
+        eff = jnp.where(i > 0, i - 1, lens + i)
+        in_b = (eff >= 0) & (eff < lens)
+        if ctx.ansi:
+            ctx.add_error("ArrayIndexOutOfBounds", both & (i != 0) & ~in_b)
+        ok = both & in_b & (i != 0)
+        pos = jnp.where(ok, jnp.clip(start + eff, 0, child.capacity - 1), -1)
+        return _gather_child(child, pos)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        k = self.children[1].eval_cpu(cols, ansi)
+        if isinstance(self.children[0].data_type(), T.MapType):
+            return _map_lookup_cpu(self.data_type(), c, k)
+        out_v, out_ok = [], []
+        for (v, ok), (i, iok) in zip(zip(c.values, c.valid),
+                                     zip(k.values, k.valid)):
+            if not ok or not iok or v is None:
+                out_v.append(None)
+                out_ok.append(False)
+                continue
+            i = int(i)
+            if i == 0:
+                raise SparkException("SQL array indices start at 1")
+            eff = i - 1 if i > 0 else len(v) + i
+            if 0 <= eff < len(v):
+                out_v.append(v[eff])
+                out_ok.append(v[eff] is not None)
+            else:
+                if ansi:
+                    raise SparkException(
+                        f"Index {i} out of bounds for array of {len(v)}")
+                out_v.append(None)
+                out_ok.append(False)
+        return _leaf_cpu_col(self.data_type(), out_v, out_ok)
+
+
+def _extract_cpu(rt, arr: CpuCol, idx: CpuCol, base: int, ansi: bool):
+    out_v, out_ok = [], []
+    for (v, ok), (i, iok) in zip(zip(arr.values, arr.valid),
+                                 zip(idx.values, idx.valid)):
+        if not ok or not iok or v is None:
+            out_v.append(None)
+            out_ok.append(False)
+            continue
+        i = int(i) - base if base else int(i)
+        if 0 <= i < len(v):
+            out_v.append(v[i])
+            out_ok.append(v[i] is not None)
+        else:
+            if ansi:
+                raise SparkException(
+                    f"Index {i} out of bounds for array of {len(v)}")
+            out_v.append(None)
+            out_ok.append(False)
+    return _leaf_cpu_col(rt, out_v, out_ok)
+
+
+def _leaf_cpu_col(rt: T.DataType, vals: list, ok: list) -> CpuCol:
+    valid = np.asarray(ok, np.bool_)
+    if isinstance(rt, (T.StringType, T.ArrayType, T.StructType, T.MapType)):
+        return CpuCol(rt, np.array(vals, object), valid)
+    np_vals = np.array([0 if (v is None or not o) else v
+                        for v, o in zip(vals, ok)], rt.np_dtype)
+    return CpuCol(rt, np_vals, valid)
+
+
+def _map_lookup_tpu(m: ColumnVector, key: ColumnVector, ctx: EvalCtx
+                    ) -> ColumnVector:
+    keys, values = m.data["keys"], m.data["values"]
+    cap = m.capacity
+    off = m.data["offsets"]
+    child_cap = keys.capacity
+    seg = _element_segments(off[: cap + 1], cap, child_cap)
+    eq, both = _cmp_child_to_row(keys, key, seg, ctx)
+    e = jnp.arange(child_cap, dtype=jnp.int32)
+    in_range = e < off[cap]
+    match = eq & both & in_range
+    first = jnp.full(cap, child_cap, jnp.int32).at[seg].min(
+        jnp.where(match, e, child_cap))
+    row_ok = _valid_of(m, ctx) & _valid_of(key, ctx) & (first < child_cap)
+    pos = jnp.where(row_ok, jnp.clip(first, 0, child_cap - 1), -1)
+    return _gather_child(values, pos)
+
+
+def _map_lookup_cpu(rt, m: CpuCol, k: CpuCol) -> CpuCol:
+    out_v, out_ok = [], []
+    for (v, ok), (key, kok) in zip(zip(m.values, m.valid),
+                                   zip(k.values, k.valid)):
+        hit = None
+        if ok and kok and v is not None:
+            for kk, vv in v:
+                if kk == key:
+                    hit = vv
+                    break
+        out_v.append(hit)
+        out_ok.append(hit is not None)
+    return _leaf_cpu_col(rt, out_v, out_ok)
+
+
+class GetMapValue(ElementAt):
+    """map[key] — same as element_at(map, key)."""
+
+
+class GetStructField(Expression):
+    def __init__(self, child: Expression, name: str):
+        self.children = [child]
+        self.field_name = name
+
+    def _field_index(self):
+        st = self.children[0].data_type()
+        for i, f in enumerate(st.fields):
+            if f.name == self.field_name:
+                return i
+        raise SparkException(f"No such struct field {self.field_name} in "
+                             f"{st!r}")
+
+    def data_type(self):
+        st = self.children[0].data_type()
+        return st.fields[self._field_index()].dtype
+
+    def _params(self):
+        return self.field_name
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        c = self.children[0].eval_tpu(ctx)
+        kid = c.data["children"][self._field_index()]
+        valid = _valid_of(c, ctx)
+        kv = kid.validity if kid.validity is not None else ctx.row_mask
+        return ColumnVector(kid.dtype, kid.data, kv & valid,
+                            dict_unique=kid.dict_unique)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        name = self.field_name
+        vals = [None if (not ok or v is None) else v.get(name)
+                for v, ok in zip(c.values, c.valid)]
+        ok = [v is not None for v in vals]
+        return _leaf_cpu_col(self.data_type(), vals, ok)
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, v). Spark null semantics: null if arr is null or
+    v is null; true when found; null when not found but the array has a
+    null element; false otherwise."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = [child, _wrap(value)]
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        val = self.children[1].eval_tpu(ctx)
+        cap = arr.capacity
+        off = arr.data["offsets"]
+        child = arr.data["child"]
+        child_cap = child.capacity
+        seg = _element_segments(off[: cap + 1], cap, child_cap)
+        eq, both = _cmp_child_to_row(child, val, seg, ctx)
+        e = jnp.arange(child_cap, dtype=jnp.int32)
+        in_range = e < off[cap]
+        found = jnp.zeros(cap, jnp.bool_).at[seg].max(eq & both & in_range)
+        cv = (child.validity if child.validity is not None
+              else jnp.ones(child_cap, jnp.bool_))
+        has_null = jnp.zeros(cap, jnp.bool_).at[seg].max(~cv & in_range)
+        inputs_ok = _valid_of(arr, ctx) & _valid_of(val, ctx)
+        validity = inputs_ok & (found | ~has_null)
+        return ColumnVector(T.BOOLEAN, found, validity)
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        val = self.children[1].eval_cpu(cols, ansi)
+        out_v, out_ok = [], []
+        for (v, ok), (x, xok) in zip(zip(arr.values, arr.valid),
+                                     zip(val.values, val.valid)):
+            if not ok or v is None or not xok:
+                out_v.append(False)
+                out_ok.append(False)
+                continue
+            found = any(el is not None and el == x for el in v)
+            has_null = any(el is None for el in v)
+            out_v.append(found)
+            out_ok.append(found or not has_null)
+        return CpuCol(T.BOOLEAN, np.asarray(out_v, np.bool_),
+                      np.asarray(out_ok, np.bool_))
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) — fixed-width elements interleave into child
+    planes on device; strings build on CPU."""
+
+    def __init__(self, children: List[Expression]):
+        self.children = [_wrap(c) for c in children]
+
+    def data_type(self):
+        if not self.children:
+            return T.ArrayType(T.NULL)
+        dt = self.children[0].data_type()
+        for c in self.children[1:]:
+            dt = T.common_type(dt, c.data_type())
+        return T.ArrayType(dt)
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        elem_t = self.data_type().element
+        cols = [c.eval_tpu(ctx) for c in self.children]
+        k = len(cols)
+        cap = ctx.capacity
+        datas = [c.data.astype(elem_t.np_dtype) for c in cols]
+        valids = [_valid_of(c, ctx) for c in cols]
+        child_data = jnp.stack(datas, axis=1).reshape(-1)
+        child_valid = jnp.stack(valids, axis=1).reshape(-1)
+        offsets = (jnp.arange(cap + 1, dtype=jnp.int32) * k)
+        child = ColumnVector(elem_t, child_data, child_valid)
+        return ColumnVector(self.data_type(),
+                            {"offsets": offsets, "child": child}, None)
+
+    def eval_cpu(self, cols, ansi=False):
+        elem_t = self.data_type().element
+        parts = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(parts[0].values) if parts else 0
+        out = []
+        for i in range(n):
+            row = []
+            for p in parts:
+                if not p.valid[i]:
+                    row.append(None)
+                else:
+                    v = p.values[i]
+                    v = v.item() if isinstance(v, np.generic) else v
+                    if elem_t.np_dtype is not None and v is not None \
+                            and not isinstance(elem_t, T.StringType):
+                        v = np.dtype(elem_t.np_dtype).type(v).item()
+                    row.append(v)
+            out.append(row)
+        return CpuCol(self.data_type(), np.array(out, object),
+                      np.ones(n, np.bool_))
+
+
+class MapKeys(Expression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type().key,
+                           contains_null=False)
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        m = self.children[0].eval_tpu(ctx)
+        data = {"offsets": m.data["offsets"], "child": m.data["keys"]}
+        return ColumnVector(self.data_type(), data, m.validity)
+
+    def eval_cpu(self, cols, ansi=False):
+        m = self.children[0].eval_cpu(cols, ansi)
+        vals = [None if (not ok or v is None) else [kk for kk, _ in v]
+                for v, ok in zip(m.values, m.valid)]
+        return CpuCol(self.data_type(), np.array(vals, object),
+                      m.valid.copy())
+
+
+class MapValues(Expression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type().value)
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        m = self.children[0].eval_tpu(ctx)
+        data = {"offsets": m.data["offsets"], "child": m.data["values"]}
+        return ColumnVector(self.data_type(), data, m.validity)
+
+    def eval_cpu(self, cols, ansi=False):
+        m = self.children[0].eval_cpu(cols, ansi)
+        vals = [None if (not ok or v is None) else [vv for _, vv in v]
+                for v, ok in zip(m.values, m.valid)]
+        return CpuCol(self.data_type(), np.array(vals, object),
+                      m.valid.copy())
+
+
+# ---------------------------------------------------------------------------
+# Generator expressions (plan-level markers; the work happens in
+# exec/tpu_nodes.GenerateExec — reference GpuGenerateExec.scala)
+# ---------------------------------------------------------------------------
+
+class Explode(Expression):
+    """explode(array|map) / explode_outer. Only valid as a top-level select
+    expression; the DataFrame layer rewrites it into a Generate node."""
+
+    outer = False
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self):
+        dt = self.children[0].data_type()
+        if isinstance(dt, T.MapType):
+            return T.StructType((T.StructField("key", dt.key, False),
+                                 T.StructField("value", dt.value)))
+        return dt.element
+
+    def output_fields(self, alias: Optional[str] = None):
+        dt = self.children[0].data_type()
+        if not isinstance(dt, (T.ArrayType, T.MapType)):
+            raise SparkException(
+                f"explode() requires an array or map input, got {dt!r}")
+        if isinstance(dt, T.MapType):
+            return [("key", dt.key), ("value", dt.value)]
+        return [(alias or "col", dt.element)]
+
+
+class ExplodeOuter(Explode):
+    outer = True
+
+
+class PosExplode(Explode):
+    position = True
+
+    def output_fields(self, alias: Optional[str] = None):
+        return [("pos", T.INT32)] + super().output_fields(alias)
+
+
+class PosExplodeOuter(PosExplode):
+    outer = True

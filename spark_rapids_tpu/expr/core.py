@@ -175,6 +175,21 @@ class Expression:
     def cast(self, dtype): return Cast(self, dtype)
     def isin(self, *vals): return In(self, [_wrap(v) for v in vals])
 
+    # Complex-type sugar (Spark Column.getItem/getField).
+    def get_item(self, key):
+        from spark_rapids_tpu.expr import complex as CX
+        if isinstance(key, str):
+            return CX.GetMapValue(self, _wrap(key))
+        return CX.GetArrayItem(self, _wrap(key))
+
+    getItem = get_item
+
+    def get_field(self, name: str):
+        from spark_rapids_tpu.expr import complex as CX
+        return CX.GetStructField(self, name)
+
+    getField = get_field
+
     # Sort-order sugar (Spark Column.asc/desc family).
     def _order(self, ascending, nulls_first=None):
         from spark_rapids_tpu.plan.nodes import SortOrder

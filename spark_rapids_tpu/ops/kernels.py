@@ -362,38 +362,69 @@ def gather_column(col: ColumnVector, indices: jax.Array, src_rows: int,
     else:
         src_valid = col.validity_or_default(src_rows)
     valid = src_valid[safe] & ~oob
+    if col.is_string and not col.is_dict:
+        # Flat strings gather as an identity-coded dictionary (zero-copy
+        # reinterpretation: vocab = the source planes themselves). A
+        # byte-plane gather cannot duplicate rows without growing past the
+        # static byte capacity — code gather sidesteps that entirely.
+        col = flat_string_as_dict(col)
     if col.is_dict:
         # dict strings gather as integer codes; the vocab is shared.
         data = {"codes": col.data["codes"][safe],
                 "dict_offsets": col.data["dict_offsets"],
                 "dict_bytes": col.data["dict_bytes"]}
         return ColumnVector(col.dtype, data, valid, dict_unique=col.dict_unique)
-    elif col.is_string:
-        offsets = col.data["offsets"]
-        raw = col.data["bytes"]
-        lens = (offsets[1:] - offsets[:-1])[safe]
-        lens = jnp.where(valid, lens, 0)
-        new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
-                                   jnp.cumsum(lens).astype(jnp.int32)])
-        out_bytes = _gather_string_bytes(raw, offsets, safe, new_off)
-        data = {"offsets": new_off, "bytes": out_bytes}
-    else:
-        data = col.data[safe]
+    if isinstance(col.dtype, T.StructType):
+        kids = [gather_column(ch, indices, src_rows, src_live=src_live)
+                for ch in col.data["children"]]
+        return ColumnVector(col.dtype, {"children": kids}, valid)
+    if isinstance(col.dtype, (T.ArrayType, T.MapType)):
+        return _gather_list_like(col, safe, valid)
+    data = col.data[safe]
     return ColumnVector(col.dtype, data, valid)
 
 
-def _gather_string_bytes(raw, offsets, row_idx, new_off):
-    """For each output byte b: output row = searchsorted(new_off, b), source
-    byte = src_start + (b - out_start). Output byte plane keeps the source
-    byte capacity (gather never grows payload)."""
-    nbytes = raw.shape[0]
-    b = jnp.arange(nbytes, dtype=jnp.int32)
-    row = jnp.searchsorted(new_off, b, side="right").astype(jnp.int32) - 1
-    row = jnp.clip(row, 0, row_idx.shape[0] - 1)
-    src_row = row_idx[row]
-    src = offsets[src_row] + (b - new_off[row])
-    src = jnp.clip(src, 0, nbytes - 1)
-    return jnp.where(b < new_off[-1], raw[src], 0).astype(jnp.uint8)
+def _gather_list_like(col: ColumnVector, safe: jax.Array, valid: jax.Array
+                      ) -> ColumnVector:
+    """Gather an array/map column: rebuild offsets from gathered lengths,
+    then map each output element back to its source element and gather the
+    child planes. Child capacity is preserved — PERMUTING gathers (sort,
+    filter compaction, explode passthrough) never grow the element count;
+    row-DUPLICATING gathers of nested columns (join payload) are excluded
+    by TypeSig until a sized nested gather lands."""
+    off = col.data["offsets"]
+    out_cap = safe.shape[0]
+    lens = jnp.where(valid, (off[1:] - off[:-1])[safe], 0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    children = ([("child", col.data["child"])] if "child" in col.data
+                else [("keys", col.data["keys"]), ("values", col.data["values"])])
+    child_cap = children[0][1].capacity
+    e = jnp.arange(child_cap, dtype=jnp.int32)
+    orow = jnp.clip(jnp.searchsorted(new_off, e, side="right").astype(jnp.int32) - 1,
+                    0, out_cap - 1)
+    src_e = off[safe[orow]] + (e - new_off[orow])
+    in_range = e < new_off[-1]
+    child_idx = jnp.where(in_range, jnp.clip(src_e, 0, child_cap - 1), -1)
+    data = {"offsets": new_off}
+    for name, ch in children:
+        data[name] = gather_column(ch, child_idx, child_cap)
+    return ColumnVector(col.dtype, data, valid)
+
+
+def flat_string_as_dict(col: ColumnVector) -> ColumnVector:
+    """Reinterpret a flat offsets+bytes string column as a dictionary
+    column with identity codes. Zero-copy: the vocab IS the source planes.
+    dict_unique=False (source rows may repeat values). The vocab keeps the
+    full source byte plane alive regardless of how few codes survive
+    downstream — acceptable: gather outputs share source lifetime anyway."""
+    if col.is_dict or not col.is_string:
+        return col
+    cap = col.capacity
+    data = {"codes": jnp.arange(cap, dtype=jnp.int32),
+            "dict_offsets": col.data["offsets"],
+            "dict_bytes": col.data["bytes"]}
+    return ColumnVector(col.dtype, data, col.validity, dict_unique=False)
 
 
 def gather_batch(batch: ColumnarBatch, indices: jax.Array, out_rows: int) -> ColumnarBatch:
@@ -614,6 +645,38 @@ def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> Colu
                                     "dict_offsets": jnp.asarray(uoff),
                                     "dict_bytes": jnp.asarray(np.ascontiguousarray(ubytes))},
                             validity)
+
+    if isinstance(dtype, T.StructType):
+        kids = []
+        for k in range(len(cols[0].data["children"])):
+            kids.append(_concat_columns([c.data["children"][k] for c in cols],
+                                        rows, cap))
+        return ColumnVector(dtype, {"children": kids}, validity)
+
+    if isinstance(dtype, (T.ArrayType, T.MapType)):
+        # Host readback of per-part element counts keeps destination
+        # offsets static (same discipline as string concat below); child
+        # planes concat recursively, so arrays of strings/structs compose.
+        elem_lens = [int(np.asarray(c.data["offsets"][r]))
+                     for c, r in zip(cols, rows)]
+        total_elems = sum(elem_lens)
+        child_cap = round_capacity(max(total_elems, 1))
+        off_parts = [jnp.zeros(1, jnp.int32)]
+        base = 0
+        for c, r, el in zip(cols, rows, elem_lens):
+            off_parts.append(c.data["offsets"][1: r + 1].astype(jnp.int32)
+                             + np.int32(base))
+            base += el
+        offsets = jnp.concatenate(off_parts)
+        if cap + 1 - offsets.shape[0] > 0:
+            offsets = jnp.concatenate([
+                offsets, jnp.full(cap + 1 - offsets.shape[0], base, jnp.int32)])
+        names = ["child"] if "child" in cols[0].data else ["keys", "values"]
+        data = {"offsets": offsets}
+        for nm in names:
+            data[nm] = _concat_columns([c.data[nm] for c in cols],
+                                       elem_lens, child_cap)
+        return ColumnVector(dtype, data, validity)
 
     if isinstance(dtype, T.StringType):
         # Host readback of per-part byte lengths keeps destination offsets

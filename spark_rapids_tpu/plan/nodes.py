@@ -541,6 +541,48 @@ class Union(PlanNode):
         return f"Union[{len(self.children)}]"
 
 
+class Generate(PlanNode):
+    """One output row per element of a generator over each input row
+    (reference GpuGenerateExec.scala: explode/posexplode, incl. _outer).
+    Output schema = child columns followed by the generated columns."""
+
+    def __init__(self, generator, gen_names: List[str], child: PlanNode,
+                 required: Optional[List[int]] = None):
+        from spark_rapids_tpu.expr.complex import Explode
+        self.children = [child]
+        assert isinstance(generator, Explode), type(generator)
+        gen = type(generator)(bind_expr(generator.children[0], child.schema))
+        self.generator = gen
+        dt = gen.children[0].data_type()
+        if not isinstance(dt, (T.ArrayType, T.MapType)):
+            from spark_rapids_tpu.expr.core import SparkException
+            raise SparkException(
+                f"explode() requires an array or map input, got {dt!r}")
+        fields = gen.output_fields()
+        if gen_names:
+            assert len(gen_names) == len(fields), \
+                f"generator yields {len(fields)} columns, got names {gen_names}"
+            fields = [(n, t) for n, (_, t) in zip(gen_names, fields)]
+        self.gen_fields = fields
+        #: child column indices carried through (Spark requiredChildOutput);
+        #: defaults to all. The exec row-duplicates these — pruning unneeded
+        #: ones both saves the gathers and keeps nested siblings (whose
+        #: duplicating gather is not supported on device) out of the plan.
+        n_child = len(child.schema.fields)
+        self.required = list(range(n_child)) if required is None \
+            else list(required)
+
+    @property
+    def schema(self):
+        base = [self.children[0].schema.fields[i] for i in self.required]
+        gen = [T.StructField(n, t) for n, t in self.gen_fields]
+        return T.Schema(tuple(base + gen))
+
+    def describe(self):
+        kind = type(self.generator).__name__
+        return f"Generate[{kind}({self.generator.children[0]!r})]"
+
+
 class Expand(PlanNode):
     """Multiple projections per input row (reference GpuExpandExec; used by
     ROLLUP/CUBE/count-distinct rewrites)."""

@@ -162,6 +162,64 @@ expr_rule(S.RegexpReplace, Sigs.COMMON, Sigs.COMMON,
           "regex replace (CPU: needs backtracking groups)",
           extra=lambda e: "capture-group regex runs on CPU")
 
+# complex types (reference complexTypeExtractors.scala / complexTypeCreator /
+# collectionOperations / GpuGenerateExec expressions)
+from spark_rapids_tpu.expr import complex as CX  # noqa: E402
+
+_NESTED_OK = Sigs.COMMON.nested()
+
+# column refs / aliases / null tests pass nested columns through untouched —
+# re-register them with the nested signature (reference: these are
+# TypeSig.all in GpuOverrides)
+expr_rule(E.BoundRef, _NESTED_OK, _NESTED_OK, "column reference")
+expr_rule(E.Alias, _NESTED_OK, _NESTED_OK, "named expression")
+expr_rule(E.IsNull, _NESTED_OK, Sigs.COMMON, "null test")
+expr_rule(E.IsNotNull, _NESTED_OK, Sigs.COMMON, "not-null test")
+
+
+def _primitive_elements_only(what: str):
+    def check(e: E.Expression) -> Optional[str]:
+        dt = e.children[0].data_type()
+        inner = dt.element if isinstance(dt, T.ArrayType) else dt.key
+        if isinstance(inner, (T.ArrayType, T.StructType, T.MapType)):
+            return f"{what} over nested element types runs on CPU"
+        return None
+    return check
+
+
+def _create_array_check(e: E.Expression) -> Optional[str]:
+    dt = e.data_type().element
+    if isinstance(dt, (T.StringType, T.ArrayType, T.StructType, T.MapType,
+                       T.NullType)):
+        return "array() of non-fixed-width elements runs on CPU"
+    return None
+
+
+expr_rule(CX.Size, _NESTED_OK, Sigs.COMMON, "size(array|map)")
+expr_rule(CX.GetArrayItem, _NESTED_OK, _NESTED_OK, "array[ordinal]")
+expr_rule(CX.ElementAt, _NESTED_OK, _NESTED_OK, "element_at(array|map, k)",
+          extra=lambda e: (_primitive_elements_only("map key lookup")(e)
+                           if isinstance(e.children[0].data_type(), T.MapType)
+                           else None))
+expr_rule(CX.GetMapValue, _NESTED_OK, _NESTED_OK, "map[key]",
+          extra=_primitive_elements_only("map key lookup"))
+expr_rule(CX.GetStructField, _NESTED_OK, _NESTED_OK, "struct field access")
+expr_rule(CX.ArrayContains, _NESTED_OK, Sigs.COMMON, "array_contains",
+          extra=_primitive_elements_only("array_contains"))
+expr_rule(CX.CreateArray, Sigs.COMMON, _NESTED_OK, "array(...)",
+          extra=_create_array_check)
+expr_rule(CX.MapKeys, _NESTED_OK, _NESTED_OK, "map_keys")
+expr_rule(CX.MapValues, _NESTED_OK, _NESTED_OK, "map_values")
+
+# JSON functions (reference GpuGetJsonObject / GpuJsonToStructs): host
+# parse tier with visible fallback
+from spark_rapids_tpu.expr import json_functions as JF  # noqa: E402
+
+for _jcls in JF.JSON_FUNCTIONS:
+    expr_rule(_jcls, Sigs.COMMON, _NESTED_OK,
+              f"{_jcls.name} (host JSON parse)",
+              extra=lambda e: f"{e.name} runs on CPU (host JSON parse)")
+
 # CPU-only row functions: registered so tagging gives a clear reason and
 # the enclosing exec falls back (reference: ops without GPU impls)
 from spark_rapids_tpu.expr import cpu_functions as CF  # noqa: E402
@@ -389,9 +447,20 @@ class SparkPlanMeta:
         self._tag_schema()
         self._tag_node()
 
+    #: nodes whose device paths carry nested columns (mask/gather/concat
+    #: only — no key normalization): scans, projection, filter, generate,
+    #: limit, union, sort payload, cache. Joins/aggregates/exchanges/windows
+    #: stay primitive-only until nested key normalization lands.
+    NESTED_SCHEMA_NODES = (P.Project, P.Filter, P.Generate, P.InMemorySource,
+                           P.ParquetScan, P.TextScan, P.Limit, P.Union,
+                           P.Sort, P.CachedRelation)
+
     def _tag_schema(self) -> None:
+        sig = (Sigs.COMMON.nested()
+               if isinstance(self.plan, self.NESTED_SCHEMA_NODES)
+               else Sigs.COMMON)
         for f in self.plan.schema.fields:
-            r = Sigs.COMMON.reason_not_supported(f.dtype)
+            r = sig.reason_not_supported(f.dtype)
             if r:
                 self.reasons.append(f"output column {f.name}: {r}")
 
@@ -411,11 +480,16 @@ class SparkPlanMeta:
         elif isinstance(p, P.Sort):
             for o in p.orders:
                 tag_expression(o.expr, self.conf, self.reasons, name)
-                if isinstance(o.expr.data_type(), T.StringType):
+                odt = o.expr.data_type()
+                if isinstance(odt, T.StringType):
                     self.reasons.append(
                         f"{name}: ORDER BY on strings requires host sort "
                         f"(device string ordering lands with the radix "
                         f"string-sort kernel)")
+                if isinstance(odt, (T.ArrayType, T.StructType, T.MapType)):
+                    self.reasons.append(
+                        f"{name}: ORDER BY on nested type {odt!r} has no "
+                        f"device key normalization (runs on CPU)")
         elif isinstance(p, P.Join):
             for e in p.left_keys + p.right_keys:
                 tag_expression(e, self.conf, self.reasons, name)
@@ -425,6 +499,25 @@ class SparkPlanMeta:
             for proj in p.projections:
                 for e in proj:
                     tag_expression(e, self.conf, self.reasons, name)
+        elif isinstance(p, P.Generate):
+            tag_expression(p.generator.children[0], self.conf, self.reasons,
+                           name)
+            # the exec row-duplicates required child columns; a duplicating
+            # gather of list-like columns would overflow their element
+            # planes (kernels._gather_list_like preserves capacity) — fall
+            # back. Structs of primitives duplicate fine (row planes only).
+            def _has_list_like(dt):
+                if isinstance(dt, (T.ArrayType, T.MapType)):
+                    return True
+                if isinstance(dt, T.StructType):
+                    return any(_has_list_like(f.dtype) for f in dt.fields)
+                return False
+            for i in p.required:
+                f = p.children[0].schema.fields[i]
+                if _has_list_like(f.dtype):
+                    self.reasons.append(
+                        f"{name}: carrying array/map column {f.name} through "
+                        f"explode needs a sized nested gather (runs on CPU)")
         elif isinstance(p, P.WindowNode):
             self._tag_window(p, name)
 
@@ -510,6 +603,8 @@ class SparkPlanMeta:
             return X.UnionExec(p, child_execs, conf)
         if isinstance(p, P.Expand):
             return X.ExpandExec(p, child_execs, conf)
+        if isinstance(p, P.Generate):
+            return X.GenerateExec(p, child_execs, conf)
         if isinstance(p, P.Sort):
             child = child_execs[0]
             if child.num_partitions > 1 and p.global_sort:

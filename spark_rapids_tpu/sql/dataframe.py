@@ -60,6 +60,32 @@ class DataFrame:
     def select(self, *exprs) -> "DataFrame":
         es = [_e(x) for x in exprs]
         from spark_rapids_tpu.expr import window as WE
+        from spark_rapids_tpu.expr import complex as CX
+
+        gens = [(i, e) for i, e in enumerate(es)
+                if isinstance(e, CX.Explode)
+                or (isinstance(e, E.Alias) and isinstance(e.children[0],
+                                                          CX.Explode))]
+        if gens:
+            if len(gens) > 1:
+                raise E.SparkException(
+                    "only one generator allowed per select clause")
+            i, ge = gens[0]
+            alias = ge.name if isinstance(ge, E.Alias) else None
+            gen = ge.children[0] if isinstance(ge, E.Alias) else ge
+            gen = type(gen)(P.bind_expr(gen.children[0], self.plan.schema))
+            fields = gen.output_fields(alias)
+            names = [n for n, _ in fields]
+            new_exprs = es[:i] + [E.col(n) for n in names] + es[i + 1:]
+            # requiredChildOutput: only child columns the projection uses
+            # ride through the row-duplicating generate
+            refs = set()
+            for e in new_exprs:
+                refs |= {r.lower() for r in e.references()}
+            required = [j for j, f in enumerate(self.plan.schema.fields)
+                        if f.name.lower() in refs]
+            gplan = P.Generate(gen, names, self.plan, required=required)
+            return DataFrame(P.Project(new_exprs, gplan), self.session)
 
         def has_window(e):
             if isinstance(e, WE.WindowExpr):

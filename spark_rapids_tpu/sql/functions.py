@@ -252,6 +252,66 @@ def format_number(c, d):
     return FormatNumber(_e(c), params=(d,))
 
 
+def explode(c):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.Explode(_e(c))
+
+
+def explode_outer(c):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.ExplodeOuter(_e(c))
+
+
+def posexplode(c):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.PosExplode(_e(c))
+
+
+def posexplode_outer(c):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.PosExplodeOuter(_e(c))
+
+
+def size(c):  # noqa: A001
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.Size(_e(c))
+
+
+def element_at(c, k):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.ElementAt(_e(c), _e(k) if isinstance(k, E.Expression) else E.lit(k))
+
+
+def array(*cs):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.CreateArray([_e(c) for c in cs])
+
+
+def array_contains(c, v):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.ArrayContains(_e(c), _e(v) if isinstance(v, E.Expression) else E.lit(v))
+
+
+def map_keys(c):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.MapKeys(_e(c))
+
+
+def map_values(c):
+    from spark_rapids_tpu.expr import complex as CX
+    return CX.MapValues(_e(c))
+
+
+def get_json_object(c, path: str):
+    from spark_rapids_tpu.expr import json_functions as JF
+    return JF.GetJsonObject(_e(c), params=(path,))
+
+
+def from_json(c, schema):
+    from spark_rapids_tpu.expr import json_functions as JF
+    return JF.JsonToStructs(_e(c), params=(schema,))
+
+
 def nvl(c, default):
     return coalesce(c, default)
 

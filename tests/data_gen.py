@@ -99,11 +99,13 @@ class LongGen(_IntGen):
     arrow_type = pa.int64()
 
     def _gen_one(self, rng):
-        # rng.integers can't span the full int64 range inclusively
+        # sample via an unsigned offset so any [lo, hi] span up to the full
+        # int64 range works (rng.integers alone can't span it inclusively)
         lo, hi = self.min_val, self.max_val
-        if hi - lo >= (1 << 63):
+        span = hi - lo  # exact python int
+        if span >= (1 << 64) - 1:
             return int(np.int64(rng.integers(0, 1 << 64, dtype=np.uint64)))
-        return int(rng.integers(lo, hi, endpoint=True))
+        return lo + int(rng.integers(0, span + 1, dtype=np.uint64))
 
 
 class UniqueLongGen(DataGen):

@@ -1,0 +1,85 @@
+"""Differential tests for JSON expressions (reference json_test.py /
+get_json_test.py semantics: path subset, invalid JSON -> null, PERMISSIVE
+from_json coercion)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col
+
+from asserts import assert_tpu_and_cpu_are_equal_collect, assert_fallback_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+DOCS = [
+    '{"a": 1, "b": {"c": "x"}, "arr": [10, 20, 30]}',
+    '{"a": null, "b": {}}',
+    '{"a": "text with \\"quote\\""}',
+    'not json at all',
+    None,
+    '[1, 2, 3]',
+    '{"a": 2.5, "flag": true, "arr": [{"k": 1}, {"k": 2}]}',
+    '{"b": {"c": {"d": 7}}}',
+    '{"a": 9007199254740993}',
+]
+
+
+def _df(s):
+    return s.create_dataframe({"j": pa.array(DOCS, pa.string())})
+
+
+def test_get_json_object_paths(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.get_json_object(col("j"), "$.a").alias("a"),
+            F.get_json_object(col("j"), "$.b.c").alias("bc"),
+            F.get_json_object(col("j"), "$.b.c.d").alias("bcd"),
+            F.get_json_object(col("j"), "$.arr[1]").alias("arr1"),
+            F.get_json_object(col("j"), "$.arr[*]").alias("all"),
+            F.get_json_object(col("j"), "$[0]").alias("top0"),
+            F.get_json_object(col("j"), "$.missing").alias("mi"),
+            F.get_json_object(col("j"), "$.arr[*].k").alias("ks")),
+        session)
+
+
+def test_get_json_object_renders_unquoted_and_compact(session):
+    out = _df(session).select(
+        F.get_json_object(col("j"), "$.a").alias("a"),
+        F.get_json_object(col("j"), "$.b").alias("b")).to_pydict()
+    assert out["a"][2] == 'text with "quote"'  # scalar string unquoted
+    assert out["b"][0] == '{"c":"x"}'          # object compact-serialized
+
+
+def test_from_json_struct(session):
+    schema = T.StructType((T.StructField("a", T.FLOAT64),
+                           T.StructField("flag", T.BOOLEAN),
+                           T.StructField("b", T.StructType((
+                               T.StructField("c", T.STRING),)))))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.from_json(col("j"), schema).alias("p")),
+        session)
+
+
+def test_from_json_then_extract(session):
+    schema = T.StructType((T.StructField("a", T.INT64),))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            F.from_json(col("j"), schema).alias("p"))
+        .select(col("p").get_field("a").alias("a")),
+        session)
+
+
+def test_json_fallback_visible(session):
+    # JSON parse is the CPU tier: the projection must fall back with a
+    # reason, results identical
+    assert_fallback_collect(
+        lambda s: _df(s).select(
+            F.get_json_object(col("j"), "$.a").alias("a")),
+        session, "Project")
