@@ -171,7 +171,10 @@ SHUFFLE_MODE = conf_str(
     "MULTITHREADED: in-process exchange by zero-copy selection-mask "
     "slicing on device (no files or serialization involved); "
     "ICI: device-resident exchange via XLA all-to-all collectives over the "
-    "mesh (reference RapidsConf.scala:1767 UCX|CACHE_ONLY|MULTITHREADED).")
+    "mesh; SERIALIZED: partitions serialize through the kudo-analog wire "
+    "format into a spillable host store (parallel writers, compression, "
+    "disk overflow — the cross-host-capable path) "
+    "(reference RapidsConf.scala:1767 UCX|CACHE_ONLY|MULTITHREADED).")
 
 SHUFFLE_WRITER_THREADS = conf_int(
     "spark.rapids.shuffle.multiThreaded.writer.threads", 8,
@@ -183,9 +186,16 @@ SHUFFLE_READER_THREADS = conf_int(
     "Threads in the executor-wide shuffle reader pool.")
 
 SHUFFLE_COMPRESSION = conf_str(
-    "spark.rapids.shuffle.compression.codec", "lz4",
-    "Codec for serialized shuffle tables: none, lz4, zstd "
-    "(reference TableCompressionCodec).")
+    "spark.rapids.shuffle.compression.codec", "zstd",
+    "Codec for serialized shuffle tables: none, zstd, zlib "
+    "(reference TableCompressionCodec; nvcomp lz4 has no TPU-side analog "
+    "in this environment, zstd plays that role).")
+
+SHUFFLE_HOST_BUDGET = conf_int(
+    "spark.rapids.shuffle.hostSpillBudget", 256 << 20,
+    "Host bytes the SERIALIZED shuffle store may hold resident before "
+    "partitions flush to disk spill files "
+    "(reference ShuffleBufferCatalog spillable shuffle data).")
 
 MULTIFILE_READER_TYPE = conf_str(
     "spark.rapids.sql.format.parquet.reader.type", "AUTO",

@@ -493,6 +493,32 @@ class ExpandExec(TpuExec):
                 yield compiled.run_projection(exprs, batch)
 
 
+class ShuffleFileScanExec(TpuExec):
+    """Reads a cross-process shuffle directory: each reduce partition
+    streams its kudo frames straight onto the device (reference: shuffle
+    reader fetching map outputs)."""
+
+    @property
+    def num_partitions(self):
+        return max(1, self.plan.n_reduce)
+
+    def execute_partition(self, ctx, pidx):
+        from spark_rapids_tpu.shuffle.exchange_files import (
+            read_partition_batches,
+        )
+        copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
+        out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        self._acquire(ctx)
+        it = read_partition_batches(self.plan.root, pidx)
+        while True:
+            with copy_t.ns():
+                batch = next(it, None)
+            if batch is None:
+                return
+            out_rows.add(rows_int(batch.num_rows))
+            yield batch
+
+
 class GenerateExec(TpuExec):
     """explode / posexplode over array and map columns, incl. _outer
     (reference GpuGenerateExec.scala).
@@ -1425,12 +1451,64 @@ class ShuffleExchangeExec(ExchangeExec):
         return self.n_out
 
     def _repartition(self, child_results):
-        if self.conf.get(C.SHUFFLE_MODE).upper() == "ICI":
+        mode = self.conf.get(C.SHUFFLE_MODE).upper()
+        if mode == "ICI":
             with self.metrics.metric(M.PARTITION_TIME).ns():
                 out = self._repartition_ici(child_results)
             if out is not None:
                 return out
+        if mode == "SERIALIZED":
+            return self._repartition_serialized(child_results)
         return self._repartition_masked(child_results)
+
+    def _repartition_serialized(self, child_results):
+        """Masked device partition, then parallel serialization through the
+        kudo-analog wire format into a spillable host store (reference
+        RapidsShuffleThreadedWriterBase:291-513 + ShuffleBufferCatalog).
+        Device planes are released once serialized; blobs page to disk
+        under spark.rapids.shuffle.hostSpillBudget. The returned partition
+        lists deserialize lazily at read time."""
+        from spark_rapids_tpu.shuffle import serde
+        from spark_rapids_tpu.shuffle.store import ShuffleStore
+        ser_t = self.metrics.metric(M.PARTITION_TIME)
+        codec = self.conf.get(C.SHUFFLE_COMPRESSION)
+        serde.codec_id(codec)  # validate up front
+        store = ShuffleStore(self.n_out,
+                             self.conf.get(C.SHUFFLE_HOST_BUDGET))
+        masked = self._repartition_masked(child_results)
+        nthreads = max(1, self.conf.get(C.SHUFFLE_WRITER_THREADS))
+        work = [(p, b) for p, part in enumerate(masked) for b in part]
+
+        def ser(item):
+            p, b = item
+            if b.row_mask is not None:
+                b = K.compact_batch(b)
+            if rows_int(b.num_rows) == 0:
+                return p, None  # empty sub-batches never ship
+            return p, serde.serialize_batch(b, codec)
+
+        with ser_t.ns():
+            if len(work) > 1 and nthreads > 1:
+                with ThreadPoolExecutor(max_workers=nthreads) as pool:
+                    for p, blob in pool.map(ser, work):
+                        if blob is not None:
+                            store.add(p, blob)
+            else:
+                for item in work:
+                    p, blob = ser(item)
+                    if blob is not None:
+                        store.add(p, blob)
+        self._store = store
+        return [[_LazyShuffleBlobs(store, p)] if store.partition_bytes(p)
+                else [] for p in range(self.n_out)]
+
+    def execute_partition(self, ctx, pidx):
+        out = self._materialize()
+        for item in out[pidx]:
+            if isinstance(item, _LazyShuffleBlobs):
+                yield from item.batches()
+            else:
+                yield item
 
     def _ici_eligible(self, child_results):
         import jax as _jax
@@ -1612,6 +1690,19 @@ def _pmod(h, n):
     return jnp.where(r < 0, r + n, r)
 
 
+class _LazyShuffleBlobs:
+    """A reduce partition's serialized blobs; deserializes at read time."""
+
+    def __init__(self, store, partition: int):
+        self.store = store
+        self.partition = partition
+
+    def batches(self):
+        from spark_rapids_tpu.shuffle import serde
+        for blob in self.store.iter_partition(self.partition):
+            yield serde.deserialize_batch(blob)
+
+
 class RoundRobinExchangeExec(ExchangeExec):
     """Round-robin repartition (reference GpuRoundRobinPartitioning)."""
 
@@ -1643,6 +1734,115 @@ class RoundRobinExchangeExec(ExchangeExec):
         for part in child_results:
             for batch in part:
                 for p, sub in enumerate(fn(batch)):
+                    out[p].append(sub)
+        return out
+
+
+class RangeExchangeExec(ExchangeExec):
+    """Range repartition by sort keys (reference GpuRangePartitioner +
+    SamplingUtils): sample transformed order keys, compute n-1 bounds on
+    host, then assign each row its partition by branch-free lexicographic
+    bound comparisons on device. Output partition p holds rows ordering
+    before partition p+1's — a per-partition sort then yields a globally
+    sorted result without collecting to one partition (the scalability
+    cliff VERDICT flagged)."""
+
+    def __init__(self, plan, children, conf, orders, n_out: int):
+        super().__init__(plan, children, conf)
+        self.orders = orders
+        self.n_out = n_out
+
+    @property
+    def num_partitions(self):
+        return self.n_out
+
+    def _key_fn(self):
+        orders = self.orders
+
+        def build():
+            def fn(batch):
+                live = batch.live_mask()
+                ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                               batch.capacity, False, live=live)
+                planes = []
+                for o in orders:
+                    kc = o.expr.eval_tpu(ectx)
+                    k, nulls = K.normalize_key(kc, batch.num_rows, live=live)
+                    null_rank = jnp.uint8(0) if o.resolved_nulls_first() \
+                        else jnp.uint8(1)
+                    val_rank = jnp.uint8(1) - null_rank
+                    planes.append(jnp.where(nulls, null_rank, val_rank))
+                    planes.append(k if o.ascending else ~k)
+                return tuple(planes), live
+            return fn
+
+        return fuse.fused(
+            ("range_keys", tuple((o.expr.fingerprint(), o.ascending,
+                                  o.resolved_nulls_first())
+                                 for o in self.orders)), build)
+
+    def _repartition(self, child_results):
+        part_t = self.metrics.metric(M.PARTITION_TIME)
+        n_out = self.n_out
+        keyfn = self._key_fn()
+        per_batch = []   # (batch, planes)
+        samples = []     # host tuples
+        budget = self.conf.get(C.CPU_RANGE_PARTITION_SAMPLE) * n_out
+        with part_t.ns():
+            for part in child_results:
+                for batch in part:
+                    planes, live = keyfn(batch)
+                    per_batch.append((batch, planes))
+                    host = jax.device_get(list(planes) + [live])
+                    lv = host[-1]
+                    idx = np.flatnonzero(lv)
+                    if len(idx) > budget:
+                        idx = idx[:: max(1, len(idx) // budget)][:budget]
+                    for i in idx:
+                        samples.append(tuple(int(p[i]) for p in host[:-1]))
+            if not samples:
+                return [[] for _ in range(n_out)]
+            samples.sort()
+            bounds = [samples[(len(samples) * (i + 1)) // n_out]
+                      for i in range(n_out - 1)]
+            # bounds ride in as TRACED plane-aligned arrays — baking their
+            # values into the fuse key would permanently cache one compiled
+            # executable per dataset
+            bound_planes = None
+
+            def build():
+                def fn(batch, planes, bplanes):
+                    live = batch.live_mask()
+                    pid = jnp.zeros(batch.capacity, jnp.int32)
+                    for bi in range(n_out - 1):
+                        # lexicographic: bound < row
+                        lt = jnp.zeros(batch.capacity, jnp.bool_)
+                        eq = jnp.ones(batch.capacity, jnp.bool_)
+                        for bp, plane in zip(bplanes, planes):
+                            bv = bp[bi]
+                            lt = lt | (eq & (plane > bv))
+                            eq = eq & (plane == bv)
+                        pid = pid + lt.astype(jnp.int32)
+                    subs = []
+                    for p in range(n_out):
+                        m = live & (pid == p)
+                        subs.append(ColumnarBatch(
+                            batch.columns,
+                            LazyRowCount(jnp.sum(m.astype(jnp.int32))), m))
+                    return subs
+                return fn
+
+            fn = fuse.fused(("range_exchange", n_out,
+                             tuple((o.expr.fingerprint(), o.ascending)
+                                   for o in self.orders)), build)
+            out: List[List[ColumnarBatch]] = [[] for _ in range(n_out)]
+            for batch, planes in per_batch:
+                if bound_planes is None:
+                    bound_planes = tuple(
+                        jnp.asarray(np.array([b[j] for b in bounds],
+                                             dtype=planes[j].dtype))
+                        for j in range(len(planes)))
+                for p, sub in enumerate(fn(batch, planes, bound_planes)):
                     out[p].append(sub)
         return out
 

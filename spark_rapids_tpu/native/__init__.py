@@ -1,0 +1,83 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+Reference parity: the reference keeps its serializer/allocator/kernels in
+native code (spark-rapids-jni); this package is the TPU build's native
+layer. Libraries build on first use with g++ into a per-user cache dir and
+load with ctypes — no pybind11/JNI, the ABI is a handful of C functions.
+Every native component has a pure-Python fallback with the identical wire
+contract so the engine still runs where a toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_BUILD_LOCK = threading.Lock()
+_KUDO_LIB: Optional[ctypes.CDLL] = None
+_KUDO_FAILED = False
+
+
+def _source_path(name: str) -> str:
+    return os.path.join(os.path.dirname(__file__), name)
+
+
+def _cache_dir() -> str:
+    d = os.environ.get("SPARK_RAPIDS_TPU_NATIVE_CACHE",
+                       os.path.join(tempfile.gettempdir(),
+                                    f"spark_rapids_tpu_native_{os.getuid()}"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build(src: str, tag: str) -> Optional[str]:
+    """Compile src to a cached .so keyed by source hash; None on failure."""
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_cache_dir(), f"{tag}_{digest}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, OSError):
+        return None
+
+
+def kudo_lib() -> Optional[ctypes.CDLL]:
+    """The kudo serializer core, or None when no toolchain is available
+    (callers fall back to the pure-Python packer)."""
+    global _KUDO_LIB, _KUDO_FAILED
+    if _KUDO_LIB is not None or _KUDO_FAILED:
+        return _KUDO_LIB
+    with _BUILD_LOCK:
+        if _KUDO_LIB is not None or _KUDO_FAILED:
+            return _KUDO_LIB
+        path = _build(_source_path("kudo.cpp"), "kudo")
+        if path is None:
+            _KUDO_FAILED = True
+            return None
+        lib = ctypes.CDLL(path)
+        u64, u32, i64 = ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int64
+        pu8 = ctypes.POINTER(ctypes.c_uint8)
+        lib.kudo_xxhash64.restype = u64
+        lib.kudo_xxhash64.argtypes = [pu8, u64, u64]
+        lib.kudo_frame_size.restype = u64
+        lib.kudo_frame_size.argtypes = [u64, u32, ctypes.POINTER(u64)]
+        lib.kudo_pack.restype = u64
+        lib.kudo_pack.argtypes = [pu8, u64, u32, ctypes.POINTER(pu8),
+                                  ctypes.POINTER(u64), pu8]
+        lib.kudo_unpack.restype = i64
+        lib.kudo_unpack.argtypes = [pu8, u64, ctypes.POINTER(u64),
+                                    ctypes.POINTER(u64), ctypes.POINTER(u32),
+                                    ctypes.POINTER(u64), ctypes.POINTER(u64),
+                                    u32, ctypes.c_int32]
+        _KUDO_LIB = lib
+    return _KUDO_LIB

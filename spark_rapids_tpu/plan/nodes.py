@@ -541,6 +541,30 @@ class Union(PlanNode):
         return f"Union[{len(self.children)}]"
 
 
+class ShuffleFileScan(PlanNode):
+    """Scan of a cross-process shuffle directory written by
+    shuffle.exchange_files.write_exchange (one partition per reduce
+    partition; self-describing kudo frames + manifest)."""
+
+    def __init__(self, root: str):
+        from spark_rapids_tpu.shuffle.exchange_files import read_manifest
+        from spark_rapids_tpu.shuffle.serde import dtype_from_json
+        self.children = []
+        self.root = root
+        m = read_manifest(root)
+        self.n_reduce = int(m["n_reduce"])
+        self._schema = T.Schema(tuple(
+            T.StructField(n, dtype_from_json(t))
+            for n, t in zip(m["names"], m["types"])))
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"ShuffleFileScan[{self.root}, n={self.n_reduce}]"
+
+
 class Generate(PlanNode):
     """One output row per element of a generator over each input row
     (reference GpuGenerateExec.scala: explode/posexplode, incl. _outer).

@@ -453,7 +453,7 @@ class SparkPlanMeta:
     #: stay primitive-only until nested key normalization lands.
     NESTED_SCHEMA_NODES = (P.Project, P.Filter, P.Generate, P.InMemorySource,
                            P.ParquetScan, P.TextScan, P.Limit, P.Union,
-                           P.Sort, P.CachedRelation)
+                           P.Sort, P.CachedRelation, P.ShuffleFileScan)
 
     def _tag_schema(self) -> None:
         sig = (Sigs.COMMON.nested()
@@ -587,6 +587,8 @@ class SparkPlanMeta:
                                          conf)
         if isinstance(p, P.CachedRelation):
             return X.CachedScanExec(p, child_execs, conf)
+        if isinstance(p, P.ShuffleFileScan):
+            return X.ShuffleFileScanExec(p, [], conf)
         if isinstance(p, P.Range):
             return X.RangeExec(p, [], conf)
         if isinstance(p, P.Project):
@@ -608,7 +610,20 @@ class SparkPlanMeta:
         if isinstance(p, P.Sort):
             child = child_execs[0]
             if child.num_partitions > 1 and p.global_sort:
-                child = X.CollectExchangeExec(p, [child], conf)
+                # range partition + per-partition sort = global order with
+                # no single-partition collapse (GpuRangePartitioner); keys
+                # whose device normalization is not order-preserving
+                # (strings hash; nested have none) still collect
+                rangeable = all(
+                    not isinstance(o.expr.data_type(),
+                                   (T.StringType, T.ArrayType, T.StructType,
+                                    T.MapType))
+                    for o in p.orders)
+                if rangeable:
+                    child = X.RangeExchangeExec(p, [child], conf, p.orders,
+                                                n_out=child.num_partitions)
+                else:
+                    child = X.CollectExchangeExec(p, [child], conf)
             return X.SortExec(p, [child], conf)
         if isinstance(p, P.WindowNode):
             child = child_execs[0]

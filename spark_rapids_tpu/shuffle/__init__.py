@@ -1,0 +1,2 @@
+"""Shuffle subsystem: wire serialization, spillable shuffle store,
+cross-process exchange (reference SURVEY.md §2.7)."""

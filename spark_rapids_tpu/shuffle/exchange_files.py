@@ -1,0 +1,72 @@
+"""Cross-process / cross-host exchange over shuffle files.
+
+Reference parity: the reference's shuffle rides Spark's shuffle files
+(RapidsShuffleThreadedWriterBase writePartitionedData ->
+standard shuffle files) so any executor can fetch any map output. Here the
+same contract: a writer process hash-partitions a DataFrame and writes one
+kudo-framed file per (map partition, reduce partition) plus a manifest;
+any other process mounts the directory as a scan. Files are
+self-describing (schema in the manifest, checksummed frames), so the
+reader needs no shared memory with the writer — this is the unit the
+DCN/object-store story builds on.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List
+
+from spark_rapids_tpu.shuffle import serde
+from spark_rapids_tpu.shuffle.store import (
+    read_reduce_partition, write_shuffle_file,
+)
+
+MANIFEST = "manifest.json"
+
+
+def write_exchange(df, root: str, keys: List[str], n_out: int,
+                   codec: str = "zstd") -> None:
+    """Hash-partition `df` by `keys` (murmur3 pmod, bit-parity with the
+    in-process exchange) and write shuffle files + manifest under root."""
+    from spark_rapids_tpu.expr.core import col
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    from spark_rapids_tpu.plan.nodes import bind_expr
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    from spark_rapids_tpu.runtime.task import TaskContext
+
+    child, _ = convert_plan(df.plan, df.session.conf)
+    ex = X.ShuffleExchangeExec(
+        df.plan, [child], df.session.conf,
+        [bind_expr(col(k), df.plan.schema) for k in keys],
+        n_out=n_out)
+    os.makedirs(root, exist_ok=True)
+    for r in range(n_out):
+        blobs = []
+        with TaskContext(partition_id=r) as ctx:
+            for batch in ex.execute_partition(ctx, r):
+                blobs.append(serde.serialize_batch(batch, codec))
+        write_shuffle_file(root, 0, r, blobs)
+    schema = df.plan.schema
+    manifest = {"n_reduce": n_out,
+                "names": list(schema.names),
+                "types": [serde.dtype_to_json(t) for t in schema.types]}
+    with open(os.path.join(root, MANIFEST), "w") as f:
+        json.dump(manifest, f)
+
+
+def read_manifest(root: str) -> dict:
+    with open(os.path.join(root, MANIFEST)) as f:
+        return json.load(f)
+
+
+def read_exchange(session, root: str):
+    """Mount a shuffle directory as a DataFrame (one partition per reduce
+    partition)."""
+    from spark_rapids_tpu.plan import nodes as P
+    from spark_rapids_tpu.sql.dataframe import DataFrame
+    return DataFrame(P.ShuffleFileScan(root), session)
+
+
+def read_partition_batches(root: str, reduce_id: int):
+    for blob in read_reduce_partition(root, reduce_id):
+        yield serde.deserialize_batch(blob)

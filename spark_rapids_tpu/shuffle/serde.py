@@ -1,0 +1,398 @@
+"""Columnar batch <-> wire bytes (the kudo-analog serializer).
+
+Reference parity: GpuColumnarBatchSerializer.scala:132 (kudo wire format
+via jni.kudo.KudoSerializer) + TableCompressionCodec (nvcomp lz4/zstd).
+Frame assembly/parsing and the integrity hash run in native C++
+(native/kudo.cpp) when the toolchain is available; a pure-Python packer
+with the identical layout is the fallback. Compression wraps the whole
+frame: 1 codec byte + codec payload ('none' | 'zstd' | 'zlib' — the
+spark.rapids.shuffle.compression.codec conf).
+
+Planes are TRIMMED to live sizes on the wire (capacity padding never
+ships) and re-padded to capacity buckets on deserialize, so a spilled or
+remote batch costs bandwidth proportional to data, not to padding.
+"""
+from __future__ import annotations
+
+import ctypes
+import json
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import (
+    ColumnVector, ColumnarBatch, round_capacity,
+)
+from spark_rapids_tpu.native import kudo_lib
+
+_MAGIC = 0x54505544554B4F31
+_VERSION = 1
+
+CODEC_NONE = 0
+CODEC_ZSTD = 1
+CODEC_ZLIB = 2
+_CODEC_NAMES = {"none": CODEC_NONE, "zstd": CODEC_ZSTD, "zlib": CODEC_ZLIB}
+
+
+def codec_id(name: str) -> int:
+    key = (name or "none").lower()
+    if key == "lz4":
+        # lz4 is not in this environment; zstd covers the same role
+        raise ValueError(
+            "shuffle codec 'lz4' is unavailable in this build; use 'zstd', "
+            "'zlib', or 'none' (spark.rapids.shuffle.compression.codec)")
+    if key not in _CODEC_NAMES:
+        raise ValueError(f"unknown shuffle codec {name!r}")
+    if key == "zstd":
+        try:  # fail fast HERE, not mid-serialization in a worker thread
+            import zstandard  # noqa: F401
+        except ImportError as e:
+            raise ValueError(
+                "shuffle codec 'zstd' needs the zstandard package; use "
+                "'zlib' or 'none'") from e
+    return _CODEC_NAMES[key]
+
+
+# ---------------------------------------------------------------------------
+# dtype <-> json
+# ---------------------------------------------------------------------------
+
+def dtype_to_json(dt: T.DataType):
+    if isinstance(dt, T.DecimalType):
+        return {"t": "decimal", "p": dt.precision, "s": dt.scale}
+    if isinstance(dt, T.ArrayType):
+        return {"t": "array", "e": dtype_to_json(dt.element)}
+    if isinstance(dt, T.MapType):
+        return {"t": "map", "k": dtype_to_json(dt.key),
+                "v": dtype_to_json(dt.value)}
+    if isinstance(dt, T.StructType):
+        return {"t": "struct",
+                "f": [[f.name, dtype_to_json(f.dtype)] for f in dt.fields]}
+    return {"t": type(dt).__name__}
+
+
+_SIMPLE = {cls.__name__: cls() for cls in
+           (T.NullType, T.BooleanType, T.Int8Type, T.Int16Type, T.Int32Type,
+            T.Int64Type, T.Float32Type, T.Float64Type, T.StringType,
+            T.DateType, T.TimestampType)}
+
+
+def dtype_from_json(d) -> T.DataType:
+    t = d["t"]
+    if t == "decimal":
+        return T.DecimalType(d["p"], d["s"])
+    if t == "array":
+        return T.ArrayType(dtype_from_json(d["e"]))
+    if t == "map":
+        return T.MapType(dtype_from_json(d["k"]), dtype_from_json(d["v"]))
+    if t == "struct":
+        return T.StructType(tuple(T.StructField(n, dtype_from_json(x))
+                                  for n, x in d["f"]))
+    return _SIMPLE[t]
+
+
+# ---------------------------------------------------------------------------
+# column <-> (descriptor, planes)
+# ---------------------------------------------------------------------------
+
+def _describe_column(col: ColumnVector, n: int, planes: List[np.ndarray]):
+    """Append trimmed host planes; return a json-able descriptor. Planes
+    must already be host numpy arrays."""
+    def add(arr) -> int:
+        planes.append(np.ascontiguousarray(arr))
+        return len(planes) - 1
+
+    valid_idx = None
+    if col.validity is not None:
+        valid_idx = add(np.asarray(col.validity)[:n])
+    d: Dict = {"dtype": dtype_to_json(col.dtype), "valid": valid_idx}
+    if col.is_dict:
+        d["kind"] = "dict"
+        d["unique"] = bool(col.dict_unique)
+        d["planes"] = [add(np.asarray(col.data["codes"])[:n]),
+                       add(np.asarray(col.data["dict_offsets"])),
+                       add(np.asarray(col.data["dict_bytes"]))]
+    elif isinstance(col.dtype, T.StringType):
+        off = np.asarray(col.data["offsets"])[: n + 1]
+        nbytes = int(off[-1]) if len(off) else 0
+        d["kind"] = "str"
+        d["planes"] = [add(off), add(np.asarray(col.data["bytes"])[:nbytes])]
+    elif isinstance(col.dtype, T.ArrayType):
+        off = np.asarray(col.data["offsets"])[: n + 1]
+        n_el = int(off[-1]) if len(off) else 0
+        d["kind"] = "array"
+        d["planes"] = [add(off)]
+        d["child"] = _describe_column(col.data["child"], n_el, planes)
+    elif isinstance(col.dtype, T.MapType):
+        off = np.asarray(col.data["offsets"])[: n + 1]
+        n_el = int(off[-1]) if len(off) else 0
+        d["kind"] = "map"
+        d["planes"] = [add(off)]
+        d["keys"] = _describe_column(col.data["keys"], n_el, planes)
+        d["values"] = _describe_column(col.data["values"], n_el, planes)
+    elif isinstance(col.dtype, T.StructType):
+        d["kind"] = "struct"
+        d["planes"] = []
+        d["children"] = [_describe_column(ch, n, planes)
+                         for ch in col.data["children"]]
+    else:
+        d["kind"] = "fixed"
+        d["planes"] = [add(np.asarray(col.data)[:n])]
+    return d
+
+
+_DTYPE_TAGS = {}
+
+
+def _plane(buffers, idx, np_dtype) -> np.ndarray:
+    return np.frombuffer(buffers[idx], dtype=np_dtype)
+
+
+def _pad(arr: np.ndarray, cap: int, fill=0) -> jnp.ndarray:
+    out = np.full((cap,) + arr.shape[1:], fill, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return jnp.asarray(out)
+
+
+def _rebuild_column(d, buffers, n: int, cap: int) -> ColumnVector:
+    dt = dtype_from_json(d["dtype"])
+    validity = None
+    if d["valid"] is not None:
+        validity = _pad(_plane(buffers, d["valid"], np.bool_), cap, False)
+    kind = d["kind"]
+    if kind == "dict":
+        codes = _pad(_plane(buffers, d["planes"][0], np.int32), cap)
+        doff = jnp.asarray(_plane(buffers, d["planes"][1], np.int32))
+        dby = _plane(buffers, d["planes"][2], np.uint8)
+        dby = jnp.asarray(dby if len(dby) else np.zeros(1, np.uint8))
+        return ColumnVector(dt, {"codes": codes, "dict_offsets": doff,
+                                 "dict_bytes": dby}, validity,
+                            dict_unique=bool(d.get("unique", True)))
+    if kind == "str":
+        off = _plane(buffers, d["planes"][0], np.int32)
+        by = _plane(buffers, d["planes"][1], np.uint8)
+        out_off = np.full(cap + 1, off[-1] if len(off) else 0, np.int32)
+        out_off[: len(off)] = off
+        bcap = round_capacity(max(len(by), 1))
+        return ColumnVector(dt, {"offsets": jnp.asarray(out_off),
+                                 "bytes": _pad(by, bcap)}, validity)
+    if kind == "array":
+        off = _plane(buffers, d["planes"][0], np.int32)
+        n_el = int(off[-1]) if len(off) else 0
+        ccap = round_capacity(max(n_el, 1))
+        out_off = np.full(cap + 1, n_el, np.int32)
+        out_off[: len(off)] = off
+        child = _rebuild_column(d["child"], buffers, n_el, ccap)
+        return ColumnVector(dt, {"offsets": jnp.asarray(out_off),
+                                 "child": child}, validity)
+    if kind == "map":
+        off = _plane(buffers, d["planes"][0], np.int32)
+        n_el = int(off[-1]) if len(off) else 0
+        ccap = round_capacity(max(n_el, 1))
+        out_off = np.full(cap + 1, n_el, np.int32)
+        out_off[: len(off)] = off
+        return ColumnVector(dt, {
+            "offsets": jnp.asarray(out_off),
+            "keys": _rebuild_column(d["keys"], buffers, n_el, ccap),
+            "values": _rebuild_column(d["values"], buffers, n_el, ccap),
+        }, validity)
+    if kind == "struct":
+        kids = [_rebuild_column(c, buffers, n, cap) for c in d["children"]]
+        return ColumnVector(dt, {"children": kids}, validity)
+    data = _pad(_plane(buffers, d["planes"][0], np.dtype(dt.np_dtype)), cap)
+    return ColumnVector(dt, data, validity)
+
+
+# ---------------------------------------------------------------------------
+# frame pack/unpack (native fast path + python fallback, same layout)
+# ---------------------------------------------------------------------------
+
+def _align8(x: int) -> int:
+    return (x + 7) & ~7
+
+
+def _pack_frame(meta: bytes, planes: List[np.ndarray]) -> bytes:
+    lib = kudo_lib()
+    bufs = [p.tobytes() if not p.flags["C_CONTIGUOUS"] else p for p in planes]
+    raw = [np.frombuffer(b, np.uint8) if isinstance(b, bytes)
+           else b.view(np.uint8).reshape(-1) for b in bufs]
+    lens = [int(r.nbytes) for r in raw]
+    if lib is not None:
+        n = len(raw)
+        lens_arr = (ctypes.c_uint64 * n)(*lens)
+        size = lib.kudo_frame_size(len(meta), n, lens_arr)
+        out = np.empty(size, np.uint8)
+        ptrs = (ctypes.POINTER(ctypes.c_uint8) * n)(
+            *[r.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) for r in raw])
+        written = lib.kudo_pack(
+            np.frombuffer(meta, np.uint8).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)) if meta
+            else ctypes.cast(ctypes.c_char_p(b"\0"),
+                             ctypes.POINTER(ctypes.c_uint8)),
+            len(meta), n, ptrs, lens_arr,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        assert written == size, (written, size)
+        return out.tobytes()
+    # pure-python identical layout
+    parts = [struct.pack("<QII", _MAGIC, _VERSION, len(raw))[:16],
+             struct.pack("<Q", len(meta)), meta,
+             b"\0" * (_align8(len(meta)) - len(meta))]
+    for ln in lens:
+        parts.append(struct.pack("<Q", ln))
+    for r, ln in zip(raw, lens):
+        parts.append(r.tobytes())
+        parts.append(b"\0" * (_align8(ln) - ln))
+    body = b"".join(parts)
+    import zlib as _z  # checksum fallback differs — use xxhash from native
+    h = _py_xxhash64(body)
+    return body + struct.pack("<Q", h)
+
+
+def _py_xxhash64(data: bytes, seed: int = 0) -> int:
+    """Pure-python xxhash64 (spec implementation; slow, fallback only)."""
+    P1, P2, P3 = 0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9
+    P4, P5 = 0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5
+    M = (1 << 64) - 1
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    def rnd(acc, inp):
+        return (rotl((acc + inp * P2) & M, 31) * P1) & M
+
+    n = len(data)
+    p = 0
+    if n >= 32:
+        v1, v2, v3, v4 = ((seed + P1 + P2) & M, (seed + P2) & M, seed & M,
+                          (seed - P1) & M)
+        while p + 32 <= n:
+            v1 = rnd(v1, int.from_bytes(data[p:p + 8], "little")); p += 8
+            v2 = rnd(v2, int.from_bytes(data[p:p + 8], "little")); p += 8
+            v3 = rnd(v3, int.from_bytes(data[p:p + 8], "little")); p += 8
+            v4 = rnd(v4, int.from_bytes(data[p:p + 8], "little")); p += 8
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ rnd(0, v)) * P1 + P4) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while p + 8 <= n:
+        h = (rotl(h ^ rnd(0, int.from_bytes(data[p:p + 8], "little")), 27)
+             * P1 + P4) & M
+        p += 8
+    if p + 4 <= n:
+        h = (rotl(h ^ (int.from_bytes(data[p:p + 4], "little") * P1) & M, 23)
+             * P2 + P3) & M
+        p += 4
+    while p < n:
+        h = (rotl(h ^ (data[p] * P5) & M, 11) * P1) & M
+        p += 1
+    h = ((h ^ (h >> 33)) * P2) & M
+    h = ((h ^ (h >> 29)) * P3) & M
+    return h ^ (h >> 32)
+
+
+def _unpack_frame(data: bytes, verify: bool = True
+                  ) -> Tuple[bytes, List[bytes]]:
+    lib = kudo_lib()
+    if lib is not None:
+        arr = np.frombuffer(data, np.uint8)
+        # size the descriptor tables from the header's own buffer count —
+        # any schema the packer accepted must be readable
+        max_bufs = max(1, struct.unpack_from("<I", data, 12)[0]) \
+            if len(data) >= 16 else 1
+        meta_off = ctypes.c_uint64()
+        meta_len = ctypes.c_uint64()
+        n_bufs = ctypes.c_uint32()
+        offs = (ctypes.c_uint64 * max_bufs)()
+        lens = (ctypes.c_uint64 * max_bufs)()
+        rc = lib.kudo_unpack(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(data),
+            ctypes.byref(meta_off), ctypes.byref(meta_len),
+            ctypes.byref(n_bufs), offs, lens, max_bufs,
+            1 if verify else 0)
+        if rc < 0:
+            raise ValueError(f"kudo frame parse failed (code {rc})")
+        meta = data[meta_off.value: meta_off.value + meta_len.value]
+        bufs = [data[offs[i]: offs[i] + lens[i]]
+                for i in range(n_bufs.value)]
+        return meta, bufs
+    magic, version, nb = struct.unpack_from("<QII", data, 0)
+    if magic != _MAGIC:
+        raise ValueError("bad kudo magic")
+    if version != _VERSION:
+        raise ValueError(f"unsupported kudo version {version}")
+    (ml,) = struct.unpack_from("<Q", data, 16)
+    pos = 24
+    meta = data[pos: pos + ml]
+    pos += _align8(ml)
+    lens = []
+    for _ in range(nb):
+        (ln,) = struct.unpack_from("<Q", data, pos)
+        lens.append(ln)
+        pos += 8
+    bufs = []
+    for ln in lens:
+        bufs.append(data[pos: pos + ln])
+        pos += _align8(ln)
+    if verify:
+        (want,) = struct.unpack_from("<Q", data, pos)
+        if _py_xxhash64(data[:pos]) != want:
+            raise ValueError("kudo frame checksum mismatch")
+    return meta, bufs
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def serialize_batch(batch: ColumnarBatch, codec: str = "zstd") -> bytes:
+    """Device batch -> wire bytes. Masked batches are compacted first (dead
+    rows never ship)."""
+    from spark_rapids_tpu.ops import kernels as K
+    from spark_rapids_tpu.columnar.batch import fetch_batch_host
+    if batch.row_mask is not None:
+        batch = K.compact_batch(batch)
+    host = fetch_batch_host(batch)
+    n = int(host.num_rows)
+    planes: List[np.ndarray] = []
+    cols = [_describe_column(c, n, planes) for c in host.columns]
+    meta = json.dumps({"n": n, "cols": cols}).encode()
+    frame = _pack_frame(meta, planes)
+    cid = codec_id(codec)
+    if cid == CODEC_ZSTD:
+        import zstandard
+        payload = zstandard.ZstdCompressor(level=1).compress(frame)
+    elif cid == CODEC_ZLIB:
+        import zlib
+        payload = zlib.compress(frame, 1)
+    else:
+        payload = frame
+    return bytes([cid]) + payload
+
+
+def deserialize_batch(data: bytes, verify: bool = True) -> ColumnarBatch:
+    """Wire bytes -> device batch (planes re-padded to capacity buckets)."""
+    cid = data[0]
+    payload = data[1:]
+    if cid == CODEC_ZSTD:
+        import zstandard
+        frame = zstandard.ZstdDecompressor().decompress(payload)
+    elif cid == CODEC_ZLIB:
+        import zlib
+        frame = zlib.decompress(payload)
+    elif cid == CODEC_NONE:
+        frame = payload
+    else:
+        raise ValueError(f"unknown codec id {cid}")
+    meta, bufs = _unpack_frame(frame, verify=verify)
+    desc = json.loads(meta.decode())
+    n = desc["n"]
+    cap = round_capacity(max(n, 1))
+    cols = [_rebuild_column(d, bufs, n, cap) for d in desc["cols"]]
+    return ColumnarBatch(cols, n)

@@ -1,0 +1,155 @@
+"""Spillable shuffle store: serialized partitions under a host budget.
+
+Reference parity: ShuffleBufferCatalog.scala / ShuffleReceivedBufferCatalog
+(spillable shuffle data) + RapidsShuffleThreadedWriterBase's file output.
+Blobs land in host memory; when the store exceeds
+spark.rapids.shuffle.hostSpillBudget the largest resident partitions flush
+to per-partition spill files (append-only segments). Readers stream blobs
+back in insertion order from memory or disk transparently.
+
+This is what stops ExchangeExec being a full in-memory barrier: device
+batches are serialized (device planes freed) and the serialized bytes
+themselves page out to disk under pressure.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class _DiskSeg:
+    __slots__ = ("path", "off", "length")
+
+    def __init__(self, path: str, off: int, length: int):
+        self.path = path
+        self.off = off
+        self.length = length
+
+    def read(self) -> bytes:
+        with open(self.path, "rb") as f:
+            f.seek(self.off)
+            return f.read(self.length)
+
+
+class ShuffleStore:
+    """One exchange's worth of serialized partitions."""
+
+    def __init__(self, n_partitions: int, host_budget_bytes: int,
+                 spill_dir: Optional[str] = None):
+        self.n_partitions = n_partitions
+        self.host_budget = host_budget_bytes
+        self._lock = threading.Lock()
+        #: partition -> ordered blob list; bytes = resident, _DiskSeg = spilled
+        self._parts: List[List[object]] = [[] for _ in range(n_partitions)]
+        self._resident = 0
+        self.bytes_written = 0
+        self.bytes_spilled = 0
+        self._dir = spill_dir
+        self._owns_dir = spill_dir is None
+        self._closed = False
+
+    def _spill_path(self, p: int) -> str:
+        if self._dir is None:
+            self._dir = tempfile.mkdtemp(prefix="tpu_shuffle_")
+            # spill dirs must not outlive the store: clean on GC/exit even
+            # when close() is never called explicitly
+            import weakref
+            self._finalizer = weakref.finalize(
+                self, shutil.rmtree, self._dir, True)
+        return os.path.join(self._dir, f"part_{p}.bin")
+
+    def add(self, partition: int, blob: bytes) -> None:
+        with self._lock:
+            assert not self._closed
+            self._parts[partition].append(blob)
+            self._resident += len(blob)
+            self.bytes_written += len(blob)
+            self._enforce_budget()
+
+    def _enforce_budget(self) -> None:
+        # flush the partitions holding the most resident bytes first
+        # (largest-victim-first, the spill framework's discipline)
+        while self._resident > self.host_budget:
+            sizes = [(sum(len(b) for b in part if isinstance(b, bytes)), p)
+                     for p, part in enumerate(self._parts)]
+            size, victim = max(sizes)
+            if size == 0:
+                break
+            path = self._spill_path(victim)
+            with open(path, "ab") as f:
+                part = self._parts[victim]
+                for i, b in enumerate(part):
+                    if isinstance(b, bytes):
+                        off = f.tell()
+                        f.write(b)
+                        part[i] = _DiskSeg(path, off, len(b))
+                        self._resident -= len(b)
+                        self.bytes_spilled += len(b)
+
+    def iter_partition(self, partition: int) -> Iterator[bytes]:
+        for b in list(self._parts[partition]):
+            yield b if isinstance(b, bytes) else b.read()
+
+    def partition_bytes(self, partition: int) -> int:
+        return sum(len(b) if isinstance(b, bytes) else b.length
+                   for b in self._parts[partition])
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._parts = [[] for _ in range(self.n_partitions)]
+            self._resident = 0
+            if self._owns_dir and self._dir and os.path.isdir(self._dir):
+                shutil.rmtree(self._dir, ignore_errors=True)
+                self._dir = None
+
+
+# ---------------------------------------------------------------------------
+# Cross-process shuffle files (the Spark-shuffle-files analog): a stable
+# on-disk layout one process writes and another reads. Format per file:
+# repeated [u64 little-endian blob length][blob bytes]; one file per
+# (map partition, reduce partition).
+# ---------------------------------------------------------------------------
+
+def shuffle_file(root: str, map_id: int, reduce_id: int) -> str:
+    return os.path.join(root, f"map_{map_id}_reduce_{reduce_id}.shuf")
+
+
+def write_shuffle_file(root: str, map_id: int, reduce_id: int,
+                       blobs: List[bytes]) -> str:
+    os.makedirs(root, exist_ok=True)
+    path = shuffle_file(root, map_id, reduce_id)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        for b in blobs:
+            f.write(len(b).to_bytes(8, "little"))
+            f.write(b)
+    os.replace(tmp, path)
+    return path
+
+
+def read_shuffle_file(path: str) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(8)
+            if len(hdr) < 8:
+                return
+            ln = int.from_bytes(hdr, "little")
+            yield f.read(ln)
+
+
+def read_reduce_partition(root: str, reduce_id: int) -> Iterator[bytes]:
+    """All map outputs for one reduce partition, map order."""
+    import glob
+    import re
+    paths = glob.glob(os.path.join(root, f"map_*_reduce_{reduce_id}.shuf"))
+
+    def map_of(p):
+        m = re.search(r"map_(\d+)_reduce_", os.path.basename(p))
+        return int(m.group(1))
+
+    for p in sorted(paths, key=map_of):
+        yield from read_shuffle_file(p)
