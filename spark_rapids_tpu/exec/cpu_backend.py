@@ -495,13 +495,13 @@ def _exec_aggregate(plan: P.Aggregate, child: List[CpuCol], ansi: bool) -> List[
     n = len(child[0].values) if child else 0
     key_cols = [e.eval_cpu(child, ansi) for e in plan.group_exprs]
 
-    # evaluate agg inputs
-    agg_inputs: List[Optional[CpuCol]] = []
+    # evaluate agg inputs (all children: min_by/max_by consume two)
+    agg_inputs: List[Optional[List[CpuCol]]] = []
     for a in plan.aggs:
         if isinstance(a.fn, CountAll) or not a.fn.children:
             agg_inputs.append(None)
         else:
-            agg_inputs.append(a.fn.children[0].eval_cpu(child, ansi))
+            agg_inputs.append([c.eval_cpu(child, ansi) for c in a.fn.children])
 
     if not key_cols:
         return _global_agg(plan, agg_inputs, n)
@@ -529,14 +529,18 @@ def _exec_aggregate(plan: P.Aggregate, child: List[CpuCol], ansi: bool) -> List[
     return out
 
 
-def _agg_by_gid(a: NamedAgg, inp: Optional[CpuCol], gid: np.ndarray,
+def _agg_by_gid(a: NamedAgg, inp, gid: np.ndarray,
                 n_groups: int) -> CpuCol:
+    from spark_rapids_tpu.expr.aggregates import SegmentedAgg
+    if isinstance(a.fn, SegmentedAgg):
+        return a.fn.eval_cpu_groups(inp, gid, n_groups)
     spec = a.fn.pandas_spec()
     rt = a.fn.result_type()
     if spec == "size":
         cnt = np.bincount(gid, minlength=n_groups).astype(np.int64)
         return CpuCol(T.INT64, cnt, np.ones(n_groups, np.bool_))
     assert inp is not None
+    inp = inp[0]
     if isinstance(inp.dtype, (T.Float32Type, T.Float64Type)):
         # pandas conflates NaN with null; floats need explicit Spark
         # semantics (NaN is a VALUE: sums/avg propagate it, min/max use the
@@ -655,7 +659,19 @@ def _global_agg(plan: P.Aggregate, agg_inputs, n: int) -> List[CpuCol]:
     gid = np.zeros(max(n, 0), np.int64)
     for a, inp in zip(plan.aggs, agg_inputs):
         if n == 0:
+            from spark_rapids_tpu.expr.aggregates import SegmentedAgg
             rt = a.fn.result_type()
+            if isinstance(a.fn, SegmentedAgg):
+                if isinstance(rt, T.ArrayType):  # collect_* of empty = []
+                    vals = np.empty(1, object)
+                    vals[0] = []
+                    out.append(CpuCol(rt, vals, np.ones(1, np.bool_)))
+                else:
+                    npdt = object if isinstance(rt, T.StringType) \
+                        else rt.np_dtype
+                    out.append(CpuCol(rt, np.zeros(1, npdt),
+                                      np.zeros(1, np.bool_)))
+                continue
             if a.fn.pandas_spec() in ("size", "count"):
                 out.append(CpuCol(T.INT64, np.zeros(1, np.int64),
                                   np.ones(1, np.bool_)))

@@ -647,6 +647,20 @@ class CoalesceBatchesExec(TpuExec):
             yield flush()
 
 
+def _order_keys(kc: ColumnVector, o, num_rows, live=None, n_chunks=None):
+    """(key_u64, nulls, asc, nulls_first) list for one sort order: one
+    entry for fixed-width types, one per 8-byte chunk for strings (EXACT
+    lexicographic device ordering via kernels.string_chunk_keys)."""
+    if isinstance(kc.dtype, T.StringType):
+        if n_chunks is None:
+            n_chunks = K.string_chunk_count(kc)
+        return [(k, nulls, o.ascending, o.resolved_nulls_first())
+                for k, nulls in K.string_chunk_keys(kc, num_rows, n_chunks,
+                                                    live=live)]
+    k, nulls = K.normalize_key(kc, num_rows, live=live)
+    return [(k, nulls, o.ascending, o.resolved_nulls_first())]
+
+
 class SortExec(TpuExec):
     """Whole-partition sort: evaluate sort-key expressions as a fused stage,
     normalize, single lexsort, gather (reference GpuSortExec in-core path;
@@ -675,10 +689,8 @@ class SortExec(TpuExec):
         key_cols = compiled.run_stage(key_exprs, batch)
         keys = []
         for o, kc in zip(self.plan.orders, key_cols):
-            k, nulls = K.normalize_key(kc, batch.num_rows,
-                                       for_order=isinstance(kc.dtype, T.StringType),
-                                       live=batch.live_mask())
-            keys.append((k, nulls, o.ascending, o.resolved_nulls_first()))
+            keys.extend(_order_keys(kc, o, batch.num_rows,
+                                    live=batch.live_mask()))
         return K.lexsort_indices(keys, traced_rows(batch.num_rows),
                                  live=batch.live_mask())
 
@@ -690,31 +702,45 @@ class SortExec(TpuExec):
         and pyarrow assembles the sorted output host-side, re-uploaded in
         reader-sized slices."""
         import pyarrow as pa
-        key_planes, tables = [], []
         names = self.schema.names
+        compacted, per_batch_keycols = [], []
         for b in batches:
             if b.row_mask is not None:
                 b = K.compact_batch(b)
             if int(b.num_rows) == 0:
                 continue
-            key_cols = compiled.run_stage([o.expr for o in self.plan.orders], b)
+            compacted.append(b)
+            per_batch_keycols.append(
+                compiled.run_stage([o.expr for o in self.plan.orders], b))
+        if not compacted:
+            return
+        # string chunk counts can differ per batch: fix each order's width
+        # to the max across batches so key planes align
+        widths = []
+        for ci, o in enumerate(self.plan.orders):
+            if isinstance(o.expr.data_type(), T.StringType):
+                widths.append(max(K.string_chunk_count(kc[ci])
+                                  for kc in per_batch_keycols))
+            else:
+                widths.append(1)
+        key_planes, tables = [], []
+        for b, key_cols in zip(compacted, per_batch_keycols):
             per_col = []
-            for o, kc in zip(self.plan.orders, key_cols):
-                k, nulls = K.normalize_key(
-                    kc, b.num_rows,
-                    for_order=isinstance(kc.dtype, T.StringType))
-                per_col.append((k[: int(b.num_rows)], nulls[: int(b.num_rows)]))
+            for o, kc, w in zip(self.plan.orders, key_cols, widths):
+                for k, nulls, _, _ in _order_keys(kc, o, b.num_rows,
+                                                  n_chunks=w):
+                    per_col.append((k[: int(b.num_rows)],
+                                    nulls[: int(b.num_rows)]))
             key_planes.append(per_col)
             tables.append(to_arrow(b, names))  # stages the data off-device
-        if not tables:
-            return
-        ncols = len(self.plan.orders)
         keys = []
-        for ci in range(ncols):
-            k = jnp.concatenate([kp[ci][0] for kp in key_planes])
-            nl = jnp.concatenate([kp[ci][1] for kp in key_planes])
-            o = self.plan.orders[ci]
-            keys.append((k, nl, o.ascending, o.resolved_nulls_first()))
+        pi = 0
+        for o, w in zip(self.plan.orders, widths):
+            for _ in range(w):
+                k = jnp.concatenate([kp[pi][0] for kp in key_planes])
+                nl = jnp.concatenate([kp[pi][1] for kp in key_planes])
+                keys.append((k, nl, o.ascending, o.resolved_nulls_first()))
+                pi += 1
         n = int(keys[0][0].shape[0])
         perm = np.asarray(K.lexsort_indices(keys, n))[:n]
         table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
@@ -744,15 +770,17 @@ class _AggKernels:
         self.pre_filter = pre_filter
 
     def _state_input_exprs(self):
-        """Expressions evaluated per input row: keys then, per agg, its input
-        cast to each state dtype that needs the raw input."""
+        """Expressions evaluated per input row: keys then, per agg, ALL its
+        input children (min_by/max_by consume two)."""
         exprs = list(self.group_exprs)
         for a in self.aggs:
-            if a.fn.children:
-                exprs.append(a.fn.children[0])
-            else:
-                exprs.append(None)
+            exprs.extend(a.fn.children)
         return exprs
+
+    @property
+    def has_custom(self) -> bool:
+        from spark_rapids_tpu.expr.aggregates import SegmentedAgg
+        return any(isinstance(a.fn, SegmentedAgg) for a in self.aggs)
 
     def _build_update(self, ansi: bool):
         """Build the fused update phase: expression eval + sort-group +
@@ -779,6 +807,7 @@ class _AggKernels:
         return fn
 
     def _update_batch(self, batch: ColumnarBatch, ectx) -> ColumnarBatch:
+        from spark_rapids_tpu.expr.aggregates import SegmentedAgg
         nkeys = len(self.group_exprs)
         exprs = [e for e in self._state_input_exprs() if e is not None]
         cols = [e.eval_tpu(ectx) for e in exprs]
@@ -786,9 +815,8 @@ class _AggKernels:
         input_cols = {}
         ci = nkeys
         for ai, a in enumerate(self.aggs):
-            if a.fn.children:
-                input_cols[ai] = cols[ci]
-                ci += 1
+            input_cols[ai] = cols[ci: ci + len(a.fn.children)]
+            ci += len(a.fn.children)
         cap = batch.capacity
         live = batch.live_mask()
 
@@ -797,11 +825,19 @@ class _AggKernels:
 
         if nkeys == 0:
             out_cols = []
+            nrows = traced_rows(batch.num_rows)
             for ai, a in enumerate(self.aggs):
+                if isinstance(a.fn, SegmentedAgg):
+                    # global custom agg: one segment over all rows
+                    res = a.fn.segmented_eval_tpu(
+                        input_cols[ai], jnp.arange(cap, dtype=jnp.int32),
+                        jnp.zeros(cap, jnp.int32), 1, live, nrows)
+                    out_cols.append(_resize_col(res, round_capacity(1)))
+                    continue
                 for (sname, sdt), (op, idx) in zip(a.fn.state_schema(),
                                                    a.fn.update_ops()):
                     if idx >= 0:
-                        src = input_cols[ai]
+                        src = input_cols[ai][idx]
                         if src.is_string:
                             raise NotImplementedError("string agg state on device")
                         vals = src.data
@@ -813,7 +849,8 @@ class _AggKernels:
                     out_cols.append(_resize_plane(ov, oval, sdt, round_capacity(1)))
             return ColumnarBatch(out_cols, 1)
 
-        fast = self._bucket_layout(key_cols)
+        fast = None if any(isinstance(a.fn, SegmentedAgg) for a in self.aggs) \
+            else self._bucket_layout(key_cols)
         if fast is not None:
             return self._bucket_update(batch, key_cols, input_cols, live, fast)
 
@@ -839,10 +876,16 @@ class _AggKernels:
                                                n_groups, batch.num_rows)
             for c in out_key_cols:
                 out_cols.append(_resize_col(c, out_cap))
+        nrows = traced_rows(batch.num_rows)
         for ai, a in enumerate(self.aggs):
+            if isinstance(a.fn, SegmentedAgg):
+                res = a.fn.segmented_eval_tpu(input_cols[ai], perm, seg_ids,
+                                              seg_cap, live, nrows)
+                out_cols.append(_resize_col(res, out_cap))
+                continue
             for (sname, sdt), (op, idx) in zip(a.fn.state_schema(), a.fn.update_ops()):
                 if idx >= 0:
-                    src = input_cols[ai]
+                    src = input_cols[ai][idx]
                     vals = src.data if not src.is_string else None
                     if src.is_string:
                         # min/max/first/last over strings: handled via host
@@ -927,7 +970,7 @@ class _AggKernels:
         for ai, a in enumerate(self.aggs):
             for (sname, sdt), (op, idx) in zip(a.fn.state_schema(), a.fn.update_ops()):
                 if idx >= 0:
-                    src = input_cols[ai]
+                    src = input_cols[ai][idx]
                     if src.is_string:
                         raise NotImplementedError("string agg state on device")
                     vals = src.data
@@ -999,7 +1042,8 @@ class _AggKernels:
             res = a.fn.evaluate_tpu(scols, state.num_rows)
             # clamp dtype
             rt = a.fn.result_type()
-            if not res.is_string and res.data.dtype != np.dtype(rt.np_dtype):
+            if not res.is_string and not res.is_nested \
+                    and res.data.dtype != np.dtype(rt.np_dtype):
                 res = ColumnVector(rt, res.data.astype(rt.np_dtype), res.validity)
             out_cols.append(res)
         return ColumnarBatch(out_cols, state.num_rows, state.row_mask)
@@ -1223,9 +1267,7 @@ class HashAggregateExec(TpuExec):
     def _sig(self, phase: str, ansi: bool = False):
         p = self.plan
         gfp = tuple(e.fingerprint() for e in p.group_exprs)
-        afp = tuple((type(a.fn).__name__,)
-                    + tuple(c.fingerprint() for c in a.fn.children)
-                    for a in p.aggs)
+        afp = tuple(a.fn.fingerprint() for a in p.aggs)
         pf = self.pre_filter.fingerprint() if self.pre_filter is not None else None
         return ("hashagg", phase, gfp, afp, ansi, pf)
 
@@ -1251,10 +1293,13 @@ class HashAggregateExec(TpuExec):
                 compiled.raise_errors(errs)
                 return out
 
-            if self.conf.get(C.AGG_FORCE_SINGLE_PASS) and nkeys > 0:
-                # Testing knob (reference forceSinglePassPartialSortAgg):
-                # concat every input batch and aggregate in ONE update pass
-                # instead of per-batch update + merge.
+            if (self.conf.get(C.AGG_FORCE_SINGLE_PASS) and nkeys > 0) \
+                    or self.kern.has_custom:
+                # One update pass over the concatenated input: the testing
+                # knob (reference forceSinglePassPartialSortAgg), and the
+                # REQUIRED path for custom segmented aggs (collect_*,
+                # min_by/max_by, percentile) whose results cannot merge —
+                # the planner already exchanged raw rows by key for them.
                 batches = list(child_batches)
                 child_batches = iter(
                     [K.concat_batches(batches)] if len(batches) > 1 else batches)
@@ -1333,9 +1378,32 @@ class HashAggregateExec(TpuExec):
     def _empty_state_batch(self) -> ColumnarBatch:
         fields = self.state_fields()
         cols = []
-        # zero-row update produces: count states = 0 (valid), others null
+        # zero-row update produces: count states = 0 (valid), collect
+        # results = [] (valid), others null
         for f in fields:
             cap = round_capacity(1)
+            if isinstance(f.dtype, T.ArrayType):
+                if isinstance(f.dtype.element, T.StringType):
+                    child = ColumnVector(
+                        f.dtype.element,
+                        {"offsets": jnp.zeros(9, jnp.int32),
+                         "bytes": jnp.zeros(8, jnp.uint8)},
+                        jnp.zeros(8, jnp.bool_))
+                else:
+                    child = ColumnVector(f.dtype.element,
+                                         jnp.zeros(8, f.dtype.element.np_dtype),
+                                         jnp.zeros(8, jnp.bool_))
+                cols.append(ColumnVector(
+                    f.dtype, {"offsets": jnp.zeros(cap + 1, jnp.int32),
+                              "child": child},
+                    jnp.arange(cap) < 1))
+                continue
+            if isinstance(f.dtype, T.StringType):
+                cols.append(ColumnVector(
+                    f.dtype, {"offsets": jnp.zeros(cap + 1, jnp.int32),
+                              "bytes": jnp.zeros(8, jnp.uint8)},
+                    jnp.zeros(cap, jnp.bool_)))
+                continue
             is_count = f.name.endswith("__count")
             data = jnp.zeros(cap, f.dtype.np_dtype)
             valid = (jnp.arange(cap) < 1) if is_count else jnp.zeros(cap, jnp.bool_)
@@ -1797,7 +1865,10 @@ class RangeExchangeExec(ExchangeExec):
                     lv = host[-1]
                     idx = np.flatnonzero(lv)
                     if len(idx) > budget:
-                        idx = idx[:: max(1, len(idx) // budget)][:budget]
+                        # ceil stride so samples span the WHOLE batch — a
+                        # floor stride takes a prefix and biases bounds on
+                        # pre-ordered input
+                        idx = idx[:: -(-len(idx) // budget)][:budget]
                     for i in idx:
                         samples.append(tuple(int(p[i]) for p in host[:-1]))
             if not samples:

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnVector
-from spark_rapids_tpu.expr.core import Expression
+from spark_rapids_tpu.expr.core import CpuCol, Expression
 
 
 class AggFunction:
@@ -335,3 +335,289 @@ class StddevPop(_MomentAgg):
 
     def pandas_spec(self):
         return ("std", 0)
+
+
+# ---------------------------------------------------------------------------
+# Custom segmented aggregates: functions whose per-group result cannot be a
+# fixed-width mergeable state (collect_list/set, min_by/max_by, percentile).
+# Reference: aggregateFunctions.scala GpuCollectList/GpuCollectSet/
+# GpuMinBy/GpuMaxBy, GpuPercentile.scala, GpuApproximatePercentile.scala.
+#
+# TPU-first: these run in COMPLETE mode only (the planner exchanges RAW
+# rows by group key first — `no_partial`), where the sort-based aggregator
+# hands them the group-sorted row order; each computes its final column in
+# one traced pass with segment reductions / one extra in-group sort.
+# ---------------------------------------------------------------------------
+
+
+class SegmentedAgg(AggFunction):
+    """Base for complete-mode custom aggregates."""
+
+    no_partial = True
+
+    def state_schema(self):
+        return [("result", self.result_type())]
+
+    def update_ops(self):
+        return [("custom", 0)]
+
+    def merge_ops(self):
+        # never reached: no_partial plans run a single update pass
+        raise NotImplementedError(
+            f"{type(self).__name__} has no mergeable partial state")
+
+    def evaluate_tpu(self, state_cols, n_groups):
+        return state_cols[0]
+
+    def segmented_eval_tpu(self, inputs, perm, seg_ids, seg_cap, live,
+                           num_rows) -> ColumnVector:
+        raise NotImplementedError
+
+    def eval_cpu_groups(self, inputs, gid, n_groups):
+        raise NotImplementedError
+
+
+def _valid_under(col: ColumnVector, live):
+    return live if col.validity is None else (col.validity & live)
+
+
+def _pack_valid_front(src: ColumnVector, perm, keep_sorted, cap):
+    """Scatter the kept sorted rows to the front (stable): returns
+    (child ColumnVector, dest positions of kept rows)."""
+    from spark_rapids_tpu.ops import kernels as K
+    dest = jnp.cumsum(keep_sorted.astype(jnp.int32)) - keep_sorted
+    src_idx = jnp.full(cap, -1, jnp.int32).at[
+        jnp.where(keep_sorted, dest, cap)].set(perm, mode="drop")
+    return K.gather_column(src, src_idx, cap), dest
+
+
+class CollectList(SegmentedAgg):
+    """collect_list: group values in stable input order, nulls dropped."""
+
+    def result_type(self):
+        return T.ArrayType(self.children[0].data_type(), contains_null=False)
+
+    def segmented_eval_tpu(self, inputs, perm, seg_ids, seg_cap, live,
+                           num_rows):
+        import jax
+        src = inputs[0]
+        cap = perm.shape[0]
+        keep = _valid_under(src, live)[perm]
+        child, _ = _pack_valid_front(src, perm, keep, cap)
+        counts = jax.ops.segment_sum(keep.astype(jnp.int32), seg_ids,
+                                     num_segments=seg_cap)
+        offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(counts).astype(jnp.int32)])
+        return ColumnVector(self.result_type(),
+                            {"offsets": offsets, "child": child}, None)
+
+    def eval_cpu_groups(self, inputs, gid, n_groups):
+        src = inputs[0]
+        out = [[] for _ in range(n_groups)]
+        for g, v, ok in zip(gid, src.values, src.valid):
+            if ok and v is not None:
+                out[g].append(v)
+        vals = np.empty(n_groups, object)
+        vals[:] = out
+        return CpuCol(self.result_type(), vals, np.ones(n_groups, np.bool_))
+
+
+class CollectSet(SegmentedAgg):
+    """collect_set: distinct group values. Spark leaves element order
+    unspecified; both backends emit ascending value order (deterministic,
+    and any order is conformant)."""
+
+    def result_type(self):
+        return T.ArrayType(self.children[0].data_type(), contains_null=False)
+
+    def segmented_eval_tpu(self, inputs, perm, seg_ids, seg_cap, live,
+                           num_rows):
+        import jax
+        from jax import lax
+        from spark_rapids_tpu.ops import kernels as K
+        src = inputs[0]
+        cap = perm.shape[0]
+        keep = _valid_under(src, live)[perm]
+        vkey, _ = K.normalize_key(src, num_rows, live=live)
+        vkey_s = vkey[perm]
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        # re-sort within groups by value (invalid rows last) to expose
+        # duplicates as adjacent runs
+        _, _, _, idx2 = lax.sort(
+            (seg_ids, (~keep).astype(jnp.uint8), vkey_s, iota),
+            num_keys=3, is_stable=True)
+        seg2 = seg_ids[idx2]
+        vk2 = vkey_s[idx2]
+        keep2 = keep[idx2]
+        first = jnp.concatenate([
+            jnp.ones(1, jnp.bool_),
+            (seg2[1:] != seg2[:-1]) | (vk2[1:] != vk2[:-1])])
+        keep2 = keep2 & first
+        perm2 = perm[idx2]
+        child, _ = _pack_valid_front(src, perm2, keep2, cap)
+        counts = jax.ops.segment_sum(keep2.astype(jnp.int32), seg2,
+                                     num_segments=seg_cap)
+        offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(counts).astype(jnp.int32)])
+        return ColumnVector(self.result_type(),
+                            {"offsets": offsets, "child": child}, None)
+
+    def eval_cpu_groups(self, inputs, gid, n_groups):
+        src = inputs[0]
+        seen = [dict() for _ in range(n_groups)]
+        for g, v, ok in zip(gid, src.values, src.valid):
+            if ok and v is not None:
+                # NaN is ONE distinct set member (Spark semantics); python
+                # dict keying by the value itself would keep every NaN
+                key = "__nan__" if isinstance(v, float) and v != v else v
+                seen[g].setdefault(key, v)
+        def skey(x):
+            return (2, 0) if isinstance(x, float) and x != x else (1, x)
+        out = [sorted(s.values(), key=skey) for s in seen]
+        vals = np.empty(n_groups, object)
+        vals[:] = out
+        return CpuCol(self.result_type(), vals, np.ones(n_groups, np.bool_))
+
+
+class _MinMaxBy(SegmentedAgg):
+    """min_by/max_by(value, ordering): value at the extreme ordering. Rows
+    with null ordering are ignored; ties break to the earliest row in
+    group-sorted (stable input) order."""
+
+    is_min = True
+
+    def result_type(self):
+        return self.children[0].data_type()
+
+    def segmented_eval_tpu(self, inputs, perm, seg_ids, seg_cap, live,
+                           num_rows):
+        import jax
+        from spark_rapids_tpu.ops import kernels as K
+        val, ordc = inputs
+        cap = perm.shape[0]
+        ok = _valid_under(ordc, live)
+        okey, _ = K.normalize_key(ordc, num_rows, live=live)
+        if not self.is_min:
+            okey = ~okey
+        key_s = jnp.where(ok, okey, jnp.uint64(0xFFFFFFFFFFFFFFFF))[perm]
+        gmin = jax.ops.segment_min(key_s, seg_ids, num_segments=seg_cap)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        hit = ok[perm] & (key_s == gmin[seg_ids])
+        pos = jnp.where(hit, iota, cap)
+        sel = jax.ops.segment_min(pos, seg_ids, num_segments=seg_cap)
+        has = sel < cap
+        src_idx = jnp.where(has, perm[jnp.clip(sel, 0, cap - 1)], -1)
+        return K.gather_column(val, src_idx, cap)
+
+    def eval_cpu_groups(self, inputs, gid, n_groups):
+        from spark_rapids_tpu.exec.cpu_backend import _norm_key_np
+        val, ordc = inputs
+        okey, onull = _norm_key_np(ordc)
+        if not self.is_min:
+            okey = ~okey
+        best = {}
+        for i, g in enumerate(gid):
+            if onull[i]:
+                continue
+            if g not in best or okey[i] < okey[best[g]]:
+                best[g] = i
+        rt = self.result_type()
+        is_obj = isinstance(rt, (T.StringType, T.ArrayType, T.StructType,
+                                 T.MapType))
+        vals = np.empty(n_groups, object) if is_obj \
+            else np.zeros(n_groups, rt.np_dtype)
+        ok = np.zeros(n_groups, np.bool_)
+        for g, i in best.items():
+            if val.valid[i]:
+                vals[g] = val.values[i]
+                ok[g] = True
+        return CpuCol(rt, vals, ok)
+
+
+class MinBy(_MinMaxBy):
+    is_min = True
+
+
+class MaxBy(_MinMaxBy):
+    is_min = False
+
+
+class Percentile(SegmentedAgg):
+    """percentile(col, p): exact percentile with linear interpolation
+    (reference GpuPercentile.scala). approx_percentile shares this path —
+    the exact answer satisfies any accuracy parameter, so on TPU the
+    approximate form is simply... exact (reference uses t-digest because
+    cuDF has one; a sorted segmented batch gives exactness for free)."""
+
+    def __init__(self, child, percentage: float):
+        super().__init__(child)
+        self.percentage = float(percentage)
+        if not (0.0 <= self.percentage <= 1.0):
+            from spark_rapids_tpu.expr.core import SparkException
+            raise SparkException(
+                f"percentage must be in [0, 1], got {percentage}")
+
+    def fingerprint(self):
+        return f"{type(self).__name__}({self.percentage};" + \
+            ",".join(c.fingerprint() for c in self.children) + ")"
+
+    def transform(self, fn):
+        return type(self)(self.children[0].transform(fn), self.percentage)
+
+    def result_type(self):
+        return T.FLOAT64
+
+    def segmented_eval_tpu(self, inputs, perm, seg_ids, seg_cap, live,
+                           num_rows):
+        import jax
+        from jax import lax
+        src = inputs[0]
+        cap = perm.shape[0]
+        keep = _valid_under(src, live)[perm]
+        v = src.data.astype(jnp.float64)[perm]
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        # kept rows pack to the FRONT globally (invalid/dead rows would
+        # otherwise sit inside their segment and shift every later
+        # segment's offsets), segment-major, values ascending
+        _, _, _, idx2 = lax.sort(
+            ((~keep).astype(jnp.uint8), seg_ids, v, iota),
+            num_keys=3, is_stable=True)
+        v2 = v[idx2]
+        m = jax.ops.segment_sum(keep.astype(jnp.int32), seg_ids,
+                                num_segments=seg_cap)
+        starts = jnp.cumsum(m) - m
+        rank = self.percentage * jnp.maximum(m - 1, 0).astype(jnp.float64)
+        lo = jnp.floor(rank).astype(jnp.int32)
+        hi = jnp.ceil(rank).astype(jnp.int32)
+        frac = rank - lo.astype(jnp.float64)
+        vlo = v2[jnp.clip(starts + lo, 0, cap - 1)]
+        vhi = v2[jnp.clip(starts + hi, 0, cap - 1)]
+        res = vlo + (vhi - vlo) * frac
+        return ColumnVector(T.FLOAT64, res, m > 0)
+
+    def eval_cpu_groups(self, inputs, gid, n_groups):
+        src = inputs[0]
+        buckets = [[] for _ in range(n_groups)]
+        for g, v, ok in zip(gid, src.values, src.valid):
+            if ok:
+                buckets[g].append(float(v))
+        vals = np.zeros(n_groups, np.float64)
+        okm = np.zeros(n_groups, np.bool_)
+        for g, b in enumerate(buckets):
+            if not b:
+                continue
+            b.sort()
+            rank = self.percentage * (len(b) - 1)
+            lo, hi = int(np.floor(rank)), int(np.ceil(rank))
+            vals[g] = b[lo] + (b[hi] - b[lo]) * (rank - lo)
+            okm[g] = True
+        return CpuCol(T.FLOAT64, vals, okm)
+
+
+class ApproxPercentile(Percentile):
+    """approx_percentile(col, p[, accuracy]): exact on this engine (see
+    Percentile) — any accuracy parameter is trivially satisfied."""
+
+    def __init__(self, child, percentage: float, accuracy: int = 10000):
+        super().__init__(child, percentage)
+        self.accuracy = accuracy

@@ -247,6 +247,49 @@ def normalize_key(col: ColumnVector, num_rows: int,
     return key, ~valid
 
 
+def string_chunk_count(col: ColumnVector) -> int:
+    """Number of 8-byte chunks covering the longest string in the column
+    (HOST-side: one device scalar fetch — call at sort boundaries, never
+    inside jit). Rounded up to a power of two to bound kernel variants."""
+    off = col.data["dict_offsets"] if col.is_dict else col.data["offsets"]
+    mx = int(jnp.max(off[1:] - off[:-1]))
+    return round_capacity(max(1, -(-mx // 8)), minimum=1)
+
+
+def string_chunk_keys(col: ColumnVector, num_rows: int, n_chunks: int,
+                      live=None) -> List[Tuple[jax.Array, jax.Array]]:
+    """EXACT device string ordering: per row, n_chunks u64 keys holding the
+    UTF-8 bytes big-endian (zero padded), most-significant chunk first —
+    unsigned lexsort over them IS lexicographic byte order (= Spark's
+    binary string ordering). Replaces the host string sort; embedded NUL
+    bytes tie with end-of-string (documented, vanishingly rare in UTF-8).
+    Dict columns build chunk planes over the (small) vocab once and gather
+    by code."""
+    if live is not None:
+        valid = live if col.validity is None else (col.validity & live)
+    else:
+        valid = col.validity_or_default(num_rows)
+    nulls = ~valid
+    if col.is_dict:
+        off, raw = col.data["dict_offsets"], col.data["dict_bytes"]
+    else:
+        off, raw = col.data["offsets"], col.data["bytes"]
+    starts = off[:-1].astype(jnp.int32)
+    ends = off[1:].astype(jnp.int32)
+    nbytes = raw.shape[0]
+    out = []
+    for j in range(n_chunks):
+        pos = starts[:, None] + 8 * j + jnp.arange(8, dtype=jnp.int32)[None, :]
+        b = jnp.where(pos < ends[:, None],
+                      raw[jnp.clip(pos, 0, nbytes - 1)], 0).astype(jnp.uint64)
+        shifts = jnp.uint64(8) * (jnp.uint64(7) - jnp.arange(8, dtype=jnp.uint64))
+        key = jnp.sum(b << shifts[None, :], axis=1)
+        if col.is_dict:
+            key = key[col.data["codes"]]
+        out.append((key, nulls))
+    return out
+
+
 def _frexp_arith(a: jax.Array):
     """(m, e) with a = m * 2^e, m in [1, 2), for positive normal a —
     computed with comparisons and exact power-of-two multiplies only.

@@ -304,6 +304,40 @@ agg_rule(A.VarianceSamp, _NUM, "var_samp")
 agg_rule(A.VariancePop, _NUM, "var_pop")
 
 
+def _primitive_input_only(what: str):
+    def check(fn) -> Optional[str]:
+        for c in fn.children:
+            if isinstance(c.data_type(), (T.ArrayType, T.StructType,
+                                          T.MapType)):
+                return f"{what} over nested inputs runs on CPU"
+        return None
+    return check
+
+
+agg_rule(A.CollectList, Sigs.COMMON, "collect_list",
+         extra=_primitive_input_only("collect_list"))
+agg_rule(A.CollectSet, Sigs.COMMON, "collect_set",
+         extra=_primitive_input_only("collect_set"))
+def _minmax_by_check(what: str):
+    def check(fn) -> Optional[str]:
+        r = _primitive_input_only(what)(fn)
+        if r:
+            return r
+        if isinstance(fn.children[1].data_type(), T.StringType):
+            # device ordering key for strings is an equality hash, not
+            # order-faithful — string ordering columns run on CPU
+            return f"{what} ordered by a string column runs on CPU"
+        return None
+    return check
+
+
+agg_rule(A.MinBy, Sigs.COMMON, "min_by", extra=_minmax_by_check("min_by"))
+agg_rule(A.MaxBy, Sigs.COMMON, "max_by", extra=_minmax_by_check("max_by"))
+agg_rule(A.Percentile, _NUM, "percentile (exact)")
+agg_rule(A.ApproxPercentile, _NUM,
+         "approx_percentile (computed exactly on this engine)")
+
+
 # ---------------------------------------------------------------------------
 # Expression tagging
 # ---------------------------------------------------------------------------
@@ -453,7 +487,8 @@ class SparkPlanMeta:
     #: stay primitive-only until nested key normalization lands.
     NESTED_SCHEMA_NODES = (P.Project, P.Filter, P.Generate, P.InMemorySource,
                            P.ParquetScan, P.TextScan, P.Limit, P.Union,
-                           P.Sort, P.CachedRelation, P.ShuffleFileScan)
+                           P.Sort, P.CachedRelation, P.ShuffleFileScan,
+                           P.Aggregate)
 
     def _tag_schema(self) -> None:
         sig = (Sigs.COMMON.nested()
@@ -475,17 +510,19 @@ class SparkPlanMeta:
         elif isinstance(p, P.Aggregate):
             for e in p.group_exprs:
                 tag_expression(e, self.conf, self.reasons, name)
+                if isinstance(e.data_type(), (T.ArrayType, T.StructType,
+                                              T.MapType)):
+                    self.reasons.append(
+                        f"{name}: grouping by nested type "
+                        f"{e.data_type()!r} has no device key normalization")
             for a in p.aggs:
                 tag_agg(a.fn, self.conf, self.reasons, name)
         elif isinstance(p, P.Sort):
+            # string ORDER BY runs on device via exact 8-byte chunk keys
+            # (kernels.string_chunk_keys)
             for o in p.orders:
                 tag_expression(o.expr, self.conf, self.reasons, name)
                 odt = o.expr.data_type()
-                if isinstance(odt, T.StringType):
-                    self.reasons.append(
-                        f"{name}: ORDER BY on strings requires host sort "
-                        f"(device string ordering lands with the radix "
-                        f"string-sort kernel)")
                 if isinstance(odt, (T.ArrayType, T.StructType, T.MapType)):
                     self.reasons.append(
                         f"{name}: ORDER BY on nested type {odt!r} has no "
@@ -653,6 +690,19 @@ class SparkPlanMeta:
             child = child.children[0]
         if child.num_partitions == 1:
             return X.HashAggregateExec(p, [child], conf, mode="complete",
+                                       pre_filter=pre_filter)
+        if any(getattr(a.fn, "no_partial", False) for a in p.aggs):
+            # custom segmented aggs (collect_*, min_by, percentile) have no
+            # mergeable partial state: exchange RAW rows by group key, then
+            # aggregate each partition completely (reference: these aggs
+            # carry whole-collection buffers between stages; shuffling rows
+            # first is the TPU-shaped equivalent)
+            if p.group_exprs:
+                exch = X.ShuffleExchangeExec(p, [child], conf, p.group_exprs,
+                                             n_out=child.num_partitions)
+            else:
+                exch = X.CollectExchangeExec(p, [child], conf)
+            return X.HashAggregateExec(p, [exch], conf, mode="complete",
                                        pre_filter=pre_filter)
         partial = X.HashAggregateExec(p, [child], conf, mode="partial",
                                       pre_filter=pre_filter)

@@ -146,9 +146,6 @@ def _describe_column(col: ColumnVector, n: int, planes: List[np.ndarray]):
     return d
 
 
-_DTYPE_TAGS = {}
-
-
 def _plane(buffers, idx, np_dtype) -> np.ndarray:
     return np.frombuffer(buffers[idx], dtype=np_dtype)
 
@@ -248,7 +245,6 @@ def _pack_frame(meta: bytes, planes: List[np.ndarray]) -> bytes:
         parts.append(r.tobytes())
         parts.append(b"\0" * (_align8(ln) - ln))
     body = b"".join(parts)
-    import zlib as _z  # checksum fallback differs — use xxhash from native
     h = _py_xxhash64(body)
     return body + struct.pack("<Q", h)
 
@@ -302,10 +298,11 @@ def _unpack_frame(data: bytes, verify: bool = True
     lib = kudo_lib()
     if lib is not None:
         arr = np.frombuffer(data, np.uint8)
-        # size the descriptor tables from the header's own buffer count —
-        # any schema the packer accepted must be readable
-        max_bufs = max(1, struct.unpack_from("<I", data, 12)[0]) \
-            if len(data) >= 16 else 1
+        # size the descriptor tables from the header's own buffer count,
+        # clamped by what the frame could possibly hold (a corrupt header
+        # must not trigger a giant allocation)
+        hdr_bufs = struct.unpack_from("<I", data, 12)[0] if len(data) >= 16 else 0
+        max_bufs = max(1, min(hdr_bufs, len(data) // 8))
         meta_off = ctypes.c_uint64()
         meta_len = ctypes.c_uint64()
         n_bufs = ctypes.c_uint32()

@@ -51,6 +51,33 @@ def last(c):
     return A.Last(_e(c))
 
 
+def collect_list(c):
+    return A.CollectList(_e(c))
+
+
+def collect_set(c):
+    return A.CollectSet(_e(c))
+
+
+def min_by(c, ord_c):
+    return A.MinBy(_e(c), _e(ord_c))
+
+
+def max_by(c, ord_c):
+    return A.MaxBy(_e(c), _e(ord_c))
+
+
+def percentile(c, p: float):
+    return A.Percentile(_e(c), p)
+
+
+def approx_percentile(c, p: float, accuracy: int = 10000):
+    return A.ApproxPercentile(_e(c), p, accuracy)
+
+
+percentile_approx = approx_percentile
+
+
 def stddev(c):
     return A.StddevSamp(_e(c))
 

@@ -47,13 +47,29 @@ def _row_eq(a, b, approx: Optional[float]) -> bool:
     return a == b
 
 
+def _canon_arrays(rows, names):
+    """Sort list-valued cells (None-first) — for aggregates whose element
+    order Spark leaves unspecified (collect_set)."""
+    def key(v):
+        return (v is not None, v if v is not None else 0)
+    for r in rows:
+        for k in names:
+            if isinstance(r.get(k), list):
+                r[k] = sorted(r[k], key=key)
+    return rows
+
+
 def assert_tables_equal(tpu: pa.Table, cpu: pa.Table,
                         ignore_order: bool = False,
-                        approx_float: Optional[float] = None) -> None:
+                        approx_float: Optional[float] = None,
+                        canonicalize_arrays: bool = False) -> None:
     assert tpu.schema.names == cpu.schema.names, \
         f"schema names differ: {tpu.schema.names} vs {cpu.schema.names}"
     trows = _canon(tpu)
     crows = _canon(cpu)
+    if canonicalize_arrays:
+        _canon_arrays(trows, tpu.schema.names)
+        _canon_arrays(crows, tpu.schema.names)
     assert len(trows) == len(crows), \
         f"row count differs: tpu={len(trows)} cpu={len(crows)}\n" \
         f"tpu={trows[:20]}\ncpu={crows[:20]}"
@@ -71,7 +87,8 @@ def assert_tables_equal(tpu: pa.Table, cpu: pa.Table,
 def assert_tpu_and_cpu_are_equal_collect(df_fn: Callable, session,
                                          ignore_order: bool = False,
                                          approx_float: Optional[float] = None,
-                                         conf: Optional[dict] = None):
+                                         conf: Optional[dict] = None,
+                                         canonicalize_arrays: bool = False):
     """df_fn(session) -> DataFrame. Runs it on the TPU engine and the CPU
     backend and diffs results."""
     if conf:
@@ -82,7 +99,8 @@ def assert_tpu_and_cpu_are_equal_collect(df_fn: Callable, session,
     df = df_fn(session)
     tpu = df.collect()
     cpu = df.collect_cpu()
-    assert_tables_equal(tpu, cpu, ignore_order, approx_float)
+    assert_tables_equal(tpu, cpu, ignore_order, approx_float,
+                        canonicalize_arrays=canonicalize_arrays)
     return tpu
 
 

@@ -1,0 +1,131 @@
+"""Differential tests for collect_list/set, min_by/max_by, percentile.
+
+Reference parity: hash_aggregate_test.py collect/percentile coverage
+(GpuCollectList/Set, GpuMinBy/MaxBy, GpuPercentile,
+GpuApproximatePercentile).
+"""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    IntegerGen, LongGen, DoubleGen, StringGen, RepeatSeqGen, UniqueLongGen,
+    gen_df,
+)
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+DATA = {
+    "k": pa.array(["a", "b", "a", None, "b", "a", None, "c"]),
+    "v": pa.array([10, 20, None, 40, 50, 60, 70, None], pa.int64()),
+    "o": pa.array([3, 1, 4, 1, 5, None, 2, 6], pa.int64()),
+    "f": pa.array([1.5, 2.5, None, 4.5, 0.5, 3.5, 2.0, None]),
+    "s": pa.array(["x", "y", "x", "z", None, "y", "w", "x"]),
+}
+
+
+def make_df(s, parts=1):
+    return s.create_dataframe(dict(DATA), num_partitions=parts)
+
+
+@pytest.mark.parametrize("parts", [1, 3])
+def test_collect_list(session, parts):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s, parts).group_by(col("k")).agg(
+            F.collect_list(col("v")).alias("lv")),
+        session, ignore_order=True)
+
+
+@pytest.mark.parametrize("parts", [1, 3])
+def test_collect_set(session, parts):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s, parts).group_by(col("k")).agg(
+            F.collect_set(col("v")).alias("sv"),
+            F.collect_set(col("s")).alias("ss")),
+        session, ignore_order=True, canonicalize_arrays=True)
+
+
+def test_collect_list_strings(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).group_by(col("k")).agg(
+            F.collect_list(col("s")).alias("ls")),
+        session, ignore_order=True)
+
+
+def test_collect_global(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).agg(F.collect_list(col("v")).alias("all"),
+                                 F.collect_set(col("k")).alias("ks")),
+        session, canonicalize_arrays=True)
+
+
+def test_collect_empty_input(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s).filter(col("v") > lit(10 ** 6))
+        .agg(F.collect_list(col("v")).alias("e")),
+        session)
+
+
+@pytest.mark.parametrize("parts", [1, 3])
+def test_min_by_max_by(session, parts):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s, parts).group_by(col("k")).agg(
+            F.min_by(col("v"), col("o")).alias("mnb"),
+            F.max_by(col("v"), col("o")).alias("mxb"),
+            F.min_by(col("s"), col("o")).alias("mnbs")),
+        session, ignore_order=True)
+
+
+def test_min_by_all_null_ord(session):
+    t = {"k": pa.array(["a", "a", "b"]),
+         "v": pa.array([1, 2, 3], pa.int64()),
+         "o": pa.array([None, None, 5], pa.int64())}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).group_by(col("k")).agg(
+            F.min_by(col("v"), col("o")).alias("m")),
+        session, ignore_order=True)
+
+
+@pytest.mark.parametrize("p", [0.0, 0.25, 0.5, 0.9, 1.0])
+def test_percentile(session, p):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: make_df(s, 2).group_by(col("k")).agg(
+            F.percentile(col("f"), p).alias("pf"),
+            F.approx_percentile(col("v"), p).alias("pv")),
+        session, ignore_order=True, approx_float=1e-12)
+
+
+def test_agg_breadth_generated(session):
+    spec = [("k", RepeatSeqGen(IntegerGen(min_val=0, max_val=25), length=20)),
+            ("v", LongGen(min_val=-(1 << 40), max_val=1 << 40)),
+            ("o", UniqueLongGen()),
+            ("d", DoubleGen(min_val=-1e9, max_val=1e9))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, spec, length=2048, seed=83, num_partitions=3)
+        .group_by(col("k")).agg(
+            F.collect_set(col("v")).alias("cs"),
+            F.min_by(col("v"), col("o")).alias("mb"),
+            F.max_by(col("d"), col("o")).alias("xb"),
+            F.percentile(col("d"), 0.75).alias("p75"),
+            F.sum("v").alias("sv")),
+        session, ignore_order=True, approx_float=1e-9,
+        canonicalize_arrays=True)
+
+
+def test_collect_list_order_preserved_single_partition(session):
+    # within one partition collect_list preserves input order (stable
+    # group sort)
+    out = make_df(session).group_by(col("k")).agg(
+        F.collect_list(col("v")).alias("lv")).to_pydict()
+    got = dict(zip(out["k"], out["lv"]))
+    assert got["a"] == [10, 60]
+    assert got["b"] == [20, 50]
+    assert got[None] == [40, 70]

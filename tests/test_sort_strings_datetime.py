@@ -75,12 +75,17 @@ def test_sort_date(session):
         session)
 
 
-def test_sort_string_falls_back(session):
-    """String ORDER BY requires host sort in round 1 -> CPU fallback with
-    identical results (reference per-op fallback discipline)."""
-    assert_fallback_collect(
+def test_sort_string_on_device(session):
+    """String ORDER BY runs on DEVICE via exact 8-byte chunk keys
+    (kernels.string_chunk_keys) — no fallback, results exact."""
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    df = make_df(session).select(col("s")).order_by(SortOrder(col("s")))
+    root, _ = convert_plan(df.plan, session.conf)
+    assert isinstance(root, X.SortExec)
+    assert_tpu_and_cpu_are_equal_collect(
         lambda s: make_df(s).select(col("s")).order_by(SortOrder(col("s"))),
-        session, "Sort")
+        session)
 
 
 # -- strings ----------------------------------------------------------------
@@ -227,10 +232,12 @@ def test_ts_cast_date(session):
 
 def test_explain_reports_fallback(session):
     from spark_rapids_tpu.plan.overrides import explain_plan
-    df = make_df(session).order_by(SortOrder(col("s")))
+    from spark_rapids_tpu.sql import functions as _F
+    df = make_df(session).select(_F.regexp_extract(col("s"), "(a+)", 1)
+                                 .alias("m"))
     text = explain_plan(df.plan, session.conf, all_ops=True)
     assert "cannot run on TPU because" in text
-    assert "ORDER BY on strings" in text
+    assert "runs on CPU" in text
 
 
 def test_exec_disable_conf(session):
@@ -238,3 +245,44 @@ def test_exec_disable_conf(session):
     s2 = TpuSession({"spark.rapids.sql.exec.Filter": "false"})
     assert_fallback_collect(
         lambda s: make_df(s).filter(col("a") > lit(2)), s2, "Filter")
+
+
+def test_device_string_sort_exact(session):
+    # unicode, shared prefixes, >8-byte strings, empties, nulls — exact
+    # lexicographic byte order on device, asc and desc
+    import pyarrow as pa
+    vals = ["pear", "Peach", "", None, "apple", "applesauce", "appl",
+            "züricher-strasse-123456789", "zürich", "éclair", "é",
+            "aaaaaaaabbbbbbbbcccccccc", "aaaaaaaabbbbbbbbcccccccd", None,
+            "z", "a" * 40, "a" * 39]
+    t = {"s": pa.array(vals), "i": pa.array(list(range(len(vals))))}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).order_by(
+            SortOrder(col("s"), ascending=True, nulls_first=False), col("i").asc()),
+        session)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).order_by(
+            SortOrder(col("s"), ascending=False), col("i").asc()),
+        session)
+
+
+def test_device_string_sort_generated(session):
+    from data_gen import StringGen, IntegerGen, gen_df
+    spec = [("s", StringGen(min_len=0, max_len=25)), ("i", IntegerGen())]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, spec, length=2048, seed=91).order_by(
+            SortOrder(col("s")), col("i").asc()),
+        session)
+
+
+def test_device_string_sort_ooc(session):
+    # out-of-core path with string keys (chunk widths differ per batch)
+    import pyarrow as pa
+    from spark_rapids_tpu.sql.session import TpuSession
+    s2 = TpuSession({"spark.rapids.sql.sort.outOfCoreBytes": 1})
+    vals = ["kiwi", "banana", None, "apple", "fig", "cherry" * 5, "date"]
+    t = {"s": pa.array(vals)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t, num_partitions=1).order_by(
+            SortOrder(col("s"))),
+        s2)
