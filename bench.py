@@ -1,15 +1,21 @@
-"""Round benchmark: hot analytics on TPU vs host CPU.
+"""Round benchmark: hot analytics on TPU vs host CPU (pyarrow/pandas).
 
 Scenario: the working set is resident (device HBM via df.cache() for the
 TPU engine — the ParquetCachedBatchSerializer analog; host RAM for the
-pyarrow baseline) and queries run repeatedly — the interactive-analytics
-case the reference accelerates. Two TPC-H-shaped queries:
+baseline) and queries run repeatedly — the interactive-analytics case the
+reference accelerates. Five TPC-H/DS-shaped queries cover the engine's
+main subsystems (VERDICT r1 #7: joins, windows, and shuffles must be
+measured, not just scans):
 
-  q6: filter + sum(price*discount)            (scan/filter/reduce)
-  q1: group by 2 string keys, 5 aggregates    (sort/segmented aggregation)
+  q6      filter + sum(price*discount)          scan/filter/reduce
+  q1      group by 2 string keys, 5 aggregates  segmented aggregation
+  q3join  lineitem x orders hash join + topN    build/probe join, sort
+  q67win  rank over (partition, order) + agg    window family
+  q72shfl 8-partition high-card group-by        hash shuffle exchange
 
-Prints ONE JSON line: geometric-mean wall-clock speedup vs the pyarrow
-CPU baseline, per-query detail included.
+Output: ONE JSON line — geometric-mean wall-clock speedup vs the host
+baseline, per-query detail including effective scanned GB/s and the
+fraction of the v5e HBM roofline (~819 GB/s) that represents.
 """
 from __future__ import annotations
 
@@ -22,8 +28,14 @@ import time
 import numpy as np
 
 ROWS = int(os.environ.get("BENCH_ROWS", 30_000_000))  # ~SF5 lineitem
-REPS = int(os.environ.get("BENCH_REPS", 5))
+ORDERS = max(ROWS // 10, 1000)
+#: the window query runs on a slice (both backends): a 30M-row
+#: groupby-rank costs minutes on the pandas baseline alone
+WIN_ROWS = min(ROWS, int(os.environ.get("BENCH_WIN_ROWS", 10_000_000)))
+SHUFFLE_PARTS = int(os.environ.get("BENCH_SHUFFLE_PARTS", 4))
+REPS = int(os.environ.get("BENCH_REPS", 3))
 BACKEND_TIMEOUT_S = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", 90))
+HBM_ROOFLINE_GBPS = 819.0  # v5e HBM bandwidth
 
 LO, HI = 8766, 9131  # [1994-01-01, 1995-01-01) in days since epoch
 
@@ -69,7 +81,7 @@ def probe_backend(timeout_s: float) -> str | None:
     return None
 
 
-METRIC = "hot_analytics_q6_q1_geomean_speedup_vs_pyarrow_cpu"
+METRIC = "hot_analytics_5q_geomean_speedup_vs_host_cpu"
 
 
 def emit_error(error: str, *, skipped: bool) -> None:
@@ -82,13 +94,14 @@ def emit_error(error: str, *, skipped: bool) -> None:
     print(json.dumps(rec))
 
 
-def make_table():
+def make_tables():
     import pyarrow as pa
 
     rng = np.random.default_rng(42)
     flags = np.array(["A", "N", "R"])[rng.integers(0, 3, ROWS)]
     status = np.array(["F", "O"])[rng.integers(0, 2, ROWS)]
-    return pa.table({
+    lineitem = pa.table({
+        "l_orderkey": rng.integers(0, ORDERS, ROWS).astype(np.int64),
         "l_returnflag": flags,
         "l_linestatus": status,
         "l_quantity": rng.integers(1, 51, ROWS).astype(np.float64),
@@ -96,6 +109,33 @@ def make_table():
         "l_discount": np.round(rng.uniform(0.0, 0.10, ROWS), 2),
         "l_shipdate": rng.integers(8400, 10600, ROWS).astype(np.int32),
     })
+    orders = pa.table({
+        "o_orderkey": np.arange(ORDERS, dtype=np.int64),
+        "o_orderdate": rng.integers(8400, 10600, ORDERS).astype(np.int32),
+        "o_custkey": rng.integers(0, max(ORDERS // 10, 10), ORDERS).astype(np.int64),
+    })
+    return lineitem, orders
+
+
+#: effective bytes each query reads from the hot working set (column plane
+#: bytes actually touched) — the numerator of the bandwidth figure
+def scanned_bytes():
+    li_col = {"l_orderkey": 8, "l_returnflag": 4, "l_linestatus": 4,
+              "l_quantity": 8, "l_extendedprice": 8, "l_discount": 8,
+              "l_shipdate": 4}  # dict strings scan as int32 codes
+    o_col = {"o_orderkey": 8, "o_orderdate": 4}
+    q6 = ROWS * (li_col["l_shipdate"] + li_col["l_discount"]
+                 + li_col["l_quantity"] + li_col["l_extendedprice"])
+    q1 = ROWS * (li_col["l_shipdate"] + li_col["l_returnflag"]
+                 + li_col["l_linestatus"] + li_col["l_quantity"]
+                 + li_col["l_extendedprice"] + li_col["l_discount"])
+    q3 = ROWS * (li_col["l_orderkey"] + li_col["l_shipdate"]
+                 + li_col["l_extendedprice"] + li_col["l_discount"]) \
+        + ORDERS * (o_col["o_orderkey"] + o_col["o_orderdate"])
+    q67 = WIN_ROWS * (li_col["l_returnflag"] + li_col["l_linestatus"]
+                      + li_col["l_shipdate"])
+    q72 = ROWS * (li_col["l_orderkey"] + li_col["l_quantity"])
+    return {"q6": q6, "q1": q1, "q3join": q3, "q67win": q67, "q72shfl": q72}
 
 
 def timeit(fn):
@@ -109,7 +149,11 @@ def timeit(fn):
     return best, result
 
 
-def cpu_queries(t):
+# ---------------------------------------------------------------------------
+# host baseline (pyarrow / pandas)
+# ---------------------------------------------------------------------------
+
+def cpu_queries(t, orders):
     import pyarrow.compute as pc
 
     def q6():
@@ -139,17 +183,72 @@ def cpu_queries(t):
                        g["l_discount_mean"].to_pylist(),
                        g["l_quantity_count"].to_pylist())}
 
-    return q6, q1
+    def q3join():
+        li = t.select(["l_orderkey", "l_shipdate", "l_extendedprice",
+                       "l_discount"])
+        li = li.filter(pc.greater(li["l_shipdate"], 9100))
+        od = orders.filter(pc.less(orders["o_orderdate"], 9500))
+        j = li.join(od, keys="l_orderkey", right_keys="o_orderkey",
+                    join_type="inner")
+        rev = pc.multiply(j["l_extendedprice"],
+                          pc.subtract(1.0, j["l_discount"]))
+        j = j.append_column("rev", rev)
+        g = j.group_by(["l_orderkey"]).aggregate([("rev", "sum")])
+        idx = pc.select_k_unstable(g, 10, [("rev_sum", "descending")])
+        top = g.take(idx)
+        return {k: round(v, 2) for k, v in
+                zip(top["l_orderkey"].to_pylist(), top["rev_sum"].to_pylist())}
+
+    def q67win():
+        import pandas as pd
+        tw = t.slice(0, WIN_ROWS)
+        df = pd.DataFrame({
+            "rf": tw["l_returnflag"].to_pandas(),
+            "ls": tw["l_linestatus"].to_pandas(),
+            "sd": tw["l_shipdate"].to_pandas(),
+        })
+        rk = df.groupby(["rf", "ls"])["sd"].rank(method="min").astype(np.int64)
+        df["rk"] = rk
+        out = df.groupby(["rf", "ls"])["rk"].max()
+        return {k: int(v) for k, v in out.items()}
+
+    def q72shfl():
+        import pyarrow as pa
+        key = pa.chunked_array([
+            np.mod(c.to_numpy(), 100_000) for c in t["l_orderkey"].chunks])
+        tt = t.select(["l_quantity"]).append_column("k", key)
+        g = tt.group_by(["k"]).aggregate([("l_quantity", "sum"),
+                                          ("l_quantity", "count")])
+        import pyarrow.compute as _pc
+        return (g.num_rows,
+                round(_pc.sum(g["l_quantity_sum"]).as_py(), 2),
+                int(_pc.sum(g["l_quantity_count"]).as_py()))
+
+    return {"q6": q6, "q1": q1, "q3join": q3join, "q67win": q67win,
+            "q72shfl": q72shfl}
 
 
-def tpu_queries(t):
+# ---------------------------------------------------------------------------
+# TPU engine
+# ---------------------------------------------------------------------------
+
+def tpu_queries(t, orders):
     from spark_rapids_tpu.sql.session import TpuSession
     from spark_rapids_tpu.sql import functions as F
     from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.expr.window import Window
 
     sess = TpuSession()
     cached = sess.create_dataframe(t).cache()
     cached.count()  # force HBM materialization
+    ocached = sess.create_dataframe(orders).cache()
+    ocached.count()
+    sharded = sess.create_dataframe(t, num_partitions=SHUFFLE_PARTS).cache()
+    sharded.count()
+    wcached = (cached if WIN_ROWS >= ROWS
+               else sess.create_dataframe(t.slice(0, WIN_ROWS)).cache())
+    if wcached is not cached:
+        wcached.count()
 
     def q6():
         cond = ((col("l_shipdate") >= lit(LO)) & (col("l_shipdate") < lit(HI))
@@ -172,7 +271,64 @@ def tpu_queries(t):
                 in zip(d["l_returnflag"], d["l_linestatus"], d["sq"], d["sp"],
                        d["mq"], d["md"], d["cnt"])}
 
-    return q6, q1
+    def q3join():
+        li = cached.filter(col("l_shipdate") > lit(9100))
+        od = ocached.filter(col("o_orderdate") < lit(9500))
+        j = li.join(od, on=[(col("l_orderkey"), col("o_orderkey"))],
+                    how="inner")
+        g = (j.select(col("l_orderkey"),
+                      (col("l_extendedprice")
+                       * (lit(1.0) - col("l_discount"))).alias("rev"))
+             .group_by(col("l_orderkey")).agg(F.sum("rev").alias("rev")))
+        top = g.order_by(col("rev").desc(), col("l_orderkey").asc()).limit(10)
+        d = top.to_pydict()
+        return {k: round(v, 2) for k, v in zip(d["l_orderkey"], d["rev"])}
+
+    def q67win():
+        w = Window.partition_by(col("l_returnflag"), col("l_linestatus")) \
+                  .order_by(col("l_shipdate"))
+        out = (wcached.select(col("l_returnflag"), col("l_linestatus"),
+                              F.rank().over(w).alias("rk"))
+               .group_by(col("l_returnflag"), col("l_linestatus"))
+               .agg(F.max("rk").alias("mx")))
+        d = out.to_pydict()
+        return {(rf, ls): int(mx) for rf, ls, mx in
+                zip(d["l_returnflag"], d["l_linestatus"], d["mx"])}
+
+    def q72shfl():
+        g = (sharded.select((col("l_orderkey") % lit(100_000)).alias("k"),
+                            col("l_quantity"))
+             .group_by(col("k"))
+             .agg(F.sum("l_quantity").alias("s"),
+                  F.count("l_quantity").alias("c")))
+        d = g.to_pydict()
+        return (len(d["k"]), round(float(np.sum(d["s"])), 2),
+                int(np.sum(d["c"])))
+
+    return {"q6": q6, "q1": q1, "q3join": q3join, "q67win": q67win,
+            "q72shfl": q72shfl}
+
+
+def _close(a, b, tol=1e-6):
+    return abs(a - b) <= tol * max(1.0, abs(a), abs(b))
+
+
+def validate(name, tpu_val, cpu_val) -> bool:
+    if name == "q6":
+        return _close(tpu_val, cpu_val)
+    if name == "q1":
+        return (set(tpu_val) == set(cpu_val) and all(
+            all(_close(a, b) for a, b in zip(tpu_val[k][:4], cpu_val[k][:4]))
+            and int(tpu_val[k][4]) == int(cpu_val[k][4]) for k in cpu_val))
+    if name == "q3join":
+        return (set(tpu_val) == set(cpu_val)
+                and all(_close(tpu_val[k], cpu_val[k], 1e-9) for k in cpu_val))
+    if name == "q67win":
+        return tpu_val == {(rf, ls): v for (rf, ls), v in cpu_val.items()}
+    if name == "q72shfl":
+        return (tpu_val[0] == cpu_val[0] and _close(tpu_val[1], cpu_val[1])
+                and tpu_val[2] == cpu_val[2])
+    return False
 
 
 def main():
@@ -181,31 +337,37 @@ def main():
         emit_error(err, skipped=True)
         return
 
-    t = make_table()
-    cq6, cq1 = cpu_queries(t)
-    tq6, tq1 = tpu_queries(t)
+    t, orders = make_tables()
+    cpu = cpu_queries(t, orders)
+    tpu = tpu_queries(t, orders)
+    nbytes = scanned_bytes()
 
-    detail = {"rows": ROWS}
+    detail = {"rows": ROWS, "orders": ORDERS, "win_rows": WIN_ROWS,
+              "shuffle_partitions": SHUFFLE_PARTS,
+              "hbm_roofline_gbps": HBM_ROOFLINE_GBPS}
     speedups = []
-    for name, cpu_fn, tpu_fn in [("q6", cq6, tq6), ("q1", cq1, tq1)]:
-        cpu_s, cpu_val = timeit(cpu_fn)
-        tpu_s, tpu_val = timeit(tpu_fn)
-        if name == "q6":
-            ok = abs(tpu_val - cpu_val) <= 1e-6 * max(1.0, abs(cpu_val))
-        else:
-            # tuples are (sum_qty, sum_price, mean_qty, mean_disc, count);
-            # counts are integers and must match exactly.
-            ok = (set(tpu_val) == set(cpu_val) and all(
-                all(abs(a - b) <= 1e-6 * max(1.0, abs(b))
-                    for a, b in zip(tpu_val[k][:4], cpu_val[k][:4]))
-                and int(tpu_val[k][4]) == int(cpu_val[k][4])
-                for k in cpu_val))
+    for name in ["q6", "q1", "q3join", "q67win", "q72shfl"]:
+        print(f"[bench] {name} cpu...", file=sys.stderr, flush=True)
+        cpu_s, cpu_val = timeit(cpu[name])
+        print(f"[bench] {name} tpu... (cpu={cpu_s:.3f}s)", file=sys.stderr,
+              flush=True)
+        tpu_s, tpu_val = timeit(tpu[name])
+        print(f"[bench] {name} done tpu={tpu_s:.3f}s", file=sys.stderr,
+              flush=True)
+        ok = validate(name, tpu_val, cpu_val)
         if not ok:
-            print(f"MISMATCH {name}: tpu={tpu_val} cpu={cpu_val}", file=sys.stderr)
+            print(f"MISMATCH {name}: tpu={tpu_val} cpu={cpu_val}",
+                  file=sys.stderr)
         sp = cpu_s / tpu_s
         speedups.append(sp)
-        detail[name] = {"tpu_s": round(tpu_s, 4), "cpu_s": round(cpu_s, 4),
-                        "speedup": round(sp, 4), "match": ok}
+        gbps = nbytes[name] / tpu_s / 1e9
+        detail[name] = {
+            "tpu_s": round(tpu_s, 4), "cpu_s": round(cpu_s, 4),
+            "speedup": round(sp, 4), "match": ok,
+            "scanned_gb": round(nbytes[name] / 1e9, 3),
+            "eff_gbps": round(gbps, 2),
+            "roofline_pct": round(100.0 * gbps / HBM_ROOFLINE_GBPS, 2),
+        }
 
     geo = math.exp(sum(math.log(s) for s in speedups) / len(speedups))
     print(json.dumps({

@@ -415,6 +415,47 @@ def _one_window_cpu(w, child, perm, segb, peerb, n, ansi) -> CpuCol:
                     vals[i] = fn.default
                 else:
                     valid[i] = False
+        elif isinstance(fn, WE.PercentRank):
+            size = hi - lo
+            r = 0
+            for i in rows:
+                if peerb[i] or i == lo:
+                    r = i - lo + 1
+                vals[i] = 0.0 if size <= 1 else (r - 1) / (size - 1)
+        elif isinstance(fn, WE.CumeDist):
+            size = hi - lo
+            for i in rows:
+                e = i
+                while e + 1 < hi and not peerb[e + 1]:
+                    e += 1
+                vals[i] = (e - lo + 1) / size
+        elif isinstance(fn, (WE.NthValue, WE.FirstValue, WE.LastValue)):
+            for i in rows:
+                if frame.upper is None:
+                    fe = hi - 1
+                elif frame.kind == "rows":
+                    fe = min(i + frame.upper, hi - 1)
+                else:  # range: frame end = end of peer group (+bound)
+                    fe = i
+                    while fe + 1 < hi and not peerb[fe + 1]:
+                        fe += 1
+                fs = lo
+                if frame.lower is not None and frame.kind == "rows":
+                    fs = max(i + frame.lower, lo)
+                if isinstance(fn, WE.LastValue):
+                    pos = fe
+                elif isinstance(fn, WE.FirstValue):
+                    pos = fs
+                else:
+                    pos = fs + fn.n - 1
+                    if pos > fe:
+                        valid[i] = False
+                        continue
+                if pos < fs or pos > fe:
+                    valid[i] = False
+                    continue
+                vals[i] = src.values[pos]
+                valid[i] = bool(src.valid[pos])
         elif isinstance(fn, WE.WindowAgg):
             agg = fn.fn
             for i in rows:
@@ -690,7 +731,9 @@ def _exec_join(plan: P.Join, left: List[CpuCol], right: List[CpuCol],
     lk = [e.eval_cpu(left, ansi) for e in plan.left_keys]
     rk = [e.eval_cpu(right, ansi) for e in plan.right_keys]
 
-    if plan.how == "cross":
+    if plan.how == "cross" or not plan.left_keys:
+        # cross join, or non-equi join (empty keys): all pairs, then the
+        # condition filter below prunes; outer completion follows
         lidx = np.repeat(np.arange(ln), rn)
         ridx = np.tile(np.arange(rn), ln)
     else:

@@ -1152,6 +1152,40 @@ def _eval_window_fn(w, sctx, seg_start, seg_end, peer_start,
             vals = jnp.where(~in_seg, dv, vals)
             valid = valid | ~in_seg
         return ColumnVector(src.dtype, vals, valid & live)
+    if isinstance(fn, WE.PercentRank):
+        n_seg = (seg_end - seg_start + 1).astype(jnp.float64)
+        rk = W.rank(seg_start, peer_start).astype(jnp.float64)
+        v = jnp.where(n_seg > 1, (rk - 1.0) / jnp.maximum(n_seg - 1.0, 1.0),
+                      0.0)
+        return ColumnVector(rt, v, live)
+    if isinstance(fn, WE.CumeDist):
+        n_seg = (seg_end - seg_start + 1).astype(jnp.float64)
+        v = (peer_end - seg_start + 1).astype(jnp.float64) / n_seg
+        return ColumnVector(rt, v, live)
+    if isinstance(fn, (WE.NthValue, WE.FirstValue, WE.LastValue)):
+        src = fn.children[0].eval_tpu(sctx)
+        svalid = src.validity if src.validity is not None else live
+        if frame.lower is None and frame.upper is None:
+            frame_end = seg_end
+        elif frame.kind == "rows":
+            frame_end = idx if frame.upper == 0 else seg_end
+        else:
+            frame_end = peer_end if frame.upper == 0 else seg_end
+        if isinstance(fn, WE.LastValue):
+            pos = frame_end
+            ok = live
+        elif isinstance(fn, WE.FirstValue):
+            pos = seg_start
+            ok = live
+        else:
+            pos = seg_start + (fn.n - 1)
+            ok = live & (pos <= frame_end)
+        from spark_rapids_tpu.ops import kernels as _K
+        gathered = _K.gather_column(
+            src, jnp.where(ok, jnp.clip(pos, 0, idx.shape[0] - 1), -1),
+            idx.shape[0], src_live=svalid)
+        return ColumnVector(gathered.dtype, gathered.data, gathered.validity,
+                            dict_unique=gathered.dict_unique)
     if isinstance(fn, WE.WindowAgg):
         return _eval_window_agg(fn, frame, sctx, seg_start, seg_end,
                                 peer_end, seg_id, idx, live)
@@ -2116,6 +2150,155 @@ class BroadcastHashJoinExec(_HashJoinBase):
         probe_iter = self.children[0].execute_partition(ctx, pidx)
         yield from self._probe_stream(ctx, probe_iter, build,
                                       self._build_keys, join_t, track)
+
+
+class BroadcastNestedLoopJoinExec(TpuExec):
+    """Non-equi joins (reference GpuBroadcastNestedLoopJoinExecBase): the
+    build (right) side broadcasts whole; the join condition evaluates over
+    TILED row pairs — left batch x one build tile per fused dispatch, with
+    the pair batch emitted directly as a selection-masked output (inner)
+    and per-side matched masks accumulated by scatter-or for outer/semi/
+    anti completions. All shapes static per (left capacity, tile rows)."""
+
+    MAX_PAIRS = 1 << 20
+
+    def __init__(self, plan, children, conf):
+        super().__init__(plan, children, conf)
+        self._build_lock = threading.Lock()
+        self._build: Optional[ColumnarBatch] = None
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def _build_side(self) -> ColumnarBatch:
+        with self._build_lock:
+            if self._build is None:
+                build_t = self.metrics.metric(M.BUILD_TIME)
+                right = self.children[1]
+                batches = []
+                with build_t.ns():
+                    for p in range(right.num_partitions):
+                        with TaskContext(partition_id=p) as tctx:
+                            batches.extend(right.execute_partition(tctx, p))
+                    if batches:
+                        self._build = K.compact_batch(K.concat_batches(batches))
+                    else:
+                        from spark_rapids_tpu.columnar.batch import empty_like_schema
+                        self._build = empty_like_schema(right.schema)
+        return self._build
+
+    def _tile_fn(self, tile_rows: int, how: str, ansi: bool):
+        cond = self.plan.condition
+
+        def build():
+            def fn(left: ColumnarBatch, build: ColumnarBatch, tile0,
+                   lmatched, bmatched):
+                lcap = left.capacity
+                pairs = lcap * tile_rows
+                p = jnp.arange(pairs, dtype=jnp.int32)
+                lidx = p // tile_rows
+                bidx = tile0 + (p % tile_rows)
+                bcap = build.capacity
+                b_in = bidx < traced_rows(build.num_rows)
+                bsafe = jnp.clip(bidx, 0, bcap - 1)
+                lcols = [K.gather_column(c, lidx, left.num_rows,
+                                         src_live=left.live_mask())
+                         for c in left.columns]
+                bcols = [K.gather_column(c, bsafe, build.num_rows)
+                         for c in build.columns]
+                live_pair = left.live_mask()[lidx] & b_in
+                ectx = EvalCtx(lcols + bcols, jnp.sum(live_pair.astype(jnp.int32)),
+                               pairs, ansi, live=live_pair)
+                if cond is not None:
+                    pred = cond.eval_tpu(ectx)
+                    pvalid = (pred.validity if pred.validity is not None
+                              else ectx.row_mask)
+                    match = live_pair & pred.data.astype(jnp.bool_) & pvalid
+                else:
+                    match = live_pair
+                lmatched = lmatched.at[lidx].max(match)
+                bmatched = bmatched.at[bsafe].max(match & b_in)
+                out = None
+                if how in ("inner", "left", "right", "full"):
+                    out = ColumnarBatch(
+                        lcols + bcols,
+                        LazyRowCount(jnp.sum(match.astype(jnp.int32))), match)
+                return out, lmatched, bmatched, dict(ectx.errors)
+            return fn
+
+        return fuse.fused(
+            ("bnlj_tile", tile_rows, how, ansi,
+             cond.fingerprint() if cond is not None else None), build)
+
+    def execute_partition(self, ctx, pidx):
+        join_t = self.metrics.metric(M.JOIN_TIME)
+        how = self.plan.how
+        ansi = self.conf.get(C.ANSI_ENABLED)
+        build = self._build_side()
+        n_build = int(build.num_rows)
+        bcap = max(build.capacity, 1)
+        bmatched_total = jnp.zeros(bcap, jnp.bool_)
+        null_right_by_cap = {}
+
+        for left in self.children[0].execute_partition(ctx, pidx):
+            self._acquire(ctx)
+            lcap = max(left.capacity, 1)
+            tile_rows = max(1, min(bcap, self.MAX_PAIRS // lcap))
+            fn = self._tile_fn(tile_rows, how, ansi)
+            lmatched = jnp.zeros(lcap, jnp.bool_)
+            with join_t.ns():
+                for t0 in range(0, max(n_build, 1), tile_rows):
+                    if n_build == 0:
+                        break
+                    out, lmatched, bmatched_total, errs = fn(
+                        left, build, jnp.int32(t0), lmatched, bmatched_total)
+                    compiled.raise_errors(errs)
+                    if out is not None and how != "left_semi":
+                        yield out
+                if how in ("left", "full"):
+                    null_right = null_right_by_cap.get(lcap)
+                    if null_right is None:
+                        # per left-capacity: columns of one output batch
+                        # must share a capacity
+                        null_right = [
+                            K.gather_column(c, jnp.full(lcap, -1, jnp.int32),
+                                            build.num_rows)
+                            for c in build.columns]
+                        null_right_by_cap[lcap] = null_right
+                    m = left.live_mask() & ~lmatched
+                    yield ColumnarBatch(
+                        list(left.columns) + null_right,
+                        LazyRowCount(jnp.sum(m.astype(jnp.int32))), m)
+                elif how == "left_semi":
+                    m = left.live_mask() & lmatched
+                    yield ColumnarBatch(
+                        list(left.columns),
+                        LazyRowCount(jnp.sum(m.astype(jnp.int32))), m)
+                elif how == "left_anti":
+                    m = left.live_mask() & ~lmatched
+                    yield ColumnarBatch(
+                        list(left.columns),
+                        LazyRowCount(jnp.sum(m.astype(jnp.int32))), m)
+
+        if how in ("right", "full") and n_build > 0:
+            # single probe partition guaranteed by the planner
+            null_left = [
+                _null_gather(f.dtype, bcap)
+                for f in self.plan.children[0].schema.fields]
+            m = build.live_mask() & ~bmatched_total
+            yield ColumnarBatch(
+                null_left + list(build.columns),
+                LazyRowCount(jnp.sum(m.astype(jnp.int32))), m)
+
+
+def _null_gather(dtype, cap: int):
+    """All-null column of `dtype` at capacity `cap`."""
+    no = jnp.zeros(cap, jnp.bool_)
+    if isinstance(dtype, T.StringType):
+        return ColumnVector(dtype, {"offsets": jnp.zeros(cap + 1, jnp.int32),
+                                    "bytes": jnp.zeros(8, jnp.uint8)}, no)
+    return ColumnVector(dtype, jnp.zeros(cap, dtype.np_dtype), no)
 
 
 class ShuffledHashJoinExec(_HashJoinBase):

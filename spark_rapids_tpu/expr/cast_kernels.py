@@ -1,0 +1,310 @@
+"""Device cast kernels: string <-> float/date/timestamp.
+
+Reference parity: jni CastStrings + GpuCast.scala string conversions.
+All kernels are branch-free byte-walks (lax.while_loop over the batch max
+length) over offsets+bytes planes; dictionary columns parse the (small)
+vocab once and gather by code at the call site.
+
+Documented divergences (same class as the reference's CastStrings notes):
+- string->double parses via int64 mantissa + pow10 scaling: results can
+  differ from correctly-rounded strtod by ~1-2 ulp.
+- date/timestamp rendering covers years 0..9999 (fixed-width digits);
+  values outside render as null.
+- timestamp parsing accepts `yyyy-MM-dd[ |T]HH:mm:ss[.ffffff]` (UTC
+  engine; no zone suffixes).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector, round_capacity
+
+
+def _walker(col: ColumnVector):
+    o = col.data["offsets"]
+    raw = col.data["bytes"]
+    starts = o[:-1].astype(jnp.int32)
+    ends = o[1:].astype(jnp.int32)
+    nb = raw.shape[0]
+
+    def at(pos):
+        return raw[jnp.clip(pos, 0, nb - 1)].astype(jnp.int32)
+
+    return starts, ends, at
+
+
+def _trim(starts, ends, at):
+    def step(state):
+        s, e = state
+        lead = (s < e) & (at(s) == 32)
+        tail = (e > s) & (at(e - 1) == 32)
+        return jnp.where(lead, s + 1, s), jnp.where(tail, e - 1, e)
+
+    def cond(state):
+        s, e = state
+        return jnp.any(((s < e) & (at(s) == 32)) | ((e > s) & (at(e - 1) == 32)))
+
+    return lax.while_loop(cond, step, (starts, ends))
+
+
+def _match_lit(at, s, e, text: bytes):
+    """Rows whose [s,e) slice equals `text` exactly."""
+    ok = (e - s) == len(text)
+    for j, ch in enumerate(text):
+        ok = ok & (at(s + j) == ch)
+    return ok
+
+
+def parse_f64(col: ColumnVector):
+    """(values f64, parsed_ok bool) — optional sign, digits, '.', digits,
+    [eE][+-]digits; 'Infinity'/'NaN' specials; spaces trimmed."""
+    starts, ends, at = _walker(col)
+    s, e = _trim(starts, ends, at)
+    n = s.shape[0]
+    first = at(s)
+    has_sign = (first == 45) | (first == 43)
+    neg = first == 45
+    ds = s + has_sign.astype(jnp.int32)
+
+    inf = _match_lit(at, ds, e, b"Infinity") | _match_lit(at, ds, e, b"Inf")
+    nan = _match_lit(at, s, e, b"NaN")
+
+    # phases: 0 = integer digits, 1 = fraction digits, 2 = exponent
+    def body(state):
+        (i, acc, scale, ndig, exp, esign, ednig, phase, good, done) = state
+        pos = ds + i
+        active = (pos < e) & ~done
+        b = at(pos)
+        prev = at(pos - 1)
+        is_digit = (b >= 48) & (b <= 57)
+        dv = (b - 48).astype(jnp.int64)
+        # mantissa digit (phase 0/1): accumulate up to 18 digits; integer
+        # digits beyond 18 inflate the scale, fraction overflow is dropped
+        mant = active & is_digit & (phase < 2)
+        room = ndig < 18
+        acc = jnp.where(mant & room, acc * 10 + dv, acc)
+        scale = jnp.where(mant & room & (phase == 1), scale + 1, scale)
+        scale = jnp.where(mant & ~room & (phase == 0), scale - 1, scale)
+        ndig = jnp.where(mant, ndig + 1, ndig)
+        # exponent digit (phase 2)
+        ed = active & is_digit & (phase == 2)
+        exp = jnp.where(ed, jnp.minimum(exp * 10 + dv.astype(jnp.int32),
+                                        9999), exp)
+        ednig = jnp.where(ed, ednig + 1, ednig)
+        # '.' -> fraction (once, from phase 0 only)
+        dot = active & (b == 46) & (phase == 0)
+        bad_dot = active & (b == 46) & (phase != 0)
+        phase = jnp.where(dot, 1, phase)
+        # e/E -> exponent (needs a mantissa digit first)
+        ee = active & ((b == 101) | (b == 69)) & (phase < 2) & (ndig > 0)
+        bad_ee = active & ((b == 101) | (b == 69)) & ~ee
+        phase = jnp.where(ee, 2, phase)
+        # exponent sign: only the byte immediately after e/E
+        exp_sign = active & ((b == 45) | (b == 43)) & (phase == 2) \
+            & ((prev == 101) | (prev == 69)) & (ednig == 0)
+        esign = jnp.where(exp_sign & (b == 45), -1, esign)
+        recognized = mant | ed | dot | ee | exp_sign
+        good = good & (~active | recognized) & ~bad_dot & ~bad_ee
+        done = done | (pos >= e)
+        return (i + 1, acc, scale, ndig, exp, esign, ednig, phase, good,
+                done)
+
+    def cond(state):
+        return ~jnp.all(state[-1])
+
+    good0 = (e > ds) & ~inf & ~nan
+    init = (jnp.int32(0), jnp.zeros(n, jnp.int64), jnp.zeros(n, jnp.int32),
+            jnp.zeros(n, jnp.int32), jnp.zeros(n, jnp.int32),
+            jnp.ones(n, jnp.int32), jnp.zeros(n, jnp.int32),
+            jnp.zeros(n, jnp.int32), good0, inf | nan | (s >= e))
+    (_, acc, scale, ndig, exp, esign, ednig, phase, good, _) = \
+        lax.while_loop(cond, body, init)
+    good = good & (ndig > 0) & ((phase < 2) | (ednig > 0))
+    p = (exp * esign - scale).astype(jnp.float64)
+    p = jnp.clip(p, -400.0, 400.0)
+    v = acc.astype(jnp.float64) * jnp.power(np.float64(10.0), p)
+    v = jnp.where(neg, -v, v)
+    v = jnp.where(inf, jnp.where(neg, -jnp.inf, jnp.inf), v)
+    v = jnp.where(nan, jnp.nan, v)
+    ok = (good | inf | nan) & (s < e)
+    return v, ok
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _civil_from_days(z):
+    z = z + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _parse_ymd_hms(col: ColumnVector, with_time: bool):
+    """Shared date/timestamp parser. Returns (days, us_of_day, ok)."""
+    starts, ends, at = _walker(col)
+    s, e = _trim(starts, ends, at)
+    n = s.shape[0]
+
+    # phases: 0 y, 1 m, 2 d, 3 H, 4 M, 5 S, 6 frac
+    NP = 7
+
+    def body(state):
+        i, pos, accs, digs, phase, good, done = state
+        active = (pos < e) & ~done
+        b = at(pos)
+        is_digit = (b >= 48) & (b <= 57)
+        d = (b - 48).astype(jnp.int64)
+        ph1 = jax.nn.one_hot(phase, NP, dtype=jnp.int64)
+        add = jnp.where((active & is_digit)[:, None], ph1, 0)
+        accs = accs * jnp.where(add > 0, 10, 1) + add * d[:, None]
+        digs = digs + add.astype(jnp.int32)
+        sep_dash = active & (b == 45) & (phase < 2)
+        sep_sp = active & ((b == 32) | (b == 84)) & (phase == 2) & with_time
+        sep_col = active & (b == 58) & ((phase == 3) | (phase == 4))
+        sep_dot = active & (b == 46) & (phase == 5) & with_time
+        sep = sep_dash | sep_sp | sep_col | sep_dot
+        phase = jnp.where(sep, phase + 1, phase)
+        good = good & (~active | is_digit | sep)
+        done = done | (pos >= e)
+        return i + 1, pos + 1, accs, digs, phase, good, done
+
+    def cond(state):
+        return ~jnp.all(state[-1])
+
+    init = (jnp.int32(0), s, jnp.zeros((n, NP), jnp.int64),
+            jnp.zeros((n, NP), jnp.int32), jnp.zeros(n, jnp.int32),
+            s < e, s >= e)
+    _, _, accs, digs, phase, good, _ = lax.while_loop(cond, body, init)
+    y = accs[:, 0]
+    m = jnp.where(digs[:, 1] > 0, accs[:, 1], 1)
+    d = jnp.where(digs[:, 2] > 0, accs[:, 2], 1)
+    # year range matches the host oracle (datetime): 1..9999
+    good = good & (digs[:, 0] >= 1) & (digs[:, 0] <= 7) \
+        & (y >= 1) & (y <= 9999)
+    good = good & ((digs[:, 1] == 0) | (digs[:, 1] <= 2))
+    good = good & ((digs[:, 2] == 0) | (digs[:, 2] <= 2))
+    good = good & (m >= 1) & (m <= 12) & (d >= 1)
+    # day-in-month bound incl. leap years
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    mdays = jnp.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                      jnp.int64)[jnp.clip(m - 1, 0, 11)]
+    mdays = jnp.where((m == 2) & leap, 29, mdays)
+    good = good & (d <= mdays)
+    # started-but-empty segments ("2020-", "2020-01-") are invalid
+    good = good & ~((phase >= 1) & (phase <= 2) & (digs[:, 1] == 0))
+    good = good & ~((phase == 2) & (digs[:, 2] == 0))
+    days = _days_from_civil(y, m, d).astype(jnp.int64)
+    if not with_time:
+        good = good & (phase <= 2)
+        return days, jnp.zeros(n, jnp.int64), good
+    H, Mi, S = accs[:, 3], accs[:, 4], accs[:, 5]
+    good = good & ((phase <= 2) | (phase >= 5))  # time needs H:M:S at least
+    has_time = phase >= 3
+    good = good & (~has_time | ((digs[:, 3] >= 1) & (digs[:, 3] <= 2)
+                                & (digs[:, 4] >= 1) & (digs[:, 4] <= 2)
+                                & (digs[:, 5] >= 1) & (digs[:, 5] <= 2)
+                                & (H < 24) & (Mi < 60) & (S < 60)))
+    frac = accs[:, 6]
+    fd = digs[:, 6]
+    good = good & ((phase < 6) | (fd >= 1))
+    us = jnp.where(fd > 0,
+                   frac * (10 ** jnp.clip(6 - fd, 0, 6)), 0)
+    us = jnp.where(fd > 6, frac // (10 ** jnp.clip(fd - 6, 0, 12)), us)
+    usod = H * 3_600_000_000 + Mi * 60_000_000 + S * 1_000_000 + us
+    return days, jnp.where(has_time, usod, 0), good
+
+
+def parse_date(col: ColumnVector):
+    days, _, ok = _parse_ymd_hms(col, with_time=False)
+    return days.astype(jnp.int32), ok
+
+
+def parse_timestamp(col: ColumnVector):
+    days, usod, ok = _parse_ymd_hms(col, with_time=True)
+    return days * 86_400_000_000 + usod, ok
+
+
+def _digits(val, count):
+    """val -> `count` ASCII digit planes, most significant first."""
+    out = []
+    for i in range(count - 1, -1, -1):
+        out.append((val // (10 ** i)) % 10 + 48)
+    return out
+
+
+def render_date(days: jax.Array, valid: jax.Array):
+    """int32 days -> flat 'yyyy-MM-dd' string planes; years outside
+    0..9999 render null."""
+    y, m, d = _civil_from_days(days.astype(jnp.int64))
+    ok = valid & (y >= 0) & (y <= 9999)
+    n = days.shape[0]
+    cols = _digits(y, 4) + [jnp.full(n, 45)] + _digits(m, 2) \
+        + [jnp.full(n, 45)] + _digits(d, 2)
+    mat = jnp.stack([c.astype(jnp.uint8) for c in cols], axis=1)
+    lens = jnp.where(ok, 10, 0).astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    bcap = round_capacity(max(n * 10, 8))
+    flat = jnp.zeros(bcap, jnp.uint8)
+    rowpos = jnp.repeat(offsets[:-1], 10).reshape(n, 10) \
+        + jnp.arange(10, dtype=jnp.int32)[None, :]
+    dest = jnp.where(ok[:, None], rowpos, bcap)
+    flat = flat.at[dest.reshape(-1)].set(mat.reshape(-1), mode="drop")
+    return ColumnVector(T.STRING, {"offsets": offsets, "bytes": flat}, ok)
+
+
+def render_timestamp(us: jax.Array, valid: jax.Array):
+    """int64 micros -> 'yyyy-MM-dd HH:mm:ss[.ffffff]' (trailing zeros of
+    the fraction trimmed; whole-second values render without fraction)."""
+    days = jnp.floor_divide(us, 86_400_000_000)
+    usod = us - days * 86_400_000_000
+    y, m, d = _civil_from_days(days)
+    ok = valid & (y >= 0) & (y <= 9999)
+    H = usod // 3_600_000_000
+    Mi = (usod // 60_000_000) % 60
+    S = (usod // 1_000_000) % 60
+    frac = usod % 1_000_000
+    # fraction length = smallest k with frac divisible by 10^(6-k)
+    # (trailing zeros trimmed; 0 when the fraction is zero)
+    flen = jnp.where(frac == 0, 0, 6)
+    for k in range(5, 0, -1):
+        flen = jnp.where((frac != 0) & (frac % (10 ** (6 - k)) == 0), k, flen)
+    n = us.shape[0]
+    base = _digits(y, 4) + [jnp.full(n, 45)] + _digits(m, 2) \
+        + [jnp.full(n, 45)] + _digits(d, 2) + [jnp.full(n, 32)] \
+        + _digits(H, 2) + [jnp.full(n, 58)] + _digits(Mi, 2) \
+        + [jnp.full(n, 58)] + _digits(S, 2) + [jnp.full(n, 46)] \
+        + _digits(frac, 6)
+    W = 26
+    mat = jnp.stack([c.astype(jnp.uint8) for c in base], axis=1)
+    lens = jnp.where(ok, jnp.where(flen > 0, 20 + flen, 19), 0) \
+        .astype(jnp.int32)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    bcap = round_capacity(max(n * W, 8))
+    flat = jnp.zeros(bcap, jnp.uint8)
+    within = jnp.arange(W, dtype=jnp.int32)[None, :] < lens[:, None]
+    rowpos = offsets[:-1][:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    dest = jnp.where(within & ok[:, None], rowpos, bcap)
+    flat = flat.at[dest.reshape(-1)].set(mat.reshape(-1), mode="drop")
+    return ColumnVector(T.STRING, {"offsets": offsets, "bytes": flat}, ok)

@@ -1,0 +1,288 @@
+"""Misc expressions: rand, sequence, parse_url, raise_error, hive hash.
+
+Reference parity: GpuRandomExpressions.scala, GpuSequenceUtil,
+GpuParseUrl.scala (JNI ParseURI), RaiseError, HashFunctions.scala hive
+hash (jni.Hash.hiveHash).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector
+from spark_rapids_tpu.expr.core import (
+    CPU_EVAL_CTX, CpuCol, EvalCtx, Expression, SparkException, _valid_of,
+)
+from spark_rapids_tpu.expr.cpu_functions import CpuRowFunction
+
+
+class Rand(Expression):
+    """rand([seed]): uniform [0,1) doubles, deterministic per
+    (seed, partition, row index) via splitmix64. NOTE: the value STREAM
+    differs from Spark's XORShiftRandom (documented divergence — Spark
+    itself calls the function non-deterministic); the distribution and
+    determinism contract match, and both backends here agree exactly."""
+
+    def __init__(self, seed: int = 0):
+        self.children = []
+        self.seed = int(seed)
+
+    def data_type(self):
+        return T.FLOAT64
+
+    def _params(self):
+        return str(self.seed)
+
+    def with_children(self, children):
+        return self
+
+    @staticmethod
+    def _mix64_np(x):
+        M = np.uint64
+        x = (x + M(0x9E3779B97F4A7C15)) & M(0xFFFFFFFFFFFFFFFF)
+        x = ((x ^ (x >> M(30))) * M(0xBF58476D1CE4E5B9)) & M(0xFFFFFFFFFFFFFFFF)
+        x = ((x ^ (x >> M(27))) * M(0x94D049BB133111EB)) & M(0xFFFFFFFFFFFFFFFF)
+        return x ^ (x >> M(31))
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        idx = jnp.cumsum(ctx.row_mask.astype(jnp.int64)) - 1
+        pos = (jnp.asarray(ctx.row_base, jnp.int64) + idx).astype(jnp.uint64)
+        pid = jnp.asarray(ctx.partition_id, jnp.int64).astype(jnp.uint64)
+        x = pos + (pid << jnp.uint64(40)) + jnp.uint64(self.seed & (2**64 - 1))
+        x = x + jnp.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> jnp.uint64(31))
+        v = (x >> jnp.uint64(11)).astype(jnp.float64) / np.float64(1 << 53)
+        return ColumnVector(T.FLOAT64, v, None)
+
+    def eval_cpu(self, cols, ansi=False):
+        n = len(cols[0].values) if cols else 0
+        M = np.uint64
+        pos = (np.uint64(CPU_EVAL_CTX.row_base) + np.arange(n, dtype=np.uint64))
+        x = pos + (M(CPU_EVAL_CTX.partition_id) << M(40)) \
+            + M(self.seed & (2**64 - 1))
+        x = self._mix64_np(x)
+        v = (x >> M(11)).astype(np.float64) / np.float64(1 << 53)
+        return CpuCol(T.FLOAT64, v, np.ones(n, np.bool_))
+
+
+class Sequence(CpuRowFunction):
+    """sequence(start, stop[, step]) -> array<long> (host tier; the
+    variable-length output needs a count-then-build device pass that lands
+    with device sequence support)."""
+
+    name = "sequence"
+
+    def __init__(self, *children, params=()):
+        super().__init__(*children, params=params)
+        self.result = T.ArrayType(T.INT64, contains_null=False)
+
+    def row_fn(self, *vals):
+        if len(vals) == 3:
+            start, stop, step = int(vals[0]), int(vals[1]), int(vals[2])
+        else:
+            start, stop = int(vals[0]), int(vals[1])
+            step = 1 if stop >= start else -1
+        if step == 0:
+            raise SparkException("sequence step must not be zero")
+        if (stop - start) * step < 0:
+            return []
+        n = (stop - start) // step + 1
+        if n > 10_000_000:
+            raise SparkException("sequence too long")
+        return list(range(start, start + n * step, step))
+
+    def eval_cpu(self, cols, ansi=False):
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values)
+        out, ok = [], []
+        for i in range(n):
+            if all(c.valid[i] for c in ins):
+                out.append(self.row_fn(*(c.values[i] for c in ins)))
+                ok.append(True)
+            else:
+                out.append(None)
+                ok.append(False)
+        vals = np.empty(n, object)
+        vals[:] = out
+        return CpuCol(self.result, vals, np.asarray(ok, np.bool_))
+
+
+class ParseUrl(CpuRowFunction):
+    """parse_url(url, part[, key]) (host tier; reference JNI ParseURI)."""
+
+    name = "parse_url"
+    result = T.STRING
+    PARTS = ("HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE",
+             "AUTHORITY", "USERINFO")
+
+    def __init__(self, *children, params=()):
+        super().__init__(*children, params=params)
+        part = (params[0] or "").upper()
+        if part not in self.PARTS:
+            raise SparkException(f"parse_url: unknown part {params[0]!r}")
+        self.part = part
+        self.key = params[1] if len(params) > 1 else None
+
+    def row_fn(self, url):
+        try:
+            u = urlparse(url)
+        except ValueError:
+            return None
+        if self.part == "HOST":
+            return u.hostname
+        if self.part == "PATH":
+            return u.path or None if u.scheme else None
+        if self.part == "QUERY":
+            if self.key is not None:
+                q = parse_qs(u.query)
+                v = q.get(self.key)
+                return v[0] if v else None
+            return u.query or None
+        if self.part == "REF":
+            return u.fragment or None
+        if self.part == "PROTOCOL":
+            return u.scheme or None
+        if self.part == "FILE":
+            return (u.path + ("?" + u.query if u.query else "")) or None
+        if self.part == "AUTHORITY":
+            return u.netloc or None
+        if self.part == "USERINFO":
+            if u.username is None:
+                return None
+            return u.username + (":" + u.password if u.password else "")
+        return None
+
+
+class RaiseError(CpuRowFunction):
+    """raise_error(msg): fails the query when evaluated on any live row."""
+
+    name = "raise_error"
+    result = T.NULL
+
+    def row_fn(self, msg):
+        raise SparkException(str(msg))
+
+
+class HiveHash(Expression):
+    """hive hash over columns (reference jni.Hash hiveHash): per-column
+    hive hashCode chained as h = h*31 + colHash; nulls hash to 0. Device
+    kernel for fixed-width + string columns."""
+
+    def __init__(self, children: List[Expression]):
+        self.children = list(children)
+
+    def data_type(self):
+        return T.INT32
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        from spark_rapids_tpu.ops import kernels as K
+        h = jnp.zeros(ctx.capacity, jnp.int32)
+        for c in self.children:
+            col = c.eval_tpu(ctx)
+            ch = _hive_hash_col_tpu(col, ctx)
+            valid = _valid_of(col, ctx)
+            ch = jnp.where(valid, ch, 0)
+            h = h * np.int32(31) + ch
+        return ColumnVector(T.INT32, h, None)
+
+    def eval_cpu(self, cols, ansi=False):
+        n = len(cols[0].values) if cols else 0
+        h = np.zeros(n, np.int32)
+        for c in self.children:
+            cc = c.eval_cpu(cols, ansi)
+            ch = _hive_hash_col_np(cc)
+            ch = np.where(cc.valid, ch, 0).astype(np.int32)
+            with np.errstate(over="ignore"):
+                h = (h.astype(np.int64) * 31 + ch).astype(np.int32)
+        return CpuCol(T.INT32, h, np.ones(n, np.bool_))
+
+
+def _hive_hash_col_tpu(col: ColumnVector, ctx) -> jax.Array:
+    from jax import lax
+    from spark_rapids_tpu.ops.kernels import _bitcast_f64_u64
+    d = col.dtype
+    if isinstance(d, T.StringType):
+        if col.is_dict:
+            voc_h = _hive_string_hash(col.data["dict_offsets"],
+                                      col.data["dict_bytes"])
+            return voc_h[col.data["codes"]]
+        return _hive_string_hash(col.data["offsets"], col.data["bytes"])
+    if isinstance(d, T.BooleanType):
+        return jnp.where(col.data, jnp.int32(1), jnp.int32(0))
+    if isinstance(d, (T.Int8Type, T.Int16Type, T.Int32Type, T.DateType)):
+        return col.data.astype(jnp.int32)
+    if isinstance(d, T.Float32Type):
+        v = jnp.where(col.data == 0.0, jnp.zeros_like(col.data), col.data)
+        return lax.bitcast_convert_type(v, jnp.int32)
+    if isinstance(d, T.Float64Type):
+        v = jnp.where(col.data == 0.0, jnp.zeros_like(col.data), col.data)
+        bits = _bitcast_f64_u64(v)
+        return ((bits ^ (bits >> jnp.uint64(32))) & jnp.uint64(0xFFFFFFFF)) \
+            .astype(jnp.int32)
+    # int64 / timestamp
+    v = col.data.astype(jnp.int64).astype(jnp.uint64)
+    return ((v ^ (v >> jnp.uint64(32))) & jnp.uint64(0xFFFFFFFF)) \
+        .astype(jnp.int32)
+
+
+def _hive_string_hash(offsets, raw) -> jax.Array:
+    """Java String.hashCode over byte slices: h = 31*h + b (signed)."""
+    from jax import lax
+    starts = offsets[:-1].astype(jnp.int32)
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    nbytes = raw.shape[0]
+
+    def body(state):
+        i, h = state
+        pos = jnp.clip(starts + i, 0, nbytes - 1)
+        b = raw[pos].astype(jnp.int8).astype(jnp.int32)
+        nh = h * np.int32(31) + b
+        return i + 1, jnp.where(i < lens, nh, h)
+
+    def cond(state):
+        return state[0] < jnp.max(lens)
+
+    _, h = lax.while_loop(cond, body,
+                          (jnp.int32(0),
+                           jnp.zeros(starts.shape[0], jnp.int32)))
+    return h
+
+
+def _hive_hash_col_np(c: CpuCol) -> np.ndarray:
+    d = c.dtype
+    with np.errstate(over="ignore"):
+        if isinstance(d, T.StringType):
+            out = np.zeros(len(c.values), np.int32)
+            for i, v in enumerate(c.values):
+                if isinstance(v, str):
+                    h = 0
+                    for b in v.encode("utf-8"):
+                        h = (h * 31 + (b if b < 128 else b - 256)) & 0xFFFFFFFF
+                    out[i] = np.uint32(h).astype(np.int32)
+            return out
+        if isinstance(d, T.BooleanType):
+            return c.values.astype(np.int32)
+        if isinstance(d, (T.Int8Type, T.Int16Type, T.Int32Type, T.DateType)):
+            return c.values.astype(np.int32)
+        if isinstance(d, T.Float32Type):
+            v = np.where(c.values == 0.0, 0.0, c.values).astype(np.float32)
+            return v.view(np.int32)
+        if isinstance(d, T.Float64Type):
+            v = np.where(c.values == 0.0, 0.0, c.values).astype(np.float64)
+            bits = v.view(np.uint64)
+            return ((bits ^ (bits >> np.uint64(32)))
+                    & np.uint64(0xFFFFFFFF)).astype(np.uint32).astype(np.int32)
+        v = c.values.astype(np.int64).view(np.uint64)
+        return ((v ^ (v >> np.uint64(32)))
+                & np.uint64(0xFFFFFFFF)).astype(np.uint32).astype(np.int32)
+
+
+MISC_CPU_FUNCTIONS = [Sequence, ParseUrl, RaiseError]

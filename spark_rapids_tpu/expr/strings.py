@@ -817,9 +817,13 @@ def cast_string_tpu(c: ColumnVector, dst: T.DataType, ctx: EvalCtx) -> ColumnVec
             return If(_RawCol(ColumnVector(T.BOOLEAN, c.data, valid)),
                       Literal("true", T.STRING),
                       Literal("false", T.STRING)).eval_tpu(ctx)
-        if src.is_integral or isinstance(src, (T.DateType, T.TimestampType)):
-            if isinstance(src, (T.DateType, T.TimestampType)):
-                raise NotImplementedError("date/timestamp -> string on device")
+        if isinstance(src, T.DateType):
+            from spark_rapids_tpu.expr import cast_kernels as CK
+            return CK.render_date(c.data, valid)
+        if isinstance(src, T.TimestampType):
+            from spark_rapids_tpu.expr import cast_kernels as CK
+            return CK.render_timestamp(c.data, valid)
+        if src.is_integral:
             return _render_int64_tpu(c.data.astype(jnp.int64), valid)
         raise NotImplementedError(f"cast {src!r} -> string on device")
     if isinstance(c.dtype, T.StringType):
@@ -837,11 +841,30 @@ def cast_string_tpu(c: ColumnVector, dst: T.DataType, ctx: EvalCtx) -> ColumnVec
                 return ColumnVector(dst, vv[codes].astype(dst.np_dtype), out_valid)
             v64, out_valid = _parse_int64_tpu(c, valid, ctx)
             return ColumnVector(dst, v64.astype(dst.np_dtype), out_valid)
-        if isinstance(dst, (T.Float32Type, T.Float64Type)):
-            raise NotImplementedError("string -> float on device")
-        if isinstance(dst, T.BooleanType):
-            from spark_rapids_tpu.expr.core import _string_eq_tpu  # noqa
-            raise NotImplementedError("string -> bool on device")
+        if isinstance(dst, (T.Float32Type, T.Float64Type, T.DateType,
+                            T.TimestampType)):
+            from spark_rapids_tpu.expr import cast_kernels as CK
+            if isinstance(dst, (T.Float32Type, T.Float64Type)):
+                parse = CK.parse_f64
+            elif isinstance(dst, T.DateType):
+                parse = CK.parse_date
+            else:
+                parse = CK.parse_timestamp
+            if c.is_dict:
+                flat = _flat_view(c)
+                vv, vok = parse(flat)
+                codes = c.data["codes"]
+                okc = vok[codes]
+                out_valid = valid & okc
+                if ctx.ansi:
+                    ctx.add_error("CAST_INVALID_INPUT", valid & ~okc)
+                vals = vv[codes]
+            else:
+                vals, vok = parse(c)
+                out_valid = valid & vok
+                if ctx.ansi:
+                    ctx.add_error("CAST_INVALID_INPUT", valid & ~vok)
+            return ColumnVector(dst, vals.astype(dst.np_dtype), out_valid)
         raise NotImplementedError(f"cast string -> {dst!r} on device")
     raise NotImplementedError
 
@@ -865,7 +888,10 @@ def cast_string_cpu(c: CpuCol, dst: T.DataType, ansi: bool) -> CpuCol:
                 import datetime
                 dt = (datetime.datetime(1970, 1, 1)
                       + datetime.timedelta(microseconds=int(v)))
-                out.append(dt.isoformat(sep=" "))
+                s_iso = dt.isoformat(sep=" ")
+                if "." in s_iso:  # Spark trims trailing fraction zeros
+                    s_iso = s_iso.rstrip("0").rstrip(".")
+                out.append(s_iso)
             elif isinstance(src, T.DecimalType):
                 import decimal
                 out.append(str(decimal.Decimal(int(v)).scaleb(-src.scale)))
@@ -916,21 +942,55 @@ def cast_string_cpu(c: CpuCol, dst: T.DataType, ansi: bool) -> CpuCol:
                     raise SparkException(f"[CAST_INVALID_INPUT] '{s}' to boolean")
                 valid[i] = False
         return CpuCol(dst, vals, valid)
-    if isinstance(dst, T.DateType):
-        import datetime
-        vals = np.zeros(n, np.int32)
+    if isinstance(dst, (T.DateType, T.TimestampType)):
+        vals = np.zeros(n, np.int64)
         for i, s in enumerate(c.values):
             if not valid[i]:
                 continue
-            try:
-                d = datetime.date.fromisoformat(s.strip())
-                vals[i] = (d - datetime.date(1970, 1, 1)).days
-            except ValueError:
+            r = _parse_dt_py(s, with_time=isinstance(dst, T.TimestampType))
+            if r is None:
                 if ansi:
-                    raise SparkException(f"[CAST_INVALID_INPUT] '{s}' to date")
+                    raise SparkException(
+                        f"[CAST_INVALID_INPUT] '{s}' to {dst!r}")
                 valid[i] = False
-        return CpuCol(dst, vals, valid)
+            else:
+                vals[i] = r
+        np_dt = np.int32 if isinstance(dst, T.DateType) else np.int64
+        return CpuCol(dst, vals.astype(np_dt), valid)
     raise NotImplementedError(f"cast string -> {dst!r}")
+
+
+def _parse_dt_py(s, with_time: bool):
+    """Spark stringToDate/stringToTimestamp subset, matching the device
+    kernel (cast_kernels._parse_ymd_hms): yyyy[-m[-d]] and
+    yyyy-m-d[ |T]H:M:S[.ffffff], UTC."""
+    import re
+    import datetime
+    if not isinstance(s, str):
+        return None
+    t = s.strip()
+    date_re = r"(\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2}))?)?"
+    time_re = r"(?:[ T](\d{1,2}):(\d{1,2}):(\d{1,2})(?:\.(\d+))?)?"
+    m = re.fullmatch(date_re + (time_re if with_time else ""), t)
+    if m is None:
+        return None
+    g = m.groups()
+    y, mo, d = int(g[0]), int(g[1] or 1), int(g[2] or 1)
+    try:
+        date = datetime.date(y, mo, d)
+    except ValueError:
+        return None
+    days = (date - datetime.date(1970, 1, 1)).days
+    if not with_time:
+        return days
+    us = 0
+    if g[3] is not None:
+        H, Mi, S = int(g[3]), int(g[4]), int(g[5])
+        if H > 23 or Mi > 59 or S > 59:
+            return None
+        frac = (g[6] or "")[:6].ljust(6, "0") if g[6] else "0"
+        us = H * 3_600_000_000 + Mi * 60_000_000 + S * 1_000_000 + int(frac)
+    return days * 86_400_000_000 + us
 
 
 def _spark_float_str(v: float) -> str:

@@ -175,6 +175,67 @@ class Lag(LeadLag):
     is_lead = False
 
 
+class PercentRank(WindowFunction):
+    """(rank - 1) / (partition rows - 1); 0.0 for single-row partitions."""
+
+    def result_type(self):
+        return T.FLOAT64
+
+
+class CumeDist(WindowFunction):
+    """rows ordering <= current (peers included) / partition rows."""
+
+    def result_type(self):
+        return T.FLOAT64
+
+
+class NthValue(WindowFunction):
+    """nth_value(col, n): the partition's nth value once the frame has
+    reached it, null before (Spark default-frame semantics)."""
+
+    def __init__(self, child: Expression, n: int):
+        if n < 1:
+            from spark_rapids_tpu.expr.core import SparkException
+            raise SparkException("nth_value offset must be >= 1")
+        self.children = [child]
+        self.n = n
+
+    def _params(self):
+        return str(self.n)
+
+    def result_type(self):
+        return self.children[0].data_type()
+
+    def transform(self, fn):
+        return NthValue(self.children[0].transform(fn), self.n)
+
+
+class FirstValue(WindowFunction):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def result_type(self):
+        return self.children[0].data_type()
+
+    def transform(self, fn):
+        return FirstValue(self.children[0].transform(fn))
+
+
+class LastValue(WindowFunction):
+    """last_value over the FRAME — with Spark's default frame (unbounded
+    preceding to current row) this is the current peer group's last row,
+    the famously surprising behavior the reference reproduces too."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def result_type(self):
+        return self.children[0].data_type()
+
+    def transform(self, fn):
+        return LastValue(self.children[0].transform(fn))
+
+
 class WindowAgg(WindowFunction):
     """An aggregate function evaluated over a window frame."""
 

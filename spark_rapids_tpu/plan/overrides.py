@@ -109,11 +109,13 @@ def _cast_check(e: E.Expression) -> Optional[str]:
     if isinstance(src, _CASTABLE_FIXED) and isinstance(dst, _CASTABLE_FIXED):
         return None
     if isinstance(dst, T.StringType):
-        if isinstance(src, (T.BooleanType,)) or src.is_integral:
+        if isinstance(src, (T.BooleanType, T.DateType, T.TimestampType)) \
+                or src.is_integral:
             return None
         return f"cast {src!r} -> string not supported on device"
     if isinstance(src, T.StringType):
-        if dst.is_integral:
+        if dst.is_integral or isinstance(dst, (T.Float32Type, T.Float64Type,
+                                               T.DateType, T.TimestampType)):
             return None
         return f"cast string -> {dst!r} not supported on device"
     if isinstance(src, T.NullType):
@@ -219,6 +221,19 @@ for _jcls in JF.JSON_FUNCTIONS:
     expr_rule(_jcls, Sigs.COMMON, _NESTED_OK,
               f"{_jcls.name} (host JSON parse)",
               extra=lambda e: f"{e.name} runs on CPU (host JSON parse)")
+
+# misc expressions (reference GpuRandomExpressions / ParseURI / hive hash)
+from spark_rapids_tpu.expr import misc as MX  # noqa: E402
+
+expr_rule(MX.Rand, Sigs.COMMON, Sigs.COMMON,
+          "rand([seed]) — splitmix64 stream (distribution-equivalent to "
+          "Spark's XORShift, stream differs; documented)")
+expr_rule(MX.HiveHash, Sigs.COMMON, Sigs.COMMON, "hive hash")
+
+for _mcls in MX.MISC_CPU_FUNCTIONS:
+    expr_rule(_mcls, Sigs.COMMON, _NESTED_OK,
+              f"{_mcls.name} (CPU tier)",
+              extra=lambda e: f"{e.name} runs on CPU (no device kernel yet)")
 
 # CPU-only row functions: registered so tagging gives a clear reason and
 # the enclosing exec falls back (reference: ops without GPU impls)
@@ -345,7 +360,8 @@ agg_rule(A.ApproxPercentile, _NUM,
 #: expressions whose evaluation needs the partition context that only the
 #: projection kernel threads (reference ExprChecks contexts,
 #: RapidsMeta.scala:945-971 — project vs groupby vs window contexts)
-PROJECT_ONLY_EXPRS = (E.SparkPartitionID, E.MonotonicallyIncreasingID)
+PROJECT_ONLY_EXPRS = (E.SparkPartitionID, E.MonotonicallyIncreasingID,
+                      MX.Rand)
 
 
 def _contains_project_only(e: E.Expression) -> bool:
@@ -577,8 +593,16 @@ class SparkPlanMeta:
                         f"{name}: string-typed window operands run on CPU "
                         f"(device window kernels are fixed-width planes)")
             fn = w.fn
+            if isinstance(fn, (WE.NthValue, WE.FirstValue, WE.LastValue)):
+                frame = spec.resolved_frame()
+                if frame.lower is not None or frame.upper not in (0, None):
+                    self.reasons.append(
+                        f"{name}: {type(fn).__name__} supports only "
+                        f"unbounded-preceding frames ending at the current "
+                        f"row or partition end")
             if isinstance(fn, (WE.RowNumber, WE.Rank, WE.DenseRank, WE.NTile,
-                               WE.LeadLag)):
+                               WE.LeadLag, WE.PercentRank, WE.CumeDist,
+                               WE.NthValue, WE.FirstValue, WE.LastValue)):
                 pass  # needs_order enforced at plan build (AnalysisException)
             elif isinstance(fn, WE.WindowAgg):
                 frame = spec.resolved_frame()
@@ -721,6 +745,12 @@ class SparkPlanMeta:
         left, right = child_execs
         if p.how == "cross":
             return X.CartesianProductExec(p, [left, right], conf)
+        if not p.left_keys:
+            # non-equi join: broadcast nested loop
+            # (GpuBroadcastNestedLoopJoinExecBase)
+            if p.how in ("right", "full") and left.num_partitions > 1:
+                left = X.CollectExchangeExec(p, [left], conf)
+            return X.BroadcastNestedLoopJoinExec(p, [left, right], conf)
         # strategy: broadcast the (right) build side when it is estimated
         # small, else hash-exchange both sides and join per partition
         est = p.children[1].estimated_rows()

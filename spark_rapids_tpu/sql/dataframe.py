@@ -173,6 +173,11 @@ class DataFrame:
         if how == "cross" or on is None:
             return DataFrame(P.Join(self.plan, other.plan, [], [], "cross"),
                              self.session)
+        if isinstance(on, E.Expression):
+            # non-equi join on an arbitrary condition (binds against the
+            # concatenated left+right schema) -> nested-loop join
+            return DataFrame(P.Join(self.plan, other.plan, [], [], how,
+                                    condition=on), self.session)
         if isinstance(on, str):
             on = [on]
         dedupe_names = None

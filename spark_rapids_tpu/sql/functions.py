@@ -121,6 +121,31 @@ def ntile(n: int):
     return NTile(n)
 
 
+def percent_rank():
+    from spark_rapids_tpu.expr.window import PercentRank
+    return PercentRank()
+
+
+def cume_dist():
+    from spark_rapids_tpu.expr.window import CumeDist
+    return CumeDist()
+
+
+def nth_value(c, n: int):
+    from spark_rapids_tpu.expr.window import NthValue
+    return NthValue(_e(c), n)
+
+
+def first_value(c):
+    from spark_rapids_tpu.expr.window import FirstValue
+    return FirstValue(_e(c))
+
+
+def last_value(c):
+    from spark_rapids_tpu.expr.window import LastValue
+    return LastValue(_e(c))
+
+
 def lead(c, offset: int = 1, default=None):
     from spark_rapids_tpu.expr.window import Lead
     return Lead(_e(c), offset, default)
@@ -205,6 +230,33 @@ def shiftright(c, n):
 
 def shiftrightunsigned(c, n):
     return MA.ShiftRightUnsigned(_e(c), _e(n))
+
+
+def rand(seed: int = 0):
+    from spark_rapids_tpu.expr.misc import Rand
+    return Rand(seed)
+
+
+def sequence(start, stop, step=None):
+    from spark_rapids_tpu.expr.misc import Sequence
+    args = [_e(start), _e(stop)] + ([_e(step)] if step is not None else [])
+    return Sequence(*args)
+
+
+def parse_url(c, part: str, key: str = None):
+    from spark_rapids_tpu.expr.misc import ParseUrl
+    params = (part,) if key is None else (part, key)
+    return ParseUrl(_e(c), params=params)
+
+
+def raise_error(c):
+    from spark_rapids_tpu.expr.misc import RaiseError
+    return RaiseError(_e(c))
+
+
+def hive_hash(*cs):
+    from spark_rapids_tpu.expr.misc import HiveHash
+    return HiveHash([_e(c) for c in cs])
 
 
 def hash(*cs):  # noqa: A001

@@ -1,0 +1,80 @@
+"""Device cast breadth: string <-> float/date/timestamp
+(reference GpuCast.scala + jni CastStrings)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.expr.core import col
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def test_string_to_double(session):
+    vals = ["1.5", "-2", "+3.25", "1e3", "2.5E-2", "-1.25e+2", ".5", "5.",
+            "  42  ", "", "abc", "1.2.3", "1e", "e5", None, "Infinity",
+            "-Infinity", "NaN", "0", "-0.0", "123456789012345678901",
+            "9e99", "1e-300", "0.000001"]
+    t = {"s": pa.array(vals)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            col("s").cast(T.FLOAT64).alias("d")),
+        session, approx_float=1e-13)
+
+
+def test_string_to_date(session):
+    vals = ["2020-01-15", "1999-12-31", "2020-1-5", "1970-01-01",
+            " 2023-06-30 ", "2020-02-29", "2019-02-29", "2020-13-01",
+            "2020-00-10", "2020-01-32", "not-a-date", "", None, "2020",
+            "2020-07", "0001-01-01", "9999-12-31", "2020-01-15-"]
+    t = {"s": pa.array(vals)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            col("s").cast(T.DATE).alias("d")),
+        session)
+
+
+def test_string_to_timestamp(session):
+    vals = ["2020-01-15 10:30:45", "2020-01-15T23:59:59.123456",
+            "2020-01-15", "1969-12-31 23:59:59.5", "2020-01-15 10:30",
+            "2020-01-15 24:00:00", "2020-01-15 10:61:00", "garbage", None,
+            "1970-01-01 00:00:00", "2020-6-5 1:2:3", "2020-01-15 10:30:45.1"]
+    t = {"s": pa.array(vals)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            col("s").cast(T.TIMESTAMP).alias("ts")),
+        session)
+
+
+def test_date_timestamp_to_string(session):
+    import datetime
+    dates = [datetime.date(2020, 1, 15), datetime.date(1969, 7, 20),
+             datetime.date(1, 1, 1), datetime.date(9999, 12, 31), None]
+    tss = [datetime.datetime(2020, 1, 15, 10, 30, 45),
+           datetime.datetime(2020, 1, 15, 10, 30, 45, 123456),
+           datetime.datetime(2020, 1, 15, 10, 30, 45, 500000),
+           datetime.datetime(1970, 1, 1), None]
+    t = {"d": pa.array(dates, pa.date32()),
+         "ts": pa.array(tss, pa.timestamp("us"))}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            col("d").cast(T.STRING).alias("ds"),
+            col("ts").cast(T.STRING).alias("tss")),
+        session)
+
+
+def test_cast_roundtrip_generated(session):
+    from data_gen import DateGen, TimestampGen, DoubleGen, gen_df
+    spec = [("d", DateGen()), ("ts", TimestampGen()),
+            ("f", DoubleGen(min_val=-1e9, max_val=1e9))]
+    # render then reparse: exact round trip on device
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, spec, length=512, seed=107).select(
+            col("d").cast(T.STRING).cast(T.DATE).alias("d2"),
+            col("ts").cast(T.STRING).cast(T.TIMESTAMP).alias("ts2")),
+        session)

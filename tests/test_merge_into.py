@@ -1,0 +1,117 @@
+"""MERGE INTO differential tests (reference GpuMergeIntoCommand.scala
+semantics: upsert, delete, conditional clauses, cardinality check)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql.merge import merge_into
+from spark_rapids_tpu.expr.core import col, lit, SparkException
+
+from asserts import assert_tables_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _target(s):
+    return s.create_dataframe({
+        "id": pa.array([1, 2, 3, 4, 5], pa.int64()),
+        "v": pa.array([10.0, 20.0, 30.0, 40.0, 50.0]),
+        "tag": pa.array(["a", "b", "c", "d", "e"]),
+    })
+
+
+def _source(s):
+    return s.create_dataframe({
+        "id": pa.array([2, 4, 6, 7], pa.int64()),
+        "v": pa.array([200.0, 400.0, 600.0, 700.0]),
+        "tag": pa.array(["B", "D", "F", "G"]),
+    })
+
+
+def _diff(m):
+    tpu = m.result().collect()
+    cpu = m.result().collect_cpu()
+    assert_tables_equal(tpu, cpu, ignore_order=True)
+    return tpu.to_pylist()
+
+
+def test_merge_upsert(session):
+    rows = _diff(
+        merge_into(_target(session), _source(session), on=["id"])
+        .when_matched_update({"v": col("__src_v"), "tag": col("__src_tag")})
+        .when_not_matched_insert())
+    got = {r["id"]: (r["v"], r["tag"]) for r in rows}
+    assert got[2] == (200.0, "B") and got[4] == (400.0, "D")
+    assert got[1] == (10.0, "a")                      # untouched
+    assert got[6] == (600.0, "F") and got[7] == (700.0, "G")  # inserted
+    assert len(got) == 7
+
+
+def test_merge_update_only(session):
+    rows = _diff(
+        merge_into(_target(session), _source(session), on=["id"])
+        .when_matched_update({"v": col("__src_v") * lit(2.0)}))
+    got = {r["id"]: r["v"] for r in rows}
+    assert got[2] == 400.0 and got[4] == 800.0 and len(got) == 5
+
+
+def test_merge_delete(session):
+    rows = _diff(
+        merge_into(_target(session), _source(session), on=["id"])
+        .when_matched_delete())
+    assert sorted(r["id"] for r in rows) == [1, 3, 5]
+
+
+def test_merge_conditional_clauses(session):
+    rows = _diff(
+        merge_into(_target(session), _source(session), on=["id"])
+        .when_matched_update({"v": col("__src_v")},
+                             condition=col("__src_v") > lit(300.0))
+        .when_not_matched_insert(condition=col("v") < lit(650.0)))
+    got = {r["id"]: r["v"] for r in rows}
+    assert got[2] == 20.0      # condition false -> untouched
+    assert got[4] == 400.0     # updated
+    assert 6 in got and 7 not in got  # insert condition
+    assert len(got) == 6
+
+
+def test_merge_insert_defaults_missing_to_null(session):
+    src = session.create_dataframe({
+        "id": pa.array([9], pa.int64()), "v": pa.array([900.0])})
+    rows = _diff(
+        merge_into(_target(session), src, on=["id"])
+        .when_not_matched_insert())
+    got = {r["id"]: r["tag"] for r in rows}
+    assert got[9] is None and len(got) == 6
+
+
+def test_merge_cardinality_violation(session):
+    dup = session.create_dataframe({
+        "id": pa.array([2, 2], pa.int64()),
+        "v": pa.array([1.0, 2.0]),
+        "tag": pa.array(["x", "y"])})
+    with pytest.raises(SparkException, match="multiple source rows"):
+        merge_into(_target(session), dup, on=["id"]) \
+            .when_matched_update({"v": col("__src_v")}).result()
+    # but duplicates that match NO target row are fine
+    dup2 = session.create_dataframe({
+        "id": pa.array([100, 100], pa.int64()),
+        "v": pa.array([1.0, 2.0]),
+        "tag": pa.array(["x", "y"])})
+    rows = _diff(merge_into(_target(session), dup2, on=["id"])
+                 .when_matched_update({"v": col("__src_v")}))
+    assert len(rows) == 5
+
+
+def test_merge_execute_writeback(session, tmp_path):
+    out = str(tmp_path / "merged")
+    merge_into(_target(session), _source(session), on=["id"]) \
+        .when_matched_update({"v": col("__src_v")}) \
+        .when_not_matched_insert() \
+        .execute_to(out)
+    back = session.read_parquet(out).to_pydict()
+    got = dict(zip(back["id"], back["v"]))
+    assert got[2] == 200.0 and got[6] == 600.0 and len(got) == 7

@@ -1,0 +1,81 @@
+"""Misc expression tests: rand, sequence, parse_url, hive hash,
+raise_error (reference GpuRandomExpressions / GpuSequenceUtil / ParseURI /
+hive hash)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit, SparkException
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def test_rand_deterministic_and_uniform(session):
+    df = session.range(0, 10000).select(F.rand(42).alias("r"))
+    out = df.to_pydict()["r"]
+    assert all(0.0 <= v < 1.0 for v in out)
+    assert len(set(out)) > 9900  # essentially all distinct
+    mean = sum(out) / len(out)
+    assert 0.45 < mean < 0.55
+    # device and CPU backends agree exactly (same splitmix64 stream)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.range(0, 512).select(F.rand(7).alias("r")), session)
+
+
+def test_sequence(session):
+    t = {"a": pa.array([1, 5, 3, None], pa.int64()),
+         "b": pa.array([4, 2, 3, 9], pa.int64())}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.sequence(col("a"), col("b")).alias("s1"),
+            F.sequence(col("a"), col("b"), lit(2)).alias("s2")),
+        session)
+
+
+def test_parse_url(session):
+    urls = ["https://user:pw@spark.apache.org:8080/path/p.php?query=1&k=v#Ref",
+            "http://example.com", "not a url", None,
+            "ftp://host/file.txt?x=1"]
+    t = {"u": pa.array(urls)}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.parse_url(col("u"), "HOST").alias("h"),
+            F.parse_url(col("u"), "PATH").alias("p"),
+            F.parse_url(col("u"), "QUERY").alias("q"),
+            F.parse_url(col("u"), "QUERY", "k").alias("qk"),
+            F.parse_url(col("u"), "PROTOCOL").alias("pr"),
+            F.parse_url(col("u"), "REF").alias("r")),
+        session)
+
+
+def test_hive_hash(session):
+    t = {"i": pa.array([1, -5, None, 2**40], pa.int64()),
+         "s": pa.array(["hello", "", None, "wörld"]),
+         "f": pa.array([1.5, -0.0, 3.25, None]),
+         "b": pa.array([True, False, None, True])}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.hive_hash(col("i"), col("s")).alias("h1"),
+            F.hive_hash(col("f"), col("b")).alias("h2"),
+            F.hive_hash(col("s")).alias("h3")),
+        session)
+
+
+def test_hive_hash_java_parity(session):
+    # "hello".hashCode() in Java == 99162322; hive string hash matches it
+    out = session.create_dataframe({"s": pa.array(["hello"])}).select(
+        F.hive_hash(col("s")).alias("h")).to_pydict()
+    assert out["h"][0] == 99162322
+
+
+def test_raise_error(session):
+    df = session.create_dataframe({"x": pa.array([1])}).select(
+        F.raise_error(lit("boom")).alias("e"))
+    with pytest.raises(SparkException, match="boom"):
+        df.collect()

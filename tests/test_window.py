@@ -134,3 +134,41 @@ def test_unsupported_window_falls_back(session):
         lambda s: s.create_dataframe(_t()).select(
             col("g"), F.stddev(col("v")).over(W_GO).alias("sd")),
         session, "WindowNode", ignore_order=True)
+
+
+# -- window breadth: percent_rank / cume_dist / nth_value / first/last ------
+
+def test_percent_rank_cume_dist(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), col("o"),
+            F.percent_rank().over(W_GO).alias("pr"),
+            F.cume_dist().over(W_GO).alias("cd")),
+        session, ignore_order=True, approx_float=1e-12)
+
+
+def test_nth_first_last_value(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_t()).select(
+            col("g"), col("o"),
+            F.first_value(col("v")).over(W_GO).alias("fv"),
+            F.last_value(col("v")).over(W_GO).alias("lv"),
+            F.nth_value(col("v"), 2).over(W_GO).alias("n2")),
+        session, ignore_order=True)
+
+
+def test_window_breadth_generated(session):
+    from data_gen import IntegerGen, LongGen, UniqueLongGen, RepeatSeqGen, gen_df
+    spec = [("p", RepeatSeqGen(IntegerGen(min_val=0, max_val=12), length=10)),
+            ("o", UniqueLongGen()),
+            ("v", LongGen(min_val=-1000, max_val=1000))]
+    w = Window.partition_by(col("p")).order_by(col("o"))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, spec, length=1024, seed=103).select(
+            col("p"), col("o"),
+            F.percent_rank().over(w).alias("pr"),
+            F.cume_dist().over(w).alias("cd"),
+            F.nth_value(col("v"), 3).over(w).alias("n3"),
+            F.first_value(col("v")).over(w).alias("fv"),
+            F.last_value(col("v")).over(w).alias("lv")),
+        session, ignore_order=True, approx_float=1e-12)
