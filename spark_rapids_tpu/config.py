@@ -197,6 +197,19 @@ SHUFFLE_HOST_BUDGET = conf_int(
     "partitions flush to disk spill files "
     "(reference ShuffleBufferCatalog spillable shuffle data).")
 
+ADAPTIVE_ENABLED = conf_bool(
+    "spark.rapids.sql.adaptive.enabled", True,
+    "Pick the join strategy at RUNTIME from measured build-side size when "
+    "the planner has no estimate (the AQE role: reference "
+    "GpuCustomShuffleReaderExec / per-stage re-planning).")
+
+PALLAS_ENABLED = conf_bool(
+    "spark.rapids.sql.pallas.enabled", True,
+    "Use hand-tiled Pallas TPU kernels for eligible inner loops "
+    "(murmur3 hash, string case map); the XLA twins run otherwise. "
+    "Process-wide: the first session's value wins (fused kernels are "
+    "cached process-globally).", startup_only=True)
+
 MULTIFILE_READER_TYPE = conf_str(
     "spark.rapids.sql.format.parquet.reader.type", "AUTO",
     "PERFILE, COALESCING, MULTITHREADED, or AUTO "

@@ -2152,6 +2152,73 @@ class BroadcastHashJoinExec(_HashJoinBase):
                                       self._build_keys, join_t, track)
 
 
+class AdaptiveJoinExec(TpuExec):
+    """Runtime join-strategy pick (the AQE role; reference
+    GpuCustomShuffleReaderExec + per-stage re-planning): when the planner
+    cannot estimate the build side, materialize it ONCE at execution time
+    and route on the MEASURED row count — broadcast when it fits, hash
+    exchange both sides otherwise. The materialized build feeds whichever
+    strategy wins (no recompute: the exchange path consumes it through an
+    in-memory source, matching AQE's reuse of materialized stages)."""
+
+    def __init__(self, plan, children, conf, part_keys):
+        super().__init__(plan, children, conf)
+        self.part_keys = part_keys
+        self._lock = threading.Lock()
+        self._chosen: Optional[TpuExec] = None
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    def _choose(self) -> TpuExec:
+        with self._lock:
+            if self._chosen is None:
+                left, right = self.children
+                bc = BroadcastHashJoinExec(self.plan, [left, right], self.conf)
+                build = bc._build_side()
+                rows = int(build.num_rows)
+                threshold = self.conf.get(C.BROADCAST_JOIN_ROW_THRESHOLD)
+                if rows <= threshold:
+                    self._chosen = bc
+                else:
+                    lkeys, rkeys = self.part_keys
+                    n_out = left.num_partitions
+                    right_src = _MaterializedExec(self.plan.children[1],
+                                                  [build], self.conf)
+                    lex = ShuffleExchangeExec(self.plan, [left], self.conf,
+                                              lkeys, n_out)
+                    rex = ShuffleExchangeExec(self.plan, [right_src],
+                                              self.conf, rkeys, n_out)
+                    self._chosen = ShuffledHashJoinExec(
+                        self.plan, [lex, rex], self.conf,
+                        part_keys=self.part_keys)
+        return self._chosen
+
+    def execute_partition(self, ctx, pidx):
+        yield from self._choose().execute_partition(ctx, pidx)
+
+
+class _MaterializedExec(TpuExec):
+    """Already-materialized device batches as a single-partition exec (the
+    reused-stage input of the adaptive path)."""
+
+    def __init__(self, plan, batches, conf):
+        super().__init__(plan, [], conf)
+        self._batches = list(batches)
+
+    @property
+    def schema(self):
+        return self.plan.schema
+
+    @property
+    def num_partitions(self):
+        return 1
+
+    def execute_partition(self, ctx, pidx):
+        yield from self._batches
+
+
 class BroadcastNestedLoopJoinExec(TpuExec):
     """Non-equi joins (reference GpuBroadcastNestedLoopJoinExecBase): the
     build (right) side broadcasts whole; the join condition evaluates over

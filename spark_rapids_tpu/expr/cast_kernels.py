@@ -39,15 +39,17 @@ def _walker(col: ColumnVector):
 
 
 def _trim(starts, ends, at):
+    """Java UTF8String.trim semantics: strip bytes <= 0x20 on both ends
+    (space AND control chars) — what Spark's string casts use."""
     def step(state):
         s, e = state
-        lead = (s < e) & (at(s) == 32)
-        tail = (e > s) & (at(e - 1) == 32)
+        lead = (s < e) & (at(s) <= 32)
+        tail = (e > s) & (at(e - 1) <= 32)
         return jnp.where(lead, s + 1, s), jnp.where(tail, e - 1, e)
 
     def cond(state):
         s, e = state
-        return jnp.any(((s < e) & (at(s) == 32)) | ((e > s) & (at(e - 1) == 32)))
+        return jnp.any(((s < e) & (at(s) <= 32)) | ((e > s) & (at(e - 1) <= 32)))
 
     return lax.while_loop(cond, step, (starts, ends))
 
@@ -71,8 +73,8 @@ def parse_f64(col: ColumnVector):
     neg = first == 45
     ds = s + has_sign.astype(jnp.int32)
 
-    inf = _match_lit(at, ds, e, b"Infinity") | _match_lit(at, ds, e, b"Inf")
-    nan = _match_lit(at, s, e, b"NaN")
+    inf = _match_lit(at, ds, e, b"Infinity")
+    nan = _match_lit(at, ds, e, b"NaN")  # Java: Sign_opt NaN
 
     # phases: 0 = integer digits, 1 = fraction digits, 2 = exponent
     def body(state):
@@ -135,28 +137,11 @@ def parse_f64(col: ColumnVector):
     return v, ok
 
 
-def _days_from_civil(y, m, d):
-    y = y - (m <= 2)
-    era = jnp.floor_divide(y, 400)
-    yoe = y - era * 400
-    mp = jnp.where(m > 2, m - 3, m + 9)
-    doy = (153 * mp + 2) // 5 + d - 1
-    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
-    return era * 146097 + doe - 719468
-
-
-def _civil_from_days(z):
-    z = z + 719468
-    era = jnp.floor_divide(z, 146097)
-    doe = z - era * 146097
-    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
-    y = yoe + era * 400
-    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
-    mp = (5 * doy + 2) // 153
-    d = doy - (153 * mp + 2) // 5 + 1
-    m = jnp.where(mp < 10, mp + 3, mp - 9)
-    y = y + (m <= 2)
-    return y, m, d
+# civil-calendar conversions shared with the datetime expression layer —
+# ONE Hinnant implementation for extraction and casting alike
+from spark_rapids_tpu.expr.datetime import (  # noqa: E402
+    _civil_from_days, _days_from_civil,
+)
 
 
 def _parse_ymd_hms(col: ColumnVector, with_time: bool):

@@ -133,7 +133,10 @@ class _CaseMap(Expression):
 
         def compute(flat, cap):
             raw = flat.data["bytes"]
-            if self.upper:
+            from spark_rapids_tpu.ops import pallas_kernels as PK
+            if PK.enabled() and raw.shape[0] % 4096 == 0:
+                shifted = PK.ascii_case_map_pallas(raw, self.upper)
+            elif self.upper:
                 shifted = jnp.where((raw >= 97) & (raw <= 122), raw - 32, raw)
             else:
                 shifted = jnp.where((raw >= 65) & (raw <= 90), raw + 32, raw)
@@ -916,13 +919,20 @@ def cast_string_cpu(c: CpuCol, dst: T.DataType, ansi: bool) -> CpuCol:
                 valid[i] = False
         return CpuCol(dst, vals.astype(dst.np_dtype), valid)
     if isinstance(dst, (T.Float32Type, T.Float64Type)):
+        import re
+        # Spark castToDouble = UTF8String.trim + Java Double.parseDouble:
+        # case-SENSITIVE Infinity/NaN, no underscores, no bare 'inf'
+        # (python float() is more lenient — do NOT use it directly)
+        num_re = re.compile(
+            r"[+-]?((\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?|Infinity|NaN)")
         vals = np.zeros(n, np.float64)
         for i, s in enumerate(c.values):
             if not valid[i]:
                 continue
-            try:
-                vals[i] = float(s.strip())
-            except ValueError:
+            t = _java_trim(s) if isinstance(s, str) else ""
+            if num_re.fullmatch(t):
+                vals[i] = float(t.replace("Infinity", "inf"))
+            else:
                 if ansi:
                     raise SparkException(f"[CAST_INVALID_INPUT] '{s}' to float")
                 valid[i] = False
@@ -960,6 +970,14 @@ def cast_string_cpu(c: CpuCol, dst: T.DataType, ansi: bool) -> CpuCol:
     raise NotImplementedError(f"cast string -> {dst!r}")
 
 
+_JAVA_WS = "".join(chr(c) for c in range(33))
+
+
+def _java_trim(s: str) -> str:
+    """Java String/UTF8String trim: strip chars <= 0x20 on both ends."""
+    return s.strip(_JAVA_WS)
+
+
 def _parse_dt_py(s, with_time: bool):
     """Spark stringToDate/stringToTimestamp subset, matching the device
     kernel (cast_kernels._parse_ymd_hms): yyyy[-m[-d]] and
@@ -968,7 +986,7 @@ def _parse_dt_py(s, with_time: bool):
     import datetime
     if not isinstance(s, str):
         return None
-    t = s.strip()
+    t = _java_trim(s)
     date_re = r"(\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2}))?)?"
     time_re = r"(?:[ T](\d{1,2}):(\d{1,2}):(\d{1,2})(?:\.(\d+))?)?"
     m = re.fullmatch(date_re + (time_re if with_time else ""), t)

@@ -65,7 +65,13 @@ def _mm3_fmix(h1, length):
 
 
 def murmur3_int32(values: jax.Array, seed: jax.Array) -> jax.Array:
-    """Murmur3 of an int32 plane (Spark hashInt)."""
+    """Murmur3 of an int32 plane (Spark hashInt). Block-aligned planes
+    take the hand-tiled Pallas kernel (ops/pallas_kernels.py); the lax
+    chain below is the reference twin and the small-plane path."""
+    from spark_rapids_tpu.ops import pallas_kernels as PK
+    if PK.enabled() and PK.pallas_supported(values.shape[0]) \
+            and getattr(seed, "ndim", 1) == 0:
+        return PK.murmur3_int32_pallas(values, seed)
     k1 = _mm3_mix_k1(values.astype(jnp.uint32))
     h1 = _mm3_mix_h1(seed.astype(jnp.uint32), k1)
     return _mm3_fmix(h1, 4)

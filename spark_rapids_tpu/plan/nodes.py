@@ -69,8 +69,11 @@ class PlanNode:
         if isinstance(self, Union):
             parts = [c.estimated_rows() for c in self.children]
             return None if any(p is None for p in parts) else sum(parts)
-        if isinstance(self, Aggregate) and not self.group_exprs:
-            return 1
+        if isinstance(self, Aggregate):
+            # grouped-aggregate cardinality is data-dependent: report
+            # UNKNOWN so the planner defers the join strategy to runtime
+            # (AdaptiveJoinExec measures the real count — the AQE role)
+            return 1 if not self.group_exprs else None
         if self.children:
             return self.children[0].estimated_rows()
         return None
@@ -126,7 +129,7 @@ class TextScan(PlanNode):
     parse kernels; GpuCSVScan.scala / GpuJsonScan.scala / GpuOrcScan.scala),
     then the standard Arrow-plane device upload."""
 
-    FORMATS = ("csv", "json", "orc")
+    FORMATS = ("csv", "json", "orc", "avro")
 
     def __init__(self, fmt: str, paths: Sequence[str],
                  schema: Optional[T.Schema] = None,
@@ -165,6 +168,11 @@ class TextScan(PlanNode):
         elif self.fmt == "json":
             import pyarrow.json as pjson
             t = pjson.read_json(path)
+            if self.columns:
+                t = t.select(self.columns)
+        elif self.fmt == "avro":
+            from spark_rapids_tpu.io.avro import read_avro
+            t = read_avro(path)
             if self.columns:
                 t = t.select(self.columns)
         else:

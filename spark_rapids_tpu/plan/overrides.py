@@ -756,6 +756,17 @@ class SparkPlanMeta:
         est = p.children[1].estimated_rows()
         small = est is not None and est <= conf.get(C.BROADCAST_JOIN_ROW_THRESHOLD)
         multi = left.num_partitions > 1
+        if multi and est is None and conf.get(C.ADAPTIVE_ENABLED) \
+                and p.how not in ("right", "full"):
+            # unknown build size: defer broadcast-vs-shuffle to RUNTIME on
+            # the measured count (AQE analog)
+            lkeys, rkeys = [], []
+            for lk, rk in zip(p.left_keys, p.right_keys):
+                ct = T.common_type(lk.data_type(), rk.data_type())
+                lkeys.append(lk if lk.data_type() == ct else E.Cast(lk, ct))
+                rkeys.append(rk if rk.data_type() == ct else E.Cast(rk, ct))
+            return X.AdaptiveJoinExec(p, [left, right], conf,
+                                      part_keys=(lkeys, rkeys))
         if multi and not small:
             # Hash-partitioning must agree ACROSS sides: Spark murmur3 is
             # width-sensitive (int32 vs int64 hash differently), so keys

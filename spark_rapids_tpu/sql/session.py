@@ -57,6 +57,8 @@ class TpuSession:
     def __init__(self, conf_overrides: Optional[Dict] = None):
         self.conf = C.RapidsConf(conf_overrides)
         self._last_meta = None
+        from spark_rapids_tpu.ops import pallas_kernels as PK
+        PK.set_enabled(self.conf.get(C.PALLAS_ENABLED))
 
     # -- sources -----------------------------------------------------------
     def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
@@ -110,6 +112,10 @@ class TpuSession:
 
     def read_json(self, *paths, columns=None) -> DataFrame:
         return DataFrame(P.TextScan("json", self._expand_paths(paths),
+                                    columns=columns), self)
+
+    def read_avro(self, *paths, columns=None) -> DataFrame:
+        return DataFrame(P.TextScan("avro", self._expand_paths(paths),
                                     columns=columns), self)
 
     def read_orc(self, *paths, columns=None) -> DataFrame:

@@ -82,3 +82,61 @@ def test_last_metrics_surface():
     assert any(k.startswith("HashAggregateExec") for k in m)
     scan = next(v for k, v in m.items() if k.startswith("InMemoryScanExec"))
     assert scan.get("numOutputRows") == 30
+
+
+# -- pallas kernels ----------------------------------------------------------
+
+def test_pallas_murmur3_matches_xla_twin():
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import pallas_kernels as PK
+    from spark_rapids_tpu.ops.kernels import (
+        _mm3_fmix, _mm3_mix_h1, _mm3_mix_k1,
+    )
+    rng = np.random.default_rng(1)
+    v = jnp.asarray(rng.integers(-2**31, 2**31, 8192,
+                                 dtype=np.int64).astype(np.int32))
+    got = np.asarray(PK.murmur3_int32_pallas(v, jnp.uint32(42)))
+    want = np.asarray(_mm3_fmix(_mm3_mix_h1(jnp.uint32(42),
+                                            _mm3_mix_k1(v.astype(jnp.uint32))),
+                                4))
+    assert np.array_equal(got, want)
+    # per-row seed planes stay on the lax twin (see kernel docstring)
+    from spark_rapids_tpu.ops.kernels import murmur3_int32
+    seeds = jnp.asarray(rng.integers(0, 2**32, 8192,
+                                     dtype=np.uint64).astype(np.uint32))
+    got2 = np.asarray(murmur3_int32(v, seeds))
+    want2 = np.asarray(_mm3_fmix(_mm3_mix_h1(seeds,
+                                             _mm3_mix_k1(v.astype(jnp.uint32))),
+                                 4))
+    assert np.array_equal(got2, want2)
+
+
+def test_pallas_case_map_matches_twin():
+    import numpy as np
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import pallas_kernels as PK
+    rng = np.random.default_rng(2)
+    raw = jnp.asarray(rng.integers(0, 256, 4096 * 3).astype(np.uint8))
+    for upper in (True, False):
+        got = np.asarray(PK.ascii_case_map_pallas(raw, upper))
+        e = np.asarray(raw)
+        if upper:
+            want = np.where((e >= 97) & (e <= 122), e - 32, e)
+        else:
+            want = np.where((e >= 65) & (e <= 90), e + 32, e)
+        assert np.array_equal(got, want)
+
+
+def test_pallas_flag_is_startup_only():
+    # the flag is process-global (fused kernels cache process-wide): a
+    # later session asking for a different value warns and keeps the first
+    import warnings
+    from spark_rapids_tpu.ops import pallas_kernels as PK
+    first = PK.enabled()
+    PK.set_enabled(first)  # same value: silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        PK.set_enabled(not first)
+    assert any("process-global" in str(x.message) for x in w)
+    assert PK.enabled() == first

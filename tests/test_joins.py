@@ -116,3 +116,70 @@ def test_join_then_agg(session):
         return (l.join(r, on=[(col("k"), col("k"))], how="inner")
                 .group_by(col("lv")).agg(F.sum("rv").alias("srv")))
     assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+
+
+# -- adaptive join strategy (AQE analog) -------------------------------------
+
+def test_adaptive_join_picks_broadcast_for_small_build(session):
+    # build side behind an aggregate: no planner estimate -> adaptive;
+    # measured count is tiny -> broadcast at runtime
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    from spark_rapids_tpu.exec import tpu_nodes as X
+
+    def q(s):
+        left = s.create_dataframe(
+            {"k": list(range(200)), "v": list(range(200))}, num_partitions=3)
+        right = s.create_dataframe(
+            {"k": [1, 2, 3, 1], "w": [10, 20, 30, 40]})
+        rsmall = right.group_by(col("k")).agg(F.sum("w").alias("sw"))
+        return left.join(rsmall, on="k", how="inner")
+
+    df = q(session)
+    root, _ = convert_plan(df.plan, session.conf)
+    nodes = []
+    def walk(e):
+        nodes.append(e)
+        for c in e.children:
+            walk(c)
+    walk(root)
+    adaptive = [n for n in nodes if isinstance(n, X.AdaptiveJoinExec)]
+    assert adaptive, [type(n).__name__ for n in nodes]
+    assert_tpu_and_cpu_are_equal_collect(q, session, ignore_order=True)
+    from spark_rapids_tpu.runtime.task import TaskContext
+    for p in range(root.num_partitions):
+        with TaskContext(partition_id=p) as c:
+            list(root.execute_partition(c, p))
+    assert isinstance(adaptive[0]._chosen, X.BroadcastHashJoinExec)
+
+
+def test_adaptive_join_shuffles_large_build():
+    from spark_rapids_tpu.sql.session import TpuSession
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    s = TpuSession({"spark.rapids.sql.join.broadcastRowThreshold": 8})
+
+    def q(ss):
+        left = ss.create_dataframe(
+            {"k": [i % 40 for i in range(300)], "v": list(range(300))},
+            num_partitions=3)
+        right = ss.create_dataframe(
+            {"k": list(range(40)), "w": list(range(40))}, num_partitions=2)
+        rbig = right.group_by(col("k")).agg(F.sum("w").alias("sw"))
+        return left.join(rbig, on="k", how="left")
+
+    df = q(s)
+    root, _ = convert_plan(df.plan, s.conf)
+    nodes = []
+    def walk(e):
+        nodes.append(e)
+        for c in e.children:
+            walk(c)
+    walk(root)
+    adaptive = [n for n in nodes if isinstance(n, X.AdaptiveJoinExec)]
+    assert adaptive
+    assert_tpu_and_cpu_are_equal_collect(q, s, ignore_order=True)
+    from spark_rapids_tpu.runtime.task import TaskContext
+    for p in range(root.num_partitions):
+        with TaskContext(partition_id=p) as c:
+            list(root.execute_partition(c, p))
+    assert isinstance(adaptive[0]._chosen, X.ShuffledHashJoinExec)

@@ -1,0 +1,83 @@
+"""Plan-ingestion contract tests (the Spark boundary seam;
+docs/architecture.md L2 re-scope)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.plan.ingest import ingest
+from spark_rapids_tpu.expr.core import SparkException
+
+from asserts import assert_tables_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _run_both(doc, session):
+    df = ingest(doc, session)
+    tpu = df.collect()
+    cpu = df.collect_cpu()
+    assert_tables_equal(tpu, cpu, ignore_order=True)
+    return tpu.to_pylist()
+
+
+def test_ingest_q6_shaped(session, tmp_path):
+    import pyarrow.parquet as pq
+    path = str(tmp_path / "li.parquet")
+    pq.write_table(pa.table({
+        "qty": pa.array([10.0, 30.0, 5.0, 20.0]),
+        "price": pa.array([100.0, 200.0, 300.0, 400.0]),
+        "disc": pa.array([0.05, 0.06, 0.02, 0.07])}), path)
+    doc = {"version": 1, "plan": {
+        "node": "aggregate", "keys": [],
+        "aggs": [{"fn": "sum", "alias": "rev",
+                  "child": {"expr": "mul",
+                            "left": {"expr": "col", "name": "price"},
+                            "right": {"expr": "col", "name": "disc"}}}],
+        "child": {"node": "filter",
+                  "condition": {"expr": "and",
+                                "left": {"expr": "ge",
+                                         "left": {"expr": "col", "name": "disc"},
+                                         "right": {"expr": "lit", "value": 0.05}},
+                                "right": {"expr": "lt",
+                                          "left": {"expr": "col", "name": "qty"},
+                                          "right": {"expr": "lit", "value": 24.0}}},
+                  "child": {"node": "parquet_scan", "paths": [path]}}}}
+    rows = _run_both(doc, session)
+    assert abs(rows[0]["rev"] - (100 * 0.05 + 400 * 0.07)) < 1e-9
+
+
+def test_ingest_join_sort_limit(session):
+    doc = {"version": 1, "plan": {
+        "node": "limit", "n": 3,
+        "child": {"node": "sort",
+                  "orders": [{"expr": {"expr": "col", "name": "v"},
+                              "ascending": False}],
+                  "child": {"node": "join", "how": "inner",
+                            "left_keys": [{"expr": "col", "name": "k"}],
+                            "right_keys": [{"expr": "col", "name": "k"}],
+                            "left": {"node": "in_memory",
+                                     "rows": {"k": [1, 2, 3, 4],
+                                              "v": [10, 20, 30, 40]}},
+                            "right": {"node": "in_memory",
+                                      "rows": {"k": [2, 3, 4, 5]}}}}}}
+    rows = ingest(doc, session).collect().to_pylist()
+    assert [r["v"] for r in rows] == [40, 30, 20]
+
+
+def test_ingest_generate_and_calls(session):
+    doc = {"version": 1, "plan": {
+        "node": "generate", "generator": "explode",
+        "input": {"expr": "call", "fn": "sequence",
+                  "args": [{"expr": "lit", "value": 1},
+                           {"expr": "col", "name": "n"}]},
+        "child": {"node": "in_memory", "rows": {"n": [2, 3]}}}}
+    rows = _run_both(doc, session)
+    assert sorted(r["col"] for r in rows) == [1, 1, 2, 2, 3]
+
+
+def test_ingest_version_gate(session):
+    with pytest.raises(SparkException, match="version"):
+        ingest({"version": 99, "plan": {}}, session)
