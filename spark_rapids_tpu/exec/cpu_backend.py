@@ -635,6 +635,10 @@ def _agg_by_gid(a: NamedAgg, inp, gid: np.ndarray,
         filled = res.fillna(0).to_numpy(dtype=np.int64)
     else:
         filled = res.fillna(0).to_numpy(dtype=np.float64)
+    if spec == "mean" and isinstance(a.fn.children[0].data_type(),
+                                     T.DecimalType):
+        # decimal state is unscaled int64; the mean must be a VALUE
+        filled = filled / 10.0 ** a.fn.children[0].data_type().scale
     return CpuCol(rt, filled.astype(rt.np_dtype), ~na)
 
 

@@ -2041,8 +2041,6 @@ class _HashJoinBase(TpuExec):
         build_parts = self._split_build(build, k) if k > 1 else None
         for probe in probe_iter:
             self._acquire(ctx)
-            if probe.row_mask is not None:
-                probe = K.compact_batch(probe)
             with join_t.ns():
                 if build_parts is not None:
                     probe_parts = self._bucket_split(probe, self._hash_keys(0), k)
@@ -2057,7 +2055,8 @@ class _HashJoinBase(TpuExec):
                 if out is not None:
                     yield out
         if track_build_matches:
-            un_idx, n_un = J.unmatched_indices(matched_build, build.num_rows)
+            un_idx, n_un = J.unmatched_indices(matched_build,
+                                               build.live_mask())
             if n_un:
                 from spark_rapids_tpu.columnar.batch import empty_like_schema
                 dummy = empty_like_schema(self.children[0].schema, capacity=8)
@@ -2067,17 +2066,19 @@ class _HashJoinBase(TpuExec):
     def _probe_one(self, probe, build, build_keys, matched_build):
         how = self.plan.how
         probe_keys = compiled.run_stage(self.plan.left_keys, probe)
+        live = probe.live_mask() if probe.row_mask is not None else None
         pi, bi, nmatch = J.join_pairs(build_keys, build.num_rows,
-                                      probe_keys, probe.num_rows)
+                                      probe_keys, probe.num_rows,
+                                      probe_live=live)
         pi, bi, nmatch = self._apply_condition(probe, build, pi, bi, nmatch)
         if how in ("left_semi", "left_anti"):
-            mask = J.probe_matched_mask(pi, probe.num_rows, probe.capacity)
+            mask = J.probe_matched_mask(pi, probe.capacity)
             if how == "left_anti":
                 mask = ~mask
             return matched_build, K.mask_filter_batch(probe, mask)
         if how in ("left", "full"):
-            mask = J.probe_matched_mask(pi, probe.num_rows, probe.capacity)
-            un_idx, n_un = J.unmatched_indices(mask, probe.num_rows)
+            mask = J.probe_matched_mask(pi, probe.capacity)
+            un_idx, n_un = J.unmatched_indices(mask, probe.live_mask())
             if n_un:
                 tot = nmatch + n_un
                 cap = round_capacity(max(tot, 1))
@@ -2088,7 +2089,7 @@ class _HashJoinBase(TpuExec):
                 nmatch = tot
         if matched_build is not None:
             matched_build = matched_build | J.probe_matched_mask(
-                bi, build.num_rows, build.capacity)
+                bi, build.capacity)
         return matched_build, self._emit(probe, build, pi, bi, nmatch)
 
     def _apply_condition(self, probe, build, pi, bi, nmatch):
@@ -2175,21 +2176,34 @@ class AdaptiveJoinExec(TpuExec):
         with self._lock:
             if self._chosen is None:
                 left, right = self.children
-                bc = BroadcastHashJoinExec(self.plan, [left, right], self.conf)
-                build = bc._build_side()
-                rows = int(build.num_rows)
                 threshold = self.conf.get(C.BROADCAST_JOIN_ROW_THRESHOLD)
-                if rows <= threshold:
-                    self._chosen = bc
+                # stream the build side only UP TO the threshold: measuring
+                # by materializing everything would hold the whole side in
+                # HBM exactly when it is too big to broadcast
+                batches, rows, overflow = [], 0, False
+                for p in range(right.num_partitions):
+                    with TaskContext(partition_id=p) as tctx:
+                        for b in right.execute_partition(tctx, p):
+                            batches.append(b)
+                            rows += rows_int(b.num_rows)
+                            if rows > threshold:
+                                overflow = True
+                                break
+                    if overflow:
+                        break
+                if not overflow:
+                    right_src = _MaterializedExec(self.plan.children[1],
+                                                  batches, self.conf)
+                    self._chosen = BroadcastHashJoinExec(
+                        self.plan, [left, right_src], self.conf)
                 else:
+                    del batches  # release; the exchange re-executes right
                     lkeys, rkeys = self.part_keys
                     n_out = left.num_partitions
-                    right_src = _MaterializedExec(self.plan.children[1],
-                                                  [build], self.conf)
                     lex = ShuffleExchangeExec(self.plan, [left], self.conf,
                                               lkeys, n_out)
-                    rex = ShuffleExchangeExec(self.plan, [right_src],
-                                              self.conf, rkeys, n_out)
+                    rex = ShuffleExchangeExec(self.plan, [right], self.conf,
+                                              rkeys, n_out)
                     self._chosen = ShuffledHashJoinExec(
                         self.plan, [lex, rex], self.conf,
                         part_keys=self.part_keys)
@@ -2402,8 +2416,14 @@ class ShuffledHashJoinExec(_HashJoinBase):
 
 def _pair_batch(left: ColumnarBatch, right: ColumnarBatch, li, ri, n: int
                 ) -> ColumnarBatch:
-    cols = [K.gather_column(c, li, left.num_rows) for c in left.columns]
-    cols += [K.gather_column(c, ri, right.num_rows) for c in right.columns]
+    # masked sides join uncompacted: gathers must use the LIVE mask, not
+    # arange<num_rows (live rows sit at arbitrary positions)
+    llive = left.live_mask() if left.row_mask is not None else None
+    rlive = right.live_mask() if right.row_mask is not None else None
+    cols = [K.gather_column(c, li, left.num_rows, src_live=llive)
+            for c in left.columns]
+    cols += [K.gather_column(c, ri, right.num_rows, src_live=rlive)
+             for c in right.columns]
     return ColumnarBatch(cols, n)
 
 

@@ -220,6 +220,9 @@ class Average(AggFunction):
         s, c = state_cols
         cnt = c.data.astype(jnp.float64)
         val = s.data.astype(jnp.float64) / jnp.where(cnt == 0, 1.0, cnt)
+        dt = self.children[0].data_type()
+        if isinstance(dt, T.DecimalType):
+            val = val / np.float64(10.0 ** dt.scale)  # unscaled -> value
         return ColumnVector(T.FLOAT64, val, (c.data > 0))
 
     def pandas_spec(self):

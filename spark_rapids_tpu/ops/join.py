@@ -21,6 +21,7 @@ mirror of the reference's incompatOps discipline).
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -32,13 +33,13 @@ from spark_rapids_tpu.columnar.batch import ColumnVector, round_capacity
 from spark_rapids_tpu.ops import kernels as K
 
 
-def _combine_keys(cols: List[ColumnVector], num_rows: int
+def _combine_keys(cols: List[ColumnVector], num_rows: int, live=None
                   ) -> Tuple[jax.Array, List[jax.Array], jax.Array]:
     """Returns (combined u64 hash, per-col normalized planes, any_null)."""
     planes = []
     any_null = None
     for c in cols:
-        k, nulls = K.normalize_key(c, num_rows)
+        k, nulls = K.normalize_key(c, num_rows, live=live)
         planes.append(k)
         any_null = nulls if any_null is None else (any_null | nulls)
     h = jnp.zeros_like(planes[0])
@@ -51,18 +52,61 @@ def _combine_keys(cols: List[ColumnVector], num_rows: int
     return h, planes, any_null
 
 
+#: direct-address table budget (int32 entries): dense integer join keys
+#: (TPC-H orderkeys, dimension ids) take the 2-gather path below this
+DENSE_KEY_RANGE_LIMIT = 1 << 26
+
+
+def _dense_int_eligible(build_keys: List[ColumnVector],
+                        probe_keys: List[ColumnVector]) -> bool:
+    if len(build_keys) != 1:
+        return False
+    bt, pt = build_keys[0].dtype, probe_keys[0].dtype
+    from spark_rapids_tpu import types as T
+    ok_types = (T.Int8Type, T.Int16Type, T.Int32Type, T.Int64Type,
+                T.DateType, T.BooleanType)
+    return isinstance(bt, ok_types) and isinstance(pt, ok_types)
+
+
 def join_pairs(build_keys: List[ColumnVector], build_rows: int,
-               probe_keys: List[ColumnVector], probe_rows: int
-               ) -> Tuple[np.ndarray, np.ndarray]:
+               probe_keys: List[ColumnVector], probe_rows: int,
+               probe_live=None) -> Tuple[np.ndarray, np.ndarray]:
     """Compute matching (probe_idx, build_idx) pairs for an equi-join.
     Returned as device arrays (int32) with -1 padding; second return is the
-    match count. Output order: probe-major (stable for the probe side)."""
+    match count. Output order: probe-major (stable for the probe side).
+
+    Two probe strategies:
+    - DENSE-INT fast path: a single bounded integer key builds a
+      direct-address (start, end) table over the key range — the probe is
+      TWO O(probe) gathers and needs no hash verification. On this
+      hardware a 32M-row binary search costs ~6s (22 round-trip gathers,
+      64-bit lanes emulated); the dense path is ~50x cheaper and covers
+      the TPC-H/star-schema join shape.
+    - general path: sort build by 64-bit key hash, vectorized binary
+      search per probe row, expand candidate ranges, verify exact
+      equality over the normalized planes."""
     bh, bplanes, bnull = _combine_keys(build_keys, build_rows)
-    ph, pplanes, pnull = _combine_keys(probe_keys, probe_rows)
+    ph, pplanes, pnull = _combine_keys(probe_keys, probe_rows,
+                                       live=probe_live)
     bcap = bh.shape[0]
     pcap = ph.shape[0]
     b_in = (jnp.arange(bcap) < build_rows) & ~bnull
-    p_in = (jnp.arange(pcap) < probe_rows) & ~pnull
+    # masked probe batches join WITHOUT compaction: liveness rides in
+    p_in = ((probe_live if probe_live is not None
+             else (jnp.arange(pcap) < probe_rows)) & ~pnull)
+
+    if _dense_int_eligible(build_keys, probe_keys):
+        bv = build_keys[0].data.astype(jnp.int64)
+        bmin_d = jnp.min(jnp.where(b_in, bv, jnp.int64(2**62)))
+        bmax_d = jnp.max(jnp.where(b_in, bv, jnp.int64(-2**62)))
+        nbuild_d = jnp.sum(b_in.astype(jnp.int32))
+        bmin, bmax, nbuild = (int(x) for x in
+                              jax.device_get([bmin_d, bmax_d, nbuild_d]))
+        span = bmax - bmin + 1
+        if nbuild > 0 and 0 < span <= DENSE_KEY_RANGE_LIMIT:
+            return _dense_int_pairs(bv, b_in, bcap,
+                                    probe_keys[0].data.astype(jnp.int64),
+                                    p_in, pcap, jnp.int64(bmin), span)
 
     # compact non-null build rows, then sort by hash
     bidx, bcount = K.filter_indices(b_in, bcap)
@@ -97,16 +141,65 @@ def join_pairs(build_keys: List[ColumnVector], build_rows: int,
     return out_p, out_b, match_count
 
 
-def probe_matched_mask(pairs_idx: jax.Array, n: int, cap: int) -> jax.Array:
-    """bool[cap]: rows of a side that appear in the matched pairs."""
+@partial(jax.jit, static_argnames=("bcap", "span"))
+def _dense_table(bv, b_in, bcap, bmin, span):
+    """(starts[span+1], sorted_orig[bcap]): direct-address layout of build
+    rows grouped by key value (counting sort by key)."""
+    slot = jnp.where(b_in, (bv - bmin).astype(jnp.int32), span)
+    cnt = jax.ops.segment_sum(jnp.ones(bcap, jnp.int32), slot,
+                              num_segments=span + 1)[:span]
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                              jnp.cumsum(cnt).astype(jnp.int32)])
+    # stable counting sort: rows ordered by (key, original index)
+    order = jnp.argsort(jnp.where(b_in, (bv - bmin),
+                                  jnp.int64(1) << 62).astype(jnp.int64))
+    sorted_orig = jnp.where(jnp.arange(bcap) < jnp.sum(b_in.astype(jnp.int32)),
+                            order, -1)
+    return starts, sorted_orig
+
+
+def _dense_int_pairs(bv, b_in, bcap, pv, p_in, pcap, bmin, span: int):
+    starts, sorted_orig = _dense_table(bv, b_in, bcap, bmin, span)
+    slot = (pv - bmin).astype(jnp.int64)
+    inside = p_in & (slot >= 0) & (slot < span)
+    sl = jnp.where(inside, slot, 0).astype(jnp.int32)
+    lo = jnp.where(inside, starts[sl], 0)
+    hi = jnp.where(inside, starts[sl + 1], 0)
+    counts = hi - lo
+    total, max_dup = (int(x) for x in jax.device_get(
+        [jnp.sum(counts.astype(jnp.int64)), jnp.max(counts)]))
+    if max_dup <= 1:
+        # unique build keys (the dominant case): pairs ARE the matching
+        # probe rows — no range expansion at all
+        m = counts > 0
+        idx, match_count = K.filter_indices(m, pcap)
+        sel = jnp.clip(idx, 0, pcap - 1)
+        out_p = jnp.where(idx >= 0, sel, -1)
+        bpos = jnp.where(idx >= 0, lo[sel], 0)
+        out_b = jnp.where(idx >= 0,
+                          sorted_orig[jnp.clip(bpos, 0, bcap - 1)], -1)
+        return out_p, out_b, match_count
+    probe_i, build_pos = K.expand_ranges(lo, hi, total)
+    build_i = jnp.where(build_pos >= 0,
+                        sorted_orig[jnp.clip(build_pos, 0, bcap - 1)], -1)
+    return probe_i, build_i, total
+
+
+def probe_matched_mask(pairs_idx: jax.Array, cap: int) -> jax.Array:
+    """bool[cap]: rows of a side that appear in the matched pairs. Pairs
+    only ever reference LIVE rows (join_pairs gates on the live mask), so
+    no in-range clamp — masked probe batches have live rows at arbitrary
+    positions."""
     m = jnp.zeros(cap + 1, jnp.bool_)
     sel = jnp.where(pairs_idx >= 0, pairs_idx, cap)
     m = m.at[sel].set(True, mode="drop")
-    return m[:cap] & (jnp.arange(cap) < n)
+    return m[:cap]
 
 
-def unmatched_indices(mask_matched: jax.Array, n: int) -> Tuple[jax.Array, int]:
-    """Indices of in-range rows NOT matched (for outer joins)."""
+def unmatched_indices(mask_matched: jax.Array, live: jax.Array
+                      ) -> Tuple[jax.Array, int]:
+    """Indices of LIVE rows not matched (for outer-join completion).
+    `live` is the side's liveness plane (bool[cap])."""
     cap = mask_matched.shape[0]
-    un = (~mask_matched) & (jnp.arange(cap) < n)
+    un = (~mask_matched) & live
     return K.filter_indices(un, cap)

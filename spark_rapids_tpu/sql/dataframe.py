@@ -233,7 +233,12 @@ class DataFrame:
         return self.collect().to_pydict()
 
     def count(self) -> int:
-        return self.collect().num_rows
+        # aggregate ENGINE-side (Spark semantics): collecting the full
+        # result to count it would ship every row across the host link
+        from spark_rapids_tpu.expr.aggregates import CountAll, NamedAgg
+        plan = P.Aggregate([], [NamedAgg(CountAll(), "count")], self.plan)
+        out = DataFrame(plan, self.session).collect()
+        return int(out.column(0)[0].as_py())
 
     def explain(self, mode: str = "placement") -> str:
         from spark_rapids_tpu.plan.overrides import explain_plan

@@ -1,0 +1,94 @@
+"""Decimal arithmetic/aggregation tests (reference decimalExpressions.scala
+/ DecimalUtils; this engine implements decimal as scaled int64, precision
+<= 18 — wider decimals are a documented limitation)."""
+import decimal
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import DecimalGen, RepeatSeqGen, IntegerGen, gen_df
+
+D = decimal.Decimal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _df(s):
+    return s.create_dataframe(pa.table({
+        "k": pa.array(["a", "b", "a", "b", None]),
+        "d": pa.array([D("1.25"), D("-3.50"), None, D("100.75"), D("0.01")],
+                      pa.decimal128(10, 2)),
+        "e": pa.array([D("0.5"), D("2.0"), D("1.5"), D("-1.0"), D("0.0")],
+                      pa.decimal128(8, 1)),
+        "i": pa.array([2, 3, 4, 5, 6], pa.int32()),
+    }))
+
+
+def test_decimal_cross_scale_arithmetic(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            (col("d") + col("e")).alias("add"),
+            (col("d") - col("e")).alias("sub"),
+            (col("d") * col("e")).alias("mul"),
+            (col("d") + col("i")).alias("addi"),
+            (col("d") * col("i")).alias("muli"),
+            (col("d") / col("e")).alias("div")),
+        session, approx_float=1e-12)
+
+
+def test_decimal_exact_values(session):
+    out = _df(session).select(
+        (col("d") + col("e")).alias("a"),
+        (col("d") * col("e")).alias("m")).to_pydict()
+    assert out["a"][0] == D("1.75")
+    assert out["m"][0] == D("0.625")
+    assert out["m"][3] == D("-100.750")
+
+
+def test_decimal_aggregates(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).group_by(col("k")).agg(
+            F.sum("d").alias("s"), F.min("d").alias("mn"),
+            F.max("d").alias("mx"), F.avg("d").alias("av"),
+            F.count("d").alias("n")),
+        session, ignore_order=True, approx_float=1e-12)
+
+
+def test_decimal_compare_sort_distinct(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).filter(col("d") > col("e")).select(col("d")),
+        session, ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).order_by(col("d").asc_nulls_first()),
+        session)
+
+
+def test_decimal_generated(session):
+    spec = [("k", RepeatSeqGen(IntegerGen(min_val=0, max_val=10), length=8)),
+            ("d", DecimalGen(8, 3)), ("e", DecimalGen(5, 1))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: gen_df(s, spec, length=1024, seed=113)
+        .select(col("k"), (col("d") + col("e")).alias("a"),
+                (col("d") * col("e")).alias("m"))
+        .group_by(col("k")).agg(F.sum("a").alias("sa"),
+                                F.min("m").alias("mm")),
+        session, ignore_order=True)
+
+
+def test_decimal_cast_roundtrips(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _df(s).select(
+            col("d").cast(T.FLOAT64).alias("f"),
+            col("d").cast(T.DecimalType(14, 4)).alias("wide"),
+            col("d").cast(T.DecimalType(6, 0)).alias("narrow"),
+            col("i").cast(T.DecimalType(10, 2)).alias("fromint")),
+        session, approx_float=1e-12)
