@@ -39,6 +39,7 @@ from spark_rapids_tpu.expr.aggregates import CountAll
 from spark_rapids_tpu.ops import groupby as G
 from spark_rapids_tpu.ops import join as J
 from spark_rapids_tpu.ops import kernels as K
+from spark_rapids_tpu.ops import radix as R
 from spark_rapids_tpu.plan import nodes as P
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime.semaphore import get_semaphore
@@ -763,11 +764,221 @@ class _AggKernels:
     _BUCKET_LIMIT = 4096
     _MATMUL_LIMIT = 64
 
+    #: segmented-reduction ops the packed radix path implements
+    _SIMPLE_OPS = frozenset({"sum", "sumsq", "count", "count_all", "min",
+                             "max", "first", "last", "any", "all"})
+
     def __init__(self, group_exprs, group_names, aggs, pre_filter):
         self.group_exprs = group_exprs
         self.group_names = group_names
         self.aggs = aggs
         self.pre_filter = pre_filter
+        self._packed_ok = self._packed_static_ok()
+
+    def _fp(self):
+        return (tuple(e.fingerprint() for e in self.group_exprs),
+                tuple(a.fn.fingerprint() for a in self.aggs),
+                self.pre_filter.fingerprint() if self.pre_filter is not None
+                else None)
+
+    def _packed_static_ok(self) -> bool:
+        """Static (plan-time) half of the radix fast-path eligibility:
+        simple reduction ops over fixed-width states, packable-looking key
+        types. The runtime half (spans fit 62 bits, strings are
+        dict-encoded) is decided per batch in update()/merge()."""
+        from spark_rapids_tpu.expr.aggregates import SegmentedAgg
+        if not self.group_exprs:
+            return False
+        for e in self.group_exprs:
+            dt = e.data_type()
+            if not isinstance(dt, (T.Int8Type, T.Int16Type, T.Int32Type,
+                                   T.Int64Type, T.DateType, T.TimestampType,
+                                   T.BooleanType, T.DecimalType,
+                                   T.StringType)):
+                return False
+        for a in self.aggs:
+            if isinstance(a.fn, SegmentedAgg):
+                return False
+            for (sname, sdt), (op, idx) in zip(a.fn.state_schema(),
+                                               a.fn.update_ops()):
+                if op not in self._SIMPLE_OPS:
+                    return False
+                if isinstance(sdt, (T.StringType, T.ArrayType, T.MapType,
+                                    T.StructType)):
+                    return False
+        return True
+
+    # -- radix fast-path dispatch (see ops/radix.py) ------------------------
+
+    def _probe_spec(self, key_cols, live):
+        """Host decision: can this batch's keys pack into one int64 plane?
+        Returns (spec, ranges_device) or (None, None). Costs one small
+        device fetch when integer key ranges are involved."""
+        kinds = R.static_kinds(key_cols)
+        if kinds is None:
+            return None, None
+        if R.needs_range_probe(kinds):
+            probe = fuse.fused(("radix_probe", tuple(kinds)),
+                               lambda: R.probe_ranges)
+            ranges = probe(key_cols, live)
+            ranges_host = np.asarray(jax.device_get(ranges))
+        else:
+            ranges = jnp.zeros(2 * len(key_cols), jnp.int64)
+            ranges_host = np.zeros(2 * len(key_cols), np.int64)
+        spec = R.plan_packing(key_cols, ranges_host)
+        return spec, ranges
+
+    def update(self, batch: ColumnarBatch, ansi: bool):
+        """The update phase entry: picks (in order) the tiny-bucket MXU
+        path, the packed radix path, or the general sort path. Returns
+        (state_batch, errors)."""
+        if self._packed_ok:
+            key_cols = compiled.run_stage(self.group_exprs, batch)
+            if self._bucket_layout(key_cols) is None:
+                spec, ranges = self._probe_spec(key_cols, batch.live_mask())
+                if spec is not None:
+                    fn = fuse.fused(
+                        ("hashagg_packed_update", self._fp(), spec.key, ansi),
+                        lambda: self._build_packed_update(ansi, spec))
+                    return fn(batch, ranges)
+        fn = fuse.fused(("hashagg_update", self._fp(), ansi),
+                        lambda: self._build_update(ansi))
+        return fn(batch)
+
+    def merge(self, batch: ColumnarBatch) -> ColumnarBatch:
+        nkeys = len(self.group_exprs)
+        if self._packed_ok and nkeys:
+            key_cols = list(batch.columns[:nkeys])
+            spec, ranges = self._probe_spec(key_cols, batch.live_mask())
+            if spec is not None:
+                fn = fuse.fused(
+                    ("hashagg_packed_merge", self._fp(), spec.key),
+                    lambda: self._build_packed_merge(spec))
+                return fn(batch, ranges)
+        fn = fuse.fused(("hashagg_merge", self._fp()),
+                        lambda: self._merge_states)
+        return fn(batch)
+
+    def _build_packed_update(self, ansi: bool, spec):
+        def fn(batch, ranges):
+            live = batch.live_mask()
+            errs = {}
+            if self.pre_filter is not None:
+                pctx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                               batch.capacity, ansi, live=live)
+                pred = self.pre_filter.eval_tpu(pctx)
+                live = live & pred.data.astype(jnp.bool_)
+                if pred.validity is not None:
+                    live = live & pred.validity
+                batch = ColumnarBatch(
+                    batch.columns,
+                    LazyRowCount(jnp.sum(live.astype(jnp.int32))), live)
+                errs.update(pctx.errors)
+            ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                           batch.capacity, ansi, live=live)
+            nkeys = len(self.group_exprs)
+            exprs = [e for e in self._state_input_exprs() if e is not None]
+            cols = [e.eval_tpu(ectx) for e in exprs]
+            key_cols = cols[:nkeys]
+            input_cols = {}
+            ci = nkeys
+            for ai, a in enumerate(self.aggs):
+                input_cols[ai] = cols[ci: ci + len(a.fn.children)]
+                ci += len(a.fn.children)
+            errs.update(ectx.errors)
+            state_specs = []
+            for ai, a in enumerate(self.aggs):
+                for (sname, sdt), (op, idx) in zip(a.fn.state_schema(),
+                                                   a.fn.update_ops()):
+                    src = input_cols[ai][idx] if idx >= 0 else None
+                    state_specs.append((op, src, sdt))
+            out = self._packed_agg(batch, live, key_cols, state_specs,
+                                   spec, ranges)
+            return out, errs
+        return fn
+
+    def _build_packed_merge(self, spec):
+        def fn(batch, ranges):
+            live = batch.live_mask()
+            nkeys = len(self.group_exprs)
+            key_cols = list(batch.columns[:nkeys])
+            state_specs = []
+            ci = nkeys
+            for a in self.aggs:
+                for (sname, sdt), op in zip(a.fn.state_schema(),
+                                            a.fn.merge_ops()):
+                    state_specs.append((op, batch.columns[ci], sdt))
+                    ci += 1
+            return self._packed_agg(batch, live, key_cols, state_specs,
+                                    spec, ranges)
+        return fn
+
+    def _packed_agg(self, batch, live, key_cols, state_specs, spec, ranges):
+        """Shared packed-radix reduction core for update and merge: pack,
+        one stable sort, cumsum/i32-scatter reductions (ops/radix.py)."""
+        packed = R.pack_keys(spec, key_cols, ranges, live)
+        lay = R.group_layout(packed, live)
+        sg = jnp.clip(lay.starts, 0, lay.cap - 1)
+        group_packed = lay.sorted_packed[sg]
+        pad_ok = lay.starts >= 0
+        out_cols: List[ColumnVector] = []
+        for c in R.unpack_keys(spec, group_packed, ranges, key_cols):
+            v = c.validity & pad_ok if c.validity is not None else pad_ok
+            out_cols.append(ColumnVector(c.dtype, c.data, v,
+                                         dict_unique=c.dict_unique))
+        for op, src, sdt in state_specs:
+            ov, oval = self._packed_op(op, src, sdt, live, lay)
+            out_cols.append(ColumnVector(sdt, ov.astype(sdt.np_dtype)
+                                         if ov.dtype != np.dtype(sdt.np_dtype)
+                                         else ov, oval))
+        return ColumnarBatch(out_cols, LazyRowCount(lay.n_groups))
+
+    def _packed_op(self, op, src, sdt, live, lay):
+        cap = lay.cap
+        if src is not None:
+            if src.is_string or src.is_nested:
+                raise NotImplementedError("string/nested agg state on device")
+            valid = (live if src.validity is None
+                     else (src.validity & live))[lay.perm]
+            vals = src.data[lay.perm]
+        else:
+            valid = live[lay.perm]
+            vals = jnp.zeros(cap, sdt.np_dtype)
+        if op == "count":
+            return R.seg_count(valid, lay), jnp.ones(cap, jnp.bool_)
+        if op == "count_all":
+            return R.seg_count_all(lay), jnp.ones(cap, jnp.bool_)
+        nvalid = R.seg_count(valid, lay)
+        some = nvalid > 0
+        if op in ("sum", "sumsq"):
+            v = vals * vals if op == "sumsq" else vals
+            if np.dtype(sdt.np_dtype) in (np.dtype(np.float64),
+                                          np.dtype(np.float32)):
+                return R.seg_sum_f64(v.astype(jnp.float64), valid, lay), some
+            return R.seg_sum_int(v, valid, lay), some
+        if op in ("min", "max"):
+            d = np.dtype(vals.dtype)
+            if d == np.dtype(np.float64):
+                return R.seg_minmax_f64(op, vals, valid, lay), some
+            if d == np.dtype(np.float32):
+                return R.seg_minmax_f32(op, vals, valid, lay), some
+            if d in (np.dtype(np.int64),):
+                return R.seg_minmax_i64(op, vals, valid, lay), some
+            init = (G._MIN_INIT if op == "min" else G._MAX_INIT)[
+                np.dtype(np.int32) if d == np.dtype(np.bool_) else d]
+            out = R.seg_minmax_i32(op, vals, valid, lay,
+                                   int(init))
+            return out.astype(vals.dtype), some
+        if op in ("first", "last"):
+            v, has = R.seg_first_last(op, vals, valid, lay)
+            return v, has & some
+        if op == "any":
+            t = valid & vals.astype(jnp.bool_)
+            return R.seg_count(t, lay) > 0, some
+        if op == "all":
+            f = valid & ~vals.astype(jnp.bool_)
+            return R.seg_count(f, lay) == 0, some
+        raise ValueError(f"unknown packed op {op}")
 
     def _state_input_exprs(self):
         """Expressions evaluated per input row: keys then, per agg, ALL its
@@ -1312,8 +1523,6 @@ class HashAggregateExec(TpuExec):
 
         if self.mode in ("partial", "complete"):
             ansi = self.conf.get(C.ANSI_ENABLED)
-            update_fn = fuse.fused(self._sig("update", ansi),
-                                   lambda: self.kern._build_update(ansi))
             from spark_rapids_tpu.runtime.retry import with_retry
 
             def attempt(b):
@@ -1323,7 +1532,7 @@ class HashAggregateExec(TpuExec):
                 # can still surface at a LATER sync point; the cooperative
                 # budget (SpillFramework.reserve) is the primary defense,
                 # this translation is best-effort.
-                out, errs = update_fn(b)
+                out, errs = self.kern.update(b, ansi)
                 compiled.raise_errors(errs)
                 return out
 
@@ -1396,8 +1605,7 @@ class HashAggregateExec(TpuExec):
         nkeys = len(self.plan.group_exprs)
         if nkeys == 0 and batch.num_rows <= 1:
             return batch
-        fn = fuse.fused(self._sig("merge"), lambda: self.kern._merge_states)
-        out = fn(batch)
+        out = self.kern.merge(batch)
         if nkeys == 0:
             out = ColumnarBatch(out.columns, 1)
         return out

@@ -1,0 +1,96 @@
+"""Profile the sort/scatter/groupby primitives on the real TPU to decide
+where the round-3 perf work goes. Not part of the test suite."""
+import time
+import numpy as np
+import spark_rapids_tpu  # noqa: F401  (enables x64, same as the engine)
+import jax
+import jax.numpy as jnp
+
+
+def _force(out):
+    """block_until_ready is a no-op on the axon tunnel backend; fetching a
+    scalar slice forces the computation."""
+    leaves = jax.tree_util.tree_leaves(out)
+    jax.device_get([l[:1] if getattr(l, "ndim", 0) else l for l in leaves])
+
+
+def bench(name, fn, *args, reps=3):
+    _force(fn(*args))  # compile + warm
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _force(fn(*args))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    print(f"{name:50s} {best*1000:10.1f} ms", flush=True)
+    return best
+
+
+def main():
+    print(jax.devices())
+    rng = np.random.default_rng(0)
+    N = 20_000_000
+    keys64 = jnp.asarray(rng.integers(0, 3_000_000, N).astype(np.int64))
+    keys32 = keys64.astype(jnp.int32)
+    keysu64 = keys64.astype(jnp.uint64)
+    vals = jnp.asarray(rng.uniform(0, 1, N))
+    vals32 = vals.astype(jnp.float32)
+
+    bench("argsort i32 20M", jax.jit(jnp.argsort), keys32)
+    bench("argsort i64 20M", jax.jit(jnp.argsort), keys64)
+    bench("argsort u64 20M", jax.jit(jnp.argsort), keysu64)
+    bench("sort i32 20M (no iota)", jax.jit(jnp.sort), keys32)
+
+    # current lexsort path shape: 3 u64 keys + null planes + iota
+    from jax import lax
+    def lex3(k1, k2, k3):
+        cap = k1.shape[0]
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        z = jnp.zeros(cap, jnp.uint8)
+        out = lax.sort((z, z, k1, z, k2, z, k3, iota), num_keys=7, is_stable=True)
+        return out[-1]
+    N2 = 10_000_000
+    a = keysu64[:N2]
+    bench("lexsort 3xu64+nulls 10M (q67win shape)", jax.jit(lex3), a, a, a)
+
+    def lex1_32(k1):
+        iota = jnp.arange(k1.shape[0], dtype=jnp.int32)
+        out = lax.sort((k1, iota), num_keys=1, is_stable=True)
+        return out[-1]
+    bench("lax.sort 1xu32+iota 10M", jax.jit(lex1_32), keys32[:N2].astype(jnp.uint32))
+    bench("lax.sort 1xu32+iota 20M", jax.jit(lex1_32), keys32.astype(jnp.uint32))
+
+    # segment_sum scatter into large bucket spaces
+    def seg(v, k, S):
+        return jax.ops.segment_sum(v, k, num_segments=S)
+    segj = jax.jit(seg, static_argnums=(2,))
+    bench("segment_sum f64 20M -> 3M buckets", segj, vals, keys32, 3_000_000)
+    bench("segment_sum f32 20M -> 3M buckets", segj, vals32, keys32, 3_000_000)
+    k100 = jnp.asarray(rng.integers(0, 100_000, 2_000_000).astype(np.int32))
+    v100 = vals[:2_000_000]
+    bench("segment_sum f64 2M -> 100k buckets", segj, v100, k100, 100_000)
+    bench("segment_sum f64 8M -> 100k buckets", segj, vals[:8_000_000],
+          jnp.asarray(rng.integers(0, 100_000, 8_000_000).astype(np.int32)), 100_000)
+
+    # one-hot matmul variant for 100k buckets? too big. skip.
+    bench("top_k 3M k=16", jax.jit(lambda v: lax.top_k(v, 16)), vals[:3_000_000])
+
+    # gather costs
+    idx = jnp.asarray(rng.integers(0, N, N).astype(np.int32))
+    bench("gather f64 20M random", jax.jit(lambda v, i: v[i]), vals, idx)
+    bench("gather i32 20M random", jax.jit(lambda v, i: v[i]), keys32, idx)
+
+    # cumsum
+    bench("cumsum i32 20M", jax.jit(lambda v: jnp.cumsum(v)), keys32)
+
+    # searchsorted 20M probes into 1.5M sorted
+    srt = jnp.sort(keys64[:1_500_000])
+    bench("searchsorted 20M into 1.5M (i64)",
+          jax.jit(lambda s, q: jnp.searchsorted(s, q)), srt, keys64)
+    srt32 = srt.astype(jnp.int32)
+    bench("searchsorted 20M into 1.5M (i32)",
+          jax.jit(lambda s, q: jnp.searchsorted(s, q)), srt32, keys32)
+
+
+if __name__ == "__main__":
+    main()

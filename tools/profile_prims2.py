@@ -1,0 +1,118 @@
+"""Round 2 of primitive profiling: f64 segmented-sum strategies and
+searchsorted alternatives."""
+import time
+import numpy as np
+import spark_rapids_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _force(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    jax.device_get([l[:1] if getattr(l, "ndim", 0) else l for l in leaves])
+
+
+def bench(name, fn, *args, reps=3):
+    _force(fn(*args))
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _force(fn(*args))
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    print(f"{name:55s} {best*1000:10.1f} ms", flush=True)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    N, S = 20_000_000, 3_000_000
+    k = jnp.asarray(rng.integers(0, S, N).astype(np.int32))
+    ks = jnp.sort(k)
+    v = jnp.asarray(rng.uniform(0, 1, N))
+    vi64 = (v * 1e9).astype(jnp.int64)
+    vi32 = (v * 1e6).astype(jnp.int32)
+
+    seg = jax.jit(lambda vv, kk: jax.ops.segment_sum(vv, kk, num_segments=S))
+    segsrt = jax.jit(lambda vv, kk: jax.ops.segment_sum(
+        vv, kk, num_segments=S, indices_are_sorted=True))
+    bench("segsum f64 20M->3M unsorted", seg, v, k)
+    bench("segsum f64 20M->3M sorted-flag", segsrt, v, ks)
+    bench("segsum i64 20M->3M unsorted", seg, vi64, k)
+    bench("segsum i64 20M->3M sorted-flag", segsrt, vi64, ks)
+    bench("segsum i32 20M->3M unsorted", seg, vi32, k)
+    bench("cumsum f64 20M", jax.jit(jnp.cumsum), v)
+    bench("cumsum i64 20M", jax.jit(jnp.cumsum), vi64)
+
+    # segmented scan (sorted): associative scan with reset flags
+    def segscan(vv, kk):
+        flag = jnp.concatenate([jnp.ones(1, jnp.bool_), kk[1:] != kk[:-1]])
+        def op(a, b):
+            av, af = a
+            bv, bf = b
+            return jnp.where(bf, bv, av + bv), af | bf
+        s, _ = lax.associative_scan(op, (vv, flag))
+        return s
+    bench("assoc segscan f64 20M (sorted)", jax.jit(segscan), v, ks)
+
+    # 2x i64 limb fixed-point: decompose f64 to hi/lo int64 at a global scale
+    def limb_sum(vv, kk):
+        hi = jnp.floor(vv)
+        lo = (vv - hi) * (2.0 ** 32)
+        shi = jax.ops.segment_sum(hi.astype(jnp.int64), kk, num_segments=S)
+        slo = jax.ops.segment_sum(lo.astype(jnp.int64), kk, num_segments=S)
+        return shi.astype(jnp.float64) + slo.astype(jnp.float64) / 2.0 ** 32
+    bench("2x i64-limb segsum 20M->3M", jax.jit(limb_sum), v, k)
+
+    # scatter-add f32 pair (value + compensation-free): err estimate only
+    v32 = v.astype(jnp.float32)
+    bench("segsum f32 20M->3M", seg, v32, k)
+
+    # searchsorted alternatives for expand/gather paths
+    srt = jnp.sort(jnp.asarray(rng.integers(0, 10 * S, 1_500_000)).astype(jnp.int64))
+    q64 = jnp.asarray(rng.integers(0, 10 * S, N).astype(np.int64))
+    bench("searchsorted i64 20M->1.5M (baseline)",
+          jax.jit(lambda s, q: jnp.searchsorted(s, q)), srt, q64)
+    # batched/blocked variant via sorting the queries first?
+    def sorted_probe(s, q):
+        qi = jnp.argsort(q)
+        r = jnp.searchsorted(s, q[qi], side="left")
+        inv = jnp.zeros_like(qi).at[qi].set(jnp.arange(q.shape[0], dtype=qi.dtype))
+        return r[inv]
+    bench("searchsorted via sorted queries", jax.jit(sorted_probe), srt, q64)
+
+    # merge-based rank: rank of each query among sorted build = searchsorted
+    # computed by sorting the union (sort-merge). cost = sort of 21.5M + cumsum
+    def merge_rank(s, q):
+        ns, nq = s.shape[0], q.shape[0]
+        allv = jnp.concatenate([s, q])
+        isq = jnp.concatenate([jnp.zeros(ns, jnp.int32), jnp.ones(nq, jnp.int32)])
+        idx = jnp.concatenate([jnp.arange(ns, dtype=jnp.int32),
+                               jnp.arange(nq, dtype=jnp.int32)])
+        # stable sort by (value, isq): build rows sort before equal queries
+        o = lax.sort((allv, isq, idx), num_keys=2, is_stable=True)
+        sv, sq, si = o
+        nbuild_before = jnp.cumsum(1 - sq) * sq  # for query rows: #build <= v
+        out = jnp.zeros(nq, nbuild_before.dtype).at[jnp.where(sq == 1, si, nq)].set(
+            nbuild_before, mode="drop")
+        return out
+    bench("merge-rank (sort union) 20M+1.5M", jax.jit(merge_rank), srt, q64)
+
+    # gather i64/f64 from 3M-sized tables (dense join probe shape)
+    tbl = jnp.asarray(rng.integers(0, 100, S).astype(np.int64))
+    idx3 = jnp.asarray(rng.integers(0, S, N).astype(np.int32))
+    bench("gather i64 20M from 3M table", jax.jit(lambda t, i: t[i]), tbl, idx3)
+    bench("gather i32 20M from 3M table", jax.jit(lambda t, i: t[i]),
+          tbl.astype(jnp.int32), idx3)
+    bench("gather f64 20M from 3M table", jax.jit(lambda t, i: t[i]),
+          tbl.astype(jnp.float64), idx3)
+
+    # scatter set (compact_indices shape): 20M -> 20M
+    dest = jnp.asarray(rng.permutation(N).astype(np.int32))
+    bench("scatter-set i32 20M", jax.jit(
+        lambda d, s: jnp.zeros(N, jnp.int32).at[d].set(s)), dest, idx3)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Per-exec timing of the losing bench queries at reduced scale."""
+import os
+import sys
+import time
+import numpy as np
+
+ROWS = int(os.environ.get("ROWS", 8_000_000))
+ORDERS = ROWS // 10
+Q = os.environ.get("Q", "q3join")
+
+import pyarrow as pa
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.expr.window import Window
+
+rng = np.random.default_rng(42)
+t = pa.table({
+    "l_orderkey": rng.integers(0, ORDERS, ROWS).astype(np.int64),
+    "l_returnflag": np.array(["A", "N", "R"])[rng.integers(0, 3, ROWS)],
+    "l_linestatus": np.array(["F", "O"])[rng.integers(0, 2, ROWS)],
+    "l_quantity": rng.integers(1, 51, ROWS).astype(np.float64),
+    "l_extendedprice": np.round(rng.uniform(900.0, 105000.0, ROWS), 2),
+    "l_discount": np.round(rng.uniform(0.0, 0.10, ROWS), 2),
+    "l_shipdate": rng.integers(8400, 10600, ROWS).astype(np.int32),
+})
+orders = pa.table({
+    "o_orderkey": np.arange(ORDERS, dtype=np.int64),
+    "o_orderdate": rng.integers(8400, 10600, ORDERS).astype(np.int32),
+})
+
+sess = TpuSession()
+print("[prof] uploading...", file=sys.stderr, flush=True)
+cached = sess.create_dataframe(t).cache(); cached.count()
+ocached = sess.create_dataframe(orders).cache(); ocached.count()
+SHUFFLE_PARTS = 4
+sharded = sess.create_dataframe(t, num_partitions=SHUFFLE_PARTS).cache()
+sharded.count()
+
+
+def q3join():
+    li = cached.filter(col("l_shipdate") > lit(9100))
+    od = ocached.filter(col("o_orderdate") < lit(9500))
+    j = li.join(od, on=[(col("l_orderkey"), col("o_orderkey"))], how="inner")
+    g = (j.select(col("l_orderkey"),
+                  (col("l_extendedprice") * (lit(1.0) - col("l_discount"))).alias("rev"))
+         .group_by(col("l_orderkey")).agg(F.sum("rev").alias("rev")))
+    top = g.order_by(col("rev").desc(), col("l_orderkey").asc()).limit(10)
+    return top.to_pydict()
+
+
+def q67win():
+    w = Window.partition_by(col("l_returnflag"), col("l_linestatus")) \
+              .order_by(col("l_shipdate"))
+    out = (cached.select(col("l_returnflag"), col("l_linestatus"),
+                         F.rank().over(w).alias("rk"))
+           .group_by(col("l_returnflag"), col("l_linestatus"))
+           .agg(F.max("rk").alias("mx")))
+    return out.to_pydict()
+
+
+def q72shfl():
+    g = (sharded.select((col("l_orderkey") % lit(100_000)).alias("k"),
+                        col("l_quantity"))
+         .group_by(col("k"))
+         .agg(F.sum("l_quantity").alias("s"), F.count("l_quantity").alias("c")))
+    return g.to_pydict()
+
+
+fn = {"q3join": q3join, "q67win": q67win, "q72shfl": q72shfl}[Q]
+print(f"[prof] warmup {Q}...", file=sys.stderr, flush=True)
+t0 = time.perf_counter(); fn(); warm = time.perf_counter() - t0
+times = []
+for _ in range(2):
+    t0 = time.perf_counter(); fn(); times.append(time.perf_counter() - t0)
+print(f"[prof] {Q} rows={ROWS} warm={warm:.2f}s best={min(times):.3f}s")
+m = sess.last_metrics()
+for k, v in m.items():
+    interesting = {mk: mv for mk, mv in v.items()
+                   if ("Time" in mk or "time" in mk) and mv and mv > 0.005}
+    if interesting:
+        print(f"  {k}: " + ", ".join(f"{mk}={mv:.3f}" for mk, mv in
+                                     sorted(interesting.items(), key=lambda x: -x[1])))
