@@ -2188,6 +2188,21 @@ class _HashJoinBase(TpuExec):
         #: broadcast path); shuffled joins have per-partition builds and a
         #: shared lock would serialize them
         self._cache_build_split = False
+        self._dense_lock = threading.Lock()
+        self._dense_cache = None  # (build identity, DenseBuildTable|None)
+
+    def _dense_table_for(self, build, build_keys):
+        """Direct-address build table for the mask-through probe, prepared
+        once per build batch (one 4-scalar fetch)."""
+        with self._dense_lock:
+            if self._dense_cache is None or self._dense_cache[0] is not build:
+                table = None
+                if int(build.num_rows) > 0:
+                    table = J.prepare_dense_build(
+                        build_keys, build.num_rows,
+                        [e.data_type() for e in self.plan.left_keys])
+                self._dense_cache = (build, table)
+            return self._dense_cache[1]
 
     def _hash_keys(self, side: int):
         if self.part_keys is None:
@@ -2241,6 +2256,21 @@ class _HashJoinBase(TpuExec):
         how = self.plan.how
         matched_build = (jnp.zeros(build.capacity, jnp.bool_)
                          if track_build_matches else None)
+        if how in ("inner", "left", "left_semi", "left_anti"):
+            # mask-through fast path: unique dense build keys mean each
+            # probe row matches <= 1 build row, so the join emits the probe
+            # planes UNTOUCHED plus build columns gathered at probe
+            # positions — no pair expansion, no compaction, no per-batch
+            # host sync (reference contrast: GpuHashJoin always assembles
+            # gather maps; on this hardware the gathers + count syncs they
+            # imply cost more than the whole probe).
+            table = self._dense_table_for(build, build_keys)
+            if table is not None and table.max_dup <= 1:
+                for probe in probe_iter:
+                    self._acquire(ctx)
+                    with join_t.ns():
+                        yield self._probe_masked(probe, build, table)
+                return
         # sub-partitioning applies to inner/left/semi/anti; right/full track
         # a build-global matched mask that bucket-local indices would
         # corrupt, so they stay on the single-pass path
@@ -2270,6 +2300,46 @@ class _HashJoinBase(TpuExec):
                 dummy = empty_like_schema(self.children[0].schema, capacity=8)
                 pi = jnp.full(un_idx.shape, -1, jnp.int32)
                 yield self._emit(dummy, build, pi, un_idx, n_un)
+
+    def _probe_masked(self, probe, build, table) -> ColumnarBatch:
+        """Unique-build-key join without pair materialization: output is a
+        masked batch sharing the probe's planes. Handles inner/left/semi/
+        anti, including join conditions (evaluated as a mask over the
+        mask-through batch — valid because each probe row has at most one
+        candidate)."""
+        how = self.plan.how
+        probe_keys = compiled.run_stage(self.plan.left_keys, probe)
+        plive = probe.live_mask()
+        bidx = J.dense_lookup(table, probe_keys, probe.num_rows,
+                              probe_live=plive)
+        matched = bidx >= 0
+        blive = build.live_mask() if build.row_mask is not None else None
+        bcols = [K.gather_column(c, bidx, build.num_rows, src_live=blive)
+                 for c in build.columns]
+        if self.plan.condition is not None:
+            joined = ColumnarBatch(list(probe.columns) + bcols,
+                                   probe.num_rows, probe.row_mask)
+            [pred] = compiled.run_stage([self.plan.condition], joined)
+            cond_ok = pred.data.astype(jnp.bool_) \
+                & pred.validity_or_default(probe.capacity)
+            matched = matched & cond_ok
+        if how == "left_semi":
+            return K.mask_filter_batch(probe, matched)
+        if how == "left_anti":
+            return K.mask_filter_batch(probe, ~matched)
+        if how == "inner":
+            live = plive & matched
+            return ColumnarBatch(
+                list(probe.columns) + bcols,
+                LazyRowCount(jnp.sum(live.astype(jnp.int32))), live)
+        # left outer: every live probe row survives; build side nulls out
+        # where unmatched (or the condition failed)
+        bcols = [ColumnVector(c.dtype, c.data,
+                              (c.validity & matched) if c.validity is not None
+                              else matched, dict_unique=c.dict_unique)
+                 for c in bcols]
+        return ColumnarBatch(list(probe.columns) + bcols,
+                             probe.num_rows, probe.row_mask)
 
     def _probe_one(self, probe, build, build_keys, matched_build):
         how = self.plan.how

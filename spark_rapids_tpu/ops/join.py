@@ -58,14 +58,85 @@ DENSE_KEY_RANGE_LIMIT = 1 << 26
 
 
 def _dense_int_eligible(build_keys: List[ColumnVector],
-                        probe_keys: List[ColumnVector]) -> bool:
-    if len(build_keys) != 1:
+                        probe_key_types) -> bool:
+    if len(build_keys) != 1 or len(probe_key_types) != 1:
         return False
-    bt, pt = build_keys[0].dtype, probe_keys[0].dtype
+    bt, pt = build_keys[0].dtype, probe_key_types[0]
     from spark_rapids_tpu import types as T
     ok_types = (T.Int8Type, T.Int16Type, T.Int32Type, T.Int64Type,
                 T.DateType, T.BooleanType)
     return isinstance(bt, ok_types) and isinstance(pt, ok_types)
+
+
+class DenseBuildTable:
+    """Direct-address layout of a build side with a single bounded integer
+    key: starts[span+1] + sorted_orig[bcap] (counting sort by key), plus
+    host facts (bmin, span, max_dup) fetched ONCE at prepare time. When
+    max_dup == 1 (unique build keys — the star-schema shape), probing is
+    completely sync-free: two gathers yield the matching build row per
+    probe row, enabling mask-through join output with no pair expansion."""
+
+    __slots__ = ("starts", "sorted_orig", "bmin", "span", "max_dup",
+                 "bcap", "build_rows")
+
+    def __init__(self, starts, sorted_orig, bmin, span, max_dup, bcap,
+                 build_rows):
+        self.starts = starts
+        self.sorted_orig = sorted_orig
+        self.bmin = bmin
+        self.span = span
+        self.max_dup = max_dup
+        self.bcap = bcap
+        self.build_rows = build_rows
+
+
+def prepare_dense_build(build_keys: List[ColumnVector], build_rows: int,
+                        probe_key_types) -> Optional[DenseBuildTable]:
+    """Build the direct-address table when the dense-int path applies.
+    probe_key_types: the probe keys' DataTypes (columns not needed).
+    ONE host fetch (4 scalars). Returns None when ineligible."""
+    if not _dense_int_eligible(build_keys, probe_key_types):
+        return None
+    bcap = build_keys[0].capacity
+    bv = build_keys[0].data.astype(jnp.int64)
+    valid = build_keys[0].validity_or_default(build_rows)
+    b_in = (jnp.arange(bcap) < build_rows) & valid
+    bmin_d = jnp.min(jnp.where(b_in, bv, jnp.int64(2**62)))
+    bmax_d = jnp.max(jnp.where(b_in, bv, jnp.int64(-2**62)))
+    nbuild_d = jnp.sum(b_in.astype(jnp.int32))
+    bmin, bmax, nbuild = (int(x) for x in
+                          jax.device_get([bmin_d, bmax_d, nbuild_d]))
+    span = bmax - bmin + 1
+    if nbuild <= 0 or not (0 < span <= DENSE_KEY_RANGE_LIMIT):
+        return None
+    starts, sorted_orig = _dense_table(bv, b_in, bcap, jnp.int64(bmin), span)
+    cnt = starts[1:] - starts[:-1]
+    max_dup = int(jnp.max(cnt)) if span > 0 else 0
+    return DenseBuildTable(starts, sorted_orig, jnp.int64(bmin), span,
+                           max_dup, bcap, build_rows)
+
+
+def dense_lookup(table: DenseBuildTable, probe_keys: List[ColumnVector],
+                 probe_rows: int, probe_live=None) -> jax.Array:
+    """Sync-free unique-key probe: int32[pcap] build row index per probe
+    row, -1 when unmatched. Requires table.max_dup <= 1."""
+    pcap = probe_keys[0].capacity
+    pv = probe_keys[0].data.astype(jnp.int64)
+    # masked batches have live rows at ARBITRARY positions: combine the
+    # column validity with the live mask directly, never arange<num_rows
+    if probe_live is not None:
+        p_in = probe_live if probe_keys[0].validity is None \
+            else (probe_live & probe_keys[0].validity)
+    else:
+        p_in = probe_keys[0].validity_or_default(probe_rows)
+    slot = pv - table.bmin
+    inside = p_in & (slot >= 0) & (slot < table.span)
+    sl = jnp.where(inside, slot, 0).astype(jnp.int32)
+    lo = table.starts[sl]
+    hi = table.starts[sl + 1]
+    bidx = jnp.where(inside & (hi > lo),
+                     table.sorted_orig[jnp.clip(lo, 0, table.bcap - 1)], -1)
+    return bidx
 
 
 def join_pairs(build_keys: List[ColumnVector], build_rows: int,
@@ -82,9 +153,24 @@ def join_pairs(build_keys: List[ColumnVector], build_rows: int,
       hardware a 32M-row binary search costs ~6s (22 round-trip gathers,
       64-bit lanes emulated); the dense path is ~50x cheaper and covers
       the TPC-H/star-schema join shape.
-    - general path: sort build by 64-bit key hash, vectorized binary
-      search per probe row, expand candidate ranges, verify exact
-      equality over the normalized planes."""
+    - general path: sort build by 64-bit key hash, find each probe row's
+      equal-hash candidate run by a SORT-MERGE rank over the hash union
+      (measured: ``searchsorted`` on 64-bit lanes costs 8.7 s for 20M
+      probes on v5e — 25x the cost of sorting the union), then expand +
+      verify exact equality over the normalized planes."""
+    table = prepare_dense_build(build_keys, build_rows,
+                                [c.dtype for c in probe_keys])
+    if table is not None:
+        pcap0 = probe_keys[0].capacity
+        if probe_live is not None:
+            p_in0 = probe_live if probe_keys[0].validity is None \
+                else (probe_live & probe_keys[0].validity)
+        else:
+            p_in0 = probe_keys[0].validity_or_default(probe_rows)
+        return _dense_int_pairs(table,
+                                probe_keys[0].data.astype(jnp.int64),
+                                p_in0, pcap0)
+
     bh, bplanes, bnull = _combine_keys(build_keys, build_rows)
     ph, pplanes, pnull = _combine_keys(probe_keys, probe_rows,
                                        live=probe_live)
@@ -95,19 +181,6 @@ def join_pairs(build_keys: List[ColumnVector], build_rows: int,
     p_in = ((probe_live if probe_live is not None
              else (jnp.arange(pcap) < probe_rows)) & ~pnull)
 
-    if _dense_int_eligible(build_keys, probe_keys):
-        bv = build_keys[0].data.astype(jnp.int64)
-        bmin_d = jnp.min(jnp.where(b_in, bv, jnp.int64(2**62)))
-        bmax_d = jnp.max(jnp.where(b_in, bv, jnp.int64(-2**62)))
-        nbuild_d = jnp.sum(b_in.astype(jnp.int32))
-        bmin, bmax, nbuild = (int(x) for x in
-                              jax.device_get([bmin_d, bmax_d, nbuild_d]))
-        span = bmax - bmin + 1
-        if nbuild > 0 and 0 < span <= DENSE_KEY_RANGE_LIMIT:
-            return _dense_int_pairs(bv, b_in, bcap,
-                                    probe_keys[0].data.astype(jnp.int64),
-                                    p_in, pcap, jnp.int64(bmin), span)
-
     # compact non-null build rows, then sort by hash
     bidx, bcount = K.filter_indices(b_in, bcap)
     bsel = jnp.clip(bidx, 0, bcap - 1)
@@ -116,12 +189,7 @@ def join_pairs(build_keys: List[ColumnVector], build_rows: int,
     sorted_h = bh_c[order]
     sorted_orig = jnp.where(bidx >= 0, bidx, -1)[order]
 
-    lo = jnp.searchsorted(sorted_h, ph, side="left").astype(jnp.int32)
-    hi = jnp.searchsorted(sorted_h, ph, side="right").astype(jnp.int32)
-    lo = jnp.where(p_in, lo, 0)
-    hi = jnp.where(p_in, hi, 0)
-    hi = jnp.minimum(hi, bcount)
-    lo = jnp.minimum(lo, hi)
+    lo, hi = _merge_rank_ranges(sorted_h, bcount, ph, p_in)
     total = int(jnp.sum((hi - lo).astype(jnp.int64)))
 
     probe_i, build_pos = K.expand_ranges(lo, hi, total)
@@ -158,17 +226,15 @@ def _dense_table(bv, b_in, bcap, bmin, span):
     return starts, sorted_orig
 
 
-def _dense_int_pairs(bv, b_in, bcap, pv, p_in, pcap, bmin, span: int):
-    starts, sorted_orig = _dense_table(bv, b_in, bcap, bmin, span)
-    slot = (pv - bmin).astype(jnp.int64)
-    inside = p_in & (slot >= 0) & (slot < span)
+def _dense_int_pairs(table: DenseBuildTable, pv, p_in, pcap):
+    starts, sorted_orig, bcap = table.starts, table.sorted_orig, table.bcap
+    slot = pv - table.bmin
+    inside = p_in & (slot >= 0) & (slot < table.span)
     sl = jnp.where(inside, slot, 0).astype(jnp.int32)
     lo = jnp.where(inside, starts[sl], 0)
     hi = jnp.where(inside, starts[sl + 1], 0)
     counts = hi - lo
-    total, max_dup = (int(x) for x in jax.device_get(
-        [jnp.sum(counts.astype(jnp.int64)), jnp.max(counts)]))
-    if max_dup <= 1:
+    if table.max_dup <= 1:
         # unique build keys (the dominant case): pairs ARE the matching
         # probe rows — no range expansion at all
         m = counts > 0
@@ -179,10 +245,46 @@ def _dense_int_pairs(bv, b_in, bcap, pv, p_in, pcap, bmin, span: int):
         out_b = jnp.where(idx >= 0,
                           sorted_orig[jnp.clip(bpos, 0, bcap - 1)], -1)
         return out_p, out_b, match_count
+    total = int(jnp.sum(counts.astype(jnp.int64)))
     probe_i, build_pos = K.expand_ranges(lo, hi, total)
     build_i = jnp.where(build_pos >= 0,
                         sorted_orig[jnp.clip(build_pos, 0, bcap - 1)], -1)
     return probe_i, build_i, total
+
+
+def _merge_rank_ranges(sorted_h: jax.Array, bcount, ph: jax.Array,
+                       p_in: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per probe row, the candidate run [lo, hi) of equal hashes in the
+    sorted build plane — via ONE stable sort of the hash union (build rows
+    tie-break before probe rows) instead of two 64-bit binary searches.
+    sorted_h must carry the all-ones sentinel beyond bcount."""
+    bcap = sorted_h.shape[0]
+    pcap = ph.shape[0]
+    # dead probe rows get the sentinel too: their run resolves empty below
+    php = jnp.where(p_in, ph, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    allh = jnp.concatenate([sorted_h, php])
+    isq = jnp.concatenate([jnp.zeros(bcap, jnp.uint8),
+                           jnp.ones(pcap, jnp.uint8)])
+    iota = jnp.arange(bcap + pcap, dtype=jnp.int32)
+    sh, sq, si = jax.lax.sort((allh, isq, iota), num_keys=2, is_stable=True)
+    # build rows at union positions <= i (build sorts before equal probes)
+    nb_prefix = jnp.cumsum((sq == 0).astype(jnp.int32))
+    # scatter each probe row's prefix count back to its original position
+    dest = jnp.where(sq == 1, si - bcap, pcap)
+    r = jnp.zeros(pcap + 1, jnp.int32).at[dest].set(nb_prefix,
+                                                    mode="drop")[:pcap]
+    r = jnp.minimum(r, bcount)  # sentinel pad rows are not candidates
+    last_b = r - 1  # compact index of the last build row with h <= h_p
+    lb = jnp.clip(last_b, 0, bcap - 1)
+    eq = (last_b >= 0) & (last_b < bcount) & (sorted_h[lb] == ph) & p_in
+    # first row of each equal-hash run in the sorted build plane
+    pos = jnp.arange(bcap, dtype=jnp.int32)
+    bound = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                             sorted_h[1:] != sorted_h[:-1]])
+    run_start = jax.lax.cummax(jnp.where(bound, pos, 0))
+    lo = jnp.where(eq, run_start[lb], 0)
+    hi = jnp.where(eq, r, 0)
+    return lo, hi
 
 
 def probe_matched_mask(pairs_idx: jax.Array, cap: int) -> jax.Array:
