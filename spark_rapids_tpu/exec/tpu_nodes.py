@@ -914,8 +914,12 @@ class _AggKernels:
         return fn
 
     def _packed_agg(self, batch, live, key_cols, state_specs, spec, ranges):
-        """Shared packed-radix reduction core for update and merge: pack,
-        one stable sort, cumsum/i32-scatter reductions (ops/radix.py)."""
+        """Shared packed-radix reduction core for update and merge. Small
+        packed key spaces (<= 2^23 buckets) take the SORT-FREE scatter
+        path; wider ones pack + sort + cumsum reductions (ops/radix.py)."""
+        if spec.total_bits <= R.BUCKET_BITS:
+            return self._bucket_scatter_agg(live, key_cols, state_specs,
+                                            spec, ranges)
         packed = R.pack_keys(spec, key_cols, ranges, live)
         lay = R.group_layout(packed, live)
         sg = jnp.clip(lay.starts, 0, lay.cap - 1)
@@ -932,6 +936,71 @@ class _AggKernels:
                                          if ov.dtype != np.dtype(sdt.np_dtype)
                                          else ov, oval))
         return ColumnarBatch(out_cols, LazyRowCount(lay.n_groups))
+
+    def _bucket_scatter_agg(self, live, key_cols, state_specs, spec, ranges):
+        lay = R.bucket_layout(spec, key_cols, ranges, live)
+        out_cols: List[ColumnVector] = []
+        for c in R.bucket_unpack_keys(spec, ranges, key_cols):
+            v = c.validity & lay.occupied if c.validity is not None \
+                else lay.occupied
+            out_cols.append(ColumnVector(c.dtype, c.data, v,
+                                         dict_unique=c.dict_unique))
+        nb = lay.bucket  # noqa: F841
+        ones = jnp.ones(1 << spec.total_bits, jnp.bool_)
+        for op, src, sdt in state_specs:
+            if src is not None:
+                if src.is_string or src.is_nested:
+                    raise NotImplementedError(
+                        "string/nested agg state on device")
+                valid = live if src.validity is None \
+                    else (src.validity & live)
+                vals = src.data
+            else:
+                valid = live
+                vals = jnp.zeros(live.shape[0], sdt.np_dtype)
+            ov, oval = self._bucket_op(op, vals, valid, sdt, lay, ones)
+            out_cols.append(ColumnVector(
+                sdt, ov.astype(sdt.np_dtype)
+                if ov.dtype != np.dtype(sdt.np_dtype) else ov, oval))
+        return ColumnarBatch(out_cols, LazyRowCount(lay.n_groups),
+                             lay.occupied)
+
+    def _bucket_op(self, op, vals, valid, sdt, lay, ones):
+        if op == "count":
+            return R.bucket_count(lay, valid), ones
+        if op == "count_all":
+            return lay.counts.astype(jnp.int64), ones
+        nvalid = R.bucket_count(lay, valid)
+        some = nvalid > 0
+        if op in ("sum", "sumsq"):
+            v = vals * vals if op == "sumsq" else vals
+            if np.dtype(sdt.np_dtype) in (np.dtype(np.float64),
+                                          np.dtype(np.float32)):
+                tot, _ = R.bucket_sum_f64(lay, v, valid)
+                return tot, some
+            return R.bucket_sum_int(lay, v, valid), some
+        if op in ("min", "max"):
+            d = np.dtype(vals.dtype)
+            if d == np.dtype(np.float64):
+                return R.bucket_minmax_f64(op, lay, vals, valid), some
+            if d == np.dtype(np.float32):
+                return R.bucket_minmax_f32(op, lay, vals, valid), some
+            if d == np.dtype(np.int64):
+                return R.bucket_minmax_i64(op, lay, vals, valid), some
+            init = (G._MIN_INIT if op == "min" else G._MAX_INIT)[
+                np.dtype(np.int32) if d == np.dtype(np.bool_) else d]
+            out = R.bucket_minmax_i32(op, lay, vals, valid, int(init))
+            return out.astype(vals.dtype), some
+        if op in ("first", "last"):
+            v, has = R.bucket_first_last(op, lay, vals, valid)
+            return v, has & some
+        if op == "any":
+            return R.bucket_count(lay, valid & vals.astype(jnp.bool_)) > 0, \
+                some
+        if op == "all":
+            return R.bucket_count(lay, valid & ~vals.astype(jnp.bool_)) == 0, \
+                some
+        raise ValueError(f"unknown bucket op {op}")
 
     def _packed_op(self, op, src, sdt, live, lay):
         cap = lay.cap

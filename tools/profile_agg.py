@@ -1,0 +1,48 @@
+"""Isolated groupby micro-bench on the engine: which path runs, and how
+long each stage takes."""
+import os
+import sys
+import time
+import numpy as np
+
+ROWS = int(os.environ.get("ROWS", 8_000_000))
+GROUPS = int(os.environ.get("GROUPS", 800_000))
+
+import pyarrow as pa
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.exec import fuse
+
+rng = np.random.default_rng(0)
+t = pa.table({
+    "k": rng.integers(0, GROUPS, ROWS).astype(np.int64),
+    "v": rng.uniform(0, 100, ROWS),
+})
+sess = TpuSession()
+print("[prof] uploading...", file=sys.stderr, flush=True)
+df = sess.create_dataframe(t).cache()
+df.count()
+
+
+def q():
+    g = df.group_by(col("k")).agg(F.sum("v").alias("s"), F.count("v").alias("c"))
+    # device-side final reduction: don't measure the 100k-row download
+    out = g.agg(F.count(col("k")).alias("n"), F.sum(col("s")).alias("ts"))
+    return out.to_pydict()
+
+
+t0 = time.perf_counter(); r = q(); warm = time.perf_counter() - t0
+times = []
+for _ in range(3):
+    t0 = time.perf_counter(); q(); times.append(time.perf_counter() - t0)
+print(f"[prof] groupby rows={ROWS} groups={GROUPS} warm={warm:.2f}s "
+      f"best={min(times):.3f}s result={r}")
+m = sess.last_metrics()
+for k, v in m.items():
+    it = {mk: mv / 1e9 for mk, mv in v.items()
+          if ("Time" in mk) and mv and mv > 5e6}
+    if it:
+        print(f"  {k}: " + ", ".join(f"{mk}={mv:.3f}s" for mk, mv in
+                                     sorted(it.items(), key=lambda x: -x[1])))
+print("fused:", sorted({k[0] for k in fuse._FUSE_CACHE}))

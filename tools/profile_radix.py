@@ -1,0 +1,88 @@
+"""Stage-by-stage timing of the radix groupby pipeline on raw arrays."""
+import time
+import numpy as np
+import spark_rapids_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+
+def _force(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    jax.device_get([l[:1] if getattr(l, "ndim", 0) else l for l in leaves])
+
+
+def bench(name, fn, *args, reps=3):
+    _force(fn(*args))
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _force(fn(*args))
+        best = min(best or 9e9, time.perf_counter() - t0)
+    print(f"{name:50s} {best*1000:10.1f} ms", flush=True)
+
+
+def main():
+    from spark_rapids_tpu.ops import radix as R
+    rng = np.random.default_rng(0)
+    N = 8_000_000
+    k = jnp.asarray(rng.integers(0, 800_000, N).astype(np.int64))
+    v = jnp.asarray(rng.uniform(0, 100, N))
+    live = jnp.ones(N, jnp.bool_)
+
+    packed = jnp.where(live, k + 1, R._SENTINEL)
+
+    bench("argsort i64 stable 8M", jax.jit(lambda p: jnp.argsort(p, stable=True)), packed)
+    bench("argsort i64 default 8M", jax.jit(jnp.argsort), packed)
+
+    def lay_tuple(p, lv):
+        lay = R.group_layout(p, lv)
+        return (lay.perm, lay.sorted_packed, lay.boundary, lay.gid,
+                lay.starts, lay.ends, lay.n_groups)
+    bench("group_layout 8M", jax.jit(lay_tuple), packed, live)
+
+    def full(p, lv, vv):
+        lay = R.group_layout(p, lv)
+        vs = vv[lay.perm]
+        valid = lv[lay.perm]
+        s = R.seg_sum_f64(vs, valid, lay)
+        c = R.seg_count(valid, lay)
+        return s, c, lay.n_groups
+    bench("layout+gather+sum+count 8M", jax.jit(full), packed, live, v)
+
+    def just_scatter(p, lv):
+        n_live = jnp.sum(lv.astype(jnp.int32))
+        perm = jnp.argsort(p, stable=True).astype(jnp.int32)
+        sp = p[perm]
+        pos = jnp.arange(N, dtype=jnp.int32)
+        boundary = jnp.concatenate([jnp.ones(1, jnp.bool_), sp[1:] != sp[:-1]])
+        boundary = boundary & (pos < n_live)
+        gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+        bpos = jnp.where(boundary, gid, N)
+        starts = jnp.full(N + 1, -1, jnp.int32).at[bpos].set(pos, mode="drop")[:N]
+        return starts
+    bench("sort+boundary+starts-scatter 8M", jax.jit(just_scatter), packed, live)
+
+    def sort_gather(p, vv):
+        perm = jnp.argsort(p, stable=True).astype(jnp.int32)
+        return p[perm], vv[perm]
+    bench("sort + 2 gathers 8M", jax.jit(sort_gather), packed, v)
+
+    def limb(vv):
+        m = jnp.max(jnp.abs(vv))
+        scale = R._exponent_scale(m)
+        scaled = vv * scale
+        hi = jnp.floor(scaled)
+        lo = jnp.round((scaled - hi) * np.float64(2.0) ** 36)
+        return jnp.cumsum(hi.astype(jnp.int64)), jnp.cumsum(lo.astype(jnp.int64))
+    bench("limb decompose + 2 i64 cumsums 8M", jax.jit(limb), v)
+
+    def specials(vv):
+        nan = jnp.isnan(vv)
+        pinf = vv == jnp.inf
+        spec = (nan.astype(jnp.int64) << jnp.int64(31)) | pinf.astype(jnp.int64)
+        return jnp.cumsum(spec)
+    bench("specials cumsum i64 8M", jax.jit(specials), v)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Candidate optimizations: sort-free i32 limb-scatter aggregation,
+2-level cumsum, elementwise baselines."""
+import time
+import numpy as np
+import spark_rapids_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+
+def _force(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    jax.device_get([l[:1] if getattr(l, "ndim", 0) else l for l in leaves])
+
+
+def bench(name, fn, *args, reps=3):
+    _force(fn(*args))
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _force(fn(*args))
+        best = min(best or 9e9, time.perf_counter() - t0)
+    print(f"{name:52s} {best*1000:10.1f} ms", flush=True)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    N = 8_000_000
+    S = 3_000_000
+    k = jnp.asarray(rng.integers(0, S, N).astype(np.int32))
+    v = jnp.asarray(rng.uniform(0, 100, N))
+
+    bench("elementwise f64 +1 8M", jax.jit(lambda x: x + 1.0), v)
+    bench("elementwise i32 +1 8M", jax.jit(lambda x: x + 1), k)
+
+    def digits(x, scale):
+        # 3 balanced base-2^16 digits of round(x * scale)
+        s = x * scale
+        d0 = jnp.round(s / np.float64(2.0**32))
+        r0 = s - d0 * np.float64(2.0**32)
+        d1 = jnp.round(r0 / np.float64(2.0**16))
+        d2 = jnp.round(r0 - d1 * np.float64(2.0**16))
+        return (d0.astype(jnp.int32), d1.astype(jnp.int32), d2.astype(jnp.int32))
+
+    def scatter_sum(kk, vv, S):
+        m = jnp.max(jnp.abs(vv))
+        from spark_rapids_tpu.ops.radix import _exponent_scale
+        scale = _exponent_scale(m) * np.float64(2.0**12)  # 48 bits below E
+        d0, d1, d2 = digits(vv, scale)
+        s0 = jax.ops.segment_sum(d0, kk, num_segments=S)
+        s1 = jax.ops.segment_sum(d1, kk, num_segments=S)
+        s2 = jax.ops.segment_sum(d2, kk, num_segments=S)
+        cnt = jax.ops.segment_sum(jnp.ones(kk.shape[0], jnp.int32), kk,
+                                  num_segments=S)
+        tot = (s0.astype(jnp.float64) * np.float64(2.0**32)
+               + s1.astype(jnp.float64) * np.float64(2.0**16)
+               + s2.astype(jnp.float64)) / scale
+        return tot, cnt
+    f = jax.jit(scatter_sum, static_argnums=(2,))
+    bench("3-limb i32 scatter sum+cnt 8M->3M", f, k, v, S)
+    k8 = jnp.asarray(rng.integers(0, 800_000, N).astype(np.int32))
+    bench("3-limb i32 scatter sum+cnt 8M->800k", f, k8, v, 800_000)
+    k1 = jnp.asarray(rng.integers(0, 100_000, 2_000_000).astype(np.int32))
+    bench("3-limb i32 scatter sum+cnt 2M->100k", f, k1, v[:2_000_000], 100_000)
+
+    # verify accuracy vs numpy
+    tot, cnt = f(k1, v[:2_000_000], 100_000)
+    ref = np.zeros(100_000)
+    np.add.at(ref, np.asarray(k1), np.asarray(v[:2_000_000]))
+    err = np.max(np.abs(np.asarray(tot) - ref) / np.maximum(1.0, np.abs(ref)))
+    print(f"3-limb max rel err vs numpy: {err:.2e}")
+
+    # minmax double scatter 8M->800k on i64
+    def mm(kk, vv):
+        v64 = (vv * 1e6).astype(jnp.int64)
+        hi = (v64 >> jnp.int64(32)).astype(jnp.int32)
+        lo = ((v64 & jnp.int64(0xFFFFFFFF)) - jnp.int64(2**31)).astype(jnp.int32)
+        whi = jax.ops.segment_max(hi, kk, num_segments=800_000)
+        cand = hi == whi[kk]
+        lom = jnp.where(cand, lo, jnp.int32(-2**31))
+        wlo = jax.ops.segment_max(lom, kk, num_segments=800_000)
+        return whi, wlo
+    bench("i64 minmax 2xi32 scatter 8M->800k", jax.jit(mm), k8, v)
+
+    # 2-level cumsum vs native
+    bench("native cumsum i64 8M", jax.jit(lambda x: jnp.cumsum(x)),
+          (v * 1e6).astype(jnp.int64))
+
+    def cumsum2(x):
+        B = 4096
+        n = x.shape[0]
+        C = n // B
+        r = x[: B * C].reshape(B, C)
+        rc = jnp.cumsum(r, axis=1)
+        blocks = jnp.concatenate([jnp.zeros(1, x.dtype),
+                                  jnp.cumsum(rc[:, -1])[:-1]])
+        out = (rc + blocks[:, None]).reshape(-1)
+        tail = x[B * C:]
+        tail_c = jnp.cumsum(tail) + out[-1]
+        return jnp.concatenate([out, tail_c])
+    x64 = (v * 1e6).astype(jnp.int64)
+    f2 = jax.jit(cumsum2)
+    bench("2-level cumsum i64 8M", f2, x64)
+    ok = bool(jnp.all(f2(x64)[: 100000] == jnp.cumsum(x64)[:100000]))
+    print("2-level cumsum correct:", ok)
+
+    # gather widths at 8M
+    idx = jnp.asarray(rng.integers(0, N, N).astype(np.int32))
+    bench("gather i32 8M", jax.jit(lambda a, i: a[i]), k, idx)
+    bench("gather i64 8M", jax.jit(lambda a, i: a[i]), x64, idx)
+    # stacked gather: 2 planes in one [2, N] take along axis 1
+    two = jnp.stack([x64, x64 + 1])
+    bench("gather [2,8M] i64 stacked", jax.jit(lambda a, i: a[:, i]), two, idx)
+
+
+if __name__ == "__main__":
+    main()
