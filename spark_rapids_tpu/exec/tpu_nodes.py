@@ -662,6 +662,87 @@ def _order_keys(kc: ColumnVector, o, num_rows, live=None, n_chunks=None):
     return [(k, nulls, o.ascending, o.resolved_nulls_first())]
 
 
+def _sort_perm_for(orders, batch):
+    key_cols = compiled.run_stage([o.expr for o in orders], batch)
+    keys = []
+    for o, kc in zip(orders, key_cols):
+        keys.extend(_order_keys(kc, o, batch.num_rows,
+                                live=batch.live_mask()))
+    return K.lexsort_indices(keys, traced_rows(batch.num_rows),
+                             live=batch.live_mask())
+
+
+def _topn_image(kc: ColumnVector, order, live) -> Optional[jax.Array]:
+    """Monotone int32 'goodness' image of a sort key: rows that belong
+    EARLIER in the output get LARGER values (so lax.top_k selects them).
+    Ties may collapse (f32-rounded 64-bit keys) — the image only gates a
+    candidate threshold; exact order comes from the final small sort.
+    Returns None for types without a cheap image (strings, nested)."""
+    d = kc.dtype
+    min32 = jnp.int32(np.int32(-2**31))
+    if kc.is_string or kc.is_nested:
+        return None
+    if isinstance(d, (T.Float32Type, T.Float64Type)):
+        x = kc.data.astype(jnp.float32)
+        x = jnp.where(jnp.isnan(x), jnp.float32(np.nan), x)
+        x = jnp.where(x == 0.0, jnp.zeros_like(x), x)
+        bits = jax.lax.bitcast_convert_type(x, jnp.int32)
+        img = jnp.where(bits < 0, ~bits ^ min32, bits)
+    elif isinstance(d, (T.Int64Type, T.TimestampType, T.DecimalType)):
+        x = kc.data.astype(jnp.float32)
+        bits = jax.lax.bitcast_convert_type(x, jnp.int32)
+        img = jnp.where(bits < 0, ~bits ^ min32, bits)
+    else:
+        img = kc.data.astype(jnp.int32)
+    if order.ascending:
+        img = ~img  # monotone reversal, no INT_MIN overflow
+    valid = kc.validity
+    if valid is not None:
+        null_img = (jnp.int32(np.int32(2**31 - 1))
+                    if order.resolved_nulls_first() else min32)
+        img = jnp.where(valid, img, null_img)
+    return jnp.where(live, img, min32)
+
+
+class TopNExec(TpuExec):
+    """ORDER BY + LIMIT n without sorting the full input (reference
+    GpuTopN): lax.top_k over a monotone 32-bit image of the primary key
+    gives a threshold; only the <= ~n surviving candidate rows get the
+    exact multi-key sort. Ties and image collapse just widen the
+    candidate set; a pathological width falls back to the full sort."""
+
+    def __init__(self, plan, children, conf, orders, n: int):
+        super().__init__(plan, children, conf)
+        self.orders = orders
+        self.n = n
+
+    def execute_partition(self, ctx, pidx):
+        sort_t = self.metrics.metric(M.SORT_TIME)
+        batches = list(self.children[0].execute_partition(ctx, pidx))
+        if not batches:
+            return
+        self._acquire(ctx)
+        batch = K.concat_batches(batches) if len(batches) > 1 else batches[0]
+        with sort_t.ns():
+            total = int(batch.num_rows)
+            n = self.n
+            if total > n:
+                [kc] = compiled.run_stage([self.orders[0].expr], batch)
+                img = _topn_image(kc, self.orders[0], batch.live_mask())
+                if img is not None:
+                    thr = jax.lax.top_k(img, min(n, total))[0][-1]
+                    cand = batch.live_mask() & (img >= thr)
+                    idx, cnt = K.filter_indices(cand, batch.capacity)
+                    if cnt <= max(4 * n, 4096):
+                        batch = K.gather_batch(batch, idx, cnt)
+                        total = cnt
+            if batch.row_mask is not None:
+                batch = K.compact_batch(batch)
+            perm = _sort_perm_for(self.orders, batch)
+            out = K.gather_batch(batch, perm, batch.num_rows)
+            yield K.slice_batch(out, 0, min(n, total))
+
+
 class SortExec(TpuExec):
     """Whole-partition sort: evaluate sort-key expressions as a fused stage,
     normalize, single lexsort, gather (reference GpuSortExec in-core path;
@@ -686,14 +767,7 @@ class SortExec(TpuExec):
             yield K.gather_batch(batch, perm, batch.num_rows)
 
     def _sort_perm(self, batch):
-        key_exprs = [o.expr for o in self.plan.orders]
-        key_cols = compiled.run_stage(key_exprs, batch)
-        keys = []
-        for o, kc in zip(self.plan.orders, key_cols):
-            keys.extend(_order_keys(kc, o, batch.num_rows,
-                                    live=batch.live_mask()))
-        return K.lexsort_indices(keys, traced_rows(batch.num_rows),
-                                 live=batch.live_mask())
+        return _sort_perm_for(self.plan.orders, batch)
 
     def _out_of_core(self, batches):
         """Out-of-core sort (reference GpuSortExec.scala:281 merge path,
@@ -750,6 +824,26 @@ class SortExec(TpuExec):
         for off in range(0, n, step):
             yield from_arrow(sorted_table.slice(off, min(step, n - off)))
 
+
+
+def _probe_pack_spec(key_cols, live):
+    """Host decision: can these key columns pack into one int64 plane?
+    Returns (spec, ranges_device) or (None, None). Costs one small device
+    fetch when integer key ranges are involved (shared by the aggregate,
+    window, and sort radix paths)."""
+    kinds = R.static_kinds(key_cols)
+    if kinds is None:
+        return None, None
+    if R.needs_range_probe(kinds):
+        probe = fuse.fused(("radix_probe", tuple(kinds)),
+                           lambda: R.probe_ranges)
+        ranges = probe(key_cols, live)
+        ranges_host = np.asarray(jax.device_get(ranges))
+    else:
+        ranges = jnp.zeros(2 * len(key_cols), jnp.int64)
+        ranges_host = np.zeros(2 * len(key_cols), np.int64)
+    spec = R.plan_packing(key_cols, ranges_host)
+    return spec, ranges
 
 
 class _AggKernels:
@@ -811,22 +905,7 @@ class _AggKernels:
     # -- radix fast-path dispatch (see ops/radix.py) ------------------------
 
     def _probe_spec(self, key_cols, live):
-        """Host decision: can this batch's keys pack into one int64 plane?
-        Returns (spec, ranges_device) or (None, None). Costs one small
-        device fetch when integer key ranges are involved."""
-        kinds = R.static_kinds(key_cols)
-        if kinds is None:
-            return None, None
-        if R.needs_range_probe(kinds):
-            probe = fuse.fused(("radix_probe", tuple(kinds)),
-                               lambda: R.probe_ranges)
-            ranges = probe(key_cols, live)
-            ranges_host = np.asarray(jax.device_get(ranges))
-        else:
-            ranges = jnp.zeros(2 * len(key_cols), jnp.int64)
-            ranges_host = np.zeros(2 * len(key_cols), np.int64)
-        spec = R.plan_packing(key_cols, ranges_host)
-        return spec, ranges
+        return _probe_pack_spec(key_cols, live)
 
     def update(self, batch: ColumnarBatch, ansi: bool):
         """The update phase entry: picks (in order) the tiny-bucket MXU
@@ -1347,6 +1426,71 @@ class WindowExec(TpuExec):
             batch = K.compact_batch(batch)
         exprs = self.plan.window_exprs
         spec = exprs[0].spec  # one spec per node (planner groups)
+
+        # packed-radix sort path: all (partition, order) keys compressed
+        # into ONE int64 plane -> single-key stable argsort + boundary
+        # diffs on the packed plane. The general multi-operand u64
+        # lax.sort below takes MINUTES to compile on TPU and pays one
+        # gather per key plane; this path is one sort + one gather.
+        nparts = len(spec.partition_exprs)
+        key_exprs = list(spec.partition_exprs) + [o.expr
+                                                  for o in spec.order_specs]
+        pspec = ranges = None
+        if key_exprs:
+            kcols = compiled.run_stage(key_exprs, batch)
+            pspec, ranges = _probe_pack_spec(kcols, batch.live_mask())
+            if pspec is not None and not all(
+                    k in (R.KIND_INT, R.KIND_BOOL)
+                    for k in pspec.kinds[nparts:]):
+                pspec = None  # dict codes are not value-ordered
+
+        def build_packed(pk):
+            flags = [(True, True)] * nparts + \
+                [(o.ascending, o.resolved_nulls_first())
+                 for o in spec.order_specs]
+            obits = sum(pk.bits[nparts:])
+
+            def fn(batch, ranges):
+                from spark_rapids_tpu.ops import window as W  # noqa: F811
+                nr = traced_rows(batch.num_rows)
+                cap = batch.capacity
+                ectx = EvalCtx(batch.columns, nr, cap, False)
+                kcols = [e.eval_tpu(ectx) for e in key_exprs]
+                live = jnp.arange(cap) < nr
+                packed = R.pack_keys_sort(pk, kcols, ranges, live, flags)
+                perm = jnp.argsort(packed, stable=True).astype(jnp.int32)
+                sorted_batch = K.gather_batch(batch, perm, batch.num_rows)
+                sp = packed[perm]
+                first = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+                part_plane = sp >> jnp.int64(obits)
+                segb = first | jnp.concatenate(
+                    [jnp.zeros(1, jnp.bool_),
+                     part_plane[1:] != part_plane[:-1]])
+                peerb = first | jnp.concatenate(
+                    [jnp.zeros(1, jnp.bool_), sp[1:] != sp[:-1]])
+                seg_start, seg_end, peer_start, peer_end = \
+                    W.segment_layout(segb, peerb)
+                seg_end = jnp.minimum(
+                    seg_end, jnp.maximum(nr - 1, 0).astype(seg_end.dtype))
+                peer_end = jnp.minimum(peer_end, seg_end)
+                seg_id = jnp.cumsum(segb.astype(jnp.int32))
+                idx = jnp.arange(cap, dtype=jnp.int32)
+                sctx = EvalCtx(sorted_batch.columns, nr, cap, False)
+                out_cols = list(sorted_batch.columns)
+                for w in exprs:
+                    out_cols.append(_eval_window_fn(
+                        w, sctx, seg_start, seg_end, peer_start, peer_end,
+                        seg_id, segb, peerb, idx, live))
+                return ColumnarBatch(out_cols, batch.num_rows)
+            return fn
+
+        if pspec is not None:
+            key = ("window_packed", tuple(w.fingerprint() for w in exprs),
+                   pspec.key)
+            fn = fuse.fused(key, lambda: build_packed(pspec))
+            with win_t.ns():
+                yield fn(batch, ranges)
+            return
 
         def build():
             def fn(batch):

@@ -184,6 +184,43 @@ def pack_keys(spec: PackSpec, key_cols: Sequence[ColumnVector],
     return jnp.where(live, packed, _SENTINEL)
 
 
+def pack_keys_sort(spec: PackSpec, key_cols: Sequence[ColumnVector],
+                   mins: jax.Array, live: jax.Array,
+                   flags: Sequence[Tuple[bool, bool]]) -> jax.Array:
+    """Order-faithful variant of pack_keys: per key, (ascending,
+    nulls_first) decides the field encoding so an ascending sort of the
+    packed plane IS the requested lexicographic order. KIND_INT/BOOL
+    only for order-significant keys (dict codes are not value-ordered;
+    callers place dict keys only in grouping positions with (True, True)
+    where any consistent order suffices)."""
+    cap = live.shape[0]
+    packed = jnp.zeros(cap, jnp.int64)
+    for i, (c, kind, b, (asc, nf)) in enumerate(
+            zip(key_cols, spec.kinds, spec.bits, flags)):
+        if kind == KIND_DICT:
+            v = c.data["codes"].astype(jnp.int64)
+            lo = jnp.int64(0)
+            hi = jnp.int64(max(int(c.dict_size) - 1, 0))
+        elif kind == KIND_BOOL:
+            v = c.data.astype(jnp.int64)
+            lo, hi = jnp.int64(0), jnp.int64(1)
+        else:
+            v = c.data.astype(jnp.int64)
+            lo, hi = mins[2 * i], mins[2 * i + 1]
+        code = (v - lo) if asc else (hi - v)
+        span_max = (jnp.int64(1) << jnp.int64(b)) - jnp.int64(2)
+        code = jnp.clip(code, 0, span_max)
+        if nf:
+            code = code + 1
+            null_code = jnp.int64(0)
+        else:
+            null_code = span_max + 1
+        if c.validity is not None:
+            code = jnp.where(c.validity, code, null_code)
+        packed = (packed << jnp.int64(b)) | code
+    return jnp.where(live, packed, _SENTINEL)
+
+
 def unpack_keys(spec: PackSpec, group_packed: jax.Array,
                 mins: jax.Array, key_cols: Sequence[ColumnVector]
                 ) -> List[ColumnVector]:

@@ -657,6 +657,22 @@ class SparkPlanMeta:
         if isinstance(p, P.Filter):
             return X.FilterExec(p, child_execs, conf)
         if isinstance(p, P.Limit):
+            se = child_execs[0]
+            # ORDER BY + LIMIT n -> TopN (reference GpuTopN): threshold
+            # selection beats sorting the whole partition; replaces the
+            # SortExec (and its range exchange — global order is
+            # irrelevant under a global limit) with per-partition TopN +
+            # collect + final TopN.
+            if isinstance(se, X.SortExec) and p.n <= 100_000:
+                inner = se.children[0]
+                if isinstance(inner, (X.RangeExchangeExec,
+                                      X.CollectExchangeExec)):
+                    inner = inner.children[0]
+                local = X.TopNExec(p, [inner], conf, se.plan.orders, p.n)
+                if inner.num_partitions > 1:
+                    coll = X.CollectExchangeExec(p, [local], conf)
+                    return X.TopNExec(p, [coll], conf, se.plan.orders, p.n)
+                return local
             local = X.LimitExec(p, child_execs, conf)
             if child_execs[0].num_partitions > 1:
                 coll = X.CollectExchangeExec(p, [local], conf)

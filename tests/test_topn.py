@@ -1,0 +1,92 @@
+"""TopN (ORDER BY + LIMIT) differential tests: the planner rewrites
+Limit(Sort) into threshold selection + small exact sort (TopNExec)."""
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.expr.core import col
+
+
+def _ref_topn(rows, keys, n):
+    return sorted(rows, key=keys)[:n]
+
+
+def test_topn_basic_desc_with_ties():
+    rng = np.random.default_rng(0)
+    m = 50_000
+    v = rng.integers(0, 1000, m)  # heavy ties
+    t = pa.table({"k": np.arange(m, dtype=np.int64), "v": v.astype(np.float64)})
+    s = TpuSession()
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    df = s.create_dataframe(t).order_by(col("v").desc(), col("k").asc()).limit(7)
+    root, _ = convert_plan(df.plan, s.conf)
+    names = []
+    def walk(e):
+        names.append(type(e).__name__)
+        [walk(c) for c in e.children]
+    walk(root)
+    assert "TopNExec" in names, names
+    d = df.to_pydict()
+    rows = list(zip(v.tolist(), np.arange(m).tolist()))
+    ref = sorted(rows, key=lambda r: (-r[0], r[1]))[:7]
+    got = list(zip(d["v"], d["k"]))
+    assert got == [(float(a), b) for a, b in ref], (got, ref)
+
+
+def test_topn_nulls_first_asc():
+    t = pa.table({
+        "v": pa.array([5.0, None, 3.0, None, 1.0, 4.0]),
+        "i": pa.array(list(range(6)), type=pa.int64()),
+    })
+    s = TpuSession()
+    d = (s.create_dataframe(t).order_by(col("v").asc(), col("i").asc())
+         .limit(3).to_pydict())
+    # Spark asc => nulls first
+    assert d["v"] == [None, None, 1.0]
+    assert d["i"] == [1, 3, 4]
+
+
+def test_topn_nulls_last_desc():
+    t = pa.table({
+        "v": pa.array([5.0, None, 3.0, None, 1.0, 4.0]),
+        "i": pa.array(list(range(6)), type=pa.int64()),
+    })
+    s = TpuSession()
+    d = (s.create_dataframe(t).order_by(col("v").desc(), col("i").asc())
+         .limit(3).to_pydict())
+    assert d["v"] == [5.0, 4.0, 3.0]
+
+
+def test_topn_limit_exceeds_rows():
+    t = pa.table({"v": pa.array([2, 1, 3], type=pa.int64())})
+    s = TpuSession()
+    d = s.create_dataframe(t).order_by(col("v").asc()).limit(10).to_pydict()
+    assert d["v"] == [1, 2, 3]
+
+
+def test_topn_multi_partition():
+    rng = np.random.default_rng(1)
+    m = 30_000
+    v = rng.uniform(-100, 100, m)
+    t = pa.table({"v": v})
+    s = TpuSession()
+    d = (s.create_dataframe(t, num_partitions=4).order_by(col("v").asc())
+         .limit(5).to_pydict())
+    assert np.allclose(d["v"], np.sort(v)[:5])
+
+
+def test_topn_string_primary_falls_back_correct():
+    t = pa.table({"s": pa.array(["pear", "apple", "fig", "kiwi", "date"]),
+                  "i": pa.array(list(range(5)), type=pa.int64())})
+    s = TpuSession()
+    d = (s.create_dataframe(t).order_by(col("s").asc()).limit(2).to_pydict())
+    assert d["s"] == ["apple", "date"]
+
+
+def test_topn_int64_extreme_values():
+    vals = [2**62, -2**62, 0, 2**62 - 1, -2**62 + 1, 7]
+    t = pa.table({"v": pa.array(vals, type=pa.int64())})
+    s = TpuSession()
+    d = s.create_dataframe(t).order_by(col("v").asc()).limit(3).to_pydict()
+    assert d["v"] == sorted(vals)[:3]
