@@ -709,12 +709,25 @@ class TopNExec(TpuExec):
     GpuTopN): lax.top_k over a monotone 32-bit image of the primary key
     gives a threshold; only the <= ~n surviving candidate rows get the
     exact multi-key sort. Ties and image collapse just widen the
-    candidate set; a pathological width falls back to the full sort."""
+    candidate set; a pathological width falls back to the full sort.
+    Two fused dispatches + ONE host sync (the candidate count) — the
+    per-dispatch cost on a tunneled device outweighs any kernel-level
+    saving, so each stage is a single jit."""
 
     def __init__(self, plan, children, conf, orders, n: int):
         super().__init__(plan, children, conf)
         self.orders = orders
         self.n = n
+        self._fusable = all(
+            not isinstance(o.expr.data_type(),
+                           (T.StringType, T.ArrayType, T.MapType,
+                            T.StructType))
+            for o in orders)
+
+    def _fp(self):
+        return (tuple((o.expr.fingerprint(), o.ascending,
+                       o.resolved_nulls_first()) for o in self.orders),
+                self.n)
 
     def execute_partition(self, ctx, pidx):
         sort_t = self.metrics.metric(M.SORT_TIME)
@@ -723,21 +736,61 @@ class TopNExec(TpuExec):
             return
         self._acquire(ctx)
         batch = K.concat_batches(batches) if len(batches) > 1 else batches[0]
+        n = self.n
+        bound = max(4 * n, 4096)
         with sort_t.ns():
-            total = int(batch.num_rows)
-            n = self.n
-            if total > n:
-                [kc] = compiled.run_stage([self.orders[0].expr], batch)
-                img = _topn_image(kc, self.orders[0], batch.live_mask())
-                if img is not None:
-                    thr = jax.lax.top_k(img, min(n, total))[0][-1]
-                    cand = batch.live_mask() & (img >= thr)
-                    idx, cnt = K.filter_indices(cand, batch.capacity)
-                    if cnt <= max(4 * n, 4096):
-                        batch = K.gather_batch(batch, idx, cnt)
-                        total = cnt
+            if self._fusable and batch.capacity > bound:
+                orders = self.orders
+
+                def build_select():
+                    def fn(b):
+                        live = b.live_mask()
+                        ectx = EvalCtx(b.columns, traced_rows(b.num_rows),
+                                       b.capacity, False, live=live)
+                        kc = orders[0].expr.eval_tpu(ectx)
+                        img = _topn_image(kc, orders[0], live)
+                        k = min(n, b.capacity)
+                        thr = jax.lax.top_k(img, k)[0][-1]
+                        cand = live & (img >= thr)
+                        return cand, jnp.sum(cand.astype(jnp.int32))
+                    return fn
+
+                sel = fuse.fused(("topn_select", self._fp()), build_select)
+                cand, cnt_d = sel(batch)
+                cnt = int(cnt_d)
+                if cnt <= bound:
+                    out_cap = round_capacity(bound)
+
+                    def build_sort():
+                        def fn(b, cand, cnt):
+                            idx = K._compact_indices(cand, b.capacity,
+                                                     out_cap)
+                            small = K.gather_batch(b, idx, cnt)
+                            keys = []
+                            sctx = EvalCtx(small.columns, cnt, out_cap,
+                                           False)
+                            for o in orders:
+                                kc = o.expr.eval_tpu(sctx)
+                                keys.extend(_order_keys(kc, o, cnt))
+                            perm = K.lexsort_indices(keys, cnt)
+                            ncap = round_capacity(n)  # <= out_cap (bound >= 4n)
+                            sel_idx = jnp.where(
+                                jnp.arange(ncap, dtype=jnp.int32)
+                                < jnp.minimum(cnt, n), perm[:ncap], -1)
+                            out = K.gather_batch(small, sel_idx, cnt)
+                            return ColumnarBatch(
+                                out.columns,
+                                LazyRowCount(jnp.minimum(cnt, n)))
+                        return fn
+
+                    srt = fuse.fused(("topn_sort", self._fp()), build_sort)
+                    yield srt(batch, cand, cnt_d)
+                    return
+            # fallback: exact full sort (string keys, tiny inputs, or a
+            # pathologically wide tie set)
             if batch.row_mask is not None:
                 batch = K.compact_batch(batch)
+            total = int(batch.num_rows)
             perm = _sort_perm_for(self.orders, batch)
             out = K.gather_batch(batch, perm, batch.num_rows)
             yield K.slice_batch(out, 0, min(n, total))
@@ -826,19 +879,41 @@ class SortExec(TpuExec):
 
 
 
-def _probe_pack_spec(key_cols, live):
+def _static_expr_ranges(key_cols, kinds, key_exprs):
+    """Expression-derived (lo, hi) bounds for every KIND_INT key, or None
+    if any is underivable. Skips the per-batch device min/max probe for
+    shapes like ``group_by(x % 1000)``."""
+    if key_exprs is None:
+        return None
+    rs = []
+    for c, kind, e in zip(key_cols, kinds, key_exprs):
+        if kind == R.KIND_INT:
+            r = e.static_range()
+            if r is None:
+                return None
+            rs.extend(r)
+        else:
+            rs.extend((0, 0))
+    return np.asarray(rs, np.int64)
+
+
+def _probe_pack_spec(key_cols, live, key_exprs=None):
     """Host decision: can these key columns pack into one int64 plane?
     Returns (spec, ranges_device) or (None, None). Costs one small device
-    fetch when integer key ranges are involved (shared by the aggregate,
-    window, and sort radix paths)."""
+    fetch when integer key ranges are involved and not statically
+    derivable (shared by the aggregate, window, and sort radix paths)."""
     kinds = R.static_kinds(key_cols)
     if kinds is None:
         return None, None
     if R.needs_range_probe(kinds):
-        probe = fuse.fused(("radix_probe", tuple(kinds)),
-                           lambda: R.probe_ranges)
-        ranges = probe(key_cols, live)
-        ranges_host = np.asarray(jax.device_get(ranges))
+        ranges_host = _static_expr_ranges(key_cols, kinds, key_exprs)
+        if ranges_host is not None:
+            ranges = jnp.asarray(ranges_host)
+        else:
+            probe = fuse.fused(("radix_probe", tuple(kinds)),
+                               lambda: R.probe_ranges)
+            ranges = probe(key_cols, live)
+            ranges_host = np.asarray(jax.device_get(ranges))
     else:
         ranges = jnp.zeros(2 * len(key_cols), jnp.int64)
         ranges_host = np.zeros(2 * len(key_cols), np.int64)
@@ -904,8 +979,8 @@ class _AggKernels:
 
     # -- radix fast-path dispatch (see ops/radix.py) ------------------------
 
-    def _probe_spec(self, key_cols, live):
-        return _probe_pack_spec(key_cols, live)
+    def _probe_spec(self, key_cols, live, key_exprs=None):
+        return _probe_pack_spec(key_cols, live, key_exprs)
 
     def update(self, batch: ColumnarBatch, ansi: bool):
         """The update phase entry: picks (in order) the tiny-bucket MXU
@@ -914,7 +989,8 @@ class _AggKernels:
         if self._packed_ok:
             key_cols = compiled.run_stage(self.group_exprs, batch)
             if self._bucket_layout(key_cols) is None:
-                spec, ranges = self._probe_spec(key_cols, batch.live_mask())
+                spec, ranges = self._probe_spec(key_cols, batch.live_mask(),
+                                                self.group_exprs)
                 if spec is not None:
                     fn = fuse.fused(
                         ("hashagg_packed_update", self._fp(), spec.key, ansi),
@@ -1045,11 +1121,16 @@ class _AggKernels:
                              lay.occupied)
 
     def _bucket_op(self, op, vals, valid, sdt, lay, ones):
+        def nv():
+            # a no-null column's validity IS the live mask, which the
+            # layout already counted — skip the extra scatter
+            return lay.counts.astype(jnp.int64) if valid is lay.live \
+                else R.bucket_count(lay, valid)
         if op == "count":
-            return R.bucket_count(lay, valid), ones
+            return nv(), ones
         if op == "count_all":
             return lay.counts.astype(jnp.int64), ones
-        nvalid = R.bucket_count(lay, valid)
+        nvalid = nv()
         some = nvalid > 0
         if op in ("sum", "sumsq"):
             v = vals * vals if op == "sumsq" else vals
@@ -1438,7 +1519,8 @@ class WindowExec(TpuExec):
         pspec = ranges = None
         if key_exprs:
             kcols = compiled.run_stage(key_exprs, batch)
-            pspec, ranges = _probe_pack_spec(kcols, batch.live_mask())
+            pspec, ranges = _probe_pack_spec(kcols, batch.live_mask(),
+                                             key_exprs)
             if pspec is not None and not all(
                     k in (R.KIND_INT, R.KIND_BOOL)
                     for k in pspec.kinds[nparts:]):
@@ -2527,8 +2609,24 @@ class _HashJoinBase(TpuExec):
                               probe_live=plive)
         matched = bidx >= 0
         blive = build.live_mask() if build.row_mask is not None else None
-        bcols = [K.gather_column(c, bidx, build.num_rows, src_live=blive)
-                 for c in build.columns]
+        # equi-join build KEY columns equal the probe keys on matched rows:
+        # reconstruct them from the (already evaluated) probe keys instead
+        # of a full-capacity gather
+        key_map = {}
+        for pk, rk in zip(probe_keys, self.plan.right_keys):
+            if isinstance(rk, BoundRef) and not pk.is_string \
+                    and not pk.is_nested:
+                key_map[rk.index] = pk
+        bcols = []
+        for ci, c in enumerate(build.columns):
+            pk = key_map.get(ci)
+            if pk is not None and pk.dtype == c.dtype and not c.is_string:
+                v = (pk.validity & matched) if pk.validity is not None \
+                    else matched
+                bcols.append(ColumnVector(c.dtype, pk.data, v))
+            else:
+                bcols.append(K.gather_column(c, bidx, build.num_rows,
+                                             src_live=blive))
         if self.plan.condition is not None:
             joined = ColumnarBatch(list(probe.columns) + bcols,
                                    probe.num_rows, probe.row_mask)

@@ -121,6 +121,13 @@ class Expression:
         kids = ",".join(c.fingerprint() for c in self.children)
         return f"{type(self).__name__}({params};{kids})"
 
+    def static_range(self):
+        """Optional (lo, hi) int bounds of this expression's values,
+        derivable from the expression alone (e.g. ``x % 1000``). Lets the
+        radix groupby/sort paths skip the per-batch device min/max probe
+        (one host sync per batch). None = unknown."""
+        return None
+
     def _params(self) -> str:
         return ""
 
@@ -292,6 +299,12 @@ class Literal(Expression):
     def data_type(self):
         return self.dtype
 
+    def static_range(self):
+        if isinstance(self.dtype, (T.Int8Type, T.Int16Type, T.Int32Type,
+                                   T.Int64Type)) and self.value is not None:
+            return (int(self.value), int(self.value))
+        return None
+
     @property
     def nullable(self):
         return self.value is None
@@ -437,6 +450,9 @@ class Alias(Expression):
 
     def data_type(self):
         return self.children[0].data_type()
+
+    def static_range(self):
+        return self.children[0].static_range()
 
     @property
     def nullable(self):
@@ -702,6 +718,19 @@ class Remainder(BinaryExpression):
 
     def data_type(self):
         return T.common_type(self.left.data_type(), self.right.data_type())
+
+    def static_range(self):
+        r = self.right.static_range()
+        if r is None or not isinstance(self.data_type(),
+                                       (T.Int8Type, T.Int16Type, T.Int32Type,
+                                        T.Int64Type)):
+            return None
+        m = max(abs(r[0]), abs(r[1]))
+        if m == 0:
+            return None
+        lr = self.left.static_range()
+        lo = 0 if (lr is not None and lr[0] >= 0) else -(m - 1)
+        return (lo, m - 1)
 
     def eval_tpu(self, ctx):
         l = self.left.eval_tpu(ctx)
