@@ -1383,13 +1383,11 @@ class _AggKernels:
             if c.validity is not None:
                 code = jnp.where(c.validity, code, null_code)
             bucket = bucket * s + jnp.clip(code, 0, null_code)
-        if B <= self._MATMUL_LIMIT:
-            occupancy = jnp.stack([jnp.any(live & (bucket == b))
-                                   for b in range(B)])
-        else:
-            occupancy = (jax.ops.segment_sum(
-                jnp.where(live, 1, 0), jnp.where(live, bucket, B),
-                num_segments=B + 1)[:B] > 0)
+        # one i32 scatter beats B full-plane masked reductions even for
+        # tiny B (each pass reads the whole plane)
+        occupancy = (jax.ops.segment_sum(
+            jnp.where(live, 1, 0), jnp.where(live, bucket, B),
+            num_segments=B + 1)[:B] > 0)
         out_cols: List[ColumnVector] = []
         # reconstruct key columns from the bucket index (B is small)
         codes = []
@@ -1887,8 +1885,15 @@ class HashAggregateExec(TpuExec):
             self._acquire(ctx)
             with agg_t.ns():
                 merged = self._merge(partials)
-                out = merged if self.mode == "partial" else self._evaluate(merged)
-                yield K.compact_batch(out)
+                # no compact at yield: exchanges, downstream aggs, and the
+                # collect boundary consume masked batches natively
+                # (zero-copy mask slices; session compacts on device right
+                # before download), and every compact costs a ~90ms count
+                # sync on the tunneled device
+                if self.mode == "partial":
+                    yield merged
+                else:
+                    yield self._evaluate(merged)
 
     # -- phase helpers -----------------------------------------------------
 

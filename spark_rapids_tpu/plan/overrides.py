@@ -747,12 +747,19 @@ class SparkPlanMeta:
         partial = X.HashAggregateExec(p, [child], conf, mode="partial",
                                       pre_filter=pre_filter)
         nkeys = len(p.group_exprs)
-        if nkeys:
+        import jax as _jax
+        single_device = len(_jax.devices()) == 1 \
+            and conf.get(C.SHUFFLE_MODE).upper() != "ICI"
+        if nkeys and not single_device:
             keys = [E.BoundRef(i, e.data_type(), n) for i, (e, n) in
                     enumerate(zip(p.group_exprs, p.group_names))]
             exch = X.ShuffleExchangeExec(p, [partial], conf, keys,
                                          n_out=child.num_partitions)
         else:
+            # one device: a hash exchange between partial and final states
+            # only re-slices arrays that already live together — collect
+            # and merge once instead (the single-process analog of AQE's
+            # shuffle elimination; multi-chip ICI keeps the real exchange)
             exch = X.CollectExchangeExec(p, [partial], conf)
         return X.HashAggregateExec(p, [exch], conf, mode="final")
 

@@ -202,8 +202,17 @@ class TpuSession:
                 import logging
                 logging.getLogger("spark_rapids_tpu").info("\n%s", text)
         names = plan.schema.names
-        tables = self.run_partitions(exec_root,
-                                     lambda b: to_arrow(b, names))
+
+        def fetch(b):
+            # compact sparse masked batches ON DEVICE before the download:
+            # the tunnel moves full planes, and a bucket-agg output can be
+            # a few-percent-occupied 4M-capacity batch
+            if b.row_mask is not None and b.capacity > 16384:
+                from spark_rapids_tpu.ops import kernels as K
+                b = K.compact_batch(b)
+            return to_arrow(b, names)
+
+        tables = self.run_partitions(exec_root, fetch)
         if not tables:
             fields = [pa.field(f.name, T.to_arrow(f.dtype))
                       for f in plan.schema.fields]
