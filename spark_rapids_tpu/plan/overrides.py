@@ -945,6 +945,8 @@ def wrap_and_tag(plan: P.PlanNode, conf) -> SparkPlanMeta:
 def convert_plan(plan: P.PlanNode, conf):
     """Returns (root_exec, meta). In explainOnly mode no device is required
     by conversion since nothing executes until iteration."""
+    from spark_rapids_tpu.plan.prune import prune_plan
+    plan = prune_plan(plan)
     meta = wrap_and_tag(plan, conf)
     from spark_rapids_tpu.plan.cost import apply_cost_optimizer
     apply_cost_optimizer(meta, conf)

@@ -1,0 +1,143 @@
+"""Column pruning (reference: Catalyst ColumnPruning, which Spark runs
+before the plugin ever sees a plan — this engine owns its own logical
+plans, so it needs the pass itself).
+
+Why it matters on TPU: a join materializes its build-side payload with
+one full-capacity random gather PER COLUMN, and a window sorts then
+gathers every input column — measured ~150-350 ms per 8-30M-row gather
+on v5e. Dropping unreferenced columns before those operators is worth
+more than any kernel tuning on them.
+
+Two rewrites, applied bottom-up:
+- Project(Join(l, r)):   push the used-column subset below the join
+- Project(Window(c)):    push the used-column subset below the window
+Both rebuild the intermediate node with remapped BoundRefs and keep the
+outer Project's schema byte-identical.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.plan import nodes as P
+
+
+def _refs(e, out: Set[int]) -> None:
+    if isinstance(e, E.BoundRef):
+        out.add(e.index)
+    for c in e.children:
+        _refs(c, out)
+
+
+def _remap(e, m: Dict[int, int]):
+    def f(x):
+        if isinstance(x, E.BoundRef):
+            return E.BoundRef(m[x.index], x.dtype, x.name)
+        return x
+    return e.transform(f)
+
+
+def _subset_project(child: P.PlanNode, used: List[int]) -> P.PlanNode:
+    fields = child.schema.fields
+    exprs = [E.BoundRef(i, fields[i].dtype, fields[i].name) for i in used]
+    return P.Project(exprs, child)
+
+
+def _clone_project(old: P.Project, new_child: P.PlanNode,
+                   new_exprs) -> P.Project:
+    q = P.Project.__new__(P.Project)
+    q.children = [new_child]
+    q.raw_exprs = old.raw_exprs
+    q.exprs = new_exprs
+    q.names = old.names
+    return q
+
+
+def _prune_join(p: P.Project, j: P.Join):
+    if j.how in ("left_semi", "left_anti"):
+        return p  # output = left schema only; nothing to split
+    left, right = j.children
+    nl = len(left.schema.fields)
+    nr = len(right.schema.fields)
+    out_used: Set[int] = set()
+    for e in p.exprs:
+        _refs(e, out_used)
+    cond_used: Set[int] = set()
+    if j.condition is not None:
+        _refs(j.condition, cond_used)
+    used_l: Set[int] = {i for i in out_used | cond_used if i < nl}
+    used_r: Set[int] = {i - nl for i in out_used | cond_used if i >= nl}
+    for e in j.left_keys:
+        _refs(e, used_l)
+    for e in j.right_keys:
+        _refs(e, used_r)
+    if len(used_l) >= nl and len(used_r) >= nr:
+        return p
+    ul, ur = sorted(used_l), sorted(used_r)
+    ml = {old: new for new, old in enumerate(ul)}
+    mr = {old: new for new, old in enumerate(ur)}
+    nj = P.Join.__new__(P.Join)
+    nj.children = [_subset_project(left, ul) if len(ul) < nl else left,
+                   _subset_project(right, ur) if len(ur) < nr else right]
+    nj.left_keys = [_remap(e, ml) for e in j.left_keys]
+    nj.right_keys = [_remap(e, mr) for e in j.right_keys]
+    nj.how = j.how
+    nj.condition_raw = j.condition_raw
+    mc = {**{o: ml[o] for o in ul},
+          **{o + nl: mr[o] + len(ul) for o in ur}}
+    nj.condition = (_remap(j.condition, mc)
+                    if j.condition is not None else None)
+    return _clone_project(p, nj, [_remap(e, mc) for e in p.exprs])
+
+
+def _prune_window(p: P.Project, w: P.WindowNode):
+    from spark_rapids_tpu.expr.window import WindowExpr, WindowSpec
+    child = w.children[0]
+    nc = len(child.schema.fields)
+    out_used: Set[int] = set()
+    for e in p.exprs:
+        _refs(e, out_used)
+    used_c: Set[int] = {i for i in out_used if i < nc}
+    for we in w.window_exprs:
+        for e in we.spec.partition_exprs:
+            _refs(e, used_c)
+        for o in we.spec.order_specs:
+            _refs(o.expr, used_c)
+        for e in we.fn.children:
+            _refs(e, used_c)
+    if len(used_c) >= nc:
+        return p
+    uc = sorted(used_c)
+    m = {old: new for new, old in enumerate(uc)}
+    nw = P.WindowNode.__new__(P.WindowNode)
+    nw.children = [_subset_project(child, uc)]
+    nw.names = w.names
+    nexprs = []
+    for we in w.window_exprs:
+        spec = WindowSpec([_remap(e, m) for e in we.spec.partition_exprs],
+                          [P.SortOrder(_remap(o.expr, m), o.ascending,
+                                       o.nulls_first)
+                           for o in we.spec.order_specs],
+                          we.spec.frame)
+        nexprs.append(WindowExpr(_remap(we.fn, m), spec))
+    nw.window_exprs = nexprs
+    # outer project: child cols remap; appended window cols shift down
+    mo = dict(m)
+    for j_ in range(len(w.window_exprs)):
+        mo[nc + j_] = len(uc) + j_
+    return _clone_project(p, nw, [_remap(e, mo) for e in p.exprs])
+
+
+def prune_plan(p: P.PlanNode) -> P.PlanNode:
+    """Bottom-up pruning. Replaces children in place (a rewritten subtree
+    is semantically identical, so sharing with sibling plans stays
+    sound); returns the possibly-rewritten node."""
+    p.children = [prune_plan(c) for c in p.children]
+    if isinstance(p, P.Project):
+        c = p.children[0]
+        if isinstance(c, P.Join):
+            return _prune_join(p, c)
+        if isinstance(c, P.WindowNode):
+            return _prune_window(p, c)
+    return p

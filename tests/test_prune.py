@@ -1,0 +1,66 @@
+"""Column pruning pass: Project-over-Join/Window pushes used columns
+below the operator (plan/prune.py); results stay identical."""
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+
+def test_join_prune_plan_shape_and_result():
+    s = TpuSession()
+    left = s.create_dataframe({"k": [1, 2, 3, 4], "a": [10, 20, 30, 40],
+                               "b": [1.0, 2.0, 3.0, 4.0],
+                               "unused1": [0, 0, 0, 0]})
+    right = s.create_dataframe({"rk": [2, 3, 5], "c": [200, 300, 500],
+                                "unused2": [9, 9, 9]})
+    j = left.join(right, on=[(col("k"), col("rk"))], how="inner")
+    out = j.select(col("k"), col("c"))
+    from spark_rapids_tpu.plan.prune import prune_plan
+    import spark_rapids_tpu.plan.nodes as P
+    pruned = prune_plan(out.plan)
+    # the join's children should now carry only the used subsets
+    join_node = pruned.children[0]
+    assert isinstance(join_node, P.Join)
+    assert join_node.children[0].schema.names == ["k"]
+    assert set(join_node.children[1].schema.names) == {"rk", "c"}
+    d = out.to_pydict()
+    assert sorted(zip(d["k"], d["c"])) == [(2, 200), (3, 300)]
+
+
+def test_join_prune_with_condition_result():
+    s = TpuSession()
+    left = s.create_dataframe({"k": [1, 1, 2], "x": [5, 6, 7],
+                               "dead": [0, 0, 0]})
+    right = s.create_dataframe({"rk": [1, 2], "y": [5, 9],
+                                "dead2": [1, 1]})
+    j = left.join(right, on=(col("k") == col("rk")) & (col("x") > col("y")),
+                  how="inner")
+    out = j.select(col("k"), col("x"), col("y"))
+    d = out.to_pydict()
+    rows = sorted(zip(d["k"], d["x"], d["y"]))
+    assert rows == [(1, 6, 5)]
+
+
+def test_window_prune_plan_shape_and_result():
+    s = TpuSession()
+    from spark_rapids_tpu.expr.window import Window
+    t = pa.table({
+        "g": pa.array([1, 1, 2, 2, 2], type=pa.int64()),
+        "o": pa.array([3, 1, 2, 5, 4], type=pa.int64()),
+        "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+        "unused": pa.array([0, 0, 0, 0, 0], type=pa.int64()),
+    })
+    df = s.create_dataframe(t)
+    w = Window.partition_by(col("g")).order_by(col("o"))
+    out = df.select(col("g"), F.rank().over(w).alias("rk"))
+    from spark_rapids_tpu.plan.prune import prune_plan
+    import spark_rapids_tpu.plan.nodes as P
+    pruned = prune_plan(out.plan)
+    wn = pruned.children[0]
+    assert isinstance(wn, P.WindowNode)
+    assert set(wn.children[0].schema.names) == {"g", "o"}
+    d = out.to_pydict()
+    got = sorted(zip(d["g"], d["rk"]))
+    assert got == [(1, 1), (1, 2), (2, 1), (2, 2), (2, 3)]
