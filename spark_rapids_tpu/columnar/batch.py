@@ -20,7 +20,7 @@ TPU-first design decisions, deliberately different from the cuDF model:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -176,6 +176,11 @@ class ColumnVector:
     #: can merge 'a' and 'A') set False — bucket-by-code aggregation
     #: requires code uniqueness.
     dict_unique: bool = True
+    #: optional host-side (min, max) int bounds (cache-time column stats,
+    #: the ParquetCachedBatchSerializer-stats analog). NOT part of the
+    #: pytree: consumed only host-side (radix packing skips its device
+    #: range probe). Conservative bounds stay valid under any row subset.
+    bounds: "Optional[Tuple[int, int]]" = None
 
     @property
     def capacity(self) -> int:

@@ -92,7 +92,15 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
     out_planes, err = fn(col_planes, jnp.asarray(traced_rows(batch.num_rows), jnp.int32),
                          batch.live_mask())
     raise_errors(err)
-    return [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
+    outs = [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
+    # column-stat bounds are host metadata (not pytree leaves): carry them
+    # across the jit boundary for passthrough column references
+    from spark_rapids_tpu.expr.core import Alias, BoundRef
+    for e, o in zip(exprs, outs):
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, BoundRef) and inner.index < len(batch.columns):
+            o.bounds = batch.columns[inner.index].bounds
+    return outs
 
 
 def raise_errors(err: Dict[str, jax.Array]) -> None:

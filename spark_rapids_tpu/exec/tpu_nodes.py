@@ -314,6 +314,7 @@ class CachedScanExec(TpuExec):
                         # Registered spillable: under HBM pressure the
                         # cache pages out to host/disk instead of OOMing.
                         merged = K.compact_batch(K.concat_batches(batches))
+                        _attach_column_stats(merged)
                         batches = [SpillableColumnarBatch(merged)]
                     out.append(batches)
                 self.plan.materialized = out
@@ -322,6 +323,34 @@ class CachedScanExec(TpuExec):
     def execute_partition(self, ctx, pidx):
         for sb in self._materialize()[pidx]:
             yield sb.get_batch()
+
+
+def _attach_column_stats(batch: ColumnarBatch) -> None:
+    """Cache-time column stats (the ParquetCachedBatchSerializer-stats
+    analog): one bulk fetch of per-int-column min/max at materialization,
+    carried as ColumnVector.bounds so later radix packing over these
+    columns skips its per-batch device range probe (a ~90ms sync)."""
+    idxs, pending = [], []
+    for i, c in enumerate(batch.columns):
+        if c.is_dict or c.is_nested or c.is_string:
+            continue
+        if not isinstance(c.dtype, (T.Int8Type, T.Int16Type, T.Int32Type,
+                                    T.Int64Type, T.DateType,
+                                    T.TimestampType, T.DecimalType)):
+            continue
+        v = c.data.astype(jnp.int64)
+        valid = c.validity_or_default(batch.num_rows)
+        lo = jnp.min(jnp.where(valid, v, jnp.int64(2**62)))
+        hi = jnp.max(jnp.where(valid, v, -jnp.int64(2**62)))
+        idxs.append(i)
+        pending.extend([lo, hi])
+    if not idxs:
+        return
+    vals = jax.device_get(pending)
+    for j, i in enumerate(idxs):
+        lo, hi = int(vals[2 * j]), int(vals[2 * j + 1])
+        if lo <= hi:
+            batch.columns[i].bounds = (lo, hi)
 
 
 class RangeExec(TpuExec):
@@ -880,15 +909,16 @@ class SortExec(TpuExec):
 
 
 def _static_expr_ranges(key_cols, kinds, key_exprs):
-    """Expression-derived (lo, hi) bounds for every KIND_INT key, or None
-    if any is underivable. Skips the per-batch device min/max probe for
-    shapes like ``group_by(x % 1000)``."""
-    if key_exprs is None:
-        return None
+    """Host-known (lo, hi) bounds for every KIND_INT key — from the
+    expression (``x % 1000``) or from cache-time column stats riding on
+    the ColumnVector — or None if any is underivable. Skips the
+    per-batch device min/max probe (a ~90ms sync)."""
     rs = []
-    for c, kind, e in zip(key_cols, kinds, key_exprs):
+    for i, (c, kind) in enumerate(zip(key_cols, kinds)):
         if kind == R.KIND_INT:
-            r = e.static_range()
+            r = key_exprs[i].static_range() if key_exprs is not None else None
+            if r is None:
+                r = c.bounds
             if r is None:
                 return None
             rs.extend(r)
@@ -1383,11 +1413,18 @@ class _AggKernels:
             if c.validity is not None:
                 code = jnp.where(c.validity, code, null_code)
             bucket = bucket * s + jnp.clip(code, 0, null_code)
-        # one i32 scatter beats B full-plane masked reductions even for
-        # tiny B (each pass reads the whole plane)
-        occupancy = (jax.ops.segment_sum(
-            jnp.where(live, 1, 0), jnp.where(live, bucket, B),
-            num_segments=B + 1)[:B] > 0)
+        if B <= self._MATMUL_LIMIT:
+            # keep the whole tiny-B path scatter-FREE: XLA fuses all the
+            # per-bucket masked reductions (occupancy + every agg state)
+            # into a handful of passes over the shared input planes; one
+            # scatter in the middle splits that fusion island and was
+            # measured to cost ~8x on a 30M-row q1 shape
+            occupancy = jnp.stack([jnp.any(live & (bucket == b))
+                                   for b in range(B)])
+        else:
+            occupancy = (jax.ops.segment_sum(
+                jnp.where(live, 1, 0), jnp.where(live, bucket, B),
+                num_segments=B + 1)[:B] > 0)
         out_cols: List[ColumnVector] = []
         # reconstruct key columns from the bucket index (B is small)
         codes = []

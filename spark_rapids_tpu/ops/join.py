@@ -77,7 +77,7 @@ class DenseBuildTable:
     probe row, enabling mask-through join output with no pair expansion."""
 
     __slots__ = ("starts", "sorted_orig", "bmin", "span", "max_dup",
-                 "bcap", "build_rows")
+                 "bcap", "build_rows", "slot_idx")
 
     def __init__(self, starts, sorted_orig, bmin, span, max_dup, bcap,
                  build_rows):
@@ -88,6 +88,13 @@ class DenseBuildTable:
         self.max_dup = max_dup
         self.bcap = bcap
         self.build_rows = build_rows
+        #: unique-key builds: build row per key slot (-1 empty), computed
+        #: once over the SPAN so probing is a single gather
+        self.slot_idx = None
+        if max_dup <= 1:
+            occ = starts[1:] > starts[:-1]
+            cand = sorted_orig[jnp.clip(starts[:-1], 0, bcap - 1)]
+            self.slot_idx = jnp.where(occ, cand, -1)
 
 
 def prepare_dense_build(build_keys: List[ColumnVector], build_rows: int,
@@ -132,11 +139,7 @@ def dense_lookup(table: DenseBuildTable, probe_keys: List[ColumnVector],
     slot = pv - table.bmin
     inside = p_in & (slot >= 0) & (slot < table.span)
     sl = jnp.where(inside, slot, 0).astype(jnp.int32)
-    lo = table.starts[sl]
-    hi = table.starts[sl + 1]
-    bidx = jnp.where(inside & (hi > lo),
-                     table.sorted_orig[jnp.clip(lo, 0, table.bcap - 1)], -1)
-    return bidx
+    return jnp.where(inside, table.slot_idx[sl], -1)
 
 
 def join_pairs(build_keys: List[ColumnVector], build_rows: int,
