@@ -744,12 +744,23 @@ class SparkPlanMeta:
                 exch = X.CollectExchangeExec(p, [child], conf)
             return X.HashAggregateExec(p, [exch], conf, mode="complete",
                                        pre_filter=pre_filter)
-        partial = X.HashAggregateExec(p, [child], conf, mode="partial",
-                                      pre_filter=pre_filter)
         nkeys = len(p.group_exprs)
         import jax as _jax
         single_device = len(_jax.devices()) == 1 \
             and conf.get(C.SHUFFLE_MODE).upper() != "ICI"
+        if single_device:
+            est = p.children[0].estimated_rows()
+            if est is not None and est <= 64_000_000:
+                # all partitions share one device and the raw input fits
+                # comfortably: one complete pass over the collected input
+                # beats partial-per-partition + exchange + final merge
+                # (each extra stage costs dispatches and a ~90ms sync)
+                coll = X.CollectExchangeExec(p, [child], conf)
+                coal = X.CoalesceBatchesExec(p, [coll], conf)
+                return X.HashAggregateExec(p, [coal], conf, mode="complete",
+                                           pre_filter=pre_filter)
+        partial = X.HashAggregateExec(p, [child], conf, mode="partial",
+                                      pre_filter=pre_filter)
         if nkeys and not single_device:
             keys = [E.BoundRef(i, e.data_type(), n) for i, (e, n) in
                     enumerate(zip(p.group_exprs, p.group_names))]

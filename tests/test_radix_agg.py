@@ -262,3 +262,35 @@ def test_packed_timestamp_key():
     got_total = sum(d["c"])
     assert got_total == n
     assert len(d["ts"]) == len(cnt)
+
+
+def test_single_device_agg_collapse(monkeypatch):
+    """One device: partial+exchange+final collapses to one complete pass
+    over the collected input (plan/overrides.py)."""
+    import jax as _jax
+    real = _jax.devices()
+    monkeypatch.setattr(_jax, "devices", lambda *a, **k: real[:1])
+    rng = np.random.default_rng(9)
+    n = 30_000
+    t = pa.table({"k": rng.integers(0, 500, n).astype(np.int64),
+                  "v": rng.uniform(0, 1, n)})
+    s = TpuSession()
+    df = s.create_dataframe(t, num_partitions=4)
+    g = df.group_by(col("k")).agg(F.sum("v").alias("s"),
+                                  F.count("v").alias("c"))
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    root, _ = convert_plan(g.plan, s.conf)
+    kinds = []
+    def walk(e):
+        kinds.append(type(e).__name__)
+        [walk(c) for c in e.children]
+    walk(root)
+    assert "ShuffleExchangeExec" not in kinds, kinds
+    assert any(k == "HashAggregateExec" for k in kinds)
+    d = g.to_pydict()
+    ref = t.group_by(["k"]).aggregate([("v", "sum"), ("v", "count")])
+    rows = {(k,): (sv, c) for k, sv, c in zip(
+        ref["k"].to_pylist(), ref["v_sum"].to_pylist(),
+        ref["v_count"].to_pylist())}
+    _cmp(d, rows, ["k"], ["s", "c"])
