@@ -2530,7 +2530,12 @@ class _HashJoinBase(TpuExec):
 
     def _dense_table_for(self, build, build_keys):
         """Direct-address build table for the mask-through probe, prepared
-        once per build batch (one 4-scalar fetch)."""
+        once per build batch (one 4-scalar fetch). Shared across actions
+        through the plan node when the build itself is (the broadcast
+        build rides the same reuse)."""
+        plan_cache = getattr(self.plan, "_dense_table_cache", None)
+        if plan_cache is not None and plan_cache[0] is build:
+            return plan_cache[1]
         with self._dense_lock:
             if self._dense_cache is None or self._dense_cache[0] is not build:
                 table = None
@@ -2539,6 +2544,7 @@ class _HashJoinBase(TpuExec):
                         build_keys, build.num_rows,
                         [e.data_type() for e in self.plan.left_keys])
                 self._dense_cache = (build, table)
+                self.plan._dense_table_cache = (build, table)
             return self._dense_cache[1]
 
     def _hash_keys(self, side: int):
@@ -2756,9 +2762,25 @@ class BroadcastHashJoinExec(_HashJoinBase):
     def num_partitions(self):
         return self.children[0].num_partitions
 
+    def _cacheable_build_plan(self) -> bool:
+        """The build result may be cached ACROSS actions (reused broadcast,
+        the ReusedExchange analog) when the build subtree is a pure view
+        over an immutable cached relation."""
+        def ok(n):
+            if isinstance(n, (P.CachedRelation,)):
+                return True
+            if isinstance(n, (P.Filter, P.Project, P.Limit)):
+                return all(ok(c) for c in n.children)
+            return False
+        return ok(self.plan.children[1])
+
     def _build_side(self) -> ColumnarBatch:
         with self._build_lock:
             if self._build is None:
+                cached = getattr(self.plan, "_bcast_cache", None)
+                if cached is not None and self._cacheable_build_plan():
+                    self._build, self._build_keys = cached
+                    return self._build
                 build_t = self.metrics.metric(M.BUILD_TIME)
                 right = self.children[1]
                 batches = []
@@ -2773,6 +2795,8 @@ class BroadcastHashJoinExec(_HashJoinBase):
                         self._build = empty_like_schema(right.schema)
                     self._build_keys = compiled.run_stage(
                         self.plan.right_keys, self._build)
+                if self._cacheable_build_plan():
+                    self.plan._bcast_cache = (self._build, self._build_keys)
         return self._build
 
     def execute_partition(self, ctx, pidx):
