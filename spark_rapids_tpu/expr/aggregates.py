@@ -384,6 +384,17 @@ def _valid_under(col: ColumnVector, live):
     return live if col.validity is None else (col.validity & live)
 
 
+def _cpu_leaf_converter(dt):
+    """CPU-tier element values arrive as raw numpy scalars; arrow nested
+    builders need real python Decimals for decimal children (the device
+    path already converts via _leaf_to_py)."""
+    if isinstance(dt, T.DecimalType):
+        import decimal
+        scale = dt.scale
+        return lambda v: decimal.Decimal(int(v)).scaleb(-scale)
+    return lambda v: v
+
+
 def _pack_valid_front(src: ColumnVector, perm, keep_sorted, cap):
     """Scatter the kept sorted rows to the front (stable): returns
     (child ColumnVector, dest positions of kept rows)."""
@@ -416,10 +427,11 @@ class CollectList(SegmentedAgg):
 
     def eval_cpu_groups(self, inputs, gid, n_groups):
         src = inputs[0]
+        conv = _cpu_leaf_converter(self.children[0].data_type())
         out = [[] for _ in range(n_groups)]
         for g, v, ok in zip(gid, src.values, src.valid):
             if ok and v is not None:
-                out[g].append(v)
+                out[g].append(conv(v))
         vals = np.empty(n_groups, object)
         vals[:] = out
         return CpuCol(self.result_type(), vals, np.ones(n_groups, np.bool_))
@@ -428,7 +440,13 @@ class CollectList(SegmentedAgg):
 class CollectSet(SegmentedAgg):
     """collect_set: distinct group values. Spark leaves element order
     unspecified; both backends emit ascending value order (deterministic,
-    and any order is conformant)."""
+    and any order is conformant).
+
+    String dedup on device rides the 64-bit double-hash equality of
+    normalize_key: two distinct strings colliding (odds ~2^-64 per pair)
+    would merge into one set element. Same documented incompat as the
+    string join path (ops/join.py), gated by the same
+    ``spark.rapids.sql.incompatibleOps.enabled`` conf."""
 
     def result_type(self):
         return T.ArrayType(self.children[0].data_type(), contains_null=False)
@@ -467,9 +485,11 @@ class CollectSet(SegmentedAgg):
 
     def eval_cpu_groups(self, inputs, gid, n_groups):
         src = inputs[0]
+        conv = _cpu_leaf_converter(self.children[0].data_type())
         seen = [dict() for _ in range(n_groups)]
         for g, v, ok in zip(gid, src.values, src.valid):
             if ok and v is not None:
+                v = conv(v)
                 # NaN is ONE distinct set member (Spark semantics); python
                 # dict keying by the value itself would keep every NaN
                 key = "__nan__" if isinstance(v, float) and v != v else v
@@ -578,6 +598,10 @@ class Percentile(SegmentedAgg):
         cap = perm.shape[0]
         keep = _valid_under(src, live)[perm]
         v = src.data.astype(jnp.float64)[perm]
+        cdt = self.children[0].data_type()
+        if isinstance(cdt, T.DecimalType):
+            # unscaled int64 state -> real value (mirrors Average)
+            v = v / (10.0 ** cdt.scale)
         iota = jnp.arange(cap, dtype=jnp.int32)
         # kept rows pack to the FRONT globally (invalid/dead rows would
         # otherwise sit inside their segment and shift every later
@@ -600,10 +624,12 @@ class Percentile(SegmentedAgg):
 
     def eval_cpu_groups(self, inputs, gid, n_groups):
         src = inputs[0]
+        cdt = self.children[0].data_type()
+        descale = (10.0 ** cdt.scale) if isinstance(cdt, T.DecimalType) else 1.0
         buckets = [[] for _ in range(n_groups)]
         for g, v, ok in zip(gid, src.values, src.valid):
             if ok:
-                buckets[g].append(float(v))
+                buckets[g].append(float(v) / descale)
         vals = np.zeros(n_groups, np.float64)
         okm = np.zeros(n_groups, np.bool_)
         for g, b in enumerate(buckets):

@@ -599,7 +599,15 @@ class Multiply(BinaryArithmetic):
             dec = lt if isinstance(lt, T.DecimalType) else rt
             other = rt if dec is lt else lt
             if other.is_integral:
-                # decimal x integral: scale unchanged, precision capped
+                # decimal x integral: scale unchanged. Mirror the
+                # decimal-x-decimal overflow guard: when the integral
+                # operand's digits could push the unscaled product past 18
+                # digits (int64 wrap territory), compute as DOUBLE instead
+                # of risking a silently wrong wrapped decimal.
+                int_prec = {1: 3, 2: 5, 4: 10, 8: 19}.get(
+                    np.dtype(other.np_dtype).itemsize, 19)
+                if dec.precision + int_prec > 18:
+                    return T.FLOAT64
                 return T.DecimalType(18, dec.scale)
             return T.FLOAT64
         return T.common_type(lt, rt)

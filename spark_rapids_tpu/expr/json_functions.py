@@ -60,7 +60,12 @@ def _walk(value, steps: List):
             return None
         hits = [_walk(v, rest) for v in value]
         hits = [h for h in hits if h is not None]
-        return hits if hits else None
+        if not hits:
+            return None
+        # Spark unwraps a wildcard that matched exactly one element
+        # ('$.a[*].b' over a one-element array returns the element, not
+        # a one-element JSON array)
+        return hits[0] if len(hits) == 1 else hits
     if isinstance(step, int):
         if not isinstance(value, list) or step >= len(value):
             return None

@@ -1,0 +1,60 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md)."""
+import decimal
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+
+def test_percentile_decimal_descaled():
+    t = pa.table({
+        "k": pa.array([1, 1, 1], type=pa.int64()),
+        "d": pa.array([decimal.Decimal("1.50"), decimal.Decimal("2.50"),
+                       decimal.Decimal("3.50")], type=pa.decimal128(10, 2)),
+    })
+    s = TpuSession()
+    g = (s.create_dataframe(t).group_by(col("k"))
+         .agg(F.percentile(col("d"), 0.5).alias("p")))
+    d = g.to_pydict()
+    assert abs(d["p"][0] - 2.5) < 1e-9, d
+
+
+def test_decimal_times_big_long_is_double():
+    """decimal x long with overflow potential computes as DOUBLE instead
+    of wrapping int64 (ADVICE medium #2)."""
+    t = pa.table({
+        "d": pa.array([decimal.Decimal("100.00")], type=pa.decimal128(10, 2)),
+        "n": pa.array([10**15], type=pa.int64()),
+    })
+    s = TpuSession()
+    out = s.create_dataframe(t).select((col("d") * col("n")).alias("x"))
+    d = out.to_pydict()
+    assert abs(d["x"][0] - 1e17) <= 1e8  # double result, no wrap / no crash
+
+
+def test_collect_list_decimal_cpu_tier():
+    t = pa.table({
+        "k": pa.array([1, 1, 2], type=pa.int64()),
+        "d": pa.array([decimal.Decimal("1.25"), decimal.Decimal("2.75"),
+                       decimal.Decimal("-3.50")], type=pa.decimal128(9, 2)),
+    })
+    s = TpuSession()
+    g = (s.create_dataframe(t).group_by(col("k"))
+         .agg(F.collect_list(col("d")).alias("l"),
+              F.collect_set(col("d")).alias("st")))
+    d = g.to_pydict()
+    got = dict(zip(d["k"], d["l"]))
+    assert sorted(got[1]) == [decimal.Decimal("1.25"), decimal.Decimal("2.75")]
+    assert got[2] == [decimal.Decimal("-3.50")]
+
+
+def test_get_json_object_single_wildcard_unwraps():
+    t = pa.table({"j": pa.array(['{"a":[{"b":1}]}', '{"a":[{"b":1},{"b":2}]}'])})
+    s = TpuSession()
+    out = s.create_dataframe(t).select(
+        F.get_json_object(col("j"), "$.a[*].b").alias("x"))
+    d = out.to_pydict()
+    assert d["x"] == ["1", "[1,2]"]
