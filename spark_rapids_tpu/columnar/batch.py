@@ -32,7 +32,9 @@ from spark_rapids_tpu import types as T
 MIN_CAPACITY = 8
 
 
-def round_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
+def round_capacity(n: int, minimum: Optional[int] = None) -> int:
+    if minimum is None:
+        minimum = MIN_CAPACITY
     """Round a row count up to the capacity bucket (next power of two)."""
     n = max(int(n), 1, minimum)
     return 1 << (n - 1).bit_length()

@@ -342,6 +342,10 @@ def conf() -> RapidsConf:
 
 def set_session_conf(c: RapidsConf) -> None:
     _local.conf = c
+    # capacity bucketing minimum is consulted deep inside kernels where no
+    # conf rides along: publish it as the module floor
+    from spark_rapids_tpu.columnar import batch as _b
+    _b.MIN_CAPACITY = max(8, int(c.get(BATCH_CAPACITY_MIN)))
 
 
 class session_conf:

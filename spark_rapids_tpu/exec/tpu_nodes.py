@@ -2146,7 +2146,9 @@ class ShuffleExchangeExec(ExchangeExec):
                     if blob is not None:
                         store.add(p, blob)
         self._store = store
-        return [[_LazyShuffleBlobs(store, p)] if store.partition_bytes(p)
+        rthreads = self.conf.get(C.SHUFFLE_READER_THREADS)
+        return [[_LazyShuffleBlobs(store, p, rthreads)]
+                if store.partition_bytes(p)
                 else [] for p in range(self.n_out)]
 
     def execute_partition(self, ctx, pidx):
@@ -2338,15 +2340,24 @@ def _pmod(h, n):
 
 
 class _LazyShuffleBlobs:
-    """A reduce partition's serialized blobs; deserializes at read time."""
+    """A reduce partition's serialized blobs; deserializes at read time.
+    Host-side decode (decompression + frame parsing) runs on the shuffle
+    reader pool (spark.rapids.shuffle.multiThreaded.reader.threads);
+    device upload stays ordered."""
 
-    def __init__(self, store, partition: int):
+    def __init__(self, store, partition: int, reader_threads: int = 1):
         self.store = store
         self.partition = partition
+        self.reader_threads = max(1, reader_threads)
 
     def batches(self):
         from spark_rapids_tpu.shuffle import serde
-        for blob in self.store.iter_partition(self.partition):
+        blobs = list(self.store.iter_partition(self.partition))
+        if self.reader_threads > 1 and len(blobs) > 1:
+            with ThreadPoolExecutor(max_workers=self.reader_threads) as pool:
+                yield from pool.map(serde.deserialize_batch, blobs)
+            return
+        for blob in blobs:
             yield serde.deserialize_batch(blob)
 
 

@@ -79,20 +79,30 @@ class PlanNode:
         return None
 
 
-def make_binder(schema: T.Schema, case_sensitive: bool = False):
+def _case_sensitive_now() -> bool:
+    from spark_rapids_tpu.config import conf as _active
+    from spark_rapids_tpu import config as _C
+    return bool(_active().get(_C.CASE_SENSITIVE))
+
+
+def make_binder(schema: T.Schema, case_sensitive=None):
     def binder(node):
         if isinstance(node, Col):
+            cs = _case_sensitive_now() if case_sensitive is None \
+                else case_sensitive
             name = node.name
             for i, f in enumerate(schema.fields):
-                if f.name == name or (not case_sensitive and f.name.lower() == name.lower()):
+                if f.name == name or (not cs and
+                                      f.name.lower() == name.lower()):
                     return BoundRef(i, f.dtype, f.name)
             raise KeyError(f"column {name!r} not found in {schema.names}")
         return node
     return binder
 
 
-def bind_expr(e: Expression, schema: T.Schema, case_sensitive: bool = False) -> Expression:
-    """Resolve Col names to BoundRefs against a child schema."""
+def bind_expr(e: Expression, schema: T.Schema, case_sensitive=None) -> Expression:
+    """Resolve Col names to BoundRefs against a child schema
+    (case sensitivity from spark.sql.caseSensitive unless forced)."""
     return e.transform(make_binder(schema, case_sensitive))
 
 
@@ -397,8 +407,12 @@ class Aggregate(PlanNode):
 def _bind_leaf(node, schema):
     if isinstance(node, Col):
         for i, f in enumerate(schema.fields):
-            if f.name == node.name or f.name.lower() == node.name.lower():
+            if f.name == node.name:
                 return BoundRef(i, f.dtype, f.name)
+        if not _case_sensitive_now():
+            for i, f in enumerate(schema.fields):
+                if f.name.lower() == node.name.lower():
+                    return BoundRef(i, f.dtype, f.name)
         raise KeyError(f"column {node.name!r} not found in {schema.names}")
     return node
 

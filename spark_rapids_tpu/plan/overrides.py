@@ -454,6 +454,23 @@ def tag_agg(fn: A.AggFunction, conf, reasons: List[str], where: str) -> None:
     if rule is None:
         reasons.append(f"{where}: aggregate {type(fn).__name__} is not supported on TPU")
         return
+    if not conf.get(C.IMPROVED_FLOAT_OPS) and isinstance(
+            fn, (A.Sum, A.Average, A.VarianceSamp, A.VariancePop,
+                 A.StddevSamp, A.StddevPop)):
+        for ch in fn.children:
+            if isinstance(ch.data_type(), (T.Float32Type, T.Float64Type)):
+                reasons.append(
+                    f"{where}: float {rule.name} accumulates in a "
+                    f"different order than CPU Spark (ULP-level diffs) — "
+                    f"disabled by spark.rapids.sql.improvedFloatOps."
+                    f"enabled=false")
+    if isinstance(fn, A.CollectSet) and not conf.get(C.INCOMPAT_ENABLED):
+        for ch in fn.children:
+            if isinstance(ch.data_type(), T.StringType):
+                reasons.append(
+                    f"{where}: collect_set over strings dedups by 64-bit "
+                    f"double-hash on device — disabled by spark.rapids."
+                    f"sql.incompatibleOps.enabled=false")
     if rule.extra is not None:
         r = rule.extra(fn)
         if r:
@@ -546,6 +563,13 @@ class SparkPlanMeta:
         elif isinstance(p, P.Join):
             for e in p.left_keys + p.right_keys:
                 tag_expression(e, self.conf, self.reasons, name)
+                if isinstance(e.data_type(), T.StringType) \
+                        and not self.conf.get(C.INCOMPAT_ENABLED):
+                    self.reasons.append(
+                        f"{name}: string join keys compare by 64-bit "
+                        f"double-hash on device (collision odds ~2^-64) — "
+                        f"disabled by spark.rapids.sql.incompatibleOps."
+                        f"enabled=false")
             if p.condition is not None:
                 tag_expression(p.condition, self.conf, self.reasons, name)
         elif isinstance(p, P.Expand):

@@ -304,15 +304,36 @@ def get_spill_framework(conf=None) -> SpillFramework:
         if conf is None:
             from spark_rapids_tpu.config import conf as _active
             conf = _active()
+        budget = _device_budget_from(conf)
         if _GLOBAL is None:
+            sd = conf.get(C.SPILL_DIR)
+            if sd:
+                os.makedirs(sd, exist_ok=True)
             _GLOBAL = SpillFramework(
-                conf.get(C.DEVICE_MEMORY_BUDGET),
+                budget,
                 conf.get(C.HOST_SPILL_LIMIT),
-                spill_dir=None)
+                spill_dir=sd or None)
         else:
-            _GLOBAL.device_budget = conf.get(C.DEVICE_MEMORY_BUDGET)
+            _GLOBAL.device_budget = budget
             _GLOBAL.host_budget = conf.get(C.HOST_SPILL_LIMIT)
         return _GLOBAL
+
+
+def _device_budget_from(conf) -> int:
+    """HBM budget = min(budgetBytes, allocFraction x detected chip HBM).
+    The fraction keeps headroom for XLA scratch on chips whose HBM the
+    runtime can report; budgetBytes remains the explicit ceiling."""
+    budget = conf.get(C.DEVICE_MEMORY_BUDGET)
+    frac = conf.get(C.DEVICE_MEMORY_FRACTION)
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats() or {}
+        total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if total:
+            budget = min(budget, int(total * frac))
+    except Exception:  # noqa: BLE001 - stats unavailable on some backends
+        pass
+    return budget
 
 
 def reset_spill_framework() -> None:

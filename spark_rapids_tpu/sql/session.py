@@ -60,8 +60,15 @@ class TpuSession:
         from spark_rapids_tpu.ops import pallas_kernels as PK
         PK.set_enabled(self.conf.get(C.PALLAS_ENABLED))
 
+    def _activate(self):
+        # name binding (case sensitivity) consults the active session conf
+        # at plan-construction time
+        from spark_rapids_tpu.config import set_session_conf
+        set_session_conf(self.conf)
+
     # -- sources -----------------------------------------------------------
     def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
+        self._activate()
         if isinstance(data, dict):
             table = pa.table(data)
         elif isinstance(data, pa.Table):
@@ -73,6 +80,7 @@ class TpuSession:
     createDataFrame = create_dataframe
 
     def read_parquet(self, *paths, columns=None) -> DataFrame:
+        self._activate()
         import os
         # hive-style partition discovery: dir of k=v subdirs -> recursive
         # file walk with the partition column reconstructed from the path
@@ -194,6 +202,19 @@ class TpuSession:
         return out
 
     def _collect_inner(self, plan: P.PlanNode) -> pa.Table:
+        if self.conf.get(C.SQL_MODE).lower() == "explainonly":
+            # plan + tag + report only; execution stays on the CPU backend
+            # with no device required (reference RapidsConf "explainOnly")
+            from spark_rapids_tpu.config import set_session_conf
+            from spark_rapids_tpu.plan.overrides import wrap_and_tag
+            from spark_rapids_tpu.exec.cpu_backend import execute_cpu
+            set_session_conf(self.conf)
+            meta = wrap_and_tag(plan, self.conf)
+            self._last_meta = meta
+            import logging
+            logging.getLogger("spark_rapids_tpu").info(
+                "\n%s", meta.explain(all_ops=True))
+            return execute_cpu(plan, self.conf.get(C.ANSI_ENABLED))
         exec_root, meta = self.prepare_execution(plan)
         explain_mode = self.conf.get(C.SQL_EXPLAIN).upper()
         if explain_mode in ("NOT_ON_TPU", "ALL"):
