@@ -93,14 +93,18 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
                          batch.live_mask())
     raise_errors(err)
     outs = [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
-    # column-stat bounds are host metadata (not pytree leaves): carry them
-    # across the jit boundary for passthrough column references
-    from spark_rapids_tpu.expr.core import Alias, BoundRef
-    for e, o in zip(exprs, outs):
-        inner = e.children[0] if isinstance(e, Alias) else e
-        if isinstance(inner, BoundRef) and inner.index < len(batch.columns):
-            o.bounds = batch.columns[inner.index].bounds
+    carry_bounds(exprs, batch.columns, outs)
     return outs
+
+
+def carry_bounds(exprs, in_cols, out_cols) -> None:
+    """Carry column-stat bounds (host metadata, not pytree leaves) across
+    a jit boundary for passthrough column references."""
+    from spark_rapids_tpu.expr.core import Alias, BoundRef
+    for e, o in zip(exprs, out_cols):
+        inner = e.children[0] if isinstance(e, Alias) else e
+        if isinstance(inner, BoundRef) and inner.index < len(in_cols):
+            o.bounds = in_cols[inner.index].bounds
 
 
 def raise_errors(err: Dict[str, jax.Array]) -> None:

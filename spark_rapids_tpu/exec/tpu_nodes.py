@@ -426,6 +426,7 @@ class ProjectExec(TpuExec):
             with op_t.ns():
                 out, errs, row_base = fn(batch, jnp.int32(pidx), row_base)
             compiled.raise_errors(errs)
+            compiled.carry_bounds(exprs, batch.columns, out.columns)
             yield out
 
 
@@ -460,6 +461,10 @@ class FilterExec(TpuExec):
             with op_t.ns():
                 out, errs = fn(batch)
             compiled.raise_errors(errs)
+            # column-stat bounds are host metadata (not pytree leaves):
+            # a filter's output columns are 1:1 row subsets of its input
+            for ic, oc in zip(batch.columns, out.columns):
+                oc.bounds = ic.bounds
             out_rows.add(out.num_rows)
             yield out
 
@@ -927,14 +932,29 @@ def _static_expr_ranges(key_cols, kinds, key_exprs):
     return np.asarray(rs, np.int64)
 
 
+def _attach_key_bounds(out_batch, spec, ranges_host) -> None:
+    """Stamp (lo, hi) column-stat bounds on a radix agg output's key
+    columns so downstream radix consumers (post-exchange merge, window
+    sort) skip their own device range probe."""
+    if ranges_host is None:
+        return
+    for i, kind in enumerate(spec.kinds):
+        if kind == R.KIND_INT and i < len(out_batch.columns):
+            lo = int(ranges_host[2 * i])
+            hi = int(ranges_host[2 * i + 1])
+            if lo <= hi:
+                out_batch.columns[i].bounds = (lo, hi)
+
+
 def _probe_pack_spec(key_cols, live, key_exprs=None):
     """Host decision: can these key columns pack into one int64 plane?
-    Returns (spec, ranges_device) or (None, None). Costs one small device
-    fetch when integer key ranges are involved and not statically
-    derivable (shared by the aggregate, window, and sort radix paths)."""
+    Returns (spec, ranges_device, ranges_host) or (None, None, None).
+    Costs one small device fetch when integer key ranges are involved and
+    not statically derivable — from the expression or from column-stat
+    bounds (shared by the aggregate, window, and sort radix paths)."""
     kinds = R.static_kinds(key_cols)
     if kinds is None:
-        return None, None
+        return None, None, None
     if R.needs_range_probe(kinds):
         ranges_host = _static_expr_ranges(key_cols, kinds, key_exprs)
         if ranges_host is not None:
@@ -948,7 +968,7 @@ def _probe_pack_spec(key_cols, live, key_exprs=None):
         ranges = jnp.zeros(2 * len(key_cols), jnp.int64)
         ranges_host = np.zeros(2 * len(key_cols), np.int64)
     spec = R.plan_packing(key_cols, ranges_host)
-    return spec, ranges
+    return spec, ranges, ranges_host
 
 
 class _AggKernels:
@@ -1019,13 +1039,16 @@ class _AggKernels:
         if self._packed_ok:
             key_cols = compiled.run_stage(self.group_exprs, batch)
             if self._bucket_layout(key_cols) is None:
-                spec, ranges = self._probe_spec(key_cols, batch.live_mask(),
-                                                self.group_exprs)
+                spec, ranges, rh = self._probe_spec(key_cols,
+                                                    batch.live_mask(),
+                                                    self.group_exprs)
                 if spec is not None:
                     fn = fuse.fused(
                         ("hashagg_packed_update", self._fp(), spec.key, ansi),
                         lambda: self._build_packed_update(ansi, spec))
-                    return fn(batch, ranges)
+                    out, errs = fn(batch, ranges)
+                    _attach_key_bounds(out, spec, rh)
+                    return out, errs
         fn = fuse.fused(("hashagg_update", self._fp(), ansi),
                         lambda: self._build_update(ansi))
         return fn(batch)
@@ -1034,12 +1057,14 @@ class _AggKernels:
         nkeys = len(self.group_exprs)
         if self._packed_ok and nkeys:
             key_cols = list(batch.columns[:nkeys])
-            spec, ranges = self._probe_spec(key_cols, batch.live_mask())
+            spec, ranges, rh = self._probe_spec(key_cols, batch.live_mask())
             if spec is not None:
                 fn = fuse.fused(
                     ("hashagg_packed_merge", self._fp(), spec.key),
                     lambda: self._build_packed_merge(spec))
-                return fn(batch, ranges)
+                out = fn(batch, ranges)
+                _attach_key_bounds(out, spec, rh)
+                return out
         fn = fuse.fused(("hashagg_merge", self._fp()),
                         lambda: self._merge_states)
         return fn(batch)
@@ -1166,7 +1191,7 @@ class _AggKernels:
             v = vals * vals if op == "sumsq" else vals
             if np.dtype(sdt.np_dtype) in (np.dtype(np.float64),
                                           np.dtype(np.float32)):
-                tot, _ = R.bucket_sum_f64(lay, v, valid)
+                tot = R.bucket_sum_f64(lay, v, valid)
                 return tot, some
             return R.bucket_sum_int(lay, v, valid), some
         if op in ("min", "max"):
@@ -1554,8 +1579,8 @@ class WindowExec(TpuExec):
         pspec = ranges = None
         if key_exprs:
             kcols = compiled.run_stage(key_exprs, batch)
-            pspec, ranges = _probe_pack_spec(kcols, batch.live_mask(),
-                                             key_exprs)
+            pspec, ranges, _ = _probe_pack_spec(kcols, batch.live_mask(),
+                                                key_exprs)
             if pspec is not None and not all(
                     k in (R.KIND_INT, R.KIND_BOOL)
                     for k in pspec.kinds[nparts:]):
@@ -2330,6 +2355,8 @@ class ShuffleExchangeExec(ExchangeExec):
                     # all n_out outputs (zero-copy partitioning); only the
                     # selection masks differ.
                     for p, sub in enumerate(fn(batch)):
+                        for ic, oc in zip(batch.columns, sub.columns):
+                            oc.bounds = ic.bounds
                         out[p].append(sub)
         return out
 
@@ -2542,11 +2569,21 @@ class _HashJoinBase(TpuExec):
     def _dense_table_for(self, build, build_keys):
         """Direct-address build table for the mask-through probe, prepared
         once per build batch (one 4-scalar fetch). Shared across actions
-        through the plan node when the build itself is (the broadcast
-        build rides the same reuse)."""
+        through the plan node when the build itself is, and across whole
+        ACTIONS through the session broadcast cache entry (the reference's
+        reused-broadcast semantics: the table is a pure function of the
+        build batch + probe key types)."""
         plan_cache = getattr(self.plan, "_dense_table_cache", None)
         if plan_cache is not None and plan_cache[0] is build:
             return plan_cache[1]
+        entry = getattr(self.plan, "_bcast_session_entry", None)
+        tkey = tuple(type(e.data_type()).__name__
+                     for e in self.plan.left_keys)
+        if entry is not None and entry["build"] is build \
+                and tkey in entry["dense"]:
+            table = entry["dense"][tkey]
+            self.plan._dense_table_cache = (build, table)
+            return table
         with self._dense_lock:
             if self._dense_cache is None or self._dense_cache[0] is not build:
                 table = None
@@ -2556,6 +2593,8 @@ class _HashJoinBase(TpuExec):
                         [e.data_type() for e in self.plan.left_keys])
                 self._dense_cache = (build, table)
                 self.plan._dense_table_cache = (build, table)
+                if entry is not None and entry["build"] is build:
+                    entry["dense"][tkey] = table
             return self._dense_cache[1]
 
     def _hash_keys(self, side: int):
@@ -2662,54 +2701,83 @@ class _HashJoinBase(TpuExec):
         mask-through batch — valid because each probe row has at most one
         candidate)."""
         how = self.plan.how
-        probe_keys = compiled.run_stage(self.plan.left_keys, probe)
-        plive = probe.live_mask()
-        bidx = J.dense_lookup(table, probe_keys, probe.num_rows,
-                              probe_live=plive)
-        matched = bidx >= 0
-        blive = build.live_mask() if build.row_mask is not None else None
-        # equi-join build KEY columns equal the probe keys on matched rows:
-        # reconstruct them from the (already evaluated) probe keys instead
-        # of a full-capacity gather
+        plan = self.plan
+        left_keys, right_keys = plan.left_keys, plan.right_keys
+        condition = plan.condition
+        # key_map: build cols reconstructable from probe keys (static
+        # decision from plan schemas)
         key_map = {}
-        for pk, rk in zip(probe_keys, self.plan.right_keys):
-            if isinstance(rk, BoundRef) and not pk.is_string \
-                    and not pk.is_nested:
-                key_map[rk.index] = pk
-        bcols = []
-        for ci, c in enumerate(build.columns):
-            pk = key_map.get(ci)
-            if pk is not None and pk.dtype == c.dtype and not c.is_string:
-                v = (pk.validity & matched) if pk.validity is not None \
-                    else matched
-                bcols.append(ColumnVector(c.dtype, pk.data, v))
-            else:
-                bcols.append(K.gather_column(c, bidx, build.num_rows,
-                                             src_live=blive))
-        if self.plan.condition is not None:
-            joined = ColumnarBatch(list(probe.columns) + bcols,
-                                   probe.num_rows, probe.row_mask)
-            [pred] = compiled.run_stage([self.plan.condition], joined)
-            cond_ok = pred.data.astype(jnp.bool_) \
-                & pred.validity_or_default(probe.capacity)
-            matched = matched & cond_ok
-        if how == "left_semi":
-            return K.mask_filter_batch(probe, matched)
-        if how == "left_anti":
-            return K.mask_filter_batch(probe, ~matched)
-        if how == "inner":
-            live = plive & matched
-            return ColumnarBatch(
-                list(probe.columns) + bcols,
-                LazyRowCount(jnp.sum(live.astype(jnp.int32))), live)
-        # left outer: every live probe row survives; build side nulls out
-        # where unmatched (or the condition failed)
-        bcols = [ColumnVector(c.dtype, c.data,
-                              (c.validity & matched) if c.validity is not None
-                              else matched, dict_unique=c.dict_unique)
-                 for c in bcols]
-        return ColumnarBatch(list(probe.columns) + bcols,
-                             probe.num_rows, probe.row_mask)
+        for ki, rk in enumerate(right_keys):
+            lt = left_keys[ki].data_type()
+            if isinstance(rk, BoundRef) and rk.index < len(build.columns):
+                c = build.columns[rk.index]
+                if lt == c.dtype and not c.is_string and not c.is_nested:
+                    key_map[rk.index] = ki
+
+        def build_fn():
+            def fn(probe, build, slot_idx, bmin):
+                plive = probe.live_mask()
+                ectx = EvalCtx(probe.columns, traced_rows(probe.num_rows),
+                               probe.capacity, False, live=plive)
+                probe_keys = [e.eval_tpu(ectx) for e in left_keys]
+                pk0 = probe_keys[0]
+                p_in = plive if pk0.validity is None \
+                    else (plive & pk0.validity)
+                bidx = J.dense_lookup_planes(slot_idx, bmin,
+                                             pk0.data.astype(jnp.int64),
+                                             p_in)
+                matched = bidx >= 0
+                blive = build.live_mask() if build.row_mask is not None \
+                    else None
+                bcols = []
+                for ci, c in enumerate(build.columns):
+                    ki = key_map.get(ci)
+                    if ki is not None:
+                        pk = probe_keys[ki]
+                        v = (pk.validity & matched) \
+                            if pk.validity is not None else matched
+                        bcols.append(ColumnVector(c.dtype, pk.data, v))
+                    else:
+                        bcols.append(K.gather_column(c, bidx, build.num_rows,
+                                                     src_live=blive))
+                if condition is not None:
+                    cctx = EvalCtx(list(probe.columns) + bcols,
+                                   traced_rows(probe.num_rows),
+                                   probe.capacity, False, live=plive)
+                    pred = condition.eval_tpu(cctx)
+                    cond_ok = pred.data.astype(jnp.bool_) \
+                        & (pred.validity if pred.validity is not None
+                           else jnp.ones(probe.capacity, jnp.bool_))
+                    matched = matched & cond_ok
+                if how == "left_semi":
+                    return K.mask_filter_batch(probe, matched)
+                if how == "left_anti":
+                    return K.mask_filter_batch(probe, ~matched)
+                if how == "inner":
+                    live = plive & matched
+                    return ColumnarBatch(
+                        list(probe.columns) + bcols,
+                        LazyRowCount(jnp.sum(live.astype(jnp.int32))), live)
+                ob = [ColumnVector(c.dtype, c.data,
+                                   (c.validity & matched)
+                                   if c.validity is not None else matched,
+                                   dict_unique=c.dict_unique)
+                      for c in bcols]
+                return ColumnarBatch(list(probe.columns) + ob,
+                                     probe.num_rows, probe.row_mask)
+            return fn
+
+        key = ("dense_probe_masked", how,
+               tuple(e.fingerprint() for e in left_keys),
+               tuple(e.fingerprint() for e in right_keys),
+               condition.fingerprint() if condition is not None else None,
+               tuple(sorted(key_map.items())))
+        fn = fuse.fused(key, build_fn)
+        out = fn(probe, build, table.slot_idx, table.bmin)
+        # probe planes pass through: carry their column-stat bounds
+        for ic, oc in zip(probe.columns, out.columns):
+            oc.bounds = ic.bounds
+        return out
 
     def _probe_one(self, probe, build, build_keys, matched_build):
         how = self.plan.how
@@ -2785,6 +2853,39 @@ class BroadcastHashJoinExec(_HashJoinBase):
             return False
         return ok(self.plan.children[1])
 
+    def _reuse_anchor(self):
+        """(CachedRelation, structural fingerprint) for the cross-action
+        broadcast cache, or (None, None). Only build subtrees reading
+        EXACTLY ONE cached relation participate: the reused entry lives ON
+        that relation (so it is dropped with the cache, never pins HBM
+        past it, and object identity cannot be confused by recycled ids —
+        the reference's exchange-reuse map scopes lifetime the same way)."""
+        rels = []
+
+        def walk(n):
+            if isinstance(n, P.CachedRelation):
+                rels.append(n)
+                return "cached"
+            parts = tuple(walk(c) for c in n.children)
+            if isinstance(n, P.Filter):
+                return ("filter", n.condition.fingerprint(), parts)
+            if isinstance(n, P.Project):
+                return ("project",
+                        tuple(e.fingerprint() for e in n.exprs), parts)
+            if isinstance(n, P.Limit):
+                return ("limit", n.n, parts)
+            # _cacheable_build_plan() admits only the node kinds above;
+            # anything else poisons the key so no reuse can happen
+            rels.append(None)
+            rels.append(None)
+            return ("uncacheable",)
+
+        fp = walk(self.plan.children[1])
+        if len(rels) != 1 or rels[0] is None:
+            return None, None
+        return rels[0], (fp, tuple(e.fingerprint()
+                                   for e in self.plan.right_keys))
+
     def _build_side(self) -> ColumnarBatch:
         with self._build_lock:
             if self._build is None:
@@ -2792,6 +2893,26 @@ class BroadcastHashJoinExec(_HashJoinBase):
                 if cached is not None and self._cacheable_build_plan():
                     self._build, self._build_keys = cached
                     return self._build
+                anchor = skey = None
+                if self._cacheable_build_plan():
+                    anchor, skey = self._reuse_anchor()
+                if anchor is not None:
+                    store = getattr(anchor, "_bcast_reuse", {})
+                    entry = store.get(skey)
+                    # entry is valid only for the materialization it was
+                    # built from (identity checked against LIVE state: a
+                    # re-cache replaces the list and invalidates)
+                    if entry is not None \
+                            and entry["mat"] is not anchor.materialized:
+                        del store[skey]  # stale: stop pinning old batches
+                        entry = None
+                    if entry is not None:
+                        self._build = entry["build"]
+                        self._build_keys = entry["keys"]
+                        self.plan._bcast_cache = (self._build,
+                                                  self._build_keys)
+                        self.plan._bcast_session_entry = entry
+                        return self._build
                 build_t = self.metrics.metric(M.BUILD_TIME)
                 right = self.children[1]
                 batches = []
@@ -2806,7 +2927,16 @@ class BroadcastHashJoinExec(_HashJoinBase):
                         self._build = empty_like_schema(right.schema)
                     self._build_keys = compiled.run_stage(
                         self.plan.right_keys, self._build)
-                if self._cacheable_build_plan():
+                if anchor is not None and anchor.materialized is not None:
+                    entry = {"build": self._build, "keys": self._build_keys,
+                             "dense": {}, "mat": anchor.materialized}
+                    store = getattr(anchor, "_bcast_reuse", None)
+                    if store is None:
+                        store = anchor._bcast_reuse = {}
+                    if len(store) >= 8:
+                        store.pop(next(iter(store)))
+                    store[skey] = entry
+                    self.plan._bcast_session_entry = entry
                     self.plan._bcast_cache = (self._build, self._build_keys)
         return self._build
 

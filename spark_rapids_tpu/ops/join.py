@@ -123,11 +123,22 @@ def prepare_dense_build(build_keys: List[ColumnVector], build_rows: int,
                            max_dup, bcap, build_rows)
 
 
+def dense_lookup_planes(slot_idx: jax.Array, bmin, pv: jax.Array,
+                        p_in: jax.Array) -> jax.Array:
+    """Traced core of the sync-free unique-key probe: int32 build row
+    index per probe row, -1 when unmatched. Shared by the eager path
+    below and the fused masked-probe kernel (exec/tpu_nodes)."""
+    span = slot_idx.shape[0]
+    slot = pv - bmin
+    inside = p_in & (slot >= 0) & (slot < span)
+    sl = jnp.where(inside, slot, 0).astype(jnp.int32)
+    return jnp.where(inside, slot_idx[sl], -1)
+
+
 def dense_lookup(table: DenseBuildTable, probe_keys: List[ColumnVector],
                  probe_rows: int, probe_live=None) -> jax.Array:
     """Sync-free unique-key probe: int32[pcap] build row index per probe
     row, -1 when unmatched. Requires table.max_dup <= 1."""
-    pcap = probe_keys[0].capacity
     pv = probe_keys[0].data.astype(jnp.int64)
     # masked batches have live rows at ARBITRARY positions: combine the
     # column validity with the live mask directly, never arange<num_rows
@@ -136,10 +147,7 @@ def dense_lookup(table: DenseBuildTable, probe_keys: List[ColumnVector],
             else (probe_live & probe_keys[0].validity)
     else:
         p_in = probe_keys[0].validity_or_default(probe_rows)
-    slot = pv - table.bmin
-    inside = p_in & (slot >= 0) & (slot < table.span)
-    sl = jnp.where(inside, slot, 0).astype(jnp.int32)
-    return jnp.where(inside, table.slot_idx[sl], -1)
+    return dense_lookup_planes(table.slot_idx, table.bmin, pv, p_in)
 
 
 def join_pairs(build_keys: List[ColumnVector], build_rows: int,

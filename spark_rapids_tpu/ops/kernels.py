@@ -430,7 +430,7 @@ def gather_column(col: ColumnVector, indices: jax.Array, src_rows: int,
     if isinstance(col.dtype, (T.ArrayType, T.MapType)):
         return _gather_list_like(col, safe, valid)
     data = col.data[safe]
-    return ColumnVector(col.dtype, data, valid)
+    return ColumnVector(col.dtype, data, valid, bounds=col.bounds)
 
 
 def _gather_list_like(col: ColumnVector, safe: jax.Array, valid: jax.Array
@@ -639,6 +639,15 @@ def concat_batches(batches: List[ColumnarBatch]) -> ColumnarBatch:
     return ColumnarBatch(out_cols, total)
 
 
+def _union_bounds(cols: List[ColumnVector]):
+    """Conservative (min, max) union across concat inputs; None if any
+    input lacks bounds (host metadata — see ColumnVector.bounds)."""
+    bs = [c.bounds for c in cols]
+    if any(b is None for b in bs):
+        return None
+    return (min(b[0] for b in bs), max(b[1] for b in bs))
+
+
 def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> ColumnVector:
     dtype = cols[0].dtype
     if any(c.is_dict for c in cols) and not all(c.is_dict for c in cols):
@@ -756,7 +765,7 @@ def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> Colu
     merged = jnp.concatenate([c.data[:r] for c, r in zip(cols, rows)])
     if cap - merged.shape[0] > 0:
         merged = jnp.concatenate([merged, jnp.zeros(cap - merged.shape[0], merged.dtype)])
-    return ColumnVector(dtype, merged, validity)
+    return ColumnVector(dtype, merged, validity, bounds=_union_bounds(cols))
 
 
 # ---------------------------------------------------------------------------

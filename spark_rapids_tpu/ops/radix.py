@@ -439,9 +439,13 @@ def seg_first_last(op: str, vals_sorted: jax.Array, valid_sorted: jax.Array,
 
 #: max total packed bits for the scatter-bucket path (8M-slot targets)
 BUCKET_BITS = 23
-#: per-bucket row-count bound under which 16-bit balanced digits cannot
-#: overflow an i32 accumulator (|digit| <= 2^15, count <= 2^15)
-_LIMB_COUNT_LIMIT = 1 << 15
+#: per-bucket row-count bound for the 16-bit-digit f64 sum: |digit| can
+#: reach 2^16 at the top of the max binade (|s| < 2^48), so counts up to
+#: 2^14 keep the i32 accumulator under 2^30
+_LIMB_COUNT_LIMIT = 1 << 14
+#: int sums keep the original 2^15 bound: their 16-bit balanced digits
+#: are strictly |d| <= 2^15 (unlike the f64 path's rounded 2^16 corner)
+_INT_LIMB_COUNT_LIMIT = 1 << 15
 
 
 class BucketLayout:
@@ -491,41 +495,59 @@ def bucket_count(lay: BucketLayout, valid) -> jax.Array:
 
 
 def bucket_sum_int(lay: BucketLayout, vals, valid) -> jax.Array:
-    """Exact mod-2^64 integer sum per bucket. Fast path: four i32 limb
-    scatters (needs per-bucket counts <= 2^15); skew fallback: one i64
-    scatter (slow but rare). Picked at runtime by lax.cond — no sync."""
+    """Exact mod-2^64 integer sum per bucket from balanced i32 limb
+    scatters. Limb width adapts to bucket depth (scatters are ~a full
+    batch pass each on this hardware): counts <= 2^9 take three 22-bit
+    limbs, counts <= 2^15 four 16-bit limbs (|digit| <= 2^15, so
+    2^15 * 2^15 = 2^30 fits i32), pathological skew one slow i64
+    scatter. Picked at runtime by lax.cond — no sync."""
     v = jnp.where(valid, vals.astype(jnp.int64), jnp.int64(0))
     sb = _safe_bucket(lay, valid)
 
-    def limb_path(_):
-        x = v
-        acc = jnp.zeros(lay.nb, jnp.int64)
-        for i in range(4):
-            if i < 3:
-                d = ((x + jnp.int64(1 << 15)) & jnp.int64(0xFFFF)) \
-                    - jnp.int64(1 << 15)
-                x = (x - d) >> jnp.int64(16)
-            else:
-                # top 16 bits: wraparound keeps mod-2^64 exactness
-                d = ((x + jnp.int64(1 << 15)) & jnp.int64(0xFFFF)) \
-                    - jnp.int64(1 << 15)
-            s = jax.ops.segment_sum(d.astype(jnp.int32), sb,
-                                    num_segments=lay.nb + 1)[:lay.nb]
-            acc = acc + (s.astype(jnp.int64) << jnp.int64(16 * i))
-        return acc
+    def limb_path(width: int, nlimbs: int):
+        half = jnp.int64(1 << (width - 1))
+        mask = jnp.int64((1 << width) - 1)
+
+        def go(_):
+            x = v
+            acc = jnp.zeros(lay.nb, jnp.int64)
+            for i in range(nlimbs):
+                d = ((x + half) & mask) - half
+                if i < nlimbs - 1:
+                    x = (x - d) >> jnp.int64(width)
+                # else: top limb truncates; wraparound keeps mod-2^64
+                s = jax.ops.segment_sum(d.astype(jnp.int32), sb,
+                                        num_segments=lay.nb + 1)[:lay.nb]
+                acc = acc + (s.astype(jnp.int64) << jnp.int64(width * i))
+            return acc
+        return go
 
     def slow_path(_):
         return jax.ops.segment_sum(v, sb, num_segments=lay.nb + 1)[:lay.nb]
 
-    return lax.cond(lay.max_cnt <= _LIMB_COUNT_LIMIT, limb_path,
-                    slow_path, None)
+    return lax.cond(
+        lay.max_cnt <= (1 << 9), limb_path(22, 3),
+        lambda _: lax.cond(lay.max_cnt <= _INT_LIMB_COUNT_LIMIT,
+                           limb_path(16, 4), slow_path, None),
+        None)
 
 
-def bucket_sum_f64(lay: BucketLayout, vals, valid) -> Tuple[jax.Array, jax.Array]:
-    """Float sum per bucket via three balanced base-2^16 digit scatters of
-    a 47-bit fixed-point representation below the batch max exponent —
-    error <= ~1 ulp of the device's own f32-pair f64. NaN/Inf patched via
-    two extra i32 count scatters. Returns (sum, nvalid)."""
+#: shallow-bucket bound for the 2-digit f64 sum: |digit| = round(s/2^24)
+#: can reach 2^24 at the top of the max binade (|s| < 2^48), so counts up
+#: to 64 keep the i32 accumulator under 2^31
+_LIMB2_COUNT_LIMIT = 1 << 6
+
+
+def bucket_sum_f64(lay: BucketLayout, vals, valid) -> jax.Array:
+    """Float sum per bucket via balanced fixed-point digit scatters of a
+    47-bit representation below the batch max exponent — error <= ~1 ulp
+    of the device's own f32-pair f64. Scatters are the dominant cost of
+    the bucket path on this hardware (~each a full pass over the batch),
+    so the digit count adapts to bucket depth: shallow buckets (the
+    high-cardinality-groupby shape) take TWO base-2^24 digits, deeper
+    ones three base-2^16 digits, pathological skew one slow f64 scatter.
+    The NaN/Inf flag scatters only execute when the batch actually
+    contains a special (one cheap any() reduce gates them)."""
     v = vals.astype(jnp.float64)
     nan = jnp.isnan(v) & valid
     pinf = (v == jnp.inf) & valid
@@ -533,45 +555,62 @@ def bucket_sum_f64(lay: BucketLayout, vals, valid) -> Tuple[jax.Array, jax.Array
     finite = valid & ~nan & ~pinf & ~ninf
     clean = jnp.where(finite, v, jnp.float64(0.0))
     sb = _safe_bucket(lay, valid)
-    nvalid = bucket_count(lay, valid)
 
     m = jnp.max(jnp.abs(clean))
     scale = _exponent_scale(m) * np.float64(2.0 ** 11)  # 47 bits below E
+    s = clean * scale
 
-    def limb_path(_):
-        s = clean * scale
-        d0 = jnp.round(s / np.float64(2.0 ** 32))
-        r0 = s - d0 * np.float64(2.0 ** 32)
-        d1 = jnp.round(r0 / np.float64(2.0 ** 16))
-        d2 = jnp.round(r0 - d1 * np.float64(2.0 ** 16))
-        tot = jnp.zeros(lay.nb, jnp.float64)
-        for d, w in ((d0, 2.0 ** 32), (d1, 2.0 ** 16), (d2, 1.0)):
-            acc = jax.ops.segment_sum(d.astype(jnp.int32), sb,
-                                      num_segments=lay.nb + 1)[:lay.nb]
-            tot = tot + acc.astype(jnp.float64) * np.float64(w)
-        return tot / scale
+    def digits_path(widths):
+        def go(_):
+            tot = jnp.zeros(lay.nb, jnp.float64)
+            rem = s
+            shift = sum(widths)
+            for w in widths:
+                shift -= w
+                d = jnp.round(rem / np.float64(2.0 ** shift)) if shift \
+                    else jnp.round(rem)
+                if shift:
+                    rem = rem - d * np.float64(2.0 ** shift)
+                acc = jax.ops.segment_sum(d.astype(jnp.int32), sb,
+                                          num_segments=lay.nb + 1)[:lay.nb]
+                tot = tot + acc.astype(jnp.float64) * np.float64(2.0 ** shift)
+            return tot / scale
+        return go
 
     def slow_path(_):
         return jax.ops.segment_sum(clean, sb,
                                    num_segments=lay.nb + 1)[:lay.nb]
 
-    total = lax.cond(lay.max_cnt <= _LIMB_COUNT_LIMIT, limb_path,
-                     slow_path, None)
+    total = lax.cond(
+        lay.max_cnt <= _LIMB2_COUNT_LIMIT, digits_path((24, 24)),
+        lambda _: lax.cond(lay.max_cnt <= _LIMB_COUNT_LIMIT,
+                           digits_path((16, 16, 16)), slow_path, None),
+        None)
 
-    # specials: (nan<<1 | pinf) and ninf counts -> two i32 OR-style maxes
-    has_nan = jax.ops.segment_max(
-        jnp.where(nan, 1, 0).astype(jnp.int32), sb,
-        num_segments=lay.nb + 1)[:lay.nb] > 0
-    has_pinf = jax.ops.segment_max(
-        jnp.where(pinf, 1, 0).astype(jnp.int32), sb,
-        num_segments=lay.nb + 1)[:lay.nb] > 0
-    has_ninf = jax.ops.segment_max(
-        jnp.where(ninf, 1, 0).astype(jnp.int32), sb,
-        num_segments=lay.nb + 1)[:lay.nb] > 0
+    any_special = nan | pinf | ninf
+
+    def exact_flags(_):
+        has_nan = jax.ops.segment_max(
+            jnp.where(nan, 1, 0).astype(jnp.int32), sb,
+            num_segments=lay.nb + 1)[:lay.nb] > 0
+        has_pinf = jax.ops.segment_max(
+            jnp.where(pinf, 1, 0).astype(jnp.int32), sb,
+            num_segments=lay.nb + 1)[:lay.nb] > 0
+        has_ninf = jax.ops.segment_max(
+            jnp.where(ninf, 1, 0).astype(jnp.int32), sb,
+            num_segments=lay.nb + 1)[:lay.nb] > 0
+        return has_nan, has_pinf, has_ninf
+
+    def no_flags(_):
+        f = jnp.zeros(lay.nb, jnp.bool_)
+        return f, f, f
+
+    has_nan, has_pinf, has_ninf = lax.cond(jnp.any(any_special), exact_flags,
+                                           no_flags, None)
     out = jnp.where(has_pinf, jnp.float64(np.inf), total)
     out = jnp.where(has_ninf, jnp.float64(-np.inf), out)
     out = jnp.where(has_nan | (has_pinf & has_ninf), jnp.float64(np.nan), out)
-    return out, nvalid
+    return out
 
 
 def bucket_minmax_i32(op, lay: BucketLayout, vals, valid, init) -> jax.Array:

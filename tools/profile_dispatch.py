@@ -1,0 +1,116 @@
+"""Per-dispatch blocking profile of a bench query: wraps fuse.fused so
+every fused stage call blocks and is timed individually, exposing where
+wall-clock goes inside the async pipeline. Also wraps the non-fused sync
+points (prepare_dense_build)."""
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+ROWS = int(os.environ.get("ROWS", 30_000_000))
+ORDERS = ROWS // 10
+Q = os.environ.get("Q", "q3join")
+
+import jax
+import pyarrow as pa
+from spark_rapids_tpu.exec import fuse
+from spark_rapids_tpu.ops import join as J
+
+TIMES = defaultdict(float)
+COUNTS = defaultdict(int)
+_orig_fused = fuse.fused
+
+
+def timed_fused(key, builder):
+    fn = _orig_fused(key, builder)
+
+    def wrapper(*a, **k):
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        name = str(key[0]) + (":" + str(key[1]) if len(key) > 1 and isinstance(key[1], str) else "")
+        TIMES[name] += dt
+        COUNTS[name] += 1
+        return out
+    return wrapper
+
+
+fuse.fused = timed_fused
+# tpu_nodes imported fuse as a module attr, so patching the module works
+# only if call sites do fuse.fused(...) — they do.
+
+_orig_prep = J.prepare_dense_build
+
+
+def timed_prep(*a, **k):
+    t0 = time.perf_counter()
+    out = _orig_prep(*a, **k)
+    TIMES["prepare_dense_build"] += time.perf_counter() - t0
+    COUNTS["prepare_dense_build"] += 1
+    return out
+
+
+J.prepare_dense_build = timed_prep
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+rng = np.random.default_rng(42)
+t = pa.table({
+    "l_orderkey": rng.integers(0, ORDERS, ROWS).astype(np.int64),
+    "l_quantity": rng.integers(1, 51, ROWS).astype(np.float64),
+    "l_extendedprice": np.round(rng.uniform(900.0, 105000.0, ROWS), 2),
+    "l_discount": np.round(rng.uniform(0.0, 0.10, ROWS), 2),
+    "l_shipdate": rng.integers(8400, 10600, ROWS).astype(np.int32),
+})
+orders = pa.table({
+    "o_orderkey": np.arange(ORDERS, dtype=np.int64),
+    "o_orderdate": rng.integers(8400, 10600, ORDERS).astype(np.int32),
+})
+
+sess = TpuSession()
+print("[prof] uploading...", file=sys.stderr, flush=True)
+cached = sess.create_dataframe(t).cache(); cached.count()
+ocached = sess.create_dataframe(orders).cache(); ocached.count()
+SHFL_ROWS = min(ROWS, 8_000_000)
+sharded = sess.create_dataframe(t.slice(0, SHFL_ROWS), num_partitions=4).cache()
+sharded.count()
+
+
+def q3join():
+    li = cached.filter(col("l_shipdate") > lit(9100))
+    od = ocached.filter(col("o_orderdate") < lit(9500))
+    j = li.join(od, on=[(col("l_orderkey"), col("o_orderkey"))], how="inner")
+    g = (j.select(col("l_orderkey"),
+                  (col("l_extendedprice") * (lit(1.0) - col("l_discount"))).alias("rev"))
+         .group_by(col("l_orderkey")).agg(F.sum("rev").alias("rev")))
+    top = g.order_by(col("rev").desc(), col("l_orderkey").asc()).limit(10)
+    return top.to_pydict()
+
+
+def q72shfl():
+    g = (sharded.select((col("l_orderkey") % lit(100_000)).alias("k"),
+                        col("l_quantity"))
+         .group_by(col("k"))
+         .agg(F.sum("l_quantity").alias("s"), F.count("l_quantity").alias("c")))
+    out = g.agg(F.count(col("k")).alias("n"), F.sum(col("s")).alias("ts"),
+                F.sum(col("c")).alias("tc"))
+    return out.to_pydict()
+
+
+for Q in [q for q in os.environ.get("QS", "q3join,q72shfl").split(",")]:
+    fn = {"q3join": q3join, "q72shfl": q72shfl}[Q]
+    print(f"[prof] warmup {Q}...", file=sys.stderr, flush=True)
+    t0 = time.perf_counter(); fn(); warm = time.perf_counter() - t0
+    TIMES.clear(); COUNTS.clear()
+    t0 = time.perf_counter(); fn(); total = time.perf_counter() - t0
+    print(f"[prof] {Q} rows={ROWS} warm={warm:.2f}s steady={total:.3f}s (blocking-instrumented)")
+    acc = 0.0
+    for k in sorted(TIMES, key=lambda k: -TIMES[k]):
+        print(f"  {TIMES[k]*1e3:8.1f} ms  x{COUNTS[k]:<3d} {k}")
+        acc += TIMES[k]
+    print(f"  {'-'*40}\n  {acc*1e3:8.1f} ms accounted; {(total-acc)*1e3:.1f} ms other")

@@ -1,0 +1,163 @@
+"""Which groupby backbone is fastest at 33M rows -> ~3M groups on this
+chip? block_until_ready does NOT reliably block on the axon backend, so
+every candidate ends in a scalar reduction that we fetch; the ~80ms
+fetch round trip is a shared constant. Data generated on device."""
+import time
+import spark_rapids_tpu  # noqa: F401  (x64 + persistent compile cache)
+import jax
+import jax.numpy as jnp
+
+N = 1 << 23  # 8.4M capacity (upload-bound tunnel)
+SPAN = 750_000
+
+import numpy as _np
+_rng = _np.random.default_rng(0)
+key = jax.device_put(_rng.integers(0, SPAN, N).astype(_np.int32))
+val = jax.device_put((_rng.random(N, _np.float32) * 1e5))
+val64 = val.astype(jnp.float64)
+live = jax.device_put(_rng.random(N) < 0.5)
+print("uploaded", flush=True)
+float(jnp.sum(val))  # force
+print("forced", flush=True)
+
+
+def t(name, fn, *a, reps=3):
+    float(fn(*a))  # compile + run
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(fn(*a))
+        ts.append(time.perf_counter() - t0)
+    print(f"{name}: {min(ts)*1e3:.1f} ms (incl ~80ms fetch)", flush=True)
+
+
+@jax.jit
+def baseline(key):
+    return jnp.sum(key[:16])
+
+
+@jax.jit
+def argsort_i64(key, live):
+    packed = jnp.where(live, key.astype(jnp.int64), jnp.int64(1) << 40)
+    o = jnp.argsort(packed)
+    return o[0] + o[-1]
+
+
+@jax.jit
+def argsort_i32(key, live):
+    packed = jnp.where(live, key, jnp.int32(SPAN + 5))
+    o = jnp.argsort(packed)
+    return o[0] + o[-1]
+
+
+@jax.jit
+def sort2op(key, live):
+    packed = jnp.where(live, key, jnp.int32(SPAN + 5))
+    iota = jnp.arange(N, dtype=jnp.int32)
+    sk, si = jax.lax.sort((packed, iota), num_keys=1)
+    return sk[0] + si[-1]
+
+
+@jax.jit
+def sort3op(key, val, live):
+    packed = jnp.where(live, key, jnp.int32(SPAN + 5))
+    iota = jnp.arange(N, dtype=jnp.int32)
+    sk, sv, si = jax.lax.sort((packed, val, iota), num_keys=1)
+    return sk[0].astype(jnp.float32) + sv[-1]
+
+
+@jax.jit
+def sort_f32val(key, val, live):
+    packed = jnp.where(live, key, jnp.int32(SPAN + 5))
+    sk, sv = jax.lax.sort((packed, val), num_keys=1)
+    return sk[0].astype(jnp.float32) + sv[-1]
+
+
+@jax.jit
+def gather_f64(order, val64):
+    return val64[order][0]
+
+
+@jax.jit
+def cumsum_i64(key):
+    return jnp.cumsum(key.astype(jnp.int64))[-1]
+
+
+@jax.jit
+def cumsum_f64(val64):
+    return jnp.cumsum(val64)[-1]
+
+
+@jax.jit
+def scatter_i32(key, live):
+    v = jnp.where(live, 1, 0).astype(jnp.int32)
+    return jax.ops.segment_sum(v, key, num_segments=SPAN)[0]
+
+
+@jax.jit
+def scatter_f32(key, val, live):
+    v = jnp.where(live, val, 0.0)
+    return jax.ops.segment_sum(v, key, num_segments=SPAN)[0]
+
+
+@jax.jit
+def scatter_f64(key, val64, live):
+    v = jnp.where(live, val64, 0.0)
+    return jax.ops.segment_sum(v, key, num_segments=SPAN)[0]
+
+
+@jax.jit
+def full_sort_groupby_i32(key, val64, live):
+    packed = jnp.where(live, key, jnp.int32(SPAN + 5))
+    order = jnp.argsort(packed, stable=True)
+    sk = packed[order]
+    sv = jnp.where(live[order], val64[order], 0.0)
+    s = jnp.cumsum(sv)
+    bound = jnp.concatenate([jnp.ones(1, jnp.bool_), sk[1:] != sk[:-1]])
+    gid = jnp.cumsum(bound.astype(jnp.int32)) - 1
+    return s[-1] + gid[-1].astype(jnp.float64)
+
+
+@jax.jit
+def full_sort_groupby_i64(key, val64, live):
+    packed = jnp.where(live, key.astype(jnp.int64), jnp.int64(1) << 40)
+    order = jnp.argsort(packed, stable=True)
+    sk = packed[order]
+    sv = jnp.where(live[order], val64[order], 0.0)
+    s = jnp.cumsum(sv)
+    bound = jnp.concatenate([jnp.ones(1, jnp.bool_), sk[1:] != sk[:-1]])
+    gid = jnp.cumsum(bound.astype(jnp.int32)) - 1
+    return s[-1] + gid[-1].astype(jnp.float64)
+
+
+@jax.jit
+def dense_scatter_groupby(key, val64, live):
+    """q3 shape: dense int key -> direct 2-limb scatter + count."""
+    scaled = jnp.where(live, val64 * (1 << 16), 0.0)
+    hi = jnp.floor(scaled / (1 << 24)).astype(jnp.int32)
+    lo = (scaled - hi.astype(jnp.float64) * (1 << 24)).astype(jnp.int32)
+    shi = jax.ops.segment_sum(hi, key, num_segments=SPAN)
+    slo = jax.ops.segment_sum(lo, key, num_segments=SPAN)
+    cnt = jax.ops.segment_sum(jnp.where(live, 1, 0).astype(jnp.int32), key,
+                              num_segments=SPAN)
+    tot = (shi.astype(jnp.float64) * (1 << 24) + slo.astype(jnp.float64)) / (1 << 16)
+    return tot[0] + cnt[-1].astype(jnp.float64)
+
+
+t("baseline tiny fetch", baseline, key)
+t("argsort i64-packed", argsort_i64, key, live)
+t("argsort i32-packed", argsort_i32, key, live)
+t("lax.sort 2-op (k,iota)", sort2op, key, live)
+t("lax.sort 3-op (k,f32,iota)", sort3op, key, val, live)
+t("lax.sort 2-op (k,f32)", sort_f32val, key, val, live)
+order = jnp.argsort(key)
+int(order[0])
+t("random gather f64 by order", gather_f64, order, val64)
+t("cumsum i64 33M", cumsum_i64, key)
+t("cumsum f64 33M", cumsum_f64, val64)
+t("segment_sum i32 33M->3M", scatter_i32, key, live)
+t("segment_sum f32 33M->3M", scatter_f32, key, val, live)
+t("segment_sum f64 33M->3M", scatter_f64, key, val64, live)
+t("FULL sort-groupby i32 pack", full_sort_groupby_i32, key, val64, live)
+t("FULL sort-groupby i64 pack", full_sort_groupby_i64, key, val64, live)
+t("FULL dense-scatter groupby", dense_scatter_groupby, key, val64, live)

@@ -1,0 +1,58 @@
+"""Measure what a host sync actually costs on the tunneled axon device,
+and whether block_until_ready really blocks."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+N = 30_000_000
+rng = np.random.default_rng(0)
+x = jax.device_put(rng.uniform(0, 1e9, N).astype(np.float32))
+jax.block_until_ready(x)
+
+
+def t(name, fn, reps=3):
+    fn()  # warm/compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    print(f"{name}: {min(ts)*1e3:.1f} ms")
+
+
+@jax.jit
+def tiny(a):
+    return a + 1.0
+
+
+@jax.jit
+def sum30(v):
+    return jnp.sum(v)
+
+
+@jax.jit
+def sort30(v):
+    return jnp.sort(v)
+
+
+@jax.jit
+def argsort30(v):
+    return jnp.argsort(v)
+
+
+one = jax.device_put(np.float32(1.0))
+
+# 1. fetch-only round trip on a tiny jitted op
+t("tiny jit dispatch+fetch", lambda: float(tiny(one)))
+# 2. big reduction + scalar fetch
+t("sum 30M + fetch", lambda: float(sum30(x)))
+# 3. sort dispatch with block_until_ready (does it block?)
+t("sort 30M block_until_ready", lambda: jax.block_until_ready(sort30(x)))
+# 4. sort + fetch one element (forces completion for real)
+t("sort 30M + fetch[0]", lambda: float(sort30(x)[0]))
+# 5. argsort + fetch
+t("argsort 30M + fetch[0]", lambda: int(argsort30(x)[0]))
+# 6. back-to-back dependent syncs (2 fetches)
+t("two dependent tiny fetches",
+  lambda: (float(tiny(one)), float(tiny(one))))
