@@ -1,0 +1,830 @@
+"""Higher-order functions over arrays and maps (lambda expressions).
+
+Reference parity: sql-plugin higherOrderFunctions.scala (GpuArrayTransform,
+GpuArrayExists, GpuArrayFilter, GpuTransformKeys, GpuTransformValues,
+GpuMapFilter, GpuNamedLambdaVariable/GpuLambdaFunction) plus ArrayForAll,
+ArrayAggregate and ZipWith from Spark's higherOrderFunctions.
+
+TPU-first design: the lambda body is an ordinary expression tree that
+evaluates ONCE over the flattened ELEMENT plane (child column of the
+array), not per row — a nested column is already a contiguous plane, so a
+lambda over N rows of K-element arrays is one fused elementwise pass over
+N*K lanes. Lambda variables bind to element-plane columns through the
+EvalCtx; outer row references are gathered to element positions by the
+row-ownership segment map (one searchsorted per stage, shared).
+
+aggregate()/reduce() is a sequential per-row fold with an arbitrary merge
+lambda — inherently order-dependent, so it runs on the CPU tier
+(supported_on_tpu=False), mirroring the reference's unsupported-op
+fallback discipline.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector
+from spark_rapids_tpu.expr.core import (
+    CpuCol, EvalCtx, Expression, SparkException, _valid_of, _wrap,
+)
+from spark_rapids_tpu.expr.complex import _element_segments, _leaf_cpu_col
+
+_ids = itertools.count()
+
+#: lambda-variable bindings for the CPU tier (TPU bindings ride on the
+#: EvalCtx). Thread-local: partitions evaluate concurrently.
+_tls = threading.local()
+
+
+def _cpu_bindings() -> dict:
+    if not hasattr(_tls, "b"):
+        _tls.b = {}
+    return _tls.b
+
+
+class _bound_cpu:
+    """Scoped CPU-tier lambda bindings: mutates the live thread-local
+    dict in place (never swaps the object — nested folds re-fetch it)."""
+
+    def __init__(self, bindings: dict):
+        self.bindings = bindings
+
+    def __enter__(self):
+        b = _cpu_bindings()
+        self.saved = {k: b.get(k, _MISSING) for k in self.bindings}
+        b.update(self.bindings)
+
+    def __exit__(self, *exc):
+        b = _cpu_bindings()
+        for k, v in self.saved.items():
+            if v is _MISSING:
+                b.pop(k, None)
+            else:
+                b[k] = v
+
+
+_MISSING = object()
+
+
+class LambdaVar(Expression):
+    """A named lambda parameter (reference GpuNamedLambdaVariable): a leaf
+    that resolves to whatever column the enclosing HOF bound it to."""
+
+    def __init__(self, dtype: T.DataType, name: str):
+        self.children = []
+        self.dtype = dtype
+        self.name = name
+        self.var_id = next(_ids)
+
+    def data_type(self):
+        return self.dtype
+
+    def _params(self):
+        # the id is deliberately NOT part of the fingerprint: two lambdas
+        # with the same structure must share a compiled kernel. Shadowing
+        # is disambiguated by the name + nesting depth at build time.
+        return f"{self.name}:{self.dtype!r}"
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        binding = getattr(ctx, "lambda_bindings", {}).get(self.var_id)
+        if binding is None:
+            raise SparkException(f"unbound lambda variable {self.name}")
+        return binding
+
+    def eval_cpu(self, cols, ansi=False):
+        binding = _cpu_bindings().get(self.var_id)
+        if binding is None:
+            raise SparkException(f"unbound lambda variable {self.name}")
+        return binding
+
+
+def make_lambda(fn: Callable, arg_types: Sequence[T.DataType],
+                names: Sequence[str]) -> tuple:
+    """Build (body, vars) from a Python callable over Expression args."""
+    vs = [LambdaVar(dt, nm) for dt, nm in zip(arg_types, names)]
+    body = _wrap(fn(*vs))
+    return body, vs
+
+
+class _OuterCols:
+    """Lazily gathers outer-row columns to element positions so BoundRefs
+    inside a lambda body see element-capacity columns. Gathers happen at
+    most once per referenced column per stage (all inside the same trace,
+    so XLA dedups further)."""
+
+    def __init__(self, row_cols, seg, in_range):
+        self._rows = row_cols
+        self._seg = seg
+        self._in_range = in_range
+        self._cache = {}
+
+    def __len__(self):
+        return len(self._rows)
+
+    def __getitem__(self, i):
+        out = self._cache.get(i)
+        if out is None:
+            from spark_rapids_tpu.ops import kernels as K
+            c = self._rows[i]
+            out = K.gather_column(
+                c, jnp.where(self._in_range, self._seg, -1), c.capacity)
+            self._cache[i] = out
+        return out
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._rows)))
+
+
+def _element_ctx(ctx: EvalCtx, arr: ColumnVector, bindings: dict):
+    """EvalCtx over the element plane of `arr`, with outer refs gathered
+    and `bindings` (var_id -> element ColumnVector) installed. Returns
+    (ectx, seg, in_range, start)."""
+    cap = arr.capacity
+    off = arr.data["offsets"]
+    first_child = (arr.data.get("child") or arr.data.get("keys"))
+    child_cap = first_child.capacity
+    seg = _element_segments(off[: cap + 1], cap, child_cap)
+    e = jnp.arange(child_cap, dtype=jnp.int32)
+    row_live = ctx.row_mask & _valid_of(arr, ctx)
+    from spark_rapids_tpu.ops import kernels as K
+    live_at_e = K.gather_column(
+        ColumnVector(T.BOOLEAN, row_live, None), seg, cap).data
+    in_range = (e < off[cap]) & live_at_e.astype(jnp.bool_)
+    ectx = EvalCtx([], jnp.sum(in_range.astype(jnp.int32)), child_cap,
+                   ctx.ansi, live=in_range,
+                   partition_id=ctx.partition_id, row_base=ctx.row_base)
+    # lazily-gathering column view AFTER init (EvalCtx list()s its arg)
+    ectx.columns = _OuterCols(ctx.columns, seg, in_range)
+    ectx.lambda_bindings = dict(getattr(ctx, "lambda_bindings", {}))
+    ectx.lambda_bindings.update(bindings)
+    return ectx, seg, in_range, off[:cap]
+
+
+def _index_col(seg, start, in_range) -> ColumnVector:
+    e = jnp.arange(seg.shape[0], dtype=jnp.int32)
+    idx = jnp.where(in_range, e - start[seg], 0)
+    return ColumnVector(T.INT32, idx, in_range)
+
+
+class _HofBase(Expression):
+    """Shared plumbing: children[0] is the collection, `body` the lambda
+    body, `vars` its parameters. Lambda-parameter dtypes resolve lazily
+    (the collection's element type is unknown until the analyzer binds
+    column refs), so every dtype-dependent entry point calls
+    _bind_types() first."""
+
+    def __init__(self, child: Expression, body: Expression,
+                 vars: List[LambdaVar]):
+        self.children = [child, body]
+        self.vars = vars
+
+    def _bind_types(self) -> None:
+        dt = self.children[0].data_type()
+        if isinstance(dt, T.MapType):
+            if len(self.vars) > 0:
+                self.vars[0].dtype = dt.key
+            if len(self.vars) > 1:
+                self.vars[1].dtype = dt.value
+        elif isinstance(dt, T.ArrayType):
+            self.vars[0].dtype = dt.element
+            if len(self.vars) > 1:
+                self.vars[1].dtype = T.INT32
+
+    def data_type(self):
+        self._bind_types()
+        return self._result_type()
+
+    def _result_type(self):
+        raise NotImplementedError
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        self._bind_types()
+        return self._eval_tpu(ctx)
+
+    def eval_cpu(self, cols, ansi=False):
+        self._bind_types()
+        return self._eval_cpu(cols, ansi)
+
+    @property
+    def body(self):
+        return self.children[1]
+
+    def _params(self):
+        return ",".join(v._params() for v in self.vars)
+
+    def with_children(self, children):
+        clone = type(self).__new__(type(self))
+        clone.children = list(children)
+        clone.vars = self.vars
+        return clone
+
+    # -- CPU helpers --------------------------------------------------------
+    def _cpu_rows(self, cols, ansi):
+        return self.children[0].eval_cpu(cols, ansi)
+
+    def _cpu_eval_body(self, elem_cols_by_var: dict, outer: Sequence[CpuCol],
+                       n_elems: int, ansi: bool) -> CpuCol:
+        with _bound_cpu(elem_cols_by_var):
+            return self.body.eval_cpu(outer, ansi)
+
+    @staticmethod
+    def _flatten_cpu(arr_col: CpuCol, elem_t: T.DataType):
+        """(flat element CpuCol, per-row lengths, row validity)."""
+        lens, flat, flat_ok = [], [], []
+        for v, ok in zip(arr_col.values, arr_col.valid):
+            if not ok or v is None:
+                lens.append(0)
+                continue
+            lens.append(len(v))
+            for el in v:
+                flat.append(el)
+                flat_ok.append(el is not None)
+        return (_leaf_cpu_col(elem_t, flat, flat_ok),
+                np.asarray(lens, np.int64), arr_col.valid)
+
+    @staticmethod
+    def _outer_repeat(outer: Sequence[CpuCol], lens) -> List[CpuCol]:
+        out = []
+        for c in outer:
+            vals = np.repeat(c.values, lens)
+            valid = np.repeat(c.valid, lens)
+            out.append(CpuCol(c.dtype, vals, valid))
+        return out
+
+
+class ArrayTransform(_HofBase):
+    """transform(arr, x -> expr) / transform(arr, (x, i) -> expr)."""
+
+    def _result_type(self):
+        return T.ArrayType(self.body.data_type())
+
+    def _eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        child = arr.data["child"]
+        bindings = {self.vars[0].var_id: child}
+        ectx, seg, in_range, start = _element_ctx(ctx, arr, bindings)
+        if len(self.vars) > 1:
+            ectx.lambda_bindings[self.vars[1].var_id] = \
+                _index_col(seg, start, in_range)
+        out_child = self.body.eval_tpu(ectx)
+        ctx.errors.extend(ectx.errors)
+        return ColumnVector(self.data_type(),
+                            {"offsets": arr.data["offsets"],
+                             "child": out_child},
+                            arr.validity)
+
+    def _eval_cpu(self, cols, ansi=False):
+        arr = self._cpu_rows(cols, ansi)
+        elem_t = self.children[0].data_type().element
+        flat, lens, row_ok = self._flatten_cpu(arr, elem_t)
+        bind = {self.vars[0].var_id: flat}
+        if len(self.vars) > 1:
+            idx = np.concatenate([np.arange(n) for n in lens]) \
+                if lens.sum() else np.zeros(0, np.int64)
+            bind[self.vars[1].var_id] = CpuCol(
+                T.INT32, idx.astype(np.int32),
+                np.ones(len(idx), np.bool_))
+        outer = self._outer_repeat(cols, lens)
+        res = self._cpu_eval_body(bind, outer, int(lens.sum()), ansi)
+        out, pos = [], 0
+        for n, ok in zip(lens, row_ok):
+            if not ok:
+                out.append(None)
+                continue
+            row = [res.values[pos + j] if res.valid[pos + j] else None
+                   for j in range(n)]
+            vals = [v.item() if isinstance(v, np.generic) else v for v in row]
+            out.append(vals)
+            pos += n
+        return CpuCol(self.data_type(), np.array(out, object),
+                      np.asarray(row_ok, np.bool_))
+
+
+class ArrayFilter(_HofBase):
+    """filter(arr, x -> bool)."""
+
+    def _result_type(self):
+        return self.children[0].data_type()
+
+    def _eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        child = arr.data["child"]
+        child_cap = child.capacity
+        bindings = {self.vars[0].var_id: child}
+        ectx, seg, in_range, start = _element_ctx(ctx, arr, bindings)
+        if len(self.vars) > 1:
+            ectx.lambda_bindings[self.vars[1].var_id] = \
+                _index_col(seg, start, in_range)
+        pred = self.body.eval_tpu(ectx)
+        ctx.errors.extend(ectx.errors)
+        keep = pred.data.astype(jnp.bool_) & in_range
+        if pred.validity is not None:
+            keep = keep & pred.validity
+        # stable compaction of kept elements within each row
+        kpre = jnp.cumsum(keep.astype(jnp.int32))
+        ex = kpre - keep.astype(jnp.int32)  # exclusive prefix
+        kept_per_row = jax.ops.segment_sum(
+            keep.astype(jnp.int32), seg, num_segments=arr.capacity)
+        new_off = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(kept_per_row).astype(jnp.int32)])
+        base = ex[jnp.clip(start[seg], 0, child_cap - 1)]
+        dest = jnp.where(keep, new_off[seg] + (ex - base), child_cap)
+        e = jnp.arange(child_cap, dtype=jnp.int32)
+        src = jnp.full(child_cap + 1, -1, jnp.int32) \
+            .at[dest].set(e, mode="drop")[:child_cap]
+        from spark_rapids_tpu.ops import kernels as K
+        out_child = K.gather_column(child, src, child_cap)
+        return ColumnVector(self.data_type(),
+                            {"offsets": new_off, "child": out_child},
+                            arr.validity)
+
+    def _eval_cpu(self, cols, ansi=False):
+        arr = self._cpu_rows(cols, ansi)
+        elem_t = self.children[0].data_type().element
+        flat, lens, row_ok = self._flatten_cpu(arr, elem_t)
+        bind = {self.vars[0].var_id: flat}
+        if len(self.vars) > 1:
+            idx = np.concatenate([np.arange(n) for n in lens]) \
+                if lens.sum() else np.zeros(0, np.int64)
+            bind[self.vars[1].var_id] = CpuCol(
+                T.INT32, idx.astype(np.int32), np.ones(len(idx), np.bool_))
+        outer = self._outer_repeat(cols, lens)
+        pred = self._cpu_eval_body(bind, outer, int(lens.sum()), ansi)
+        out, pos = [], 0
+        for n, ok in zip(lens, row_ok):
+            if not ok:
+                out.append(None)
+                continue
+            row = []
+            for j in range(n):
+                if pred.valid[pos + j] and bool(pred.values[pos + j]):
+                    v = flat.values[pos + j]
+                    row.append(None if not flat.valid[pos + j]
+                               else (v.item() if isinstance(v, np.generic)
+                                     else v))
+            out.append(row)
+            pos += n
+        return CpuCol(self.data_type(), np.array(out, object),
+                      np.asarray(row_ok, np.bool_))
+
+
+class _ArrayPredicateBase(_HofBase):
+    """Shared exists/forall: per-row tri-state reduction over the lambda
+    predicate (Spark three-valued logic)."""
+
+    def _result_type(self):
+        return T.BOOLEAN
+
+    def _tpu_tristate(self, ctx):
+        arr = self.children[0].eval_tpu(ctx)
+        child = arr.data["child"]
+        bindings = {self.vars[0].var_id: child}
+        ectx, seg, in_range, _ = _element_ctx(ctx, arr, bindings)
+        pred = self.body.eval_tpu(ectx)
+        ctx.errors.extend(ectx.errors)
+        pv = pred.data.astype(jnp.bool_)
+        pok = (pred.validity if pred.validity is not None
+               else jnp.ones(child.capacity, jnp.bool_))
+        cap = arr.capacity
+        any_true = jnp.zeros(cap, jnp.bool_).at[seg].max(
+            pv & pok & in_range, mode="drop")
+        any_false = jnp.zeros(cap, jnp.bool_).at[seg].max(
+            ~pv & pok & in_range, mode="drop")
+        any_null = jnp.zeros(cap, jnp.bool_).at[seg].max(
+            ~pok & in_range, mode="drop")
+        return arr, any_true, any_false, any_null
+
+    def _cpu_tristate(self, cols, ansi):
+        arr = self._cpu_rows(cols, ansi)
+        elem_t = self.children[0].data_type().element
+        flat, lens, row_ok = self._flatten_cpu(arr, elem_t)
+        outer = self._outer_repeat(cols, lens)
+        pred = self._cpu_eval_body({self.vars[0].var_id: flat}, outer,
+                                   int(lens.sum()), ansi)
+        at, af, an = [], [], []
+        pos = 0
+        for n in lens:
+            t = f = nl = False
+            for j in range(n):
+                if not pred.valid[pos + j]:
+                    nl = True
+                elif bool(pred.values[pos + j]):
+                    t = True
+                else:
+                    f = True
+            at.append(t)
+            af.append(f)
+            an.append(nl)
+            pos += n
+        return (arr, np.asarray(at, np.bool_), np.asarray(af, np.bool_),
+                np.asarray(an, np.bool_), np.asarray(row_ok, np.bool_))
+
+
+class ArrayExists(_ArrayPredicateBase):
+    """exists(arr, p): true if any true, else null if any null-pred, else
+    false (Spark 3 three-valued semantics)."""
+
+    def _eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr, any_true, any_false, any_null = self._tpu_tristate(ctx)
+        valid = _valid_of(arr, ctx) & (any_true | ~any_null)
+        return ColumnVector(T.BOOLEAN, any_true, valid)
+
+    def _eval_cpu(self, cols, ansi=False):
+        arr, at, af, an, row_ok = self._cpu_tristate(cols, ansi)
+        return CpuCol(T.BOOLEAN, at, row_ok & (at | ~an))
+
+
+class ArrayForAll(_ArrayPredicateBase):
+    """forall(arr, p): false if any false, else null if any null-pred,
+    else true."""
+
+    def _eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr, any_true, any_false, any_null = self._tpu_tristate(ctx)
+        valid = _valid_of(arr, ctx) & (any_false | ~any_null)
+        return ColumnVector(T.BOOLEAN, ~any_false, valid)
+
+    def _eval_cpu(self, cols, ansi=False):
+        arr, at, af, an, row_ok = self._cpu_tristate(cols, ansi)
+        return CpuCol(T.BOOLEAN, ~af, row_ok & (af | ~an))
+
+
+class TransformValues(_HofBase):
+    """transform_values(map, (k, v) -> expr)."""
+
+    def _result_type(self):
+        mt = self.children[0].data_type()
+        return T.MapType(mt.key, self.body.data_type())
+
+    def _eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        m = self.children[0].eval_tpu(ctx)
+        keys, values = m.data["keys"], m.data["values"]
+        bindings = {self.vars[0].var_id: keys,
+                    self.vars[1].var_id: values}
+        ectx, _, _, _ = _element_ctx(ctx, m, bindings)
+        out_vals = self.body.eval_tpu(ectx)
+        ctx.errors.extend(ectx.errors)
+        return ColumnVector(self.data_type(),
+                            {"offsets": m.data["offsets"], "keys": keys,
+                             "values": out_vals}, m.validity)
+
+    def _eval_cpu(self, cols, ansi=False):
+        return _map_transform_cpu(self, cols, ansi, transform_key=False)
+
+
+class TransformKeys(_HofBase):
+    """transform_keys(map, (k, v) -> expr). Spark default dedup policy is
+    EXCEPTION: duplicate produced keys raise."""
+
+    def _result_type(self):
+        mt = self.children[0].data_type()
+        return T.MapType(self.body.data_type(), mt.value)
+
+    def _eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        m = self.children[0].eval_tpu(ctx)
+        keys, values = m.data["keys"], m.data["values"]
+        bindings = {self.vars[0].var_id: keys,
+                    self.vars[1].var_id: values}
+        ectx, seg, in_range, _ = _element_ctx(ctx, m, bindings)
+        out_keys = self.body.eval_tpu(ectx)
+        ctx.errors.extend(ectx.errors)
+        if out_keys.validity is not None:
+            ctx.add_error("NullMapKey",
+                          jnp.zeros(m.capacity, jnp.bool_).at[seg].max(
+                              ~out_keys.validity & in_range, mode="drop"))
+        # duplicate detection: sort (seg, key64) and compare neighbours
+        from spark_rapids_tpu.ops import kernels as K
+        k64, knull = K.normalize_key(out_keys, ectx.num_rows, live=in_range)
+        child_cap = k64.shape[0]
+        segK = jnp.where(in_range, seg, m.capacity)
+        order = jnp.lexsort((k64, segK))
+        ss, kk = segK[order], k64[order]
+        dup = (ss[1:] == ss[:-1]) & (kk[1:] == kk[:-1]) \
+            & (ss[1:] < m.capacity)
+        dup_row = jnp.zeros(m.capacity + 1, jnp.bool_).at[
+            jnp.where(dup, ss[1:], m.capacity)].max(True, mode="drop")
+        ctx.add_error("DuplicateMapKey", dup_row[:m.capacity])
+        return ColumnVector(self.data_type(),
+                            {"offsets": m.data["offsets"], "keys": out_keys,
+                             "values": values}, m.validity)
+
+    def _eval_cpu(self, cols, ansi=False):
+        return _map_transform_cpu(self, cols, ansi, transform_key=True)
+
+
+def _map_transform_cpu(node: _HofBase, cols, ansi, transform_key: bool):
+    m = node.children[0].eval_cpu(cols, ansi)
+    mt = node.children[0].data_type()
+    lens, fk, fv = [], [], []
+    for v, ok in zip(m.values, m.valid):
+        if not ok or v is None:
+            lens.append(0)
+            continue
+        lens.append(len(v))
+        for kk, vv in v:
+            fk.append(kk)
+            fv.append(vv)
+    lens = np.asarray(lens, np.int64)
+    kc = _leaf_cpu_col(mt.key, fk, [k is not None for k in fk])
+    vc = _leaf_cpu_col(mt.value, fv, [x is not None for x in fv])
+    outer = node._outer_repeat(cols, lens)
+    res = node._cpu_eval_body(
+        {node.vars[0].var_id: kc, node.vars[1].var_id: vc}, outer,
+        int(lens.sum()), ansi)
+    out, pos = [], 0
+    for n, ok in zip(lens, m.valid):
+        if not ok:
+            out.append(None)
+            continue
+        entries = []
+        seen = set()
+        for j in range(n):
+            r = res.values[pos + j] if res.valid[pos + j] else None
+            r = r.item() if isinstance(r, np.generic) else r
+            if transform_key:
+                if r is None:
+                    raise SparkException("Cannot use null as map key")
+                if r in seen:
+                    raise SparkException(f"Duplicate map key {r}")
+                seen.add(r)
+                entries.append((r, fv[pos + j] if pos + j < len(fv) else None))
+            else:
+                entries.append((fk[pos + j], r))
+        out.append(entries)
+        pos += n
+    return CpuCol(node.data_type(), np.array(out, object),
+                  np.asarray(m.valid, np.bool_))
+
+
+class MapFilter(_HofBase):
+    """map_filter(map, (k, v) -> bool)."""
+
+    def _result_type(self):
+        return self.children[0].data_type()
+
+    def _eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        m = self.children[0].eval_tpu(ctx)
+        keys, values = m.data["keys"], m.data["values"]
+        child_cap = keys.capacity
+        bindings = {self.vars[0].var_id: keys, self.vars[1].var_id: values}
+        ectx, seg, in_range, start = _element_ctx(ctx, m, bindings)
+        pred = self.body.eval_tpu(ectx)
+        ctx.errors.extend(ectx.errors)
+        keep = pred.data.astype(jnp.bool_) & in_range
+        if pred.validity is not None:
+            keep = keep & pred.validity
+        kpre = jnp.cumsum(keep.astype(jnp.int32))
+        ex = kpre - keep.astype(jnp.int32)
+        kept_per_row = jax.ops.segment_sum(
+            keep.astype(jnp.int32), seg, num_segments=m.capacity)
+        new_off = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32),
+             jnp.cumsum(kept_per_row).astype(jnp.int32)])
+        base = ex[jnp.clip(start[seg], 0, child_cap - 1)]
+        dest = jnp.where(keep, new_off[seg] + (ex - base), child_cap)
+        e = jnp.arange(child_cap, dtype=jnp.int32)
+        src = jnp.full(child_cap + 1, -1, jnp.int32) \
+            .at[dest].set(e, mode="drop")[:child_cap]
+        from spark_rapids_tpu.ops import kernels as K
+        return ColumnVector(self.data_type(),
+                            {"offsets": new_off,
+                             "keys": K.gather_column(keys, src, child_cap),
+                             "values": K.gather_column(values, src,
+                                                       child_cap)},
+                            m.validity)
+
+    def _eval_cpu(self, cols, ansi=False):
+        m = self.children[0].eval_cpu(cols, ansi)
+        mt = self.children[0].data_type()
+        lens, fk, fv = [], [], []
+        for v, ok in zip(m.values, m.valid):
+            if not ok or v is None:
+                lens.append(0)
+                continue
+            lens.append(len(v))
+            for kk, vv in v:
+                fk.append(kk)
+                fv.append(vv)
+        lens = np.asarray(lens, np.int64)
+        kc = _leaf_cpu_col(mt.key, fk, [k is not None for k in fk])
+        vc = _leaf_cpu_col(mt.value, fv, [x is not None for x in fv])
+        outer = self._outer_repeat(cols, lens)
+        pred = self._cpu_eval_body(
+            {self.vars[0].var_id: kc, self.vars[1].var_id: vc}, outer,
+            int(lens.sum()), ansi)
+        out, pos = [], 0
+        for n, ok in zip(lens, m.valid):
+            if not ok:
+                out.append(None)
+                continue
+            out.append([(fk[pos + j], fv[pos + j]) for j in range(n)
+                        if pred.valid[pos + j]
+                        and bool(pred.values[pos + j])])
+            pos += n
+        return CpuCol(self.data_type(), np.array(out, object),
+                      np.asarray(m.valid, np.bool_))
+
+
+class ZipWith(_HofBase):
+    """zip_with(a, b, (x, y) -> expr): element-wise over both arrays,
+    padding the shorter with nulls."""
+
+    def __init__(self, left: Expression, right: Expression,
+                 body: Expression, vars: List[LambdaVar]):
+        self.children = [left, body, right]
+        self.vars = vars
+
+    def with_children(self, children):
+        clone = type(self).__new__(type(self))
+        clone.children = list(children)
+        clone.vars = self.vars
+        return clone
+
+    def _bind_types(self) -> None:
+        lt = self.children[0].data_type()
+        rt = self.children[2].data_type()
+        if isinstance(lt, T.ArrayType):
+            self.vars[0].dtype = lt.element
+        if isinstance(rt, T.ArrayType):
+            self.vars[1].dtype = rt.element
+
+    def _result_type(self):
+        return T.ArrayType(self.body.data_type())
+
+    def _eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        from spark_rapids_tpu.ops import kernels as K
+        a = self.children[0].eval_tpu(ctx)
+        b = self.children[2].eval_tpu(ctx)
+        cap = a.capacity
+        aoff, boff = a.data["offsets"], b.data["offsets"]
+        alen = aoff[1: cap + 1] - aoff[:cap]
+        blen = boff[1: cap + 1] - boff[:cap]
+        row_ok = ctx.row_mask & _valid_of(a, ctx) & _valid_of(b, ctx)
+        olen = jnp.where(row_ok, jnp.maximum(alen, blen), 0)
+        new_off = jnp.concatenate(
+            [jnp.zeros(1, jnp.int32), jnp.cumsum(olen).astype(jnp.int32)])
+        out_cap = a.data["child"].capacity + b.data["child"].capacity
+        e = jnp.arange(out_cap, dtype=jnp.int32)
+        seg = jnp.clip(
+            jnp.searchsorted(new_off, e, side="right").astype(jnp.int32) - 1,
+            0, cap - 1)
+        in_range = e < new_off[cap]
+        j = e - new_off[seg]  # position within the output row
+        a_ok = in_range & (j < alen[seg])
+        b_ok = in_range & (j < blen[seg])
+        a_idx = jnp.where(a_ok, aoff[seg] + j, -1)
+        b_idx = jnp.where(b_ok, boff[seg] + j, -1)
+        av = K.gather_column(a.data["child"], a_idx,
+                             a.data["child"].capacity)
+        bv = K.gather_column(b.data["child"], b_idx,
+                             b.data["child"].capacity)
+        ectx = EvalCtx([], jnp.sum(in_range.astype(jnp.int32)), out_cap,
+                       ctx.ansi, live=in_range,
+                       partition_id=ctx.partition_id, row_base=ctx.row_base)
+        ectx.columns = _OuterCols(ctx.columns, seg, in_range)
+        ectx.lambda_bindings = dict(getattr(ctx, "lambda_bindings", {}))
+        ectx.lambda_bindings[self.vars[0].var_id] = av
+        ectx.lambda_bindings[self.vars[1].var_id] = bv
+        out_child = self.body.eval_tpu(ectx)
+        ctx.errors.extend(ectx.errors)
+        return ColumnVector(self.data_type(),
+                            {"offsets": new_off, "child": out_child},
+                            row_ok)
+
+    def _eval_cpu(self, cols, ansi=False):
+        a = self.children[0].eval_cpu(cols, ansi)
+        b = self.children[2].eval_cpu(cols, ansi)
+        at = self.children[0].data_type().element
+        bt = self.children[2].data_type().element
+        lens, fa, fb = [], [], []
+        row_ok = []
+        for (av, aok), (bv, bok) in zip(zip(a.values, a.valid),
+                                        zip(b.values, b.valid)):
+            ok = aok and bok and av is not None and bv is not None
+            row_ok.append(ok)
+            if not ok:
+                lens.append(0)
+                continue
+            n = max(len(av), len(bv))
+            lens.append(n)
+            for j in range(n):
+                fa.append(av[j] if j < len(av) else None)
+                fb.append(bv[j] if j < len(bv) else None)
+        lens = np.asarray(lens, np.int64)
+        ac = _leaf_cpu_col(at, fa, [v is not None for v in fa])
+        bc = _leaf_cpu_col(bt, fb, [v is not None for v in fb])
+        outer = self._outer_repeat(cols, lens)
+        res = self._cpu_eval_body(
+            {self.vars[0].var_id: ac, self.vars[1].var_id: bc}, outer,
+            int(lens.sum()), ansi)
+        out, pos = [], 0
+        for n, ok in zip(lens, row_ok):
+            if not ok:
+                out.append(None)
+                continue
+            row = [res.values[pos + j] if res.valid[pos + j] else None
+                   for j in range(n)]
+            out.append([v.item() if isinstance(v, np.generic) else v
+                        for v in row])
+            pos += n
+        return CpuCol(self.data_type(), np.array(out, object),
+                      np.asarray(row_ok, np.bool_))
+
+
+class ArrayAggregate(_HofBase):
+    """aggregate(arr, zero, (acc, x) -> merge[, acc -> finish]): a
+    sequential per-row fold — order-dependent with an arbitrary merge
+    lambda, so it runs on the CPU tier (the reference rejects it to CPU
+    the same way for unsupported shapes)."""
+
+    def __init__(self, child: Expression, zero: Expression,
+                 merge_body: Expression, merge_vars: List[LambdaVar],
+                 finish_body: Optional[Expression] = None,
+                 finish_vars: Optional[List[LambdaVar]] = None):
+        self.children = [child, merge_body, _wrap(zero)] + \
+            ([finish_body] if finish_body is not None else [])
+        self.vars = merge_vars
+        self.finish_vars = finish_vars or []
+
+    def with_children(self, children):
+        clone = type(self).__new__(type(self))
+        clone.children = list(children)
+        clone.vars = self.vars
+        clone.finish_vars = self.finish_vars
+        return clone
+
+    @property
+    def merge_body(self):
+        return self.children[1]
+
+    @property
+    def finish_body(self):
+        return self.children[3] if len(self.children) > 3 else None
+
+    def _result_type(self):
+        fb = self.finish_body
+        return fb.data_type() if fb is not None else \
+            self.merge_body.data_type()
+
+    def supported_on_tpu(self):
+        return False
+
+    def _bind_types(self) -> None:
+        dt = self.children[0].data_type()
+        if isinstance(dt, T.ArrayType):
+            self.vars[1].dtype = dt.element
+        self.vars[0].dtype = self.children[2].data_type()
+        if self.finish_vars:
+            self.finish_vars[0].dtype = self.merge_body.data_type()
+
+    def _eval_tpu(self, ctx):
+        raise NotImplementedError("aggregate() folds run on CPU")
+
+    def _eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        zero = self.children[2].eval_cpu(cols, ansi)
+        elem_t = self.children[0].data_type().element
+        acc_t = self.merge_body.data_type()
+        n = len(arr.values)
+        acc_vals = list(zero.values)
+        acc_ok = list(zero.valid)
+        max_len = max((len(v) for v, ok in zip(arr.values, arr.valid)
+                       if ok and v is not None), default=0)
+        for step in range(max_len):
+            xs, xok, active = [], [], []
+            for i in range(n):
+                v, ok = arr.values[i], arr.valid[i]
+                if ok and v is not None and step < len(v):
+                    active.append(i)
+                    xs.append(v[step])
+                    xok.append(v[step] is not None)
+            if not active:
+                break
+            sub_acc = _leaf_cpu_col(acc_t, [acc_vals[i] for i in active],
+                                    [acc_ok[i] for i in active])
+            sub_x = _leaf_cpu_col(elem_t, xs, xok)
+            with _bound_cpu({self.vars[0].var_id: sub_acc,
+                             self.vars[1].var_id: sub_x}):
+                outer = [CpuCol(c.dtype, c.values[active],
+                                c.valid[active]) for c in cols]
+                res = self.merge_body.eval_cpu(outer, ansi)
+            for j, i in enumerate(active):
+                acc_vals[i] = res.values[j]
+                acc_ok[i] = bool(res.valid[j])
+        out_ok = [bool(a and o) for a, o in zip(arr.valid, acc_ok)]
+        if self.finish_body is not None:
+            acc = _leaf_cpu_col(acc_t, acc_vals, acc_ok)
+            with _bound_cpu({self.finish_vars[0].var_id: acc}):
+                res = self.finish_body.eval_cpu(cols, ansi)
+            return CpuCol(self.data_type(), res.values,
+                          res.valid & np.asarray(arr.valid, np.bool_))
+        return _leaf_cpu_col(self.data_type(),
+                             [v if ok else None
+                              for v, ok in zip(acc_vals, out_ok)], out_ok)

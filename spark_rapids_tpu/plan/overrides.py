@@ -290,6 +290,23 @@ for _cls in (S.Trim, S.LTrim, S.RTrim, S.InitCap, S.Ascii, S.InStr,
     expr_rule(_cls, Sigs.COMMON, Sigs.COMMON, _cls.__name__.lower())
 
 
+# higher-order functions (lambdas over arrays/maps) — hof.py
+from spark_rapids_tpu.expr import hof as H  # noqa: E402
+
+_ARR = TypeSig(["ARRAY", "MAP", "NULL"]) + Sigs.COMMON
+expr_rule(H.LambdaVar, Sigs.COMMON, Sigs.COMMON, "lambda parameter")
+expr_rule(H.ArrayTransform, _ARR, _ARR, "transform(array, lambda)")
+expr_rule(H.ArrayFilter, _ARR, _ARR, "filter(array, lambda)")
+expr_rule(H.ArrayExists, _ARR, Sigs.COMMON, "exists(array, lambda)")
+expr_rule(H.ArrayForAll, _ARR, Sigs.COMMON, "forall(array, lambda)")
+expr_rule(H.TransformKeys, _ARR, _ARR, "transform_keys(map, lambda)")
+expr_rule(H.TransformValues, _ARR, _ARR, "transform_values(map, lambda)")
+expr_rule(H.MapFilter, _ARR, _ARR, "map_filter(map, lambda)")
+expr_rule(H.ZipWith, _ARR, _ARR, "zip_with(a, b, lambda)")
+expr_rule(H.ArrayAggregate, _ARR, Sigs.COMMON,
+          "aggregate(array, zero, merge[, finish]) — CPU fold")
+
+
 # Aggregate function rules
 AGG_RULES: Dict[Type, ExprRule] = {}
 

@@ -611,3 +611,84 @@ def datediff(end, start):
 
 def last_day(c):
     return DT.LastDay(_e(c))
+
+
+# ---------------------------------------------------------------------------
+# Higher-order functions (lambda expressions over arrays/maps)
+# Reference: sql-plugin higherOrderFunctions.scala
+# ---------------------------------------------------------------------------
+
+def _lambda(fn, n_args, names):
+    from spark_rapids_tpu.expr import hof as H
+    from spark_rapids_tpu import types as T
+    import inspect
+    try:
+        arity = len(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        arity = n_args
+    import builtins
+    arity = builtins.min(builtins.max(arity, 1), n_args)
+    return H.make_lambda(fn, [T.NULL] * arity, names[:arity])
+
+
+def transform(c, fn):
+    """transform(array, x -> expr) or transform(array, (x, i) -> expr)."""
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(fn, 2, ["x", "i"])
+    return H.ArrayTransform(_e(c), body, vs)
+
+
+def filter(c, fn):  # noqa: A001 - Spark's F.filter
+    """filter(array, x -> bool) / filter(array, (x, i) -> bool)."""
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(fn, 2, ["x", "i"])
+    return H.ArrayFilter(_e(c), body, vs)
+
+
+def exists(c, fn):
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(fn, 1, ["x"])
+    return H.ArrayExists(_e(c), body, vs)
+
+
+def forall(c, fn):
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(fn, 1, ["x"])
+    return H.ArrayForAll(_e(c), body, vs)
+
+
+def aggregate(c, zero, merge, finish=None):
+    """aggregate(array, zero, (acc, x) -> new_acc[, acc -> out])."""
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(merge, 2, ["acc", "x"])
+    fb = fvs = None
+    if finish is not None:
+        fb, fvs = _lambda(finish, 1, ["acc"])
+    return H.ArrayAggregate(_e(c), _e(zero), body, vs, fb, fvs)
+
+
+reduce = aggregate  # Spark 3.4+ alias
+
+
+def zip_with(a, b, fn):
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(fn, 2, ["x", "y"])
+    return H.ZipWith(_e(a), _e(b), body, vs)
+
+
+def transform_keys(c, fn):
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(fn, 2, ["k", "v"])
+    return H.TransformKeys(_e(c), body, vs)
+
+
+def transform_values(c, fn):
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(fn, 2, ["k", "v"])
+    return H.TransformValues(_e(c), body, vs)
+
+
+def map_filter(c, fn):
+    from spark_rapids_tpu.expr import hof as H
+    body, vs = _lambda(fn, 2, ["k", "v"])
+    return H.MapFilter(_e(c), body, vs)

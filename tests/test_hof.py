@@ -1,0 +1,131 @@
+"""Differential tests for higher-order functions (lambdas over arrays and
+maps). Reference scope: sql-plugin higherOrderFunctions.scala."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _arrays(n=60, seed=7):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        if rng.random() < 0.1:
+            rows.append(None)
+            continue
+        ln = int(rng.integers(0, 6))
+        rows.append([None if rng.random() < 0.15 else int(v)
+                     for v in rng.integers(-50, 50, ln)])
+    base = rng.integers(1, 10, n).astype(np.int64)
+    return pa.table({"a": pa.array(rows, pa.list_(pa.int64())),
+                     "m": pa.array(base)})
+
+
+def _two_arrays(n=50, seed=11):
+    rng = np.random.default_rng(seed)
+
+    def mk():
+        rows = []
+        for _ in range(n):
+            if rng.random() < 0.1:
+                rows.append(None)
+                continue
+            ln = int(rng.integers(0, 5))
+            rows.append([int(v) for v in rng.integers(-20, 20, ln)])
+        return pa.array(rows, pa.list_(pa.int64()))
+    return pa.table({"a": mk(), "b": mk()})
+
+
+def _maps(n=40, seed=3):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        if rng.random() < 0.1:
+            rows.append(None)
+            continue
+        k = rng.choice(20, size=int(rng.integers(0, 5)), replace=False)
+        rows.append([(int(kk), int(rng.integers(-30, 30))) for kk in k])
+    return pa.table({"m": pa.array(rows, pa.map_(pa.int64(), pa.int64()))})
+
+
+def test_transform_simple(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_arrays()).select(
+            F.transform(col("a"), lambda x: x * lit(2) + lit(1)).alias("t")),
+        session)
+
+
+def test_transform_with_index_and_outer_ref(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_arrays()).select(
+            F.transform(col("a"), lambda x, i: x + i).alias("ti"),
+            F.transform(col("a"), lambda x: x * col("m")).alias("to")),
+        session)
+
+
+def test_filter_lambda(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_arrays()).select(
+            F.filter(col("a"), lambda x: x > lit(0)).alias("f"),
+            F.filter(col("a"), lambda x, i: i % lit(2) == lit(0)).alias("fe")),
+        session)
+
+
+def test_exists_forall_three_valued(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_arrays()).select(
+            F.exists(col("a"), lambda x: x > lit(25)).alias("ex"),
+            F.forall(col("a"), lambda x: x > lit(-49)).alias("fa")),
+        session)
+
+
+def test_zip_with(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_two_arrays()).select(
+            F.zip_with(col("a"), col("b"),
+                       lambda x, y: x + y).alias("z")),
+        session)
+
+
+def test_transform_values_and_map_filter(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_maps()).select(
+            F.transform_values(col("m"), lambda k, v: v * lit(3)).alias("tv"),
+            F.map_filter(col("m"), lambda k, v: v > lit(0)).alias("mf")),
+        session)
+
+
+def test_transform_keys(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_maps()).select(
+            F.transform_keys(col("m"), lambda k, v: k + lit(100)).alias("tk")),
+        session)
+
+
+def test_aggregate_fold_cpu_tier(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_arrays()).select(
+            F.aggregate(col("a"), lit(0),
+                        lambda acc, x: acc + F.coalesce(x, lit(0))).alias("s"),
+            F.aggregate(col("a"), lit(1),
+                        lambda acc, x: acc * F.coalesce(x, lit(1)),
+                        lambda acc: acc + lit(5)).alias("p")),
+        session)
+
+
+def test_nested_hof(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_arrays()).select(
+            F.transform(F.filter(col("a"), lambda x: x.is_not_null()),
+                        lambda x: x - lit(1)).alias("nf")),
+        session)
