@@ -1,0 +1,733 @@
+"""Array collection operations (non-lambda).
+
+Reference parity: sql-plugin collectionOperations.scala (GpuArrayMin/Max,
+GpuSortArray, GpuSlice, GpuFlattenArray, GpuArraysOverlap, GpuArrayRemove,
+GpuArrayDistinct? — the reference covers this family via cudf list ops),
+GpuElementAt relatives live in expr/complex.py.
+
+TPU-first design: every per-row set/sort operation is ONE global pass over
+the flattened element plane — a lexicographic sort by (owning row, element
+key) turns per-row multiset questions (distinct, membership, min/max,
+sort) into segmented scans, the same count-then-compact discipline the
+join uses. String elements ride the 64-bit equality-faithful normalize_key
+(documented hash-collision incompat, as joins); ORDER-sensitive ops
+(sort_array, array_min/max) handle fixed-width keys on device and fall
+back to CPU for strings.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnVector
+from spark_rapids_tpu.expr.core import (
+    CpuCol, EvalCtx, Expression, SparkException, _valid_of, _wrap,
+)
+from spark_rapids_tpu.expr.complex import (
+    _element_segments, _leaf_cpu_col, _cmp_child_to_row,
+)
+
+
+def _offsets(col: ColumnVector):
+    cap = col.capacity
+    off = col.data["offsets"]
+    return off[:cap], off[1: cap + 1] - off[:cap]
+
+
+def _elem_layout(arr: ColumnVector):
+    """(child, seg, e, in_range, start) for an array column."""
+    cap = arr.capacity
+    off = arr.data["offsets"]
+    child = arr.data["child"]
+    child_cap = child.capacity
+    seg = _element_segments(off[: cap + 1], cap, child_cap)
+    e = jnp.arange(child_cap, dtype=jnp.int32)
+    in_range = e < off[cap]
+    return child, seg, e, in_range, off[:cap]
+
+
+def _compact_elements(arr: ColumnVector, keep: jax.Array,
+                      out_dtype: Optional[T.DataType] = None) -> ColumnVector:
+    """New array column keeping elements where `keep` (stable within each
+    row); offsets recomputed, child gathered (shared with hof.ArrayFilter
+    semantics)."""
+    from spark_rapids_tpu.ops import kernels as K
+    child, seg, e, in_range, start = _elem_layout(arr)
+    child_cap = child.capacity
+    keep = keep & in_range
+    kpre = jnp.cumsum(keep.astype(jnp.int32))
+    ex = kpre - keep.astype(jnp.int32)
+    kept_per_row = jax.ops.segment_sum(keep.astype(jnp.int32), seg,
+                                       num_segments=arr.capacity)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(kept_per_row).astype(jnp.int32)])
+    base = ex[jnp.clip(start[seg], 0, child_cap - 1)]
+    dest = jnp.where(keep, new_off[seg] + (ex - base), child_cap)
+    src = jnp.full(child_cap + 1, -1, jnp.int32) \
+        .at[dest].set(e, mode="drop")[:child_cap]
+    out_child = K.gather_column(child, src, child_cap)
+    return ColumnVector(out_dtype or arr.dtype,
+                        {"offsets": new_off, "child": out_child},
+                        arr.validity)
+
+
+def _elem_eq_key(child: ColumnVector, in_range, num_rows):
+    """64-bit equality key per element + null flag (normalize_key)."""
+    from spark_rapids_tpu.ops import kernels as K
+    k, nulls = K.normalize_key(child, num_rows)
+    return k, nulls
+
+
+def _group_first_flags(seg, key64, is_null, in_range, cap, child_cap):
+    """Per element: is it the FIRST occurrence of its (row, value) among
+    in-range elements? Nulls form their own value group per row. One
+    3-operand sort + boundary scan + scatter back to element order."""
+    segK = jnp.where(in_range, seg, cap).astype(jnp.int32)
+    # fold the null flag into the key (nulls sort together, distinct from
+    # any value's hash with overwhelming probability is NOT enough — use a
+    # separate operand so null != value exactly)
+    nullk = is_null.astype(jnp.int32)
+    e = jnp.arange(child_cap, dtype=jnp.int32)
+    ss, nn, kk, si = jax.lax.sort((segK, nullk, key64, e), num_keys=3)
+    first_sorted = jnp.concatenate([
+        jnp.ones(1, jnp.bool_),
+        (ss[1:] != ss[:-1]) | (nn[1:] != nn[:-1]) | (kk[1:] != kk[:-1])])
+    # group id in sorted order; min element index per group = the
+    # original position that "wins" (order of first occurrence)
+    gid = jnp.cumsum(first_sorted.astype(jnp.int32)) - 1
+    winner = jnp.full(child_cap + 1, child_cap, jnp.int32) \
+        .at[jnp.where(ss < cap, gid, child_cap)].min(si, mode="drop")
+    first_of_group = winner[gid]  # per sorted row
+    keep_sorted = si == first_of_group
+    keep = jnp.zeros(child_cap, jnp.bool_).at[si].set(keep_sorted,
+                                                      mode="drop")
+    return keep & in_range, (segK, nullk, key64)
+
+
+def _membership_flags(a: ColumnVector, b: ColumnVector, num_rows):
+    """For each element of a: does an equal element exist in the SAME ROW
+    of b? Returns (present bool plane over a's elements, a_layout,
+    b_has_null per row, a null-flag plane). One sort over the union."""
+    a_child, a_seg, a_e, a_in, _ = _elem_layout(a)
+    b_child, b_seg, b_e, b_in, _ = _elem_layout(b)
+    cap = a.capacity
+    ak, anull = _elem_eq_key(a_child, a_in, num_rows)
+    bk, bnull = _elem_eq_key(b_child, b_in, num_rows)
+    na, nb = a_child.capacity, b_child.capacity
+    seg_u = jnp.concatenate([jnp.where(a_in, a_seg, cap),
+                             jnp.where(b_in, b_seg, cap)]).astype(jnp.int32)
+    null_u = jnp.concatenate([anull, bnull]).astype(jnp.int32)
+    key_u = jnp.concatenate([ak, bk])
+    side_u = jnp.concatenate([jnp.zeros(na, jnp.int32),
+                              jnp.ones(nb, jnp.int32)])
+    iota = jnp.arange(na + nb, dtype=jnp.int32)
+    ss, nn, kk, sd, si = jax.lax.sort((seg_u, null_u, key_u, side_u, iota),
+                                      num_keys=4)
+    first = jnp.concatenate([
+        jnp.ones(1, jnp.bool_),
+        (ss[1:] != ss[:-1]) | (nn[1:] != nn[:-1]) | (kk[1:] != kk[:-1])])
+    gid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    ngroups = na + nb
+    has_b = jnp.zeros(ngroups + 1, jnp.bool_).at[
+        jnp.where(ss < cap, gid, ngroups)].max(sd == 1, mode="drop")
+    present_sorted = has_b[gid]
+    present_u = jnp.zeros(na + nb, jnp.bool_).at[si].set(present_sorted,
+                                                         mode="drop")
+    b_has_null = jnp.zeros(cap, jnp.bool_).at[
+        jnp.where(b_in, b_seg, cap)].max(bnull, mode="drop")
+    return present_u[:na], (a_child, a_seg, a_e, a_in), b_has_null, anull
+
+
+class ArrayMin(Expression):
+    """array_min(arr): least non-null element (NaN > any number)."""
+
+    _op = "min"
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self):
+        return self.children[0].data_type().element
+
+    def supported_on_tpu(self):
+        et = self.children[0].data_type().element
+        return not isinstance(et, (T.StringType, T.ArrayType, T.MapType,
+                                   T.StructType))
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        child, seg, e, in_range, _ = _elem_layout(arr)
+        cap = arr.capacity
+        cv = (child.validity if child.validity is not None
+              else jnp.ones(child.capacity, jnp.bool_))
+        ok = in_range & cv
+        et = self.data_type()
+        from spark_rapids_tpu.ops import radix as R
+        d = np.dtype(et.np_dtype)
+        if d in (np.dtype(np.float64), np.dtype(np.float32)):
+            o = R._f64_order_i64(child.data.astype(jnp.float64))
+        else:
+            o = child.data.astype(jnp.int64)
+        init = np.iinfo(np.int64).max if self._op == "min" \
+            else np.iinfo(np.int64).min
+        o = jnp.where(ok, o, jnp.int64(init))
+        red = (lambda t, s, v: t.at[s].min(v, mode="drop")) \
+            if self._op == "min" else \
+            (lambda t, s, v: t.at[s].max(v, mode="drop"))
+        w = red(jnp.full(cap + 1, init, jnp.int64),
+                jnp.where(ok, seg, cap), o)[:cap]
+        some = jnp.zeros(cap, jnp.bool_).at[jnp.where(ok, seg, cap)].max(
+            True, mode="drop")
+        if d in (np.dtype(np.float64), np.dtype(np.float32)):
+            vals = R._i64_order_f64(w).astype(et.np_dtype)
+        else:
+            vals = w.astype(et.np_dtype)
+        return ColumnVector(et, vals, _valid_of(arr, ctx) & some)
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        out_v, out_ok = [], []
+        pick = min if self._op == "min" else max
+        for v, ok in zip(arr.values, arr.valid):
+            vals = [x for x in (v or []) if x is not None] \
+                if ok and v is not None else []
+            if not ok or v is None or not vals:
+                out_v.append(None)
+                out_ok.append(False)
+                continue
+            if any(isinstance(x, float) and np.isnan(x) for x in vals):
+                nonnan = [x for x in vals if not (isinstance(x, float)
+                                                  and np.isnan(x))]
+                if self._op == "max" or not nonnan:
+                    out_v.append(float("nan"))
+                else:
+                    out_v.append(pick(nonnan))
+            else:
+                out_v.append(pick(vals))
+            out_ok.append(True)
+        return _leaf_cpu_col(self.data_type(), out_v, out_ok)
+
+
+class ArrayMax(ArrayMin):
+    """array_max(arr)."""
+
+    _op = "max"
+
+
+class ArrayPosition(Expression):
+    """array_position(arr, v): 1-based index of first match, 0 if absent,
+    null if arr or v is null."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = [child, _wrap(value)]
+
+    def data_type(self):
+        return T.INT64
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        val = self.children[1].eval_tpu(ctx)
+        child, seg, e, in_range, start = _elem_layout(arr)
+        eq, both = _cmp_child_to_row(child, val, seg, ctx)
+        match = eq & both & in_range
+        cap = arr.capacity
+        first = jnp.full(cap + 1, np.iinfo(np.int32).max, jnp.int32).at[
+            jnp.where(match, seg, cap)].min(e, mode="drop")[:cap]
+        found = first < np.iinfo(np.int32).max
+        pos = jnp.where(found, first - start + 1, 0).astype(jnp.int64)
+        valid = _valid_of(arr, ctx) & _valid_of(val, ctx)
+        return ColumnVector(T.INT64, pos, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        val = self.children[1].eval_cpu(cols, ansi)
+        out_v, out_ok = [], []
+        for (v, ok), (x, xok) in zip(zip(arr.values, arr.valid),
+                                     zip(val.values, val.valid)):
+            if not ok or v is None or not xok:
+                out_v.append(0)
+                out_ok.append(False)
+                continue
+            pos = 0
+            for i, el in enumerate(v):
+                if el is not None and el == x:
+                    pos = i + 1
+                    break
+            out_v.append(pos)
+            out_ok.append(True)
+        return CpuCol(T.INT64, np.asarray(out_v, np.int64),
+                      np.asarray(out_ok, np.bool_))
+
+
+class ArrayRemove(Expression):
+    """array_remove(arr, v): drop elements equal to v (nulls kept)."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = [child, _wrap(value)]
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        val = self.children[1].eval_tpu(ctx)
+        child, seg, e, in_range, _ = _elem_layout(arr)
+        eq, both = _cmp_child_to_row(child, val, seg, ctx)
+        keep = ~(eq & both)
+        out = _compact_elements(arr, keep & in_range)
+        return ColumnVector(out.dtype, out.data,
+                            _valid_of(arr, ctx) & _valid_of(val, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        val = self.children[1].eval_cpu(cols, ansi)
+        out_v, out_ok = [], []
+        for (v, ok), (x, xok) in zip(zip(arr.values, arr.valid),
+                                     zip(val.values, val.valid)):
+            if not ok or v is None or not xok:
+                out_v.append(None)
+                out_ok.append(False)
+                continue
+            out_v.append([el for el in v if el is None or el != x])
+            out_ok.append(True)
+        return CpuCol(self.data_type(), np.array(out_v, object),
+                      np.asarray(out_ok, np.bool_))
+
+
+class Slice(Expression):
+    """slice(arr, start, length): 1-based; negative start counts from the
+    end; start=0 errors; negative length errors."""
+
+    def __init__(self, child: Expression, start: Expression,
+                 length: Expression):
+        self.children = [child, _wrap(start), _wrap(length)]
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        from spark_rapids_tpu.ops import kernels as K
+        arr = self.children[0].eval_tpu(ctx)
+        st = self.children[1].eval_tpu(ctx)
+        ln = self.children[2].eval_tpu(ctx)
+        child, seg, e, in_range, start = _elem_layout(arr)
+        cap = arr.capacity
+        _, lens = _offsets(arr)
+        valid = (_valid_of(arr, ctx) & _valid_of(st, ctx)
+                 & _valid_of(ln, ctx))
+        s = st.data.astype(jnp.int32)
+        l = ln.data.astype(jnp.int32)
+        ctx.add_error("SliceStartZero", valid & (s == 0))
+        ctx.add_error("SliceNegativeLength", valid & (l < 0))
+        begin = jnp.where(s > 0, s - 1, lens + s)  # 0-based
+        begin_c = jnp.clip(begin, 0, lens)
+        out_len = jnp.clip(jnp.minimum(l, lens - begin_c), 0, None)
+        out_len = jnp.where(valid & (begin >= 0) & (begin < lens),
+                            out_len, 0)
+        new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(out_len).astype(jnp.int32)])
+        child_cap = child.capacity
+        oe = jnp.arange(child_cap, dtype=jnp.int32)
+        oseg = jnp.clip(jnp.searchsorted(new_off, oe, side="right")
+                        .astype(jnp.int32) - 1, 0, cap - 1)
+        o_in = oe < new_off[cap]
+        src = jnp.where(
+            o_in, start[oseg] + begin_c[oseg] + (oe - new_off[oseg]), -1)
+        out_child = K.gather_column(child, src, child_cap)
+        return ColumnVector(self.data_type(),
+                            {"offsets": new_off, "child": out_child}, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        st = self.children[1].eval_cpu(cols, ansi)
+        ln = self.children[2].eval_cpu(cols, ansi)
+        out_v, out_ok = [], []
+        for (v, ok), (s, sok), (l, lok) in zip(
+                zip(arr.values, arr.valid), zip(st.values, st.valid),
+                zip(ln.values, ln.valid)):
+            if not ok or v is None or not sok or not lok:
+                out_v.append(None)
+                out_ok.append(False)
+                continue
+            s, l = int(s), int(l)
+            if s == 0:
+                raise SparkException("Unexpected value for start in slice: "
+                                     "SQL array indices start at 1")
+            if l < 0:
+                raise SparkException(
+                    f"Unexpected value for length in slice: {l}")
+            b = s - 1 if s > 0 else len(v) + s
+            out_v.append(v[b: b + l] if b >= 0 else [])
+            out_ok.append(True)
+        return CpuCol(self.data_type(), np.array(out_v, object),
+                      np.asarray(out_ok, np.bool_))
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc): nulls first when ascending, last when
+    descending (Spark semantics)."""
+
+    def __init__(self, child: Expression, asc: bool = True):
+        self.children = [child]
+        self.asc = bool(asc)
+
+    def _params(self):
+        return str(self.asc)
+
+    def with_children(self, children):
+        return SortArray(children[0], self.asc)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def supported_on_tpu(self):
+        et = self.children[0].data_type().element
+        return not isinstance(et, (T.StringType, T.ArrayType, T.MapType,
+                                   T.StructType))
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        from spark_rapids_tpu.ops import kernels as K
+        from spark_rapids_tpu.ops import radix as R
+        arr = self.children[0].eval_tpu(ctx)
+        child, seg, e, in_range, start = _elem_layout(arr)
+        child_cap = child.capacity
+        cap = arr.capacity
+        et = self.data_type().element
+        d = np.dtype(et.np_dtype)
+        if d in (np.dtype(np.float64), np.dtype(np.float32)):
+            o = R._f64_order_i64(child.data.astype(jnp.float64))
+        else:
+            o = child.data.astype(jnp.int64)
+        if not self.asc:
+            o = ~o  # descending: monotone bitwise reversal (no overflow)
+        cv = (child.validity if child.validity is not None
+              else jnp.ones(child_cap, jnp.bool_))
+        # Spark puts nulls FIRST ascending, LAST descending: in the
+        # ascending sort of the (possibly reversed) key that is -inf for
+        # asc and +inf for desc
+        null_key = jnp.int64(np.iinfo(np.int64).min if self.asc
+                             else np.iinfo(np.int64).max)
+        o = jnp.where(cv, o, null_key)
+        segK = jnp.where(in_range, seg, cap).astype(jnp.int32)
+        iota = jnp.arange(child_cap, dtype=jnp.int32)
+        ss, oo, si = jax.lax.sort((segK, o, iota), num_keys=2)
+        # sorted elements land back contiguously: position i of the sorted
+        # union IS the destination (rows are contiguous in both layouts)
+        out_child = K.gather_column(child, jnp.where(ss < cap, si, -1),
+                                    child_cap)
+        return ColumnVector(self.data_type(),
+                            {"offsets": arr.data["offsets"],
+                             "child": out_child}, arr.validity)
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        out_v = []
+        for v, ok in zip(arr.values, arr.valid):
+            if not ok or v is None:
+                out_v.append(None)
+                continue
+            nn = [x for x in v if x is not None]
+            nulls = [None] * (len(v) - len(nn))
+            key = (lambda x: (np.isnan(x), x)) \
+                if nn and isinstance(nn[0], float) else (lambda x: x)
+            nn.sort(key=key, reverse=not self.asc)
+            out_v.append(nulls + nn if self.asc else nn + nulls)
+        return CpuCol(self.data_type(), np.array(out_v, object),
+                      arr.valid.copy())
+
+
+class Flatten(Expression):
+    """flatten(arr<arr<T>>): null if the outer row or ANY inner array is
+    null."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self):
+        return self.children[0].data_type().element
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        inner = arr.data["child"]  # array<T> column over mid elements
+        cap = arr.capacity
+        off = arr.data["offsets"]
+        start = off[:cap]
+        end = off[1: cap + 1]
+        ioff = inner.data["offsets"]
+        mid_cap = inner.capacity
+        # out offsets: inner_off at each outer boundary
+        new_off = ioff[jnp.clip(off[: cap + 1], 0, mid_cap)]
+        new_off = new_off - new_off[0]
+        mid_valid = (inner.validity if inner.validity is not None
+                     else jnp.ones(mid_cap, jnp.bool_))
+        seg = _element_segments(off[: cap + 1], cap, mid_cap)
+        m = jnp.arange(mid_cap, dtype=jnp.int32)
+        m_in = m < off[cap]
+        has_null_inner = jnp.zeros(cap, jnp.bool_).at[
+            jnp.where(m_in, seg, cap)].max(~mid_valid, mode="drop")
+        valid = _valid_of(arr, ctx) & ~has_null_inner
+        return ColumnVector(self.data_type(),
+                            {"offsets": new_off.astype(jnp.int32),
+                             "child": inner.data["child"]}, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        out_v, out_ok = [], []
+        for v, ok in zip(arr.values, arr.valid):
+            if not ok or v is None or any(x is None for x in v):
+                out_v.append(None)
+                out_ok.append(False)
+                continue
+            out_v.append([el for sub in v for el in sub])
+            out_ok.append(True)
+        return CpuCol(self.data_type(), np.array(out_v, object),
+                      np.asarray(out_ok, np.bool_))
+
+
+class ArrayDistinct(Expression):
+    """array_distinct(arr): first-occurrence order; at most one null kept.
+    String elements use the 64-bit equality hash (documented incompat)."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        arr = self.children[0].eval_tpu(ctx)
+        child, seg, e, in_range, _ = _elem_layout(arr)
+        k, nulls = _elem_eq_key(child, in_range, ctx.num_rows)
+        keep, _ = _group_first_flags(seg, k, nulls, in_range, arr.capacity,
+                                     child.capacity)
+        return _compact_elements(arr, keep)
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        out_v = []
+        for v, ok in zip(arr.values, arr.valid):
+            if not ok or v is None:
+                out_v.append(None)
+                continue
+            seen, row = set(), []
+            saw_null = False
+            for el in v:
+                if el is None:
+                    if not saw_null:
+                        saw_null = True
+                        row.append(None)
+                elif el not in seen:
+                    seen.add(el)
+                    row.append(el)
+            out_v.append(row)
+        return CpuCol(self.data_type(), np.array(out_v, object),
+                      arr.valid.copy())
+
+
+class _ArraySetBase(Expression):
+    """Shared union/intersect/except machinery."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    def data_type(self):
+        lt = self.children[0].data_type()
+        rt = self.children[1].data_type()
+        return T.ArrayType(T.common_type(lt.element, rt.element))
+
+    def _cpu_rows(self, cols, ansi):
+        a = self.children[0].eval_cpu(cols, ansi)
+        b = self.children[1].eval_cpu(cols, ansi)
+        out_v, out_ok = [], []
+        for (av, aok), (bv, bok) in zip(zip(a.values, a.valid),
+                                        zip(b.values, b.valid)):
+            if not aok or av is None or not bok or bv is None:
+                out_v.append(None)
+                out_ok.append(False)
+                continue
+            out_v.append(self._combine(av, bv))
+            out_ok.append(True)
+        return CpuCol(self.data_type(), np.array(out_v, object),
+                      np.asarray(out_ok, np.bool_))
+
+    eval_cpu = _cpu_rows
+
+    @staticmethod
+    def _dedup(vals):
+        seen, out, saw_null = set(), [], False
+        for el in vals:
+            if el is None:
+                if not saw_null:
+                    saw_null = True
+                    out.append(None)
+            elif el not in seen:
+                seen.add(el)
+                out.append(el)
+        return out
+
+
+class ArrayUnion(_ArraySetBase):
+    """array_union(a, b): distinct elements of a then b."""
+
+    def _combine(self, av, bv):
+        return self._dedup(list(av) + list(bv))
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        from spark_rapids_tpu.ops import kernels as K
+        a = self.children[0].eval_tpu(ctx)
+        b = self.children[1].eval_tpu(ctx)
+        # concat per row, then distinct: build the concatenated array
+        # column (a's elements then b's within each row), reusing concat
+        # offsets arithmetic.
+        cat = _concat_arrays_tpu(a, b, ctx, self.data_type())
+        child, seg, e, in_range, _ = _elem_layout(cat)
+        k, nulls = _elem_eq_key(child, in_range, ctx.num_rows)
+        keep, _ = _group_first_flags(seg, k, nulls, in_range, cat.capacity,
+                                     child.capacity)
+        out = _compact_elements(cat, keep, self.data_type())
+        valid = _valid_of(a, ctx) & _valid_of(b, ctx)
+        return ColumnVector(out.dtype, out.data, valid)
+
+
+class ArrayIntersect(_ArraySetBase):
+    """array_intersect(a, b): distinct elements of a present in b."""
+
+    def _combine(self, av, bv):
+        bs = set(x for x in bv if x is not None)
+        bnull = any(x is None for x in bv)
+        return self._dedup([x for x in av
+                            if (x is None and bnull)
+                            or (x is not None and x in bs)])
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        a = self.children[0].eval_tpu(ctx)
+        b = self.children[1].eval_tpu(ctx)
+        present, (a_child, a_seg, a_e, a_in), b_has_null, anull = \
+            _membership_flags(a, b, ctx.num_rows)
+        k, nulls = _elem_eq_key(a_child, a_in, ctx.num_rows)
+        first, _ = _group_first_flags(a_seg, k, nulls, a_in, a.capacity,
+                                      a_child.capacity)
+        keep = first & jnp.where(nulls, b_has_null[jnp.clip(
+            a_seg, 0, a.capacity - 1)], present)
+        out = _compact_elements(a, keep, self.data_type())
+        valid = _valid_of(a, ctx) & _valid_of(b, ctx)
+        return ColumnVector(out.dtype, out.data, valid)
+
+
+class ArrayExcept(_ArraySetBase):
+    """array_except(a, b): distinct elements of a NOT present in b."""
+
+    def _combine(self, av, bv):
+        bs = set(x for x in bv if x is not None)
+        bnull = any(x is None for x in bv)
+        return self._dedup([x for x in av
+                            if (x is None and not bnull)
+                            or (x is not None and x not in bs)])
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        a = self.children[0].eval_tpu(ctx)
+        b = self.children[1].eval_tpu(ctx)
+        present, (a_child, a_seg, a_e, a_in), b_has_null, anull = \
+            _membership_flags(a, b, ctx.num_rows)
+        k, nulls = _elem_eq_key(a_child, a_in, ctx.num_rows)
+        first, _ = _group_first_flags(a_seg, k, nulls, a_in, a.capacity,
+                                      a_child.capacity)
+        keep = first & jnp.where(nulls, ~b_has_null[jnp.clip(
+            a_seg, 0, a.capacity - 1)], ~present)
+        out = _compact_elements(a, keep, self.data_type())
+        valid = _valid_of(a, ctx) & _valid_of(b, ctx)
+        return ColumnVector(out.dtype, out.data, valid)
+
+
+class ArraysOverlap(Expression):
+    """arrays_overlap(a, b): true if a common non-null element exists;
+    otherwise null if either side has a null element (and both non-empty);
+    else false."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        a = self.children[0].eval_tpu(ctx)
+        b = self.children[1].eval_tpu(ctx)
+        present, (a_child, a_seg, a_e, a_in), b_has_null, anull = \
+            _membership_flags(a, b, ctx.num_rows)
+        cap = a.capacity
+        segc = jnp.where(a_in, a_seg, cap)
+        common = jnp.zeros(cap, jnp.bool_).at[segc].max(
+            present & ~anull, mode="drop")
+        a_has_null = jnp.zeros(cap, jnp.bool_).at[segc].max(anull,
+                                                            mode="drop")
+        _, alens = _offsets(a)
+        _, blens = _offsets(b)
+        nonempty = (alens > 0) & (blens > 0)
+        unknown = nonempty & (a_has_null | b_has_null) & ~common
+        valid = _valid_of(a, ctx) & _valid_of(b, ctx) & ~unknown
+        return ColumnVector(T.BOOLEAN, common, valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        a = self.children[0].eval_cpu(cols, ansi)
+        b = self.children[1].eval_cpu(cols, ansi)
+        out_v, out_ok = [], []
+        for (av, aok), (bv, bok) in zip(zip(a.values, a.valid),
+                                        zip(b.values, b.valid)):
+            if not aok or av is None or not bok or bv is None:
+                out_v.append(False)
+                out_ok.append(False)
+                continue
+            bs = set(x for x in bv if x is not None)
+            common = any(x is not None and x in bs for x in av)
+            has_null = (any(x is None for x in av)
+                        or any(x is None for x in bv))
+            unknown = (len(av) > 0 and len(bv) > 0 and has_null
+                       and not common)
+            out_v.append(common)
+            out_ok.append(not unknown)
+        return CpuCol(T.BOOLEAN, np.asarray(out_v, np.bool_),
+                      np.asarray(out_ok, np.bool_))
+
+
+def _concat_arrays_tpu(a: ColumnVector, b: ColumnVector, ctx,
+                       out_t: T.DataType) -> ColumnVector:
+    """Row-wise array concat: a's elements then b's. Child capacity is the
+    sum of both child planes (static)."""
+    from spark_rapids_tpu.ops import kernels as K
+    cap = a.capacity
+    _, alens = _offsets(a)
+    _, blens = _offsets(b)
+    astart = a.data["offsets"][:cap]
+    bstart = b.data["offsets"][:cap]
+    olen = alens + blens
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(olen).astype(jnp.int32)])
+    a_child, b_child = a.data["child"], b.data["child"]
+    out_cap = a_child.capacity + b_child.capacity
+    e = jnp.arange(out_cap, dtype=jnp.int32)
+    seg = jnp.clip(jnp.searchsorted(new_off, e, side="right")
+                   .astype(jnp.int32) - 1, 0, cap - 1)
+    o_in = e < new_off[cap]
+    j = e - new_off[seg]
+    from_a = j < alens[seg]
+    a_idx = jnp.where(o_in & from_a, astart[seg] + j, -1)
+    b_idx = jnp.where(o_in & ~from_a, bstart[seg] + (j - alens[seg]), -1)
+    av = K.gather_column(a_child, a_idx, a_child.capacity)
+    bv = K.gather_column(b_child, b_idx, b_child.capacity)
+    et = out_t.element
+    data = jnp.where(from_a, av.data.astype(et.np_dtype),
+                     bv.data.astype(et.np_dtype)) \
+        if not a_child.is_string else None
+    if data is None:
+        raise NotImplementedError("string array concat on device")
+    va = av.validity if av.validity is not None else o_in
+    vb = bv.validity if bv.validity is not None else o_in
+    valid = jnp.where(from_a, va, vb) & o_in
+    child = ColumnVector(et, data, valid)
+    return ColumnVector(out_t, {"offsets": new_off, "child": child}, None)

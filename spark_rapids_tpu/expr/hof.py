@@ -113,35 +113,6 @@ def make_lambda(fn: Callable, arg_types: Sequence[T.DataType],
     return body, vs
 
 
-class _OuterCols:
-    """Lazily gathers outer-row columns to element positions so BoundRefs
-    inside a lambda body see element-capacity columns. Gathers happen at
-    most once per referenced column per stage (all inside the same trace,
-    so XLA dedups further)."""
-
-    def __init__(self, row_cols, seg, in_range):
-        self._rows = row_cols
-        self._seg = seg
-        self._in_range = in_range
-        self._cache = {}
-
-    def __len__(self):
-        return len(self._rows)
-
-    def __getitem__(self, i):
-        out = self._cache.get(i)
-        if out is None:
-            from spark_rapids_tpu.ops import kernels as K
-            c = self._rows[i]
-            out = K.gather_column(
-                c, jnp.where(self._in_range, self._seg, -1), c.capacity)
-            self._cache[i] = out
-        return out
-
-    def __iter__(self):
-        return (self[i] for i in range(len(self._rows)))
-
-
 def _element_ctx(ctx: EvalCtx, arr: ColumnVector, bindings: dict):
     """EvalCtx over the element plane of `arr`, with outer refs gathered
     and `bindings` (var_id -> element ColumnVector) installed. Returns
@@ -157,11 +128,13 @@ def _element_ctx(ctx: EvalCtx, arr: ColumnVector, bindings: dict):
     live_at_e = K.gather_column(
         ColumnVector(T.BOOLEAN, row_live, None), seg, cap).data
     in_range = (e < off[cap]) & live_at_e.astype(jnp.bool_)
+    from spark_rapids_tpu.ops import kernels as K
     ectx = EvalCtx([], jnp.sum(in_range.astype(jnp.int32)), child_cap,
                    ctx.ansi, live=in_range,
                    partition_id=ctx.partition_id, row_base=ctx.row_base)
     # lazily-gathering column view AFTER init (EvalCtx list()s its arg)
-    ectx.columns = _OuterCols(ctx.columns, seg, in_range)
+    ectx.columns = K.LazyGatheredCols(
+        ctx.columns, jnp.where(in_range, seg, -1), ctx.num_rows)
     ectx.lambda_bindings = dict(getattr(ctx, "lambda_bindings", {}))
     ectx.lambda_bindings.update(bindings)
     return ectx, seg, in_range, off[:cap]
@@ -685,10 +658,12 @@ class ZipWith(_HofBase):
                              a.data["child"].capacity)
         bv = K.gather_column(b.data["child"], b_idx,
                              b.data["child"].capacity)
+        from spark_rapids_tpu.ops import kernels as K
         ectx = EvalCtx([], jnp.sum(in_range.astype(jnp.int32)), out_cap,
                        ctx.ansi, live=in_range,
                        partition_id=ctx.partition_id, row_base=ctx.row_base)
-        ectx.columns = _OuterCols(ctx.columns, seg, in_range)
+        ectx.columns = K.LazyGatheredCols(
+            ctx.columns, jnp.where(in_range, seg, -1), ctx.num_rows)
         ectx.lambda_bindings = dict(getattr(ctx, "lambda_bindings", {}))
         ectx.lambda_bindings[self.vars[0].var_id] = av
         ectx.lambda_bindings[self.vars[1].var_id] = bv

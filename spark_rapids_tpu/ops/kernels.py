@@ -476,6 +476,34 @@ def flat_string_as_dict(col: ColumnVector) -> ColumnVector:
     return ColumnVector(col.dtype, data, col.validity, dict_unique=False)
 
 
+class LazyGatheredCols:
+    """A column list view that gathers source columns by a shared index
+    plane ON FIRST ACCESS (memoized). Lambda bodies (expr/hof) and window
+    functions (exec/tpu_nodes) evaluate over reindexed row spaces where
+    most columns are never read — a 16M-row gather costs ~200ms, so
+    laziness is worth real wall-clock, and XLA CSEs the duplicate index
+    arithmetic for the columns that ARE read."""
+
+    def __init__(self, cols, indices, num_rows):
+        self._cols = cols
+        self._idx = indices
+        self._rows = num_rows
+        self._cache = {}
+
+    def __len__(self):
+        return len(self._cols)
+
+    def __getitem__(self, i):
+        out = self._cache.get(i)
+        if out is None:
+            out = gather_column(self._cols[i], self._idx, self._rows)
+            self._cache[i] = out
+        return out
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._cols)))
+
+
 def gather_batch(batch: ColumnarBatch, indices: jax.Array, out_rows: int) -> ColumnarBatch:
     live = batch.live_mask() if batch.row_mask is not None else None
     cols = [gather_column(c, indices, batch.num_rows, src_live=live)

@@ -157,9 +157,17 @@ def _rlike_check(e):
 
 expr_rule(S.RLike, Sigs.COMMON, Sigs.COMMON,
           "Java regex match (bit-parallel device NFA)", extra=_rlike_check)
+def _extract_check(e):
+    if not e.supported_on_tpu():
+        return (f"regexp_extract pattern {e.pattern!r} outside the tagged "
+                f"device NFA subset: {e._nfa_err} (reference RegexParser "
+                f"reject strategy)")
+    return None
+
+
 expr_rule(S.RegexpExtract, Sigs.COMMON, Sigs.COMMON,
-          "regex capture extract (CPU: needs backtracking groups)",
-          extra=lambda e: "capture-group regex runs on CPU")
+          "regex capture extract (tagged device NFA; rejects fall back)",
+          extra=_extract_check)
 expr_rule(S.RegexpReplace, Sigs.COMMON, Sigs.COMMON,
           "regex replace (CPU: needs backtracking groups)",
           extra=lambda e: "capture-group regex runs on CPU")
@@ -290,10 +298,20 @@ for _cls in (S.Trim, S.LTrim, S.RTrim, S.InitCap, S.Ascii, S.InStr,
     expr_rule(_cls, Sigs.COMMON, Sigs.COMMON, _cls.__name__.lower())
 
 
+expr_rule(DT.FromUtcTimestamp, Sigs.COMMON, Sigs.COMMON,
+          "from_utc_timestamp (IANA transition table on device)",
+          extra=lambda e: None if e.supported_on_tpu()
+          else f"unknown timezone {e.zone!r}")
+expr_rule(DT.ToUtcTimestamp, Sigs.COMMON, Sigs.COMMON,
+          "to_utc_timestamp (IANA transition table on device)",
+          extra=lambda e: None if e.supported_on_tpu()
+          else f"unknown timezone {e.zone!r}")
+
+
 # higher-order functions (lambdas over arrays/maps) — hof.py
 from spark_rapids_tpu.expr import hof as H  # noqa: E402
 
-_ARR = TypeSig(["ARRAY", "MAP", "NULL"]) + Sigs.COMMON
+_ARR = Sigs.COMMON.nested()
 expr_rule(H.LambdaVar, Sigs.COMMON, Sigs.COMMON, "lambda parameter")
 expr_rule(H.ArrayTransform, _ARR, _ARR, "transform(array, lambda)")
 expr_rule(H.ArrayFilter, _ARR, _ARR, "filter(array, lambda)")
@@ -304,7 +322,32 @@ expr_rule(H.TransformValues, _ARR, _ARR, "transform_values(map, lambda)")
 expr_rule(H.MapFilter, _ARR, _ARR, "map_filter(map, lambda)")
 expr_rule(H.ZipWith, _ARR, _ARR, "zip_with(a, b, lambda)")
 expr_rule(H.ArrayAggregate, _ARR, Sigs.COMMON,
-          "aggregate(array, zero, merge[, finish]) — CPU fold")
+          "aggregate(array, zero, merge[, finish]) — CPU fold",
+          extra=lambda e: "aggregate() sequential lambda fold runs on CPU")
+
+
+# array collection operations — array_ops.py
+from spark_rapids_tpu.expr import array_ops as AO  # noqa: E402
+
+expr_rule(AO.ArrayMin, _ARR, Sigs.COMMON, "array_min",
+          extra=lambda e: None if e.supported_on_tpu()
+          else "array_min over string/nested elements runs on CPU")
+expr_rule(AO.ArrayMax, _ARR, Sigs.COMMON, "array_max",
+          extra=lambda e: None if e.supported_on_tpu()
+          else "array_max over string/nested elements runs on CPU")
+expr_rule(AO.ArrayPosition, _ARR, Sigs.COMMON, "array_position")
+expr_rule(AO.ArrayRemove, _ARR, _ARR, "array_remove")
+expr_rule(AO.Slice, _ARR, _ARR, "slice")
+expr_rule(AO.SortArray, _ARR, _ARR, "sort_array",
+          extra=lambda e: None if e.supported_on_tpu()
+          else "sort_array over string/nested elements runs on CPU")
+expr_rule(AO.Flatten, _ARR, _ARR, "flatten")
+expr_rule(AO.ArrayDistinct, _ARR, _ARR,
+          "array_distinct (string elements dedup by 64-bit hash)")
+expr_rule(AO.ArrayUnion, _ARR, _ARR, "array_union")
+expr_rule(AO.ArrayIntersect, _ARR, _ARR, "array_intersect")
+expr_rule(AO.ArrayExcept, _ARR, _ARR, "array_except")
+expr_rule(AO.ArraysOverlap, _ARR, Sigs.COMMON, "arrays_overlap")
 
 
 # Aggregate function rules
@@ -407,12 +450,18 @@ def _register_tz_sensitive():
 
 def _check_session_timezone(e: E.Expression, conf, where: str) -> None:
     """Reference discipline (GpuOverrides nonUTC tagging): a non-UTC session
-    timezone must never silently produce UTC answers. Our CPU interpreter is
-    also UTC-only, so unlike the reference (which can fall back to CPU
-    Spark) the only honest behavior is to refuse the plan outright."""
+    timezone must never silently produce UTC answers. Zones resolvable
+    from the IANA database are handled by the localize_session_tz plan
+    rewrite (expressions arriving here are already shifted); anything else
+    (unknown zone string) is refused outright — our CPU interpreter is
+    also UTC-only, so unlike the reference there is nothing to fall back
+    to."""
     tz = conf.get(C.SESSION_TIMEZONE)
     if tz in _UTC_NAMES:
         return
+    from spark_rapids_tpu.expr import tzdb
+    if tzdb.is_valid_zone(tz):
+        return  # localize_session_tz already rewrote the plan
     if not _TZ_SENSITIVE:
         _register_tz_sensitive()
     if not isinstance(e, _TZ_SENSITIVE):
@@ -426,6 +475,123 @@ def _check_session_timezone(e: E.Expression, conf, where: str) -> None:
             f"{where}: {type(e).__name__} with spark.sql.session.timeZone="
             f"{tz!r} is not supported (this engine evaluates timestamps in "
             f"UTC only); set the session timezone to UTC")
+
+
+def _localize_node_fn(tz: str):
+    """Per-node rewrite for timezone localization — suitable for ONE
+    bottom-up transform() application over an expression tree. (Applying
+    the whole-tree localize_expr at every node would re-wrap already
+    localized children and shift timestamps twice.)"""
+    if not _TZ_SENSITIVE:
+        _register_tz_sensitive()
+    from spark_rapids_tpu.expr import cpu_functions as CPUF
+    from spark_rapids_tpu.expr.core import Cast
+
+    def is_ts(x):
+        try:
+            return isinstance(x.data_type(), T.TimestampType)
+        except Exception:  # noqa: BLE001 - unresolved stays untouched
+            return False
+
+    def wrap_ts_children(node):
+        kids = [DT.FromUtcTimestamp(c, tz) if is_ts(c) else c
+                for c in node.children]
+        return node.with_children(kids)
+
+    def f(node):
+        if isinstance(node, _TZ_SENSITIVE) and not isinstance(
+                node, DT.UnixTimestampFromTs):
+            # field extraction / formatting of a ts happens in local time
+            if any(is_ts(c) for c in node.children):
+                return wrap_ts_children(node)
+            if isinstance(node, CPUF.FromUnixtime):
+                # seconds -> formatted local string: shift via ts domain
+                sec = node.children[0]
+                shifted = DT.UnixTimestampFromTs(
+                    DT.FromUtcTimestamp(DT.TimestampSeconds(sec), tz))
+                return node.with_children([shifted] + node.children[1:])
+            return node
+        if isinstance(node, Cast):
+            src = None
+            try:
+                src = node.children[0].data_type()
+            except Exception:  # noqa: BLE001
+                return node
+            dst = node.to
+            if isinstance(src, T.TimestampType) and isinstance(
+                    dst, (T.DateType, T.StringType)):
+                return node.with_children(
+                    [DT.FromUtcTimestamp(node.children[0], tz)])
+            if isinstance(dst, T.TimestampType) and isinstance(
+                    src, (T.DateType, T.StringType)):
+                return DT.ToUtcTimestamp(node, tz)
+        return node
+
+    return f
+
+
+def localize_expr(e: E.Expression, tz: str) -> E.Expression:
+    """Rewrite a timezone-sensitive expression for a non-UTC session by
+    shifting TIMESTAMP operands through the zone's transition table
+    (reference: the GpuTimeZoneDB rewrite inside each datetime kernel;
+    here it is ONE plan-level rule so every extraction/format expression
+    stays a plain UTC kernel). Spark timestamps are instants; the session
+    timezone affects field extraction, formatting/parsing, and
+    date<->timestamp casts — exactly the places wrapped here."""
+    return e.transform(_localize_node_fn(tz))
+
+
+def localize_plan(plan, conf):
+    """Apply localize_expr to every expression in the plan when the
+    session timezone is a resolvable non-UTC zone."""
+    tz = conf.get(C.SESSION_TIMEZONE)
+    if tz in _UTC_NAMES:
+        return plan
+    from spark_rapids_tpu.expr import tzdb
+    if not tzdb.is_valid_zone(tz):
+        return plan  # tagging will refuse tz-sensitive expressions
+    from spark_rapids_tpu.plan import nodes as P
+
+    node_f = _localize_node_fn(tz)
+
+    def fix(e):
+        return e.transform(node_f)
+
+    def walk(n):
+        for c in n.children:
+            walk(c)
+        if isinstance(n, P.Project):
+            n.exprs = [fix(e) for e in n.exprs]
+        elif isinstance(n, P.Filter):
+            n.condition = fix(n.condition)
+        elif isinstance(n, P.Aggregate):
+            n.group_exprs = [fix(e) for e in n.group_exprs]
+            # transform() visits every node once bottom-up; pass the
+            # NODE function (the tree-level fix would double-wrap)
+            n.aggs = [a.transform(node_f) for a in n.aggs]
+        elif isinstance(n, P.Generate):
+            n.generator = fix(n.generator)
+        elif isinstance(n, P.Expand):
+            n.projections = [[fix(e) for e in row]
+                             for row in n.projections]
+        elif isinstance(n, P.Join):
+            n.left_keys = [fix(e) for e in n.left_keys]
+            n.right_keys = [fix(e) for e in n.right_keys]
+            if n.condition is not None:
+                n.condition = fix(n.condition)
+        elif isinstance(n, P.Sort):
+            for o in n.orders:
+                o.expr = fix(o.expr)
+        elif isinstance(n, P.WindowNode):
+            for we in n.window_exprs:
+                we.spec.partition_exprs = [fix(e)
+                                           for e in we.spec.partition_exprs]
+                for o in we.spec.order_specs:
+                    o.expr = fix(o.expr)
+                we.fn = fix(we.fn)
+
+    walk(plan)
+    return plan
 
 
 def tag_expression(e: E.Expression, conf, reasons: List[str], where: str) -> None:
@@ -998,6 +1164,7 @@ def convert_plan(plan: P.PlanNode, conf):
     """Returns (root_exec, meta). In explainOnly mode no device is required
     by conversion since nothing executes until iteration."""
     from spark_rapids_tpu.plan.prune import prune_plan
+    plan = localize_plan(plan, conf)
     plan = prune_plan(plan)
     meta = wrap_and_tag(plan, conf)
     from spark_rapids_tpu.plan.cost import apply_cost_optimizer

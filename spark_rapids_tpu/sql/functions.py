@@ -692,3 +692,81 @@ def map_filter(c, fn):
     from spark_rapids_tpu.expr import hof as H
     body, vs = _lambda(fn, 2, ["k", "v"])
     return H.MapFilter(_e(c), body, vs)
+
+
+# ---------------------------------------------------------------------------
+# Array collection operations (reference collectionOperations.scala)
+# ---------------------------------------------------------------------------
+
+def array_min(c):
+    from spark_rapids_tpu.expr.array_ops import ArrayMin
+    return ArrayMin(_e(c))
+
+
+def array_max(c):
+    from spark_rapids_tpu.expr.array_ops import ArrayMax
+    return ArrayMax(_e(c))
+
+
+def array_position(c, v):
+    from spark_rapids_tpu.expr.array_ops import ArrayPosition
+    return ArrayPosition(_e(c), _e(v))
+
+
+def array_remove(c, v):
+    from spark_rapids_tpu.expr.array_ops import ArrayRemove
+    return ArrayRemove(_e(c), _e(v))
+
+
+def slice(c, start, length):  # noqa: A001 - Spark's F.slice
+    from spark_rapids_tpu.expr.array_ops import Slice
+    return Slice(_e(c), _e(start), _e(length))
+
+
+def sort_array(c, asc=True):
+    from spark_rapids_tpu.expr.array_ops import SortArray
+    return SortArray(_e(c), asc)
+
+
+def flatten(c):
+    from spark_rapids_tpu.expr.array_ops import Flatten
+    return Flatten(_e(c))
+
+
+def array_distinct(c):
+    from spark_rapids_tpu.expr.array_ops import ArrayDistinct
+    return ArrayDistinct(_e(c))
+
+
+def array_union(a, b):
+    from spark_rapids_tpu.expr.array_ops import ArrayUnion
+    return ArrayUnion(_e(a), _e(b))
+
+
+def array_intersect(a, b):
+    from spark_rapids_tpu.expr.array_ops import ArrayIntersect
+    return ArrayIntersect(_e(a), _e(b))
+
+
+def array_except(a, b):
+    from spark_rapids_tpu.expr.array_ops import ArrayExcept
+    return ArrayExcept(_e(a), _e(b))
+
+
+def arrays_overlap(a, b):
+    from spark_rapids_tpu.expr.array_ops import ArraysOverlap
+    return ArraysOverlap(_e(a), _e(b))
+
+
+def from_utc_timestamp(ts, tz):
+    from spark_rapids_tpu.expr.datetime import FromUtcTimestamp
+    from spark_rapids_tpu.expr.core import Literal
+    z = tz.value if isinstance(tz, Literal) else tz
+    return FromUtcTimestamp(_e(ts), z)
+
+
+def to_utc_timestamp(ts, tz):
+    from spark_rapids_tpu.expr.datetime import ToUtcTimestamp
+    from spark_rapids_tpu.expr.core import Literal
+    z = tz.value if isinstance(tz, Literal) else tz
+    return ToUtcTimestamp(_e(ts), z)

@@ -1,0 +1,132 @@
+"""Differential tests for non-lambda array collection operations
+(reference collectionOperations.scala scope)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _arrays(n=70, seed=13, lo=-20, hi=20, null_p=0.12):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        if rng.random() < 0.1:
+            rows.append(None)
+            continue
+        ln = int(rng.integers(0, 7))
+        rows.append([None if rng.random() < null_p else int(v)
+                     for v in rng.integers(lo, hi, ln)])
+    return rows
+
+
+def _tbl(n=70, seed=13):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "a": pa.array(_arrays(n, seed), pa.list_(pa.int64())),
+        "b": pa.array(_arrays(n, seed + 1), pa.list_(pa.int64())),
+        "v": pa.array(rng.integers(-20, 20, n).astype(np.int64)),
+        "s": pa.array(rng.integers(-3, 4, n).astype(np.int32)),
+        "l": pa.array(rng.integers(0, 5, n).astype(np.int32)),
+    })
+
+
+def test_array_min_max(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_tbl()).select(
+            F.array_min(col("a")).alias("mn"),
+            F.array_max(col("a")).alias("mx")),
+        session)
+
+
+def test_array_min_max_float_nan(session):
+    rows = [[1.5, float("nan"), -2.0], [float("nan")], [], None, [3.25]]
+    t = pa.table({"a": pa.array(rows, pa.list_(pa.float64()))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.array_min(col("a")).alias("mn"),
+            F.array_max(col("a")).alias("mx")),
+        session)
+
+
+def test_array_position_and_remove(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_tbl()).select(
+            F.array_position(col("a"), col("v")).alias("p"),
+            F.array_position(col("a"), lit(7)).alias("p7"),
+            F.array_remove(col("a"), col("v")).alias("r")),
+        session)
+
+
+def test_slice(session):
+    t = _tbl()
+    # start must be nonzero and length nonnegative for the valid path
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.slice(col("a"), F.when(col("s") == lit(0), lit(1))
+                    .otherwise(col("s")), col("l")).alias("sl"),
+            F.slice(col("a"), lit(2), lit(2)).alias("s22"),
+            F.slice(col("a"), lit(-2), lit(3)).alias("sneg")),
+        session)
+
+
+def test_sort_array(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_tbl()).select(
+            F.sort_array(col("a")).alias("sa"),
+            F.sort_array(col("a"), asc=False).alias("sd")),
+        session)
+
+
+def test_flatten(session):
+    rng = np.random.default_rng(2)
+    rows = []
+    for _ in range(50):
+        if rng.random() < 0.1:
+            rows.append(None)
+            continue
+        outer = []
+        for _ in range(int(rng.integers(0, 4))):
+            if rng.random() < 0.1:
+                outer.append(None)
+            else:
+                outer.append([int(v) for v in
+                              rng.integers(-9, 9, int(rng.integers(0, 4)))])
+        rows.append(outer)
+    t = pa.table({"aa": pa.array(rows, pa.list_(pa.list_(pa.int64())))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.flatten(col("aa")).alias("f")),
+        session)
+
+
+def test_array_distinct(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_tbl(seed=40)).select(
+            F.array_distinct(col("a")).alias("d")),
+        session)
+
+
+def test_array_set_ops(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_tbl(seed=41)).select(
+            F.array_union(col("a"), col("b")).alias("u"),
+            F.array_intersect(col("a"), col("b")).alias("i"),
+            F.array_except(col("a"), col("b")).alias("e")),
+        session)
+
+
+def test_arrays_overlap(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_tbl(seed=42)).select(
+            F.arrays_overlap(col("a"), col("b")).alias("o")),
+        session)
