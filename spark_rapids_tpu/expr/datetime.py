@@ -530,3 +530,108 @@ class TimestampSeconds(Expression):
     def eval_cpu(self, cols, ansi=False):
         c = self.children[0].eval_cpu(cols, ansi)
         return CpuCol(T.TIMESTAMP, c.values.astype(np.int64) * 1_000_000, c.valid)
+
+
+# ---------------------------------------------------------------------------
+# Timezone conversion (reference TimeZoneDB.scala + JNI GpuTimeZoneDB:
+# non-UTC sessions keep datetime expressions on device via an IANA
+# transition table; here the table is parsed host-side from TZif files
+# (expr/tzdb.py) and applied with a searchsorted over the few-hundred-entry
+# transition plane)
+# ---------------------------------------------------------------------------
+
+import datetime as _dt  # noqa: E402
+
+_EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+
+
+class _TzShiftBase(Expression):
+    """Shared machinery: per-row offset lookup from a zone's transition
+    table. The zone is plan-time constant (literal); non-literal zones
+    are tagged to CPU by the rule."""
+
+    def __init__(self, child: Expression, zone: str):
+        self.children = [child]
+        self.zone = str(zone)
+
+    def _params(self):
+        return self.zone
+
+    def with_children(self, children):
+        return type(self)(children[0], self.zone)
+
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def supported_on_tpu(self):
+        from spark_rapids_tpu.expr import tzdb
+        return tzdb.is_valid_zone(self.zone)
+
+    def _table(self):
+        from spark_rapids_tpu.expr import tzdb
+        return tzdb.zone_table(self.zone)
+
+
+class FromUtcTimestamp(_TzShiftBase):
+    """from_utc_timestamp(ts, zone): shift a UTC instant so its UTC
+    rendering equals the zone's wall clock."""
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        trans, offs = self._table()
+        v = c.data.astype(jnp.int64)
+        if len(trans) == 0:
+            out = v + jnp.int64(int(offs[0]))
+        else:
+            idx = jnp.searchsorted(jnp.asarray(trans), v, side="right")
+            out = v + jnp.asarray(offs)[idx]
+        return ColumnVector(T.TIMESTAMP, out, _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        from zoneinfo import ZoneInfo
+        c = self.children[0].eval_cpu(cols, ansi)
+        z = ZoneInfo(self.zone)
+        out = np.zeros(len(c.values), np.int64)
+        for i, (v, ok) in enumerate(zip(c.values, c.valid)):
+            if not ok:
+                continue
+            dt = _EPOCH + _dt.timedelta(microseconds=int(v))
+            off = dt.astimezone(z).utcoffset().total_seconds()
+            out[i] = int(v) + int(off * 1_000_000)
+        return CpuCol(T.TIMESTAMP, out, c.valid.copy())
+
+
+class ToUtcTimestamp(_TzShiftBase):
+    """to_utc_timestamp(ts, zone): interpret the timestamp's UTC rendering
+    as the zone's wall clock and return the instant. Gap/overlap times
+    resolve to the pre-transition (earlier) offset via the fold=0
+    local-boundary table (tzdb.local_boundaries), matching java.time and
+    this expression's zoneinfo-based CPU tier."""
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expr import tzdb
+        c = self.children[0].eval_tpu(ctx)
+        bounds, offs = tzdb.local_boundaries(self.zone)
+        v = c.data.astype(jnp.int64)
+        if len(bounds) == 0:
+            out = v - jnp.int64(int(offs[0]))
+        else:
+            idx = jnp.searchsorted(jnp.asarray(bounds), v, side="right")
+            out = v - jnp.asarray(offs)[idx]
+        return ColumnVector(T.TIMESTAMP, out, _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        from zoneinfo import ZoneInfo
+        c = self.children[0].eval_cpu(cols, ansi)
+        z = ZoneInfo(self.zone)
+        out = np.zeros(len(c.values), np.int64)
+        for i, (v, ok) in enumerate(zip(c.values, c.valid)):
+            if not ok:
+                continue
+            # interpret the UTC civil fields as zone-local (fold=0 picks
+            # the earlier offset in overlaps, pre-gap offset in gaps)
+            naive = _EPOCH + _dt.timedelta(microseconds=int(v))
+            local = naive.replace(tzinfo=z, fold=0)
+            out[i] = int(v) - int(local.utcoffset().total_seconds()
+                                  * 1_000_000)
+        return CpuCol(T.TIMESTAMP, out, c.valid.copy())

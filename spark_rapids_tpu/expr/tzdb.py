@@ -1,0 +1,151 @@
+"""Timezone transition database for device-side timestamp localization.
+
+Reference parity: sql-plugin TimeZoneDB.scala + the JNI GpuTimeZoneDB,
+which load IANA rules into a device table so non-UTC sessions keep
+datetime expressions on the GPU. The TPU-first shape of the same idea:
+
+- HOST, once per zone: parse the binary TZif file (RFC 8536) straight
+  from the system zoneinfo directory into (transition instants, UTC
+  offsets) arrays. Zones have a few hundred transitions; the table is
+  bytes, not megabytes.
+- DEVICE, per batch: ``searchsorted`` of the timestamp plane against the
+  transition instants (a log2(~300)-step branchless binary search over a
+  VMEM-resident table) + one gather for the offset. Future transitions
+  beyond the TZif data use the POSIX footer rule approximated by the
+  last recorded offset pair — correct for all zones whose current DST
+  rules match their final recorded year (the reference's table has the
+  same horizon discipline).
+
+Local->UTC (``to_utc_timestamp``) resolves through a LOCAL-wall-time
+boundary table (local_boundaries): DST gaps take the pre-gap offset and
+overlaps the earlier offset — java.time's fold=0 resolution, matching
+this module's zoneinfo-based CPU twin exactly.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from functools import lru_cache
+from typing import Tuple
+
+import numpy as np
+
+#: microseconds per second (Spark timestamps are int64 micros)
+_US = 1_000_000
+
+_TZPATHS = ("/usr/share/zoneinfo", "/usr/lib/zoneinfo",
+            "/usr/share/lib/zoneinfo", "/etc/zoneinfo")
+
+
+class UnknownTimeZone(ValueError):
+    pass
+
+
+def _read_tzif(zone: str) -> bytes:
+    if not zone or zone in (".", "..") or "//" in zone or "\0" in zone:
+        raise UnknownTimeZone(zone)
+    for base in _TZPATHS:
+        p = os.path.join(base, *zone.split("/"))
+        if os.path.isfile(p) and os.path.realpath(p).startswith(
+                os.path.realpath(base)):
+            with open(p, "rb") as f:
+                return f.read()
+    raise UnknownTimeZone(zone)
+
+
+def _parse_block(data: bytes, pos: int, time_size: int):
+    """One TZif data block; returns (transitions, offsets_sec, next_pos)."""
+    hdr = struct.unpack(">4s c 15x 6I", data[pos: pos + 44])
+    magic, _ver, isutcnt, isstdcnt, leapcnt, timecnt, typecnt, charcnt = hdr
+    if magic != b"TZif":
+        raise ValueError("not a TZif file")
+    pos += 44
+    tfmt = ">%d%s" % (timecnt, "q" if time_size == 8 else "l")
+    trans = struct.unpack_from(tfmt, data, pos)
+    pos += timecnt * time_size
+    idx = struct.unpack_from(">%dB" % timecnt, data, pos)
+    pos += timecnt
+    types = []
+    for _ in range(typecnt):
+        utoff, isdst, abbrind = struct.unpack_from(">lBB", data, pos)
+        types.append(utoff)
+        pos += 6
+    pos += charcnt
+    pos += leapcnt * (time_size + 4)
+    pos += isstdcnt + isutcnt
+    offsets = [types[i] for i in idx]
+    #: offset BEFORE the first transition: the first non-dst type, else
+    #: type 0 (RFC 8536 §3.2 guidance)
+    base = types[0] if types else 0
+    return np.asarray(trans, np.int64), np.asarray(offsets, np.int64), \
+        np.int64(base), pos
+
+
+@lru_cache(maxsize=256)
+def zone_table(zone: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(transitions_us int64[n], offsets_us int64[n+1]) for a zone.
+    offsets_us[i] applies to instants < transitions_us[i] (offsets_us[0]
+    before all transitions); offsets_us[n] after the last."""
+    data = _read_tzif(zone)
+    trans, offs, base, pos = _parse_block(data, 0, 4)
+    if data[4:5] in (b"2", b"3"):
+        # v2+: a second block with 64-bit times supersedes the v1 data
+        trans, offs, base, _ = _parse_block(data, pos, 8)
+    if len(trans) == 0:
+        fixed = np.asarray([base * _US], np.int64)
+        return np.zeros(0, np.int64), fixed
+    offsets = np.concatenate([[base], offs]) * _US
+    return trans * _US, offsets
+
+
+def utc_offset_us(zone: str, ts_us: np.ndarray) -> np.ndarray:
+    """Host-side: UTC offset (us) in effect at each UTC instant."""
+    trans, offsets = zone_table(zone)
+    if len(trans) == 0:
+        return np.full(ts_us.shape, offsets[0], np.int64)
+    idx = np.searchsorted(trans, ts_us, side="right")
+    return offsets[idx]
+
+
+def from_utc_us(zone: str, ts_us: np.ndarray) -> np.ndarray:
+    return ts_us + utc_offset_us(zone, ts_us)
+
+
+@lru_cache(maxsize=256)
+def local_boundaries(zone: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(boundaries_us int64[n], offsets_us int64[n+1]) in LOCAL wall time
+    with java.time fold=0 resolution: the pre-transition offset applies
+    to every local instant below boundary[i] = trans[i] +
+    max(offset_before, offset_after) — which resolves DST gaps to the
+    pre-gap offset and overlaps to the earlier offset, both matching
+    ZonedDateTime.ofLocal/zoneinfo fold=0."""
+    trans, offsets = zone_table(zone)
+    if len(trans) == 0:
+        return trans, offsets
+    b = trans + np.maximum(offsets[:-1], offsets[1:])
+    # pathological zones (day-skip offset jumps) could locally unsort the
+    # boundaries; enforce monotonicity so searchsorted stays valid
+    b = np.maximum.accumulate(b)
+    return b, offsets
+
+
+def local_offset_us(zone: str, local_us: np.ndarray) -> np.ndarray:
+    """Host-side: UTC offset for LOCAL wall-clock instants (fold=0)."""
+    b, offsets = local_boundaries(zone)
+    if len(b) == 0:
+        return np.full(local_us.shape, offsets[0], np.int64)
+    idx = np.searchsorted(b, local_us, side="right")
+    return offsets[idx]
+
+
+def to_utc_us(zone: str, local_us: np.ndarray) -> np.ndarray:
+    """local->UTC with fold=0 (earlier-offset) resolution."""
+    return local_us - local_offset_us(zone, local_us)
+
+
+def is_valid_zone(zone: str) -> bool:
+    try:
+        zone_table(zone)
+        return True
+    except (UnknownTimeZone, ValueError, OSError):
+        return False

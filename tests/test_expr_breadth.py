@@ -238,10 +238,11 @@ def test_jax_udf_fuses_on_device(session):
         session, approx_float=1e-12)
 
 
-def test_non_utc_session_timezone_refused():
-    # Reading spark.sql.session.timeZone and silently answering in UTC is
-    # the failure mode the reference's non-UTC tagging prevents; since the
-    # CPU interpreter is UTC-only too, the engine must refuse outright.
+def test_non_utc_session_timezone():
+    # Resolvable IANA zones localize on device via the transition table
+    # (reference TimeZoneDB); unknown zone strings are still refused —
+    # silently answering in UTC is the failure mode the reference's
+    # non-UTC tagging prevents.
     import datetime as dtm
     import pytest as _pt
     from spark_rapids_tpu.expr.core import SparkException
@@ -251,12 +252,17 @@ def test_non_utc_session_timezone_refused():
         "d": pa.array([dtm.date(2024, 3, 7)], pa.date32()),
     })
     df = s.create_dataframe(t)
-    with _pt.raises(SparkException, match="session.timeZone"):
-        df.select(F.hour(col("ts")).alias("h")).collect()
-    # date-typed inputs are timezone-free and must still work
+    # 12:30 UTC = 07:30 EST
+    assert df.select(F.hour(col("ts")).alias("h")).to_pydict()["h"] == [7]
+    # date-typed inputs are timezone-free
     assert s.create_dataframe(t).select(
         F.year(col("d")).alias("y")).to_pydict()["y"] == [2024]
     # UTC spellings are all accepted
     s2 = TpuSession({"spark.sql.session.timeZone": "Etc/UTC"})
     assert s2.create_dataframe(t).select(
         F.hour(col("ts")).alias("h")).to_pydict()["h"] == [12]
+    # unknown zones refuse outright
+    s3 = TpuSession({"spark.sql.session.timeZone": "Not/AZone"})
+    with _pt.raises(SparkException, match="session.timeZone"):
+        s3.create_dataframe(t).select(
+            F.hour(col("ts")).alias("h")).collect()
