@@ -76,6 +76,14 @@ class Empty(RxNode):
     pass
 
 
+@dataclasses.dataclass
+class Group(RxNode):
+    """Capturing group marker (index 1-based). Transparent for matching;
+    the tagged extraction path records its position spans."""
+    index: int
+    child: RxNode
+
+
 def _bits_of(chars: str) -> np.ndarray:
     b = np.zeros(256, np.bool_)
     for ch in chars:
@@ -134,6 +142,7 @@ class _Parser:
         self.i = 0
         self.anchored_start = False
         self.anchored_end = False
+        self.ngroups = 0
 
     def peek(self) -> Optional[str]:
         return self.p[self.i] if self.i < len(self.p) else None
@@ -222,11 +231,13 @@ class _Parser:
         if ch == "(":
             if self.peek() == "?":
                 raise RegexUnsupported("(?...) group")
+            self.ngroups += 1
+            gidx = self.ngroups
             inner = self.alt()
             if self.peek() != ")":
                 raise RegexUnsupported("unterminated (")
             self.take()
-            return inner
+            return Group(gidx, inner)
         if ch == "[":
             return self.char_class()
         if ch == ".":
@@ -331,6 +342,8 @@ def _expand_repeat(node: RxNode) -> RxNode:
         return Concat([_expand_repeat(p) for p in node.parts])
     if isinstance(node, Alt):
         return Alt([_expand_repeat(p) for p in node.parts])
+    if isinstance(node, Group):
+        return Group(node.index, _expand_repeat(node.child))
     return node
 
 
@@ -343,6 +356,8 @@ def _clone(node: RxNode) -> RxNode:
         return Alt([_clone(p) for p in node.parts])
     if isinstance(node, Repeat):
         return Repeat(_clone(node.child), node.min, node.max)
+    if isinstance(node, Group):
+        return Group(node.index, _clone(node.child))
     return Empty()
 
 
@@ -360,7 +375,7 @@ def glushkov(ast: RxNode, anchored_start: bool, anchored_end: bool) -> NFA:
         if isinstance(node, (Concat, Alt)):
             for p in node.parts:
                 number(p)
-        elif isinstance(node, Repeat):
+        elif isinstance(node, (Repeat, Group)):
             number(node.child)
 
     number(ast)
@@ -404,6 +419,8 @@ def glushkov(ast: RxNode, anchored_start: bool, anchored_end: bool) -> NFA:
                         follow[i] |= cf
             nul = cn or node.min == 0
             return cf, cl, nul
+        if isinstance(node, Group):
+            return analyze(node.child)
         raise RegexUnsupported(type(node).__name__)
 
     follow = [0] * (len(atoms) + 1)
@@ -506,3 +523,339 @@ def _byte_table(nfa: NFA) -> np.ndarray:
     for i in range(1, nfa.n + 1):
         tbl |= np.where(nfa.byte_classes[i], np.uint32(1 << i), np.uint32(0))
     return tbl
+
+
+# ---------------------------------------------------------------------------
+# Tagged extraction (regexp_extract): leftmost-greedy submatch spans
+# ---------------------------------------------------------------------------
+
+MAX_TAG_STATES = 12
+
+
+def _first_set(node, pos_of) -> int:
+    """first-position bitmask of a subtree (mirrors analyze())."""
+    if isinstance(node, Empty):
+        return 0
+    if isinstance(node, Atom):
+        return 1 << pos_of[id(node)]
+    if isinstance(node, Alt):
+        f = 0
+        for p in node.parts:
+            f |= _first_set(p, pos_of)
+        return f
+    if isinstance(node, Concat):
+        f = 0
+        for p in node.parts:
+            f |= _first_set(p, pos_of)
+            if not _nullable(p):
+                break
+        return f
+    if isinstance(node, (Repeat, Group)):
+        return _first_set(node.child, pos_of)
+    return 0
+
+
+def _nullable(node) -> bool:
+    if isinstance(node, Empty):
+        return True
+    if isinstance(node, Atom):
+        return False
+    if isinstance(node, Alt):
+        return any(_nullable(p) for p in node.parts)
+    if isinstance(node, Concat):
+        return all(_nullable(p) for p in node.parts)
+    if isinstance(node, Repeat):
+        return node.min == 0 or _nullable(node.child)
+    if isinstance(node, Group):
+        return _nullable(node.child)
+    return False
+
+
+def _members(node, pos_of) -> int:
+    if isinstance(node, Atom):
+        return 1 << pos_of[id(node)]
+    m = 0
+    for c in (node.parts if isinstance(node, (Concat, Alt))
+              else [node.child] if isinstance(node, (Repeat, Group))
+              else []):
+        m |= _members(c, pos_of)
+    return m
+
+
+def _has_alt(node) -> bool:
+    if isinstance(node, Alt):
+        return True
+    kids = (node.parts if isinstance(node, (Concat, Alt))
+            else [node.child] if isinstance(node, (Repeat, Group)) else [])
+    return any(_has_alt(k) for k in kids)
+
+
+@dataclasses.dataclass
+class TaggedNFA:
+    """NFA + capture-group metadata for ONE group. The tagged simulation
+    is restricted to alternation-free patterns, where leftmost-greedy
+    disambiguation reduces to (minimal match start, then per-step
+    preference for the lowest predecessor position) — the linear-spine
+    subset the reference's transpiler also handles most cleanly.
+    group 0 = the whole match.
+
+    reset_edges: (f, to) pairs whose traversal RESTARTS the group span —
+    entries from outside the group plus loop-back edges of repeats that
+    wrap the group (Java keeps the LAST iteration's capture); loop edges
+    of repeats INSIDE the group extend the span instead.
+    """
+    nfa: NFA
+    member_mask: int
+    entry_mask: int
+    reset_edges: frozenset
+
+
+def compile_extract(pattern: str, group: int) -> TaggedNFA:
+    """Compile for submatch extraction. Raises RegexUnsupported outside
+    the tagged subset (alternation, > MAX_TAG_STATES positions, bad
+    group index)."""
+    p = _Parser(pattern)
+    ast0 = p.parse()
+    if group < 0 or group > p.ngroups:
+        raise RegexUnsupported(f"group {group} of {p.ngroups}")
+    if _has_alt(ast0):
+        raise RegexUnsupported("alternation in extract pattern")
+    if p.anchored_end:
+        # the tagged accept snapshot records matches at every position;
+        # $-anchoring needs an end-of-row gate (and the Java trailing-\n
+        # concession) — reject to CPU rather than diverge
+        raise RegexUnsupported("$-anchored extract pattern")
+    ast = _expand_repeat(ast0)
+    atoms: List[Atom] = []
+
+    def number(node):
+        if isinstance(node, Atom):
+            atoms.append(node)
+        elif isinstance(node, (Concat, Alt)):
+            for q in node.parts:
+                number(q)
+        elif isinstance(node, (Repeat, Group)):
+            number(node.child)
+
+    number(ast)
+    if len(atoms) > MAX_TAG_STATES:
+        raise RegexUnsupported(
+            f"extract pattern needs > {MAX_TAG_STATES} positions")
+    pos_of = {id(a): i + 1 for i, a in enumerate(atoms)}
+
+    # members/entries of every clone of the requested group (group 0 =
+    # whole pattern). Multiple clones arise from {m,n} expansion; their
+    # masks union — the per-edge reset set disambiguates instances.
+    member_mask = 0
+    entry_mask = 0
+    if group == 0:
+        member_mask = _members(ast, pos_of)
+        entry_mask = _first_set(ast, pos_of)
+    else:
+        def collect(node):
+            nonlocal member_mask, entry_mask
+            if isinstance(node, Group) and node.index == group:
+                member_mask |= _members(node, pos_of)
+                entry_mask |= _first_set(node, pos_of)
+                return
+            for c in (node.parts if isinstance(node, (Concat, Alt))
+                      else [node.child]
+                      if isinstance(node, (Repeat, Group)) else []):
+                collect(c)
+        collect(ast)
+        if member_mask == 0:
+            raise RegexUnsupported("empty or never-matching group")
+
+    # Re-run the follow analysis with edge attribution: an edge resets
+    # the group when it ENTERS the group from outside, or when it is a
+    # loop-back added by a repeat that is NOT inside the group.
+    reset_edges = set()
+
+    def record_edges(last_mask, first_mask, inside_group):
+        for f in range(1, len(atoms) + 1):
+            if last_mask & (1 << f):
+                for to in range(1, len(atoms) + 1):
+                    if first_mask & (1 << to) and entry_mask & (1 << to):
+                        from_outside = not (member_mask & (1 << f))
+                        if from_outside or not inside_group:
+                            reset_edges.add((f, to))
+
+    def analyze2(node, inside_group):
+        if isinstance(node, Empty):
+            return 0, 0, True
+        if isinstance(node, Atom):
+            m = 1 << pos_of[id(node)]
+            return m, m, False
+        if isinstance(node, Group):
+            return analyze2(node.child,
+                            inside_group
+                            or (group != 0 and node.index == group))
+        if isinstance(node, Concat):
+            f = l = 0
+            nul = True
+            for q in node.parts:
+                qf, ql, qn = analyze2(q, inside_group)
+                record_edges(l, qf, inside_group)
+                if nul:
+                    f |= qf
+                l = ql | (l if qn else 0)
+                nul = nul and qn
+            return f, l, nul
+        if isinstance(node, Repeat):
+            cf, cl, cn = analyze2(node.child, inside_group)
+            if node.max is None:
+                record_edges(cl, cf, inside_group)
+            return cf, cl, cn or node.min == 0
+        raise RegexUnsupported(type(node).__name__)
+
+    analyze2(ast, group == 0)
+    # seed entries (from the start state) always reset
+    nfa = glushkov(ast, p.anchored_start, p.anchored_end)
+    for to in range(1, nfa.n + 1):
+        if nfa.first & (1 << to) and entry_mask & (1 << to):
+            reset_edges.add((0, to))
+        # entries reached from non-member positions reset too (concat
+        # edges from before the group)
+        for f in range(1, nfa.n + 1):
+            if nfa.follow[f] & (1 << to) and entry_mask & (1 << to)                     and not (member_mask & (1 << f)):
+                reset_edges.add((f, to))
+    return TaggedNFA(nfa, member_mask, entry_mask, frozenset(reset_edges))
+
+
+def nfa_extract(t: TaggedNFA, offsets: jax.Array, raw: jax.Array):
+    """Per row: (matched bool, group byte start, group byte end) —
+    offsets are row-relative byte positions; a matched row whose group
+    did not participate reports start=end (empty string, Spark
+    regexp_extract semantics)."""
+    nfa = t.nfa
+    n = nfa.n
+    nrows = offsets.shape[0] - 1
+    starts = offsets[:-1]
+    lens = offsets[1:] - offsets[:-1]
+    maxlen = jnp.max(lens)
+    nbytes = int(raw.shape[0])
+    B = jnp.asarray(_byte_table(nfa))
+    member_all = t.member_mask
+
+    #: per-state predecessor lists (f=0 is the seed/start state)
+    preds = [[] for _ in range(n + 1)]
+    for i in range(1, n + 1):
+        if nfa.first & (1 << i):
+            preds[i].append(0)
+        for f in range(1, n + 1):
+            if nfa.follow[f] & (1 << i):
+                preds[i].append(f)
+
+    BIG = jnp.int32(np.iinfo(np.int32).max)
+
+    def in_mask(state: int, mask: int) -> bool:
+        return bool(mask & (1 << state))
+
+    def step(pos, carry):
+        S, ms, gs, ge, best = carry
+        # ms/gs/ge: i32[n+1, rows] per-state registers (match start,
+        # group start, group end; -1 = not participating)
+        idx = jnp.clip(starts + pos, 0, nbytes - 1)
+        byte = raw[idx].astype(jnp.int32)
+        active = pos < lens
+        hit_bits = B[byte]
+        new_ms, new_gs, new_ge = [], [], []
+        alive_bits = []
+        for to in range(1, n + 1):
+            to_hit = (hit_bits >> jnp.uint32(to)) & jnp.uint32(1) != 0
+            cand_ms = BIG * jnp.ones(nrows, jnp.int32)
+            cand_gs = -jnp.ones(nrows, jnp.int32)
+            cand_ge = -jnp.ones(nrows, jnp.int32)
+            got = jnp.zeros(nrows, jnp.bool_)
+            is_entry = in_mask(to, t.entry_mask)
+            # predecessors in priority order: smaller position first,
+            # seed (0) LAST (a new thread only wins on smaller start,
+            # which cannot happen — existing threads started earlier)
+            order = sorted([f for f in preds[to] if f != 0]) + \
+                ([0] if 0 in preds[to] else [])
+            for f in order:
+                if f == 0:
+                    f_alive = jnp.ones(nrows, jnp.bool_) \
+                        if not nfa.anchored_start else \
+                        jnp.full(nrows, pos == 0)
+                    f_ms = jnp.full(nrows, pos, jnp.int32)
+                    f_gs = -jnp.ones(nrows, jnp.int32)
+                    f_ge = -jnp.ones(nrows, jnp.int32)
+                else:
+                    f_alive = (S >> jnp.uint32(f)) & jnp.uint32(1) != 0
+                    f_ms, f_gs, f_ge = ms[f], gs[f], ge[f]
+                # group-register transition for this STATIC (f, to)
+                # edge (Java last-iteration capture: the precomputed
+                # reset set restarts the span; other in-group edges
+                # extend it)
+                if in_mask(to, member_all):
+                    if (f, to) in t.reset_edges or (is_entry and f == 0):
+                        e_gs = jnp.full(nrows, pos, jnp.int32)
+                    else:
+                        e_gs = f_gs
+                    e_ge = jnp.full(nrows, pos + 1, jnp.int32)
+                else:
+                    e_gs, e_ge = f_gs, f_ge
+                better = f_alive & (~got | (f_ms < cand_ms))
+                cand_ms = jnp.where(better, f_ms, cand_ms)
+                cand_gs = jnp.where(better, e_gs, cand_gs)
+                cand_ge = jnp.where(better, e_ge, cand_ge)
+                got = got | f_alive
+            ok = got & to_hit & active
+            new_ms.append(jnp.where(ok, cand_ms, BIG))
+            new_gs.append(jnp.where(ok, cand_gs, -1))
+            new_ge.append(jnp.where(ok, cand_ge, -1))
+            alive_bits.append(ok)
+        S2 = jnp.zeros(nrows, jnp.uint32)
+        for to, ok in zip(range(1, n + 1), alive_bits):
+            S2 = S2 | jnp.where(ok, jnp.uint32(1 << to), jnp.uint32(0))
+        ms2 = jnp.stack([jnp.full(nrows, BIG, jnp.int32)] + new_ms)
+        gs2 = jnp.stack([-jnp.ones(nrows, jnp.int32)] + new_gs)
+        ge2 = jnp.stack([-jnp.ones(nrows, jnp.int32)] + new_ge)
+        ms2 = jnp.where(active, ms2, ms)
+        gs2 = jnp.where(active, gs2, gs)
+        ge2 = jnp.where(active, ge2, ge)
+        S2 = jnp.where(active, S2, S)
+        # accept snapshot: leftmost start, then longest end (= latest pos)
+        b_has, b_ms, b_gs, b_ge = best
+        acc_has = jnp.zeros(nrows, jnp.bool_)
+        acc_ms = jnp.full(nrows, BIG, jnp.int32)
+        acc_gs = -jnp.ones(nrows, jnp.int32)
+        acc_ge = -jnp.ones(nrows, jnp.int32)
+        for i in sorted(range(1, n + 1)):
+            if nfa.last & (1 << i):
+                alive = (S2 >> jnp.uint32(i)) & jnp.uint32(1) != 0
+                alive = alive & active
+                better = alive & (~acc_has | (ms2[i] < acc_ms))
+                acc_ms = jnp.where(better, ms2[i], acc_ms)
+                acc_gs = jnp.where(better, gs2[i], acc_gs)
+                acc_ge = jnp.where(better, ge2[i], acc_ge)
+                acc_has = acc_has | alive
+        replace = acc_has & (~b_has | (acc_ms <= b_ms))
+        best = (b_has | acc_has,
+                jnp.where(replace, acc_ms, b_ms),
+                jnp.where(replace, acc_gs, b_gs),
+                jnp.where(replace, acc_ge, b_ge))
+        return S2, ms2, gs2, ge2, best
+
+    S0 = jnp.zeros(nrows, jnp.uint32)
+    ms0 = jnp.full((n + 1, nrows), BIG, jnp.int32)
+    gs0 = -jnp.ones((n + 1, nrows), jnp.int32)
+    ge0 = -jnp.ones((n + 1, nrows), jnp.int32)
+    best0 = (jnp.zeros(nrows, jnp.bool_), jnp.full(nrows, BIG, jnp.int32),
+             -jnp.ones(nrows, jnp.int32), -jnp.ones(nrows, jnp.int32))
+    _, _, _, _, best = lax.fori_loop(0, maxlen.astype(jnp.int32), step,
+                                     (S0, ms0, gs0, ge0, best0))
+    has, bms, bgs, bge = best
+    if nfa.nullable:
+        # empty match at position 0 wins when nothing matched earlier
+        has_empty = jnp.ones(nrows, jnp.bool_)
+        take = has_empty & ~has
+        has = has | has_empty
+        bgs = jnp.where(take, 0, bgs)
+        bge = jnp.where(take, 0, bge)
+    # non-participating group -> empty span
+    g0 = jnp.where(has & (bgs >= 0), bgs, 0)
+    g1 = jnp.where(has & (bge >= 0), bge, 0)
+    g1 = jnp.maximum(g1, g0)
+    return has, g0, g1

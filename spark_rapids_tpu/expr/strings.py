@@ -602,16 +602,60 @@ class _RegexCpuBase(Expression):
 
 
 class RegexpExtract(_RegexCpuBase):
+    """regexp_extract: capture-group extraction. Alternation-free
+    patterns within the tagged-NFA subset run ON DEVICE (expr/regex.py
+    compile_extract — the reference transpiles to the cudf regex engine
+    the same transpile-or-reject way, RegexParser.scala); everything
+    else falls back to the CPU tier."""
+
     def __init__(self, child, pattern: str, group: int = 1):
         self.children = [child]
         self.pattern = pattern
         self.group = group
+        from spark_rapids_tpu.expr.regex import (
+            RegexUnsupported, compile_extract)
+        try:
+            self._tagged = compile_extract(pattern, group)
+            self._nfa_err = None
+        except RegexUnsupported as e:
+            self._tagged = None
+            self._nfa_err = str(e)
 
     def _params(self):
         return f"{self.pattern!r},{self.group}"
 
     def with_children(self, children):
         return RegexpExtract(children[0], self.pattern, self.group)
+
+    def supported_on_tpu(self):
+        return self._tagged is not None
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expr.regex import nfa_extract
+        c = self.children[0].eval_tpu(ctx)
+        t = self._tagged
+
+        def compute(flat, cap):
+            off = flat.data["offsets"][: cap + 1].astype(jnp.int32)
+            raw = flat.data["bytes"]
+            has, g0, g1 = nfa_extract(t, off, raw)
+            lens = jnp.where(has, g1 - g0, 0)
+            new_off = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32),
+                 jnp.cumsum(lens).astype(jnp.int32)])
+            bcap = int(raw.shape[0])
+            b = jnp.arange(bcap, dtype=jnp.int32)
+            row = jnp.clip(
+                jnp.searchsorted(new_off, b, side="right").astype(jnp.int32)
+                - 1, 0, cap - 1)
+            src = jnp.clip(off[row] + g0[row] + (b - new_off[row]),
+                           0, bcap - 1)
+            out_bytes = jnp.where(b < new_off[-1], raw[src],
+                                  0).astype(jnp.uint8)
+            return ColumnVector(T.STRING, {"offsets": new_off,
+                                           "bytes": out_bytes}, None)
+
+        return _lift_unary(ctx, c, compute)
 
     def eval_cpu(self, cols, ansi=False):
         import re

@@ -233,11 +233,12 @@ def test_ts_cast_date(session):
 def test_explain_reports_fallback(session):
     from spark_rapids_tpu.plan.overrides import explain_plan
     from spark_rapids_tpu.sql import functions as _F
-    df = make_df(session).select(_F.regexp_extract(col("s"), "(a+)", 1)
+    # alternation is outside the tagged device-NFA subset -> CPU fallback
+    df = make_df(session).select(_F.regexp_extract(col("s"), "(a+|b)x", 1)
                                  .alias("m"))
     text = explain_plan(df.plan, session.conf, all_ops=True)
     assert "cannot run on TPU because" in text
-    assert "runs on CPU" in text
+    assert "reject strategy" in text
 
 
 def test_exec_disable_conf(session):
