@@ -1601,7 +1601,6 @@ class WindowExec(TpuExec):
                 live = jnp.arange(cap) < nr
                 packed = R.pack_keys_sort(pk, kcols, ranges, live, flags)
                 perm = jnp.argsort(packed, stable=True).astype(jnp.int32)
-                sorted_batch = K.gather_batch(batch, perm, batch.num_rows)
                 sp = packed[perm]
                 first = jnp.zeros(cap, jnp.bool_).at[0].set(True)
                 part_plane = sp >> jnp.int64(obits)
@@ -1617,12 +1616,20 @@ class WindowExec(TpuExec):
                 peer_end = jnp.minimum(peer_end, seg_end)
                 seg_id = jnp.cumsum(segb.astype(jnp.int32))
                 idx = jnp.arange(cap, dtype=jnp.int32)
-                sctx = EvalCtx(sorted_batch.columns, nr, cap, False)
-                out_cols = list(sorted_batch.columns)
+                # pass-through columns stay in ORIGINAL row order (window
+                # output order is unspecified); window results compute in
+                # sorted space and scatter back — data columns are only
+                # gathered if a frame agg / lead-lag reads them
+                sctx = EvalCtx([], nr, cap, False)
+                sctx.columns = K.LazyGatheredCols(batch.columns, perm,
+                                                  batch.num_rows)
+                out_cols = list(batch.columns)
                 for w in exprs:
-                    out_cols.append(_eval_window_fn(
+                    wc = _eval_window_fn(
                         w, sctx, seg_start, seg_end, peer_start, peer_end,
-                        seg_id, segb, peerb, idx, live))
+                        seg_id, segb, peerb, idx, live)
+                    out_cols.append(_scatter_window_output(
+                        wc, perm, cap, live, batch.num_rows))
                 return ColumnarBatch(out_cols, batch.num_rows)
             return fn
 
@@ -1692,6 +1699,21 @@ class WindowExec(TpuExec):
 # bound window exprs/spec — never the exec node, whose child tree can pin
 # HBM-resident cached batches for the process lifetime (same hazard the
 # _AggKernels class exists to avoid).
+def _scatter_window_output(col: ColumnVector, perm, cap, live_orig,
+                           num_rows):
+    """Sorted-space window result -> original row order (one inverse-perm
+    gather instead of gathering every output column into sorted order).
+    gather_column handles every plane layout (dict strings from lead/lag
+    included); XLA CSEs the shared inverse permutation across outputs."""
+    inv = jnp.zeros(cap, jnp.int32).at[perm].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    out = K.gather_column(col, inv, num_rows)
+    valid = out.validity & live_orig if out.validity is not None \
+        else live_orig
+    return ColumnVector(out.dtype, out.data, valid,
+                        dict_unique=out.dict_unique)
+
+
 def _eval_window_fn(w, sctx, seg_start, seg_end, peer_start,
                     peer_end, seg_id, segb, peerb, idx, live):
     from spark_rapids_tpu.ops import window as W

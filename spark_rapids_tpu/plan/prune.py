@@ -129,6 +129,46 @@ def _prune_window(p: P.Project, w: P.WindowNode):
     return _clone_project(p, nw, [_remap(e, mo) for e in p.exprs])
 
 
+def _absorbable_project(pr: P.Project) -> bool:
+    """A Project may fold into its consumer only when its expressions are
+    deterministic and context-free: partition-context expressions
+    (spark_partition_id, monotonically_increasing_id), rand, and UDF
+    tiers evaluate with state the aggregate stage does not carry."""
+    from spark_rapids_tpu.plan.overrides import _contains_project_only
+
+    def bad(e) -> bool:
+        name = type(e).__name__
+        if name in ("Rand", "PythonRowUDF", "JaxColumnarUDF"):
+            return True
+        return any(bad(c) for c in e.children)
+
+    return not any(_contains_project_only(e) or bad(e) for e in pr.exprs)
+
+
+def _absorb_project_into_agg(a: P.Aggregate, pr: P.Project) -> P.Aggregate:
+    """Aggregate(Project(c)) -> Aggregate'(c): substitute the project's
+    expressions into the aggregate's key/input expressions so key+input
+    evaluation happens INSIDE the fused aggregation kernel — the project's
+    intermediate batch (a full-capacity materialization per column) never
+    exists. The reference reaches the same shape via Catalyst's
+    CollapseProject before the plugin sees the plan."""
+    def subst(e):
+        def f(x):
+            if isinstance(x, E.BoundRef):
+                return pr.exprs[x.index]
+            return x
+        return e.transform(f)
+
+    na = P.Aggregate.__new__(P.Aggregate)
+    na.children = [pr.children[0]]
+    na.raw_group_exprs = a.raw_group_exprs
+    na.group_exprs = [subst(e) for e in a.group_exprs]
+    na.group_names = list(a.group_names)
+    na.aggs = [ag.transform(lambda n: subst(n) if isinstance(n, E.BoundRef)
+                            else n) for ag in a.aggs]
+    return na
+
+
 def prune_plan(p: P.PlanNode) -> P.PlanNode:
     """Bottom-up pruning. Replaces children in place (a rewritten subtree
     is semantically identical, so sharing with sibling plans stays
@@ -140,4 +180,8 @@ def prune_plan(p: P.PlanNode) -> P.PlanNode:
             return _prune_join(p, c)
         if isinstance(c, P.WindowNode):
             return _prune_window(p, c)
+    if isinstance(p, P.Aggregate):
+        c = p.children[0]
+        if isinstance(c, P.Project) and _absorbable_project(c):
+            return _absorb_project_into_agg(p, c)
     return p

@@ -62,6 +62,8 @@ from spark_rapids_tpu.expr.core import col, lit
 rng = np.random.default_rng(42)
 t = pa.table({
     "l_orderkey": rng.integers(0, ORDERS, ROWS).astype(np.int64),
+    "l_returnflag": np.array(["A", "N", "R"])[rng.integers(0, 3, ROWS)],
+    "l_linestatus": np.array(["F", "O"])[rng.integers(0, 2, ROWS)],
     "l_quantity": rng.integers(1, 51, ROWS).astype(np.float64),
     "l_extendedprice": np.round(rng.uniform(900.0, 105000.0, ROWS), 2),
     "l_discount": np.round(rng.uniform(0.0, 0.10, ROWS), 2),
@@ -77,8 +79,15 @@ print("[prof] uploading...", file=sys.stderr, flush=True)
 cached = sess.create_dataframe(t).cache(); cached.count()
 ocached = sess.create_dataframe(orders).cache(); ocached.count()
 SHFL_ROWS = min(ROWS, 8_000_000)
-sharded = sess.create_dataframe(t.slice(0, SHFL_ROWS), num_partitions=4).cache()
+sharded = sess.create_dataframe(
+    t.slice(0, SHFL_ROWS).select(["l_orderkey", "l_quantity"]),
+    num_partitions=4).cache()
 sharded.count()
+WIN_ROWS = min(ROWS, 10_000_000)
+wcached = sess.create_dataframe(
+    t.slice(0, WIN_ROWS).select(["l_returnflag", "l_linestatus",
+                                 "l_shipdate"])).cache()
+wcached.count()
 
 
 def q3join():
@@ -92,6 +101,17 @@ def q3join():
     return top.to_pydict()
 
 
+def q67win():
+    from spark_rapids_tpu.expr.window import Window
+    w = Window.partition_by(col("l_returnflag"), col("l_linestatus")) \
+              .order_by(col("l_shipdate"))
+    out = (wcached.select(col("l_returnflag"), col("l_linestatus"),
+                          F.rank().over(w).alias("rk"))
+           .group_by(col("l_returnflag"), col("l_linestatus"))
+           .agg(F.max("rk").alias("mx")))
+    return out.to_pydict()
+
+
 def q72shfl():
     g = (sharded.select((col("l_orderkey") % lit(100_000)).alias("k"),
                         col("l_quantity"))
@@ -103,7 +123,7 @@ def q72shfl():
 
 
 for Q in [q for q in os.environ.get("QS", "q3join,q72shfl").split(",")]:
-    fn = {"q3join": q3join, "q72shfl": q72shfl}[Q]
+    fn = {"q3join": q3join, "q72shfl": q72shfl, "q67win": q67win}[Q]
     print(f"[prof] warmup {Q}...", file=sys.stderr, flush=True)
     t0 = time.perf_counter(); fn(); warm = time.perf_counter() - t0
     TIMES.clear(); COUNTS.clear()
