@@ -731,3 +731,276 @@ def _concat_arrays_tpu(a: ColumnVector, b: ColumnVector, ctx,
     valid = jnp.where(from_a, va, vb) & o_in
     child = ColumnVector(et, data, valid)
     return ColumnVector(out_t, {"offsets": new_off, "child": child}, None)
+
+
+# ---------------------------------------------------------------------------
+# CPU-tier collection constructors (device kernels graduate later; the
+# reference keeps these on the JNI list-ops surface)
+# ---------------------------------------------------------------------------
+
+def _obj_array(rows):
+    """Object ndarray that NEVER collapses equal-length rows into a 2-D
+    array (both np.array(rows, object) and arr[:] = rows do when row
+    lengths happen to match)."""
+    arr = np.empty(len(rows), object)
+    for i, r in enumerate(rows):
+        arr[i] = r
+    return arr
+
+
+class _CpuCollection(Expression):
+    def supported_on_tpu(self):
+        return False
+
+    def eval_tpu(self, ctx):
+        raise NotImplementedError(f"{type(self).__name__} runs on CPU")
+
+
+class ArrayRepeat(_CpuCollection):
+    """array_repeat(v, n)."""
+
+    def __init__(self, value: Expression, count: Expression):
+        self.children = [_wrap(value), _wrap(count)]
+
+    def data_type(self):
+        return T.ArrayType(self.children[0].data_type())
+
+    def eval_cpu(self, cols, ansi=False):
+        v = self.children[0].eval_cpu(cols, ansi)
+        n = self.children[1].eval_cpu(cols, ansi)
+        out, ok = [], []
+        for (val, vok), (cnt, cok) in zip(zip(v.values, v.valid),
+                                          zip(n.values, n.valid)):
+            if not cok:
+                out.append(None)
+                ok.append(False)
+                continue
+            c = max(int(cnt), 0)
+            val = val.item() if isinstance(val, np.generic) else val
+            out.append([val if vok else None] * c)
+            ok.append(True)
+        return CpuCol(self.data_type(), _obj_array(out),
+                      np.asarray(ok, np.bool_))
+
+
+class ArrayJoin(_CpuCollection):
+    """array_join(arr, sep[, nullReplacement])."""
+
+    def __init__(self, child: Expression, sep: str,
+                 null_replacement: Optional[str] = None):
+        self.children = [child]
+        self.sep = sep
+        self.null_replacement = null_replacement
+
+    def _params(self):
+        return f"{self.sep!r},{self.null_replacement!r}"
+
+    def with_children(self, children):
+        return ArrayJoin(children[0], self.sep, self.null_replacement)
+
+    def data_type(self):
+        return T.STRING
+
+    def eval_cpu(self, cols, ansi=False):
+        arr = self.children[0].eval_cpu(cols, ansi)
+        out = []
+        for v, ok in zip(arr.values, arr.valid):
+            if not ok or v is None:
+                out.append(None)
+                continue
+            parts = []
+            for el in v:
+                if el is None:
+                    if self.null_replacement is not None:
+                        parts.append(self.null_replacement)
+                else:
+                    parts.append(el if isinstance(el, str) else str(el))
+            out.append(self.sep.join(parts))
+        return CpuCol(T.STRING, np.array(out, object), arr.valid.copy())
+
+
+class ArraysZip(_CpuCollection):
+    """arrays_zip(a, b, ...) -> array<struct<...>> (None-padded)."""
+
+    def __init__(self, children, names=None):
+        self.children = list(children)
+        self.names = list(names) if names else \
+            [str(i) for i in range(len(self.children))]
+
+    def _params(self):
+        return ",".join(self.names)
+
+    def with_children(self, children):
+        return ArraysZip(children, self.names)
+
+    def data_type(self):
+        fields = tuple(
+            T.StructField(n, c.data_type().element)
+            for n, c in zip(self.names, self.children))
+        return T.ArrayType(T.StructType(fields))
+
+    def eval_cpu(self, cols, ansi=False):
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values)
+        out, ok = [], []
+        for i in range(n):
+            if not all(c.valid[i] and c.values[i] is not None for c in ins):
+                out.append(None)
+                ok.append(False)
+                continue
+            rows = [c.values[i] for c in ins]
+            ln = max(len(r) for r in rows) if rows else 0
+            out.append([{nm: (r[j] if j < len(r) else None)
+                         for nm, r in zip(self.names, rows)}
+                        for j in range(ln)])
+            ok.append(True)
+        return CpuCol(self.data_type(), _obj_array(out),
+                      np.asarray(ok, np.bool_))
+
+
+class MapEntries(Expression):
+    """map_entries(m) -> array<struct<key,value>> — device: the map's
+    planes ARE the answer (offsets + key/value children re-labelled)."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def data_type(self):
+        mt = self.children[0].data_type()
+        return T.ArrayType(T.StructType((
+            T.StructField("key", mt.key, False),
+            T.StructField("value", mt.value))))
+
+    def eval_tpu(self, ctx: EvalCtx) -> ColumnVector:
+        m = self.children[0].eval_tpu(ctx)
+        st = self.data_type().element
+        child = ColumnVector(st, {"children": [m.data["keys"],
+                                               m.data["values"]]}, None)
+        return ColumnVector(self.data_type(),
+                            {"offsets": m.data["offsets"], "child": child},
+                            m.validity)
+
+    def eval_cpu(self, cols, ansi=False):
+        m = self.children[0].eval_cpu(cols, ansi)
+        out = [None if (not ok or v is None)
+               else [{"key": k, "value": vv} for k, vv in v]
+               for v, ok in zip(m.values, m.valid)]
+        return CpuCol(self.data_type(), _obj_array(out),
+                      m.valid.copy())
+
+
+class MapConcat(_CpuCollection):
+    """map_concat(m1, m2, ...): last-wins duplicate handling is an
+    EXCEPTION in Spark's default policy — mirrored here."""
+
+    def __init__(self, children):
+        self.children = list(children)
+
+    def with_children(self, children):
+        return MapConcat(children)
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def eval_cpu(self, cols, ansi=False):
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values)
+        out, ok = [], []
+        for i in range(n):
+            if not all(c.valid[i] and c.values[i] is not None for c in ins):
+                out.append(None)
+                ok.append(False)
+                continue
+            seen = set()
+            entries = []
+            for c in ins:
+                for k, v in c.values[i]:
+                    if k in seen:
+                        raise SparkException(f"Duplicate map key {k}")
+                    seen.add(k)
+                    entries.append((k, v))
+            out.append(entries)
+            ok.append(True)
+        return CpuCol(self.data_type(), _obj_array(out),
+                      np.asarray(ok, np.bool_))
+
+
+class MapFromArrays(_CpuCollection):
+    """map_from_arrays(keys, values)."""
+
+    def __init__(self, keys: Expression, values: Expression):
+        self.children = [keys, values]
+
+    def data_type(self):
+        return T.MapType(self.children[0].data_type().element,
+                         self.children[1].data_type().element)
+
+    def eval_cpu(self, cols, ansi=False):
+        ks = self.children[0].eval_cpu(cols, ansi)
+        vs = self.children[1].eval_cpu(cols, ansi)
+        out, ok = [], []
+        for (k, kok), (v, vok) in zip(zip(ks.values, ks.valid),
+                                      zip(vs.values, vs.valid)):
+            if not kok or k is None or not vok or v is None:
+                out.append(None)
+                ok.append(False)
+                continue
+            if len(k) != len(v):
+                raise SparkException(
+                    "map_from_arrays: key and value arrays differ in length")
+            if any(x is None for x in k):
+                raise SparkException("Cannot use null as map key")
+            seen = set()
+            for x in k:
+                xx = x.item() if isinstance(x, np.generic) else x
+                if xx in seen:
+                    raise SparkException(f"Duplicate map key {xx}")
+                seen.add(xx)
+            out.append(list(zip(k, v)))
+            ok.append(True)
+        return CpuCol(self.data_type(), _obj_array(out),
+                      np.asarray(ok, np.bool_))
+
+
+class StrToMap(_CpuCollection):
+    """str_to_map(s, pairDelim, keyValueDelim)."""
+
+    def __init__(self, child: Expression, pair_delim: str = ",",
+                 kv_delim: str = ":"):
+        self.children = [child]
+        self.pair_delim = pair_delim
+        self.kv_delim = kv_delim
+
+    def _params(self):
+        return f"{self.pair_delim!r},{self.kv_delim!r}"
+
+    def with_children(self, children):
+        return StrToMap(children[0], self.pair_delim, self.kv_delim)
+
+    def data_type(self):
+        return T.MapType(T.STRING, T.STRING)
+
+    def eval_cpu(self, cols, ansi=False):
+        import re
+        c = self.children[0].eval_cpu(cols, ansi)
+        pd = re.compile(self.pair_delim)
+        kd = re.compile(self.kv_delim)
+        out = []
+        for s, ok in zip(c.values, c.valid):
+            if not ok or not isinstance(s, str):
+                out.append(None)
+                continue
+            entries = []
+            seen = set()
+            # Spark treats both delimiters as REGEXES
+            for pair in pd.split(s):
+                kv = kd.split(pair, maxsplit=1)
+                k = kv[0]
+                v = kv[1] if len(kv) > 1 else None
+                if k in seen:
+                    raise SparkException(f"Duplicate map key {k!r}")
+                seen.add(k)
+                entries.append((k, v))
+            out.append(entries)
+        return CpuCol(self.data_type(), _obj_array(out),
+                      c.valid.copy())

@@ -292,3 +292,202 @@ class FormatNumber(CpuRowFunction):
 ALL_CPU_FUNCTIONS = [Reverse, ConcatWs, LPad, RPad, Translate,
                      SubstringIndex, Md5, Sha2, DateFormat, ToDateFmt,
                      FromUnixtime, FormatNumber]
+
+
+# ---------------------------------------------------------------------------
+# String breadth second tier (CPU rows; device kernels graduate later)
+# ---------------------------------------------------------------------------
+
+class FindInSet(CpuRowFunction):
+    """find_in_set(s, csv): 1-based index of s within the comma list."""
+
+    name = "find_in_set"
+    result = T.INT32
+
+    def row_fn(self, s, csv):
+        if not isinstance(s, str) or not isinstance(csv, str):
+            return None
+        if "," in s:
+            return 0
+        parts = csv.split(",")
+        try:
+            return parts.index(s) + 1
+        except ValueError:
+            return 0
+
+
+class Levenshtein(CpuRowFunction):
+    name = "levenshtein"
+    result = T.INT32
+
+    def row_fn(self, a, b):
+        if not isinstance(a, str) or not isinstance(b, str):
+            return None
+        if len(a) < len(b):
+            a, b = b, a
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
+
+
+class Base64Encode(CpuRowFunction):
+    name = "base64"
+    result = T.STRING
+
+    def row_fn(self, s):
+        import base64
+        if isinstance(s, bytes):
+            return base64.b64encode(s).decode()
+        if isinstance(s, str):
+            return base64.b64encode(s.encode()).decode()
+        return None
+
+
+class UnBase64(CpuRowFunction):
+    name = "unbase64"
+    result = T.STRING
+
+    def row_fn(self, s):
+        import base64
+        if not isinstance(s, str):
+            return None
+        try:
+            return base64.b64decode(s).decode("utf-8", "replace")
+        except Exception:  # noqa: BLE001 - Spark: invalid input -> error/null
+            return None
+
+
+class FormatString(CpuRowFunction):
+    """format_string(fmt, args...): java.lang.String.format subset via
+    Python %-interpolation of the common conversions."""
+
+    name = "format_string"
+    result = T.STRING
+
+    def eval_cpu(self, cols, ansi=False):
+        fmt = self.params[0]
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values) if ins else 0
+        out, ok = [], []
+        for i in range(n):
+            # java.util.Formatter renders null arguments as "null"
+            args = tuple(
+                "null" if not c.valid[i] else
+                (c.values[i].item() if isinstance(c.values[i], np.generic)
+                 else c.values[i]) for c in ins)
+            try:
+                out.append(fmt % args)
+                ok.append(True)
+            except (TypeError, ValueError):
+                out.append(None)
+                ok.append(False)
+        return CpuCol(T.STRING, np.array(out, object),
+                      np.asarray(ok, np.bool_))
+
+
+class Elt(CpuRowFunction):
+    """elt(n, s1, s2, ...): the n-th argument string (1-based); null when
+    out of range (ANSI: error)."""
+
+    name = "elt"
+    result = T.STRING
+
+    def eval_cpu(self, cols, ansi=False):
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        idx = ins[0]
+        n = len(idx.values)
+        out, ok = [], []
+        for i in range(n):
+            if not idx.valid[i]:
+                out.append(None)
+                ok.append(False)
+                continue
+            k = int(idx.values[i])
+            if 1 <= k < len(ins):
+                c = ins[k]
+                out.append(c.values[i] if c.valid[i] else None)
+                ok.append(bool(c.valid[i]))
+            else:
+                if ansi:
+                    raise SparkException(f"elt index {k} out of range")
+                out.append(None)
+                ok.append(False)
+        return CpuCol(T.STRING, np.array(out, object),
+                      np.asarray(ok, np.bool_))
+
+
+class Soundex(CpuRowFunction):
+    name = "soundex"
+    result = T.STRING
+
+    _CODE = {**{c: "1" for c in "BFPV"}, **{c: "2" for c in "CGJKQSXZ"},
+             **{c: "3" for c in "DT"}, "L": "4",
+             **{c: "5" for c in "MN"}, "R": "6"}
+
+    def row_fn(self, s):
+        if not isinstance(s, str):
+            return None
+        if not s or not s[0].isalpha():
+            return s
+        u = s.upper()
+        out = [u[0]]
+        prev = self._CODE.get(u[0], "")
+        for ch in u[1:]:
+            code = self._CODE.get(ch, "")
+            if code and code != prev:
+                out.append(code)
+                if len(out) == 4:
+                    break
+            if ch not in "HW":
+                prev = code
+        return "".join(out).ljust(4, "0")
+
+
+class JsonTuple(CpuRowFunction):
+    """json_tuple is a generator in Spark; this expression form returns
+    the ARRAY of extracted fields (the DataFrame layer explodes it into
+    columns). Reference GpuJsonTuple.scala."""
+
+    name = "json_tuple"
+
+    @property
+    def result(self):
+        return T.ArrayType(T.STRING)
+
+    def data_type(self):
+        return T.ArrayType(T.STRING)
+
+    def eval_cpu(self, cols, ansi=False):
+        import json
+        c = self.children[0].eval_cpu(cols, ansi)
+        fields = self.params
+        out, ok = [], []
+        for s, v in zip(c.values, c.valid):
+            if not v or not isinstance(s, str):
+                out.append(None)
+                ok.append(False)
+                continue
+            try:
+                obj = json.loads(s)
+            except ValueError:
+                obj = None
+            row = []
+            for f in fields:
+                x = obj.get(f) if isinstance(obj, dict) else None
+                if x is None:
+                    row.append(None)
+                elif isinstance(x, (dict, list)):
+                    row.append(json.dumps(x, separators=(",", ":")))
+                elif isinstance(x, bool):
+                    row.append("true" if x else "false")
+                else:
+                    row.append(str(x))
+            out.append(row)
+            ok.append(True)
+        return CpuCol(self.result, np.array(out, object),
+                      np.asarray(ok, np.bool_))

@@ -635,3 +635,291 @@ class ToUtcTimestamp(_TzShiftBase):
             out[i] = int(v) - int(local.utcoffset().total_seconds()
                                   * 1_000_000)
         return CpuCol(T.TIMESTAMP, out, c.valid.copy())
+
+
+# ---------------------------------------------------------------------------
+# Datetime breadth second tier (reference datetimeExpressions.scala)
+# ---------------------------------------------------------------------------
+
+def _days_from_civil(y, m, d):
+    """(y, m, d) -> days since epoch; branch-free days_from_civil."""
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+class MakeDate(Expression):
+    """make_date(y, m, d): null (ANSI: error) on invalid components."""
+
+    def __init__(self, y, m, d):
+        self.children = [y, m, d]
+
+    def data_type(self):
+        return T.DATE
+
+    def with_children(self, children):
+        return MakeDate(*children)
+
+    #: Spark/LocalDate year bounds; also keeps the day count in int32
+    _YMIN, _YMAX = -999_999_999, 999_999_999
+
+    def eval_tpu(self, ctx):
+        cy, cm, cd = [c.eval_tpu(ctx) for c in self.children]
+        y = cy.data.astype(jnp.int64)
+        m = cm.data.astype(jnp.int64)
+        d = cd.data.astype(jnp.int64)
+        yc = jnp.clip(y, -6_000_000, 6_000_000)  # int32-day-safe window
+        days = _days_from_civil(yc, m, d)
+        # validity: round-trip check catches day overflow per month
+        yy, mm, dd = _civil_from_days(days)
+        ok = ((m >= 1) & (m <= 12) & (d >= 1) & (yy == yc) & (mm == m)
+              & (dd == d) & (y == yc)
+              & (days >= -(2 ** 31)) & (days < 2 ** 31))
+        valid = _valid_of(cy, ctx) & _valid_of(cm, ctx) & _valid_of(cd, ctx)
+        if ctx.ansi:
+            ctx.add_error("InvalidDate", valid & ~ok)
+        return ColumnVector(T.DATE, days.astype(jnp.int32), valid & ok)
+
+    def eval_cpu(self, cols, ansi=False):
+        # same civil arithmetic as the device path (python datetime.date
+        # caps years at 9999 — Spark's LocalDate does not)
+        cy, cm, cd = [c.eval_cpu(cols, ansi) for c in self.children]
+        y = cy.values.astype(np.int64)
+        m = cm.values.astype(np.int64)
+        d = cd.values.astype(np.int64)
+        yc = np.clip(y, -6_000_000, 6_000_000)
+        ym = yc - (m <= 2)
+        era = np.floor_divide(ym, 400)
+        yoe = ym - era * 400
+        mp = m + np.where(m > 2, -3, 9)
+        doy = np.floor_divide(153 * mp + 2, 5) + d - 1
+        doe = yoe * 365 + np.floor_divide(yoe, 4) \
+            - np.floor_divide(yoe, 100) + doy
+        days = era * 146097 + doe - 719468
+        yy, mm, dd = _civil_from_days_np(days)
+        ok = ((m >= 1) & (m <= 12) & (d >= 1) & (yy == yc) & (mm == m)
+              & (dd == d) & (y == yc)
+              & (days >= -(2 ** 31)) & (days < 2 ** 31))
+        valid = cy.valid & cm.valid & cd.valid
+        if ansi and bool((valid & ~ok).any()):
+            from spark_rapids_tpu.expr.core import SparkException
+            raise SparkException("invalid date components")
+        return CpuCol(T.DATE, days.astype(np.int32), valid & ok)
+
+
+class NextDay(Expression):
+    """next_day(date, dayOfWeek): the next date AFTER `date` that falls on
+    the given weekday. Null for an unrecognized weekday name."""
+
+    #: Spark getDayOfWeekFromString: exact 2/3-letter abbreviations or
+    #: full names only — "FRIENDS" is invalid, not Friday
+    _DOW = {}
+    for _i, _names in enumerate([("MO", "MON", "MONDAY"),
+                                 ("TU", "TUE", "TUESDAY"),
+                                 ("WE", "WED", "WEDNESDAY"),
+                                 ("TH", "THU", "THURSDAY"),
+                                 ("FR", "FRI", "FRIDAY"),
+                                 ("SA", "SAT", "SATURDAY"),
+                                 ("SU", "SUN", "SUNDAY")]):
+        for _n in _names:
+            _DOW[_n] = _i
+
+    def __init__(self, child, day: str):
+        self.children = [child]
+        self.day = str(day)
+        self._target = self._DOW.get(self.day.strip().upper())
+
+    def _params(self):
+        return self.day
+
+    def with_children(self, children):
+        return NextDay(children[0], self.day)
+
+    def data_type(self):
+        return T.DATE
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        valid = _valid_of(c, ctx)
+        if self._target is None:
+            return ColumnVector(T.DATE, jnp.zeros(ctx.capacity, jnp.int32),
+                                jnp.zeros(ctx.capacity, jnp.bool_))
+        d = c.data.astype(jnp.int64)
+        dow = jnp.mod(d + 3, 7)  # 1970-01-01 was a Thursday (MO=0)
+        delta = jnp.mod(jnp.int64(self._target) - dow + 6, 7) + 1
+        return ColumnVector(T.DATE, (d + delta).astype(jnp.int32), valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        if self._target is None:
+            return CpuCol(T.DATE, np.zeros(len(c.values), np.int32),
+                          np.zeros(len(c.values), np.bool_))
+        d = c.values.astype(np.int64)
+        dow = np.mod(d + 3, 7)
+        delta = np.mod(self._target - dow + 6, 7) + 1
+        return CpuCol(T.DATE, (d + delta).astype(np.int32), c.valid)
+
+
+class MonthsBetween(Expression):
+    """months_between(end, start[, roundOff]): whole months plus a
+    31-day-month fraction; both-last-day-of-month counts as whole."""
+
+    def __init__(self, end, start, round_off: bool = True):
+        self.children = [end, start]
+        self.round_off = bool(round_off)
+
+    def _params(self):
+        return str(self.round_off)
+
+    def with_children(self, children):
+        return MonthsBetween(children[0], children[1], self.round_off)
+
+    def data_type(self):
+        return T.FLOAT64
+
+    @staticmethod
+    def _split(ts_us):
+        days = jnp.floor_divide(ts_us, 86_400_000_000)
+        tod = ts_us - days * 86_400_000_000
+        y, m, d = _civil_from_days(days)
+        return y, m, d, tod, days
+
+    def eval_tpu(self, ctx):
+        e = self.children[0].eval_tpu(ctx)
+        s = self.children[1].eval_tpu(ctx)
+
+        def as_us(c):
+            if isinstance(c.dtype, T.DateType):
+                return c.data.astype(jnp.int64) * 86_400_000_000
+            return c.data.astype(jnp.int64)
+
+        ey, em, ed, etod, edays = self._split(as_us(e))
+        sy, sm, sd, stod, sdays = self._split(as_us(s))
+        # last-day-of-month detection via next-day month change
+        _, em2, _ = _civil_from_days(edays + 1)
+        _, sm2, _ = _civil_from_days(sdays + 1)
+        e_last = em2 != em
+        s_last = sm2 != sm
+        months = (ey - sy) * 12 + (em - sm)
+        same_day = ed == sd
+        whole = (e_last & s_last) | same_day
+        esec = ed.astype(jnp.float64) * 86400 + etod.astype(jnp.float64) / 1e6
+        ssec = sd.astype(jnp.float64) * 86400 + stod.astype(jnp.float64) / 1e6
+        frac = jnp.where(whole, 0.0, (esec - ssec) / (31.0 * 86400))
+        out = months.astype(jnp.float64) + frac
+        if self.round_off:
+            out = jnp.round(out * 1e8) / 1e8
+        return ColumnVector(T.FLOAT64, out, _valid_of(e, ctx) & _valid_of(s, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        import calendar
+        import datetime as dtm
+        e = self.children[0].eval_cpu(cols, ansi)
+        s = self.children[1].eval_cpu(cols, ansi)
+
+        def as_dt(c, i):
+            v = int(c.values[i])
+            if isinstance(c.dtype, T.DateType):
+                return dtm.datetime(1970, 1, 1) + dtm.timedelta(days=v)
+            return dtm.datetime(1970, 1, 1) + dtm.timedelta(microseconds=v)
+
+        out = np.zeros(len(e.values), np.float64)
+        for i in range(len(out)):
+            if not (e.valid[i] and s.valid[i]):
+                continue
+            de, ds = as_dt(e, i), as_dt(s, i)
+            e_last = de.day == calendar.monthrange(de.year, de.month)[1]
+            s_last = ds.day == calendar.monthrange(ds.year, ds.month)[1]
+            months = (de.year - ds.year) * 12 + (de.month - ds.month)
+            if (e_last and s_last) or de.day == ds.day:
+                v = float(months)
+            else:
+                esec = de.day * 86400 + de.hour * 3600 + de.minute * 60 \
+                    + de.second + de.microsecond / 1e6
+                ssec = ds.day * 86400 + ds.hour * 3600 + ds.minute * 60 \
+                    + ds.second + ds.microsecond / 1e6
+                v = months + (esec - ssec) / (31.0 * 86400)
+            out[i] = round(v, 8) if self.round_off else v
+        return CpuCol(T.FLOAT64, out, e.valid & s.valid)
+
+
+class _TrivialConvert(Expression):
+    """Base for unit conversions that are a single multiply/divide."""
+
+    in_t = T.TIMESTAMP
+    out_t = T.INT64
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return self.out_t
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def _fn(self, v, xp):
+        raise NotImplementedError
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        out = self._fn(c.data.astype(jnp.int64), jnp)
+        return ColumnVector(self.out_t, out.astype(self.out_t.np_dtype),
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        out = self._fn(c.values.astype(np.int64), np)
+        return CpuCol(self.out_t, out.astype(self.out_t.np_dtype), c.valid)
+
+
+class UnixDate(_TrivialConvert):
+    """unix_date(date) -> days since epoch (int32)."""
+    in_t = T.DATE
+    out_t = T.INT32
+
+    def _fn(self, v, xp):
+        return v
+
+
+class DateFromUnixDate(_TrivialConvert):
+    in_t = T.INT32
+    out_t = T.DATE
+
+    def _fn(self, v, xp):
+        return v
+
+
+class UnixMicros(_TrivialConvert):
+    def _fn(self, v, xp):
+        return v
+
+
+class UnixMillis(_TrivialConvert):
+    def _fn(self, v, xp):
+        return xp.floor_divide(v, 1000)
+
+
+class UnixSeconds(_TrivialConvert):
+    def _fn(self, v, xp):
+        return xp.floor_divide(v, 1_000_000)
+
+
+class TimestampMillis(_TrivialConvert):
+    in_t = T.INT64
+    out_t = T.TIMESTAMP
+
+    def _fn(self, v, xp):
+        return v * 1000
+
+
+class TimestampMicros(_TrivialConvert):
+    in_t = T.INT64
+    out_t = T.TIMESTAMP
+
+    def _fn(self, v, xp):
+        return v

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
@@ -522,3 +523,278 @@ class Murmur3Hash(Expression):
         h = K.spark_murmur3_batch(batch.columns, batch.num_rows)
         vals = np.asarray(h).astype(np.int32)[:n]
         return CpuCol(TT.INT32, vals, np.ones(n, np.bool_))
+
+
+# ---------------------------------------------------------------------------
+# Extended math breadth (reference mathExpressions.scala second tier)
+# ---------------------------------------------------------------------------
+
+class Cbrt(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.cbrt)
+    fn_cpu = staticmethod(np.cbrt)
+
+
+class Cot(_UnaryDouble):
+    fn_tpu = staticmethod(lambda v: 1.0 / jnp.tan(v))
+    fn_cpu = staticmethod(lambda v: 1.0 / np.tan(v))
+
+
+class Sec(_UnaryDouble):
+    fn_tpu = staticmethod(lambda v: 1.0 / jnp.cos(v))
+    fn_cpu = staticmethod(lambda v: 1.0 / np.cos(v))
+
+
+class Csc(_UnaryDouble):
+    fn_tpu = staticmethod(lambda v: 1.0 / jnp.sin(v))
+    fn_cpu = staticmethod(lambda v: 1.0 / np.sin(v))
+
+
+class ToDegrees(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.degrees)
+    fn_cpu = staticmethod(np.degrees)
+
+
+class ToRadians(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.radians)
+    fn_cpu = staticmethod(np.radians)
+
+
+class Expm1(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.expm1)
+    fn_cpu = staticmethod(np.expm1)
+
+
+class Log1p(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.log1p)
+    fn_cpu = staticmethod(np.log1p)
+    domain = staticmethod(lambda v: v > -1)
+
+
+class Rint(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.rint)
+    fn_cpu = staticmethod(np.rint)
+
+
+class Hypot(Expression):
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self):
+        return T.FLOAT64
+
+    def with_children(self, children):
+        return Hypot(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        l = self.children[0].eval_tpu(ctx)
+        r = self.children[1].eval_tpu(ctx)
+        v = jnp.hypot(l.data.astype(np.float64), r.data.astype(np.float64))
+        return ColumnVector(T.FLOAT64, v, _valid_of(l, ctx) & _valid_of(r, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        l = self.children[0].eval_cpu(cols, ansi)
+        r = self.children[1].eval_cpu(cols, ansi)
+        return CpuCol(T.FLOAT64,
+                      np.hypot(l.values.astype(np.float64),
+                               r.values.astype(np.float64)),
+                      l.valid & r.valid)
+
+
+#: 0!..20! fit int64 (Spark returns null outside [0, 20])
+_FACTORIALS = np.cumprod([1] + list(range(1, 21)), dtype=np.int64)
+
+
+class Factorial(Expression):
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT64
+
+    def with_children(self, children):
+        return Factorial(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        v = c.data.astype(jnp.int64)  # range-check BEFORE any narrowing
+        ok = (v >= 0) & (v <= 20)
+        out = jnp.asarray(_FACTORIALS)[jnp.clip(v, 0, 20).astype(jnp.int32)]
+        return ColumnVector(T.INT64, out, _valid_of(c, ctx) & ok)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        v = c.values.astype(np.int64)
+        ok = (v >= 0) & (v <= 20)
+        out = _FACTORIALS[np.clip(v, 0, 20)]
+        return CpuCol(T.INT64, out, c.valid & ok)
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b where a is NaN."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self):
+        return T.FLOAT64
+
+    def with_children(self, children):
+        return NaNvl(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        l = self.children[0].eval_tpu(ctx)
+        r = self.children[1].eval_tpu(ctx)
+        lv = l.data.astype(np.float64)
+        rv = r.data.astype(np.float64)
+        nan = jnp.isnan(lv)
+        out = jnp.where(nan, rv, lv)
+        lval = _valid_of(l, ctx)
+        rval = _valid_of(r, ctx)
+        return ColumnVector(T.FLOAT64, out, jnp.where(nan, rval, lval))
+
+    def eval_cpu(self, cols, ansi=False):
+        l = self.children[0].eval_cpu(cols, ansi)
+        r = self.children[1].eval_cpu(cols, ansi)
+        lv = l.values.astype(np.float64)
+        nan = np.isnan(lv)
+        return CpuCol(T.FLOAT64, np.where(nan, r.values.astype(np.float64), lv),
+                      np.where(nan, r.valid, l.valid))
+
+
+class BitwiseCount(Expression):
+    """bit_count(x): number of set bits (negative ints count two's-
+    complement bits; booleans count as 0/1). Result int32."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return BitwiseCount(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        if isinstance(c.dtype, T.BooleanType):
+            out = c.data.astype(jnp.int32)
+        else:
+            w = 64 if np.dtype(c.dtype.np_dtype).itemsize == 8 else 32
+            u = c.data.astype(jnp.int64).astype(jnp.uint64) \
+                if w == 64 else c.data.astype(jnp.int32).astype(jnp.uint32)
+            if w == 32:
+                # mask sign-extension of narrow types
+                nbits = np.dtype(c.dtype.np_dtype).itemsize * 8
+                u = u & jnp.uint32((1 << nbits) - 1) if nbits < 32 else u
+            out = jax.lax.population_count(u).astype(jnp.int32)
+        return ColumnVector(T.INT32, out, _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        if isinstance(c.dtype, T.BooleanType):
+            out = c.values.astype(np.int32)
+        else:
+            nbits = np.dtype(c.dtype.np_dtype).itemsize * 8
+            u = c.values.astype(np.int64).astype(np.uint64)
+            if nbits < 64:
+                u = u & np.uint64((1 << nbits) - 1)
+            out = np.array([bin(int(x)).count("1") for x in u], np.int32)
+        return CpuCol(T.INT32, out, c.valid)
+
+
+class BitwiseGet(Expression):
+    """getbit(x, pos): bit at position pos (0 = LSB); error on pos out of
+    range in ANSI, null otherwise? Spark: error always — we null outside
+    range non-ANSI for fallback-free columnar eval and error in ANSI."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self):
+        return T.INT8
+
+    def with_children(self, children):
+        return BitwiseGet(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        p = self.children[1].eval_tpu(ctx)
+        nbits = np.dtype(c.dtype.np_dtype).itemsize * 8
+        pos = p.data.astype(jnp.int32)
+        in_range = (pos >= 0) & (pos < nbits)
+        if ctx.ansi:
+            ctx.add_error("BitPosOutOfRange",
+                          _valid_of(p, ctx) & ~in_range)
+        v = c.data.astype(jnp.int64)
+        out = ((v >> jnp.clip(pos, 0, nbits - 1).astype(jnp.int64))
+               & jnp.int64(1)).astype(jnp.int8)
+        return ColumnVector(T.INT8, out,
+                            _valid_of(c, ctx) & _valid_of(p, ctx) & in_range)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        p = self.children[1].eval_cpu(cols, ansi)
+        nbits = np.dtype(c.dtype.np_dtype).itemsize * 8
+        pos = p.values.astype(np.int64)
+        in_range = (pos >= 0) & (pos < nbits)
+        if ansi and bool((p.valid & ~in_range).any()):
+            from spark_rapids_tpu.expr.core import SparkException
+            raise SparkException("bit position out of range")
+        out = ((c.values.astype(np.int64) >> np.clip(pos, 0, nbits - 1))
+               & 1).astype(np.int8)
+        return CpuCol(T.INT8, out, c.valid & p.valid & in_range)
+
+
+class BRound(Expression):
+    """bround(x, scale): HALF_EVEN rounding (Spark Round is HALF_UP)."""
+
+    def __init__(self, child, scale: int = 0):
+        self.children = [child]
+        self.scale = int(scale)
+
+    def _params(self):
+        return str(self.scale)
+
+    def with_children(self, children):
+        return BRound(children[0], self.scale)
+
+    def data_type(self):
+        dt = self.children[0].data_type()
+        return dt if not isinstance(dt, T.Float32Type) else T.FLOAT32
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        dt = self.data_type()
+        p = np.float64(10.0 ** self.scale)
+        if dt.is_integral:
+            if self.scale >= 0:
+                return ColumnVector(dt, c.data, _valid_of(c, ctx))
+            q = np.int64(10 ** (-self.scale))
+            v = c.data.astype(jnp.int64)
+            half = q // 2
+            base = jnp.floor_divide(v, q)
+            rem = v - base * q
+            up = (rem > half) | ((rem == half) & (base % 2 != 0))
+            out = (base + up.astype(jnp.int64)) * q
+            return ColumnVector(dt, out.astype(dt.np_dtype),
+                                _valid_of(c, ctx))
+        v = c.data.astype(jnp.float64) * p
+        out = (jnp.round(v) / p).astype(dt.np_dtype)  # jnp.round = half-even
+        return ColumnVector(dt, out, _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        dt = self.data_type()
+        if dt.is_integral:
+            if self.scale >= 0:
+                return CpuCol(dt, c.values, c.valid)
+            q = 10 ** (-self.scale)
+            v = c.values.astype(np.int64)
+            half = q // 2
+            base = np.floor_divide(v, q)
+            rem = v - base * q
+            up = (rem > half) | ((rem == half) & (base % 2 != 0))
+            return CpuCol(dt, ((base + up) * q).astype(dt.np_dtype), c.valid)
+        p = 10.0 ** self.scale
+        out = (np.round(c.values.astype(np.float64) * p) / p).astype(dt.np_dtype)
+        return CpuCol(dt, out, c.valid)

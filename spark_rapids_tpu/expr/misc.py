@@ -286,3 +286,181 @@ def _hive_hash_col_np(c: CpuCol) -> np.ndarray:
 
 
 MISC_CPU_FUNCTIONS = [Sequence, ParseUrl, RaiseError]
+
+
+# ---------------------------------------------------------------------------
+# Hash breadth: crc32 + xxhash64 expressions (reference jni.Hash)
+# ---------------------------------------------------------------------------
+
+def _crc32_table():
+    t = np.zeros(256, np.uint32)
+    for i in range(256):
+        c = np.uint32(i)
+        for _ in range(8):
+            c = np.uint32(0xEDB88320) ^ (c >> np.uint32(1)) \
+                if c & np.uint32(1) else c >> np.uint32(1)
+        t[i] = c
+    return t
+
+
+_CRC32_TABLE = _crc32_table()
+
+
+class Crc32(Expression):
+    """crc32(str|binary) -> bigint. Device: table-gather per byte inside
+    the usual max-length lockstep loop (same shape as murmur3_bytes)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT64
+
+    def with_children(self, children):
+        return Crc32(children[0])
+
+    def eval_tpu(self, ctx):
+        import jax
+        from jax import lax
+        from spark_rapids_tpu.expr.strings import _lift_unary
+        c = self.children[0].eval_tpu(ctx)
+        table = jnp.asarray(_CRC32_TABLE)
+
+        def compute(flat, cap):
+            off = flat.data["offsets"][: cap + 1].astype(jnp.int32)
+            raw = flat.data["bytes"]
+            starts = off[:-1]
+            lens = off[1:] - off[:-1]
+            nbytes = int(raw.shape[0])
+
+            def body(i, crc):
+                idx = jnp.clip(starts + i, 0, nbytes - 1)
+                byte = raw[idx].astype(jnp.uint32)
+                nxt = table[((crc ^ byte) & jnp.uint32(0xFF)).astype(jnp.int32)] \
+                    ^ (crc >> jnp.uint32(8))
+                return jnp.where(i < lens, nxt, crc)
+
+            crc0 = jnp.full(cap, 0xFFFFFFFF, jnp.uint32)
+            crc = lax.fori_loop(0, jnp.max(lens), body, crc0)
+            out = (~crc).astype(jnp.uint32).astype(jnp.int64)
+            return ColumnVector(T.INT64, out, None)
+
+        out = _lift_unary(ctx, c, compute)
+        return ColumnVector(T.INT64, out.data, _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        import zlib
+        c = self.children[0].eval_cpu(cols, ansi)
+        vals = np.array([zlib.crc32(s.encode() if isinstance(s, str) else
+                                    (s or b"")) for s in c.values], np.int64)
+        return CpuCol(T.INT64, vals, c.valid)
+
+
+class XxHash64(Expression):
+    """xxhash64(cols..., seed 42): Spark-compatible chained xxhash64 over
+    fixed-width columns — <=4-byte types go through XXH64.hashInt, 8-byte
+    through hashLong, exactly as Spark's XxHash64Function dispatches; each
+    row's hash seeds the next column's. String columns fall back to CPU
+    (no bytes kernel yet); null fields pass the running seed through."""
+
+    def __init__(self, children):
+        self.children = list(children)
+
+    def data_type(self):
+        return T.INT64
+
+    def with_children(self, children):
+        return XxHash64(children)
+
+    def supported_on_tpu(self):
+        for c in self.children:
+            dt = c.data_type()
+            if isinstance(dt, (T.StringType, T.ArrayType, T.MapType,
+                               T.StructType)):
+                return False
+        return True
+
+    @staticmethod
+    def _norm(col):
+        """(plane, is_int32) per Spark's per-type hash dispatch."""
+        import jax.lax as lax
+        d = col.dtype
+        if isinstance(d, T.Float32Type):
+            v = jnp.where(col.data == 0.0, jnp.zeros_like(col.data),
+                          col.data)
+            v = jnp.where(jnp.isnan(v), jnp.float32(np.nan), v)
+            return lax.bitcast_convert_type(v, jnp.int32), True
+        if isinstance(d, T.Float64Type):
+            from spark_rapids_tpu.ops.kernels import _bitcast_f64_u64
+            v = jnp.where(col.data == 0.0, jnp.zeros_like(col.data),
+                          col.data)
+            v = jnp.where(jnp.isnan(v), jnp.float64(np.nan), v)
+            return _bitcast_f64_u64(v).astype(jnp.int64), False
+        if isinstance(d, (T.BooleanType, T.Int8Type, T.Int16Type,
+                          T.Int32Type, T.DateType)):
+            return col.data.astype(jnp.int32), True
+        return col.data.astype(jnp.int64), False
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.ops import kernels as K
+        cols = [c.eval_tpu(ctx) for c in self.children]
+        cap = ctx.capacity
+        h = jnp.full(cap, np.uint64(42), jnp.uint64)
+        for c in cols:
+            v, is32 = self._norm(c)
+            valid = c.validity_or_default(ctx.num_rows) & ctx.row_mask
+            h2 = (K.xxhash64_int32(v, h) if is32
+                  else K.xxhash64_int64(v, h)).astype(jnp.uint64)
+            h = jnp.where(valid, h2, h)
+        return ColumnVector(T.INT64, h.astype(jnp.int64), None)
+
+    def eval_cpu(self, cols, ansi=False):
+        M = (1 << 64) - 1
+        P1, P2, P3 = 0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, \
+            0x165667B19E3779F9
+        P4, P5 = 0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5
+
+        def rotl(x, r):
+            return ((x << r) | (x >> (64 - r))) & M
+
+        def avalanche(h):
+            h = ((h ^ (h >> 33)) * P2) & M
+            h = ((h ^ (h >> 29)) * P3) & M
+            return h ^ (h >> 32)
+
+        def hash_long(v, seed):
+            h = (seed + P5 + 8) & M
+            k1 = (rotl((v * P2) & M, 31) * P1) & M
+            h = h ^ k1
+            h = (rotl(h, 27) * P1 + P4) & M
+            return avalanche(h)
+
+        def hash_int(v, seed):
+            h = (seed + P5 + 4) & M
+            h = h ^ ((v & 0xFFFFFFFF) * P1) & M
+            h = (rotl(h & M, 23) * P2 + P3) & M
+            return avalanche(h)
+
+        ins = [c.eval_cpu(cols, ansi) for c in self.children]
+        n = len(ins[0].values) if ins else 0
+        out = np.zeros(n, np.int64)
+        for i in range(n):
+            h = 42
+            for c in ins:
+                if not c.valid[i]:
+                    continue
+                d = c.dtype
+                v = c.values[i]
+                if isinstance(d, T.Float32Type):
+                    f = np.float32(0.0 if v == 0 else v)
+                    h = hash_int(int(f.view(np.int32)) & 0xFFFFFFFF, h)
+                elif isinstance(d, T.Float64Type):
+                    f = np.float64(0.0 if v == 0 else v)
+                    h = hash_long(int(f.view(np.uint64)), h)
+                elif isinstance(d, (T.BooleanType, T.Int8Type, T.Int16Type,
+                                    T.Int32Type, T.DateType)):
+                    h = hash_int(int(np.int32(v)) & 0xFFFFFFFF, h)
+                else:
+                    h = hash_long(int(np.int64(v).view(np.uint64)), h)
+            out[i] = np.uint64(h).astype(np.int64)
+        return CpuCol(T.INT64, out, np.ones(n, np.bool_))

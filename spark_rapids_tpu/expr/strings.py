@@ -1333,3 +1333,145 @@ class StringRepeat(Expression):
         vals = np.array([s * self.n if isinstance(s, str) else s
                          for s in c.values], object)
         return CpuCol(T.STRING, vals, c.valid)
+
+
+# ---------------------------------------------------------------------------
+# String breadth second tier: device-trivial length/slice family
+# ---------------------------------------------------------------------------
+
+class OctetLength(Expression):
+    """octet_length(): UTF-8 byte count."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return OctetLength(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+
+        def compute(flat, cap):
+            off = flat.data["offsets"]
+            lens = (off[1: cap + 1] - off[:cap]).astype(jnp.int32)
+            return ColumnVector(T.INT32, lens, None)
+
+        return _lift_unary(ctx, c, compute)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        vals = np.array([len(s.encode()) if isinstance(s, str) else 0
+                         for s in c.values], np.int32)
+        return CpuCol(T.INT32, vals, c.valid)
+
+
+class BitLength(OctetLength):
+    """bit_length(): 8 * octet_length."""
+
+    def with_children(self, children):
+        return BitLength(children[0])
+
+    def eval_tpu(self, ctx):
+        base = super().eval_tpu(ctx)
+        return ColumnVector(T.INT32, base.data * 8, base.validity)
+
+    def eval_cpu(self, cols, ansi=False):
+        base = super().eval_cpu(cols, ansi)
+        return CpuCol(T.INT32, base.values * 8, base.valid)
+
+
+class Left(Substring):
+    """left(s, n) = substring(s, 1, n); n < 0 yields ''."""
+
+    def __init__(self, child, n: int):
+        super().__init__(child, 1, max(int(n), 0))
+
+    def with_children(self, children):
+        return Left(children[0], self.length)
+
+
+class Right(Expression):
+    """right(s, n): last n characters ('' for n <= 0)."""
+
+    def __init__(self, child, n: int):
+        self.children = [child]
+        self.n = int(n)
+
+    def _params(self):
+        return str(self.n)
+
+    def with_children(self, children):
+        return Right(children[0], self.n)
+
+    def data_type(self):
+        return T.STRING
+
+    def eval_tpu(self, ctx):
+        if self.n <= 0:
+            inner = Substring(self.children[0], 1, 0)
+        else:
+            inner = Substring(self.children[0], -self.n, self.n)
+        inner = inner.with_children([self.children[0]])
+        return inner.eval_tpu(ctx)
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        n = self.n
+        vals = np.array([s[-n:] if isinstance(s, str) and n > 0 else
+                         ("" if isinstance(s, str) else None)
+                         for s in c.values], object)
+        return CpuCol(T.STRING, vals, c.valid)
+
+
+class Chr(Expression):
+    """chr(n): the character with code n % 256 for positive n in Latin-1
+    range (Spark semantics: n <= 0 -> '', 256-multiples -> '\\0' etc.)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return Chr(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        v = c.data.astype(jnp.int64)
+        code = jnp.where(v < 0, jnp.int64(0), v % 256)
+        # UTF-8: codes < 128 are one byte; 128..255 encode as two bytes.
+        # Spark: only NEGATIVE n gives ''; chr(0) and chr(256) are '\\x00'
+        two = code >= 128
+        lens = jnp.where(c.validity_or_default(ctx.num_rows) & (v >= 0),
+                         jnp.where(two, 2, 1), 0).astype(jnp.int32)
+        off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+        cap = ctx.capacity
+        bcap = 2 * cap
+        b = jnp.arange(bcap, dtype=jnp.int32)
+        row = jnp.clip(jnp.searchsorted(off, b, side="right").astype(jnp.int32)
+                       - 1, 0, cap - 1)
+        in_r = b < off[-1]
+        second = b - off[row] == 1
+        cd = code[row]
+        byte1 = jnp.where(cd < 128, cd, 0xC0 | (cd >> 6))
+        byte2 = 0x80 | (cd & 0x3F)
+        ob = jnp.where(second, byte2, byte1)
+        out_bytes = jnp.where(in_r, ob, 0).astype(jnp.uint8)
+        return ColumnVector(T.STRING, {"offsets": off, "bytes": out_bytes},
+                            _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        out = []
+        for v, ok in zip(c.values, c.valid):
+            if not ok:
+                out.append(None)
+                continue
+            n = int(v)
+            out.append("" if n < 0 else chr(n % 256))
+        return CpuCol(T.STRING, np.array(out, object), c.valid)

@@ -192,15 +192,35 @@ def _rotl64(x, r):
     return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
 
 
-def xxhash64_int64(values: jax.Array, seed: int = 42) -> jax.Array:
-    v = values.astype(jnp.uint64)
-    h = np.uint64(seed) + _XXP5 + np.uint64(8)
-    k1 = _rotl64(v * _XXP2, 31) * _XXP1
-    h = h ^ k1
-    h = _rotl64(h, 27) * _XXP1 + np.uint64(0x85EBCA77C2B2AE63)
+_XXP4 = np.uint64(0x85EBCA77C2B2AE63)
+
+
+def _xx_avalanche(h):
     h = (h ^ (h >> np.uint64(33))) * _XXP2
     h = (h ^ (h >> np.uint64(29))) * _XXP3
-    return (h ^ (h >> np.uint64(32))).astype(jnp.int64)
+    return h ^ (h >> np.uint64(32))
+
+
+def xxhash64_int64(values: jax.Array, seed=42) -> jax.Array:
+    """XXH64.hashLong: seed may be a scalar or a per-row uint64 vector
+    (Spark chains column hashes through the seed)."""
+    v = values.astype(jnp.uint64)
+    seed = seed.astype(jnp.uint64) if hasattr(seed, "astype")         else np.uint64(seed)
+    h = seed + _XXP5 + np.uint64(8)
+    k1 = _rotl64(v * _XXP2, 31) * _XXP1
+    h = h ^ k1
+    h = _rotl64(h, 27) * _XXP1 + _XXP4
+    return _xx_avalanche(h).astype(jnp.int64)
+
+
+def xxhash64_int32(values: jax.Array, seed=42) -> jax.Array:
+    """XXH64.hashInt (Spark uses it for <= 4-byte fixed types)."""
+    v = values.astype(jnp.int32).astype(jnp.uint32).astype(jnp.uint64)
+    seed = seed.astype(jnp.uint64) if hasattr(seed, "astype")         else np.uint64(seed)
+    h = seed + _XXP5 + np.uint64(4)
+    h = h ^ (v * _XXP1)
+    h = _rotl64(h, 23) * _XXP2 + _XXP3
+    return _xx_avalanche(h).astype(jnp.int64)
 
 
 # ---------------------------------------------------------------------------
@@ -676,6 +696,34 @@ def _union_bounds(cols: List[ColumnVector]):
     return (min(b[0] for b in bs), max(b[1] for b in bs))
 
 
+def unify_vocabs(cols: List[ColumnVector]):
+    """Union the vocabularies of several dict-string columns host-side.
+    Returns (union_offsets np.int32[k+1], union_bytes np.uint8[m],
+    per-column code remaps). Equal strings map to ONE union code, so
+    code-identity reasoning (bucket agg, ICI fixed-width exchange) stays
+    sound across the inputs."""
+    vocab_planes = []
+    for c in cols:
+        vocab_planes.extend([c.data["dict_offsets"], c.data["dict_bytes"]])
+    host = jax.device_get(vocab_planes)
+    union: dict = {}
+    remaps = []
+    for i in range(len(cols)):
+        off, by = np.asarray(host[2 * i]), np.asarray(host[2 * i + 1])
+        remap = np.zeros(len(off) - 1, np.int32)
+        for k in range(len(off) - 1):
+            sv = bytes(by[off[k]: off[k + 1]])
+            if sv not in union:
+                union[sv] = len(union)
+            remap[k] = union[sv]
+        remaps.append(remap)
+    ub = b"".join(union.keys())
+    uoff = np.zeros(len(union) + 1, np.int32)
+    uoff[1:] = np.cumsum([len(sv) for sv in union.keys()])
+    ubytes = np.frombuffer(ub, np.uint8) if ub else np.zeros(1, np.uint8)
+    return uoff, np.ascontiguousarray(ubytes), remaps
+
+
 def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> ColumnVector:
     dtype = cols[0].dtype
     if any(c.is_dict for c in cols) and not all(c.is_dict for c in cols):
@@ -703,25 +751,7 @@ def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> Colu
         # runs at eager concat boundaries only). Equal strings must map to
         # one code — duplicated vocab entries would make "unique bucket"
         # reasoning (bucketed agg, merge-skip) silently wrong.
-        vocab_planes = []
-        for c in cols:
-            vocab_planes.extend([c.data["dict_offsets"], c.data["dict_bytes"]])
-        host = jax.device_get(vocab_planes)
-        union: dict = {}
-        remaps = []
-        for i in range(len(cols)):
-            off, by = np.asarray(host[2 * i]), np.asarray(host[2 * i + 1])
-            remap = np.zeros(len(off) - 1, np.int32)
-            for k in range(len(off) - 1):
-                s = bytes(by[off[k]: off[k + 1]])
-                if s not in union:
-                    union[s] = len(union)
-                remap[k] = union[s]
-            remaps.append(remap)
-        ub = b"".join(union.keys())
-        uoff = np.zeros(len(union) + 1, np.int32)
-        uoff[1:] = np.cumsum([len(s) for s in union.keys()])
-        ubytes = np.frombuffer(ub, np.uint8) if ub else np.zeros(1, np.uint8)
+        uoff, ubytes, remaps = unify_vocabs(cols)
         code_parts = [jnp.asarray(remap)[c.data["codes"][:r]]
                       for c, r, remap in zip(cols, rows, remaps)]
         codes = jnp.concatenate(code_parts)
@@ -729,7 +759,7 @@ def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> Colu
             codes = jnp.concatenate([codes, jnp.zeros(pad, codes.dtype)])
         return ColumnVector(dtype, {"codes": codes,
                                     "dict_offsets": jnp.asarray(uoff),
-                                    "dict_bytes": jnp.asarray(np.ascontiguousarray(ubytes))},
+                                    "dict_bytes": jnp.asarray(ubytes)},
                             validity)
 
     if isinstance(dtype, T.StructType):

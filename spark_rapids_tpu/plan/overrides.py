@@ -350,6 +350,70 @@ expr_rule(AO.ArrayExcept, _ARR, _ARR, "array_except")
 expr_rule(AO.ArraysOverlap, _ARR, Sigs.COMMON, "arrays_overlap")
 
 
+# math/string/datetime/collection breadth second tier
+from spark_rapids_tpu.expr import cpu_functions as _CPUF  # noqa: E402
+from spark_rapids_tpu.expr import misc as _MISC  # noqa: E402
+
+for _cls in (MA.Cbrt, MA.Cot, MA.Sec, MA.Csc, MA.ToDegrees, MA.ToRadians,
+             MA.Expm1, MA.Log1p, MA.Rint, MA.Hypot, MA.NaNvl):
+    expr_rule(_cls, _NUM, _NUM, _cls.__name__.lower())
+expr_rule(MA.Factorial, _NUM, _NUM, "factorial (null outside [0, 20])")
+expr_rule(MA.BitwiseCount, _NUM, _NUM, "bit_count")
+expr_rule(MA.BitwiseGet, _NUM, _NUM, "getbit")
+expr_rule(MA.BRound, _NUM, _NUM, "bround (HALF_EVEN)")
+
+expr_rule(DT.MakeDate, Sigs.COMMON, Sigs.COMMON, "make_date")
+expr_rule(DT.NextDay, Sigs.COMMON, Sigs.COMMON, "next_day")
+expr_rule(DT.MonthsBetween, Sigs.COMMON, Sigs.COMMON, "months_between")
+for _cls in (DT.UnixDate, DT.DateFromUnixDate, DT.UnixMicros,
+             DT.UnixMillis, DT.UnixSeconds, DT.TimestampMillis,
+             DT.TimestampMicros):
+    expr_rule(_cls, Sigs.COMMON, Sigs.COMMON, _cls.__name__.lower())
+
+for _cls in (S.OctetLength, S.BitLength, S.Left, S.Right, S.Chr):
+    expr_rule(_cls, Sigs.COMMON, Sigs.COMMON, _cls.__name__.lower())
+
+def _cpu_tier(doc):
+    return lambda e: doc
+
+expr_rule(_CPUF.FindInSet, Sigs.COMMON, Sigs.COMMON, "find_in_set",
+          extra=_cpu_tier("find_in_set runs on CPU"))
+expr_rule(_CPUF.Levenshtein, Sigs.COMMON, Sigs.COMMON, "levenshtein",
+          extra=_cpu_tier("levenshtein runs on CPU"))
+expr_rule(_CPUF.Base64Encode, Sigs.COMMON, Sigs.COMMON, "base64",
+          extra=_cpu_tier("base64 runs on CPU"))
+expr_rule(_CPUF.UnBase64, Sigs.COMMON, Sigs.COMMON, "unbase64",
+          extra=_cpu_tier("unbase64 runs on CPU"))
+expr_rule(_CPUF.FormatString, Sigs.COMMON, Sigs.COMMON, "format_string",
+          extra=_cpu_tier("format_string runs on CPU"))
+expr_rule(_CPUF.Elt, Sigs.COMMON, Sigs.COMMON, "elt",
+          extra=_cpu_tier("elt runs on CPU"))
+expr_rule(_CPUF.Soundex, Sigs.COMMON, Sigs.COMMON, "soundex",
+          extra=_cpu_tier("soundex runs on CPU"))
+expr_rule(_CPUF.JsonTuple, _ARR, _ARR, "json_tuple",
+          extra=_cpu_tier("json_tuple runs on CPU"))
+
+expr_rule(_MISC.Crc32, Sigs.COMMON, Sigs.COMMON, "crc32")
+expr_rule(_MISC.XxHash64, Sigs.COMMON, Sigs.COMMON,
+          "xxhash64 (Spark-compatible, seed 42)",
+          extra=lambda e: None if e.supported_on_tpu()
+          else "xxhash64 over string/nested columns runs on CPU")
+
+expr_rule(AO.ArrayRepeat, _ARR, _ARR, "array_repeat",
+          extra=_cpu_tier("array_repeat runs on CPU"))
+expr_rule(AO.ArrayJoin, _ARR, Sigs.COMMON, "array_join",
+          extra=_cpu_tier("array_join runs on CPU"))
+expr_rule(AO.ArraysZip, _ARR, _ARR, "arrays_zip",
+          extra=_cpu_tier("arrays_zip runs on CPU"))
+expr_rule(AO.MapEntries, _ARR, _ARR, "map_entries")
+expr_rule(AO.MapConcat, _ARR, _ARR, "map_concat",
+          extra=_cpu_tier("map_concat runs on CPU"))
+expr_rule(AO.MapFromArrays, _ARR, _ARR, "map_from_arrays",
+          extra=_cpu_tier("map_from_arrays runs on CPU"))
+expr_rule(AO.StrToMap, Sigs.COMMON, _ARR, "str_to_map",
+          extra=_cpu_tier("str_to_map runs on CPU"))
+
+
 # Aggregate function rules
 AGG_RULES: Dict[Type, ExprRule] = {}
 

@@ -770,3 +770,198 @@ def to_utc_timestamp(ts, tz):
     from spark_rapids_tpu.expr.core import Literal
     z = tz.value if isinstance(tz, Literal) else tz
     return ToUtcTimestamp(_e(ts), z)
+
+
+# ---------------------------------------------------------------------------
+# Math / string / datetime / collection breadth second tier
+# ---------------------------------------------------------------------------
+
+def _math1(name):
+    def f(c):
+        from spark_rapids_tpu.expr import math as MA
+        return getattr(MA, name)(_e(c))
+    f.__name__ = name.lower()
+    return f
+
+
+cbrt = _math1("Cbrt")
+cot = _math1("Cot")
+sec = _math1("Sec")
+csc = _math1("Csc")
+degrees = _math1("ToDegrees")
+radians = _math1("ToRadians")
+expm1 = _math1("Expm1")
+log1p = _math1("Log1p")
+rint = _math1("Rint")
+factorial = _math1("Factorial")
+bit_count = _math1("BitwiseCount")
+
+
+def hypot(a, b):
+    from spark_rapids_tpu.expr.math import Hypot
+    return Hypot(_e(a), _e(b))
+
+
+def nanvl(a, b):
+    from spark_rapids_tpu.expr.math import NaNvl
+    return NaNvl(_e(a), _e(b))
+
+
+def getbit(c, pos):
+    from spark_rapids_tpu.expr.math import BitwiseGet
+    return BitwiseGet(_e(c), _e(pos))
+
+
+bit_get = getbit
+
+
+def bround(c, scale=0):
+    from spark_rapids_tpu.expr.math import BRound
+    return BRound(_e(c), scale)
+
+
+def make_date(y, m, d):
+    from spark_rapids_tpu.expr.datetime import MakeDate
+    return MakeDate(_e(y), _e(m), _e(d))
+
+
+def next_day(c, day):
+    from spark_rapids_tpu.expr.datetime import NextDay
+    return NextDay(_e(c), day)
+
+
+def months_between(end, start, roundOff=True):
+    from spark_rapids_tpu.expr.datetime import MonthsBetween
+    return MonthsBetween(_e(end), _e(start), roundOff)
+
+
+def _dt1(name):
+    def f(c):
+        from spark_rapids_tpu.expr import datetime as DTm
+        return getattr(DTm, name)(_e(c))
+    f.__name__ = name.lower()
+    return f
+
+
+unix_date = _dt1("UnixDate")
+date_from_unix_date = _dt1("DateFromUnixDate")
+unix_micros = _dt1("UnixMicros")
+unix_millis = _dt1("UnixMillis")
+unix_seconds = _dt1("UnixSeconds")
+timestamp_millis = _dt1("TimestampMillis")
+timestamp_micros = _dt1("TimestampMicros")
+
+
+def octet_length(c):
+    from spark_rapids_tpu.expr.strings import OctetLength
+    return OctetLength(_e(c))
+
+
+def bit_length(c):
+    from spark_rapids_tpu.expr.strings import BitLength
+    return BitLength(_e(c))
+
+
+def left(c, n):
+    from spark_rapids_tpu.expr.strings import Left
+    from spark_rapids_tpu.expr.core import Literal
+    return Left(_e(c), n.value if isinstance(n, Literal) else n)
+
+
+def right(c, n):
+    from spark_rapids_tpu.expr.strings import Right
+    from spark_rapids_tpu.expr.core import Literal
+    return Right(_e(c), n.value if isinstance(n, Literal) else n)
+
+
+def chr_(c):
+    from spark_rapids_tpu.expr.strings import Chr
+    return Chr(_e(c))
+
+
+char = chr_
+
+
+def find_in_set(s, csv):
+    from spark_rapids_tpu.expr.cpu_functions import FindInSet
+    return FindInSet(_e(s), _e(csv))
+
+
+def levenshtein(a, b):
+    from spark_rapids_tpu.expr.cpu_functions import Levenshtein
+    return Levenshtein(_e(a), _e(b))
+
+
+def base64(c):
+    from spark_rapids_tpu.expr.cpu_functions import Base64Encode
+    return Base64Encode(_e(c))
+
+
+def unbase64(c):
+    from spark_rapids_tpu.expr.cpu_functions import UnBase64
+    return UnBase64(_e(c))
+
+
+def format_string(fmt, *cols):
+    from spark_rapids_tpu.expr.cpu_functions import FormatString
+    return FormatString(*[_e(c) for c in cols], params=(fmt,))
+
+
+def elt(n, *cols):
+    from spark_rapids_tpu.expr.cpu_functions import Elt
+    return Elt(_e(n), *[_e(c) for c in cols])
+
+
+def soundex(c):
+    from spark_rapids_tpu.expr.cpu_functions import Soundex
+    return Soundex(_e(c))
+
+
+def json_tuple(c, *fields):
+    from spark_rapids_tpu.expr.cpu_functions import JsonTuple
+    return JsonTuple(_e(c), params=tuple(fields))
+
+
+def crc32(c):
+    from spark_rapids_tpu.expr.misc import Crc32
+    return Crc32(_e(c))
+
+
+def xxhash64(*cols):
+    from spark_rapids_tpu.expr.misc import XxHash64
+    return XxHash64([_e(c) for c in cols])
+
+
+def array_repeat(v, n):
+    from spark_rapids_tpu.expr.array_ops import ArrayRepeat
+    return ArrayRepeat(_e(v), _e(n))
+
+
+def array_join(c, sep, null_replacement=None):
+    from spark_rapids_tpu.expr.array_ops import ArrayJoin
+    return ArrayJoin(_e(c), sep, null_replacement)
+
+
+def arrays_zip(*cols):
+    from spark_rapids_tpu.expr.array_ops import ArraysZip
+    return ArraysZip([_e(c) for c in cols])
+
+
+def map_entries(c):
+    from spark_rapids_tpu.expr.array_ops import MapEntries
+    return MapEntries(_e(c))
+
+
+def map_concat(*cols):
+    from spark_rapids_tpu.expr.array_ops import MapConcat
+    return MapConcat([_e(c) for c in cols])
+
+
+def map_from_arrays(k, v):
+    from spark_rapids_tpu.expr.array_ops import MapFromArrays
+    return MapFromArrays(_e(k), _e(v))
+
+
+def str_to_map(c, pair_delim=",", kv_delim=":"):
+    from spark_rapids_tpu.expr.array_ops import StrToMap
+    return StrToMap(_e(c), pair_delim, kv_delim)

@@ -1,0 +1,200 @@
+"""Differential tests for the second-tier expression breadth: extended
+math, datetime unit conversions, string length/slice family, hashes,
+collection constructors (VERDICT r3 #1: registry breadth)."""
+import datetime as dtm
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _num_tbl(n=80, seed=21):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "f": pa.array(np.round(rng.uniform(-100, 100, n), 3)),
+        "g": pa.array(np.round(rng.uniform(0.1, 50, n), 3)),
+        "i": pa.array(rng.integers(-1000, 1000, n).astype(np.int64)),
+        "j": pa.array(rng.integers(0, 25, n).astype(np.int32)),
+        "p": pa.array(rng.integers(0, 64, n).astype(np.int32)),
+    })
+
+
+def test_math_extended_unary(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_num_tbl()).select(
+            F.cbrt(col("f")).alias("cb"),
+            F.cot(col("g")).alias("ct"),
+            F.sec(col("g")).alias("se"),
+            F.csc(col("g")).alias("cs"),
+            F.degrees(col("f")).alias("dg"),
+            F.radians(col("f")).alias("rd"),
+            F.expm1(col("g") / lit(50.0)).alias("em"),
+            F.log1p(col("g")).alias("lp"),
+            F.rint(col("f")).alias("ri")),
+        session, approx_float=1e-12)
+
+
+def test_math_binary_and_bits(session):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(_num_tbl()).select(
+            F.hypot(col("f"), col("g")).alias("hy"),
+            F.nanvl(col("f") / (col("f") - col("f")), col("g")).alias("nv"),
+            F.factorial(col("j")).alias("fa"),
+            F.bit_count(col("i")).alias("bc"),
+            F.getbit(col("i"), col("p")).alias("gb"),
+            F.bround(col("f"), 1).alias("br"),
+            F.bround(col("i"), -2).alias("bri")),
+        session, approx_float=1e-12)
+
+
+def test_datetime_conversions(session):
+    rng = np.random.default_rng(3)
+    n = 60
+    t = pa.table({
+        "d": pa.array(rng.integers(-20000, 20000, n).astype(np.int32),
+                      pa.date32()),
+        "ts": pa.array(rng.integers(-2_000_000_000, 2_000_000_000, n)
+                       * 1000, pa.timestamp("us")),
+        "ms": pa.array(rng.integers(-10**12, 10**12, n)),
+        "y": pa.array(rng.integers(1, 3000, n).astype(np.int32)),
+        "m": pa.array(rng.integers(0, 14, n).astype(np.int32)),
+        "dd": pa.array(rng.integers(0, 33, n).astype(np.int32)),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.unix_date(col("d")).alias("ud"),
+            F.date_from_unix_date(F.unix_date(col("d"))).alias("rt"),
+            F.unix_micros(col("ts")).alias("um"),
+            F.unix_millis(col("ts")).alias("ul"),
+            F.unix_seconds(col("ts")).alias("us"),
+            F.timestamp_millis(col("ms")).alias("tm"),
+            F.timestamp_micros(col("ms")).alias("tu"),
+            F.make_date(col("y"), col("m"), col("dd")).alias("md"),
+            F.next_day(col("d"), "Mon").alias("nd"),
+            F.months_between(col("ts"), col("ts")).alias("mb0")),
+        session)
+
+
+def test_months_between_values(session):
+    t = pa.table({"e": pa.array([dtm.datetime(2024, 3, 31), dtm.datetime(2024, 2, 29),
+                                 dtm.datetime(2024, 7, 15, 12, 0), None],
+                                pa.timestamp("us")),
+                  "s": pa.array([dtm.datetime(2024, 2, 29), dtm.datetime(2023, 2, 28),
+                                 dtm.datetime(2024, 5, 10, 6, 30),
+                                 dtm.datetime(2024, 1, 1)], pa.timestamp("us"))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.months_between(col("e"), col("s")).alias("mb")),
+        session, approx_float=1e-9)
+
+
+def test_string_lengths_and_slices(session):
+    t = pa.table({"s": pa.array(["hello", "", "héllo wörld", None, "日本語",
+                                 "x", "padded   ", "ab"]),
+                  "n": pa.array([1, 2, 3, 4, 0, -1, 2, 5],
+                                type=pa.int32())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.octet_length(col("s")).alias("ol"),
+            F.bit_length(col("s")).alias("bl"),
+            F.left(col("s"), 3).alias("lf"),
+            F.right(col("s"), 2).alias("rt"),
+            F.chr_(col("n") + lit(64)).alias("ch")),
+        session)
+
+
+def test_cpu_tier_string_functions(session):
+    t = pa.table({"s": pa.array(["abc", "b,a,c", "hello", None, "Robert"]),
+                  "t": pa.array(["abd", "a,b,c", "hola", "x", "Rupert"])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.find_in_set(col("s"), col("t")).alias("fis"),
+            F.levenshtein(col("s"), col("t")).alias("lv"),
+            F.base64(col("s")).alias("b64"),
+            F.unbase64(F.base64(col("s"))).alias("rt64"),
+            F.soundex(col("s")).alias("sx"),
+            F.format_string("%s/%s", col("s"), col("t")).alias("fs"),
+            F.elt(lit(2), col("s"), col("t")).alias("el")),
+        session)
+
+
+def test_hashes(session):
+    rng = np.random.default_rng(8)
+    t = pa.table({"s": pa.array(["", "a", "abc", None, "hello world",
+                                 "The quick brown fox"] * 5),
+                  "i": pa.array(rng.integers(-10**9, 10**9, 30)),
+                  "f": pa.array(rng.uniform(-5, 5, 30))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.crc32(col("s")).alias("crc"),
+            F.xxhash64(col("i"), col("f")).alias("xx")),
+        session)
+
+
+def test_crc32_known_values(session):
+    # independently-known CRC32 vectors
+    import zlib
+    t = pa.table({"s": pa.array(["", "a", "123456789", "hello"])})
+    out = session.create_dataframe(t).select(
+        F.crc32(col("s")).alias("c")).to_pydict()
+    assert out["c"] == [zlib.crc32(x.encode()) for x in
+                        ["", "a", "123456789", "hello"]]
+
+
+def test_collection_constructors(session):
+    rows_a = [[1, 2], [], None, [5, None, 7]]
+    rows_b = [[9], [8, 7], [1], [2, 3]]
+    maps = [[(1, 10)], [(2, 20), (3, 30)], None, [(4, None)]]
+    t = pa.table({
+        "a": pa.array(rows_a, pa.list_(pa.int64())),
+        "b": pa.array(rows_b, pa.list_(pa.int64())),
+        "m": pa.array(maps, pa.map_(pa.int64(), pa.int64())),
+        "v": pa.array([7, 8, None, 9], pa.int64()),
+        "n": pa.array([2, 0, 3, -1], pa.int32()),
+        "s": pa.array(["a:1,b:2", "x:9", None, "k:"]),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.array_repeat(col("v"), col("n")).alias("ar"),
+            F.array_join(col("a"), "-", "NULL").alias("aj"),
+            F.map_entries(col("m")).alias("me"),
+            F.map_from_arrays(col("b"), col("b")).alias("mfa"),
+            F.str_to_map(col("s")).alias("stm")),
+        session)
+
+
+def test_arrays_zip_and_map_concat(session):
+    t = pa.table({
+        "a": pa.array([[1, 2], [3]], pa.list_(pa.int64())),
+        "b": pa.array([[9], [8, 7]], pa.list_(pa.int64())),
+        "m1": pa.array([[(1, 10)], [(2, 20)]],
+                       pa.map_(pa.int64(), pa.int64())),
+        "m2": pa.array([[(5, 50)], [(6, 60)]],
+                       pa.map_(pa.int64(), pa.int64())),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.arrays_zip(col("a"), col("b")).alias("z"),
+            F.map_concat(col("m1"), col("m2")).alias("mc")),
+        session)
+
+
+def test_json_tuple(session):
+    t = pa.table({"j": pa.array(
+        ['{"a": 1, "b": "x"}', '{"a": null}', "not json", None,
+         '{"b": {"c": 2}}'])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            F.json_tuple(col("j"), "a", "b").alias("jt")),
+        session)
