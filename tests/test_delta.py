@@ -1,0 +1,128 @@
+"""Delta Lake transaction-log tests: create/append/delete/update/merge
+round-trips on disk with log replay, checkpoints, time travel, and
+optimistic-concurrency conflicts (VERDICT r3 #6; reference delta-lake/
+GpuOptimisticTransaction + command family)."""
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql.delta import (
+    ConcurrentModification, DeltaTable, DeltaLog)
+from spark_rapids_tpu.expr.core import col, lit
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _t(k, v):
+    return pa.table({"k": pa.array(k, pa.int64()),
+                     "v": pa.array(v, pa.float64())})
+
+
+def test_create_and_read_roundtrip(session, tmp_path):
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p, _t([1, 2, 3], [1.0, 2.0, 3.0]))
+    # a real _delta_log with protocol/metaData/add actions
+    log0 = os.path.join(p, "_delta_log", "0" * 20 + ".json")
+    actions = [json.loads(l) for l in open(log0) if l.strip()]
+    kinds = {k for a in actions for k in a}
+    assert {"commitInfo", "protocol", "metaData", "add"} <= kinds
+    got = DeltaTable.for_path(session, p).to_df().collect().to_pylist()
+    assert sorted(r["k"] for r in got) == [1, 2, 3]
+
+
+def test_append_and_time_travel(session, tmp_path):
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p, _t([1], [1.0]))
+    dt.append(session.create_dataframe(_t([2], [2.0])))
+    dt.append(session.create_dataframe(_t([3], [3.0])))
+    assert dt.to_df().count() == 3
+    # time travel to version 1 (after first append)
+    assert dt.to_df(version=1).count() == 2
+    assert dt.to_df(version=0).count() == 1
+    hist = dt.history()
+    assert [h["version"] for h in hist] == [2, 1, 0]
+    assert hist[-1]["operation"] == "CREATE TABLE AS SELECT"
+
+
+def test_delete_copy_on_write(session, tmp_path):
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p,
+                           _t(list(range(10)), [float(i) for i in range(10)]))
+    n = dt.delete(col("k") >= lit(7))
+    assert n == 3
+    got = sorted(r["k"] for r in dt.to_df().collect().to_pylist())
+    assert got == list(range(7))
+    # the old file is tombstoned in the log, not referenced by HEAD
+    snap = dt.log.snapshot()
+    assert all(a["dataChange"] for a in snap.files.values())
+    # full-table delete
+    assert dt.delete() == 7
+    assert dt.to_df().count() == 0
+
+
+def test_update_conditional(session, tmp_path):
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p, _t([1, 2, 3, 4], [1., 2., 3., 4.]))
+    n = dt.update({"v": col("v") * lit(10.0)}, col("k") > lit(2))
+    assert n == 2
+    got = {r["k"]: r["v"] for r in dt.to_df().collect().to_pylist()}
+    assert got == {1: 1.0, 2: 2.0, 3: 30.0, 4: 40.0}
+
+
+def test_merge_transactional(session, tmp_path):
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p, _t([1, 2, 3], [1., 2., 3.]))
+    src = session.create_dataframe(_t([2, 3, 9], [20., 30., 90.]))
+    (dt.merge(src, on=["k"])
+       .when_matched_update({"v": col("__src_v")})
+       .when_not_matched_insert()
+       .execute())
+    got = {r["k"]: r["v"] for r in dt.to_df().collect().to_pylist()}
+    assert got == {1: 1.0, 2: 20.0, 3: 30.0, 9: 90.0}
+    assert dt.history()[0]["operation"] == "MERGE"
+
+
+def test_optimistic_concurrency_conflict(session, tmp_path):
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p, _t([1], [1.0]))
+    a = DeltaTable.for_path(session, p)
+    b = DeltaTable.for_path(session, p)
+    snap_a = a.log.snapshot()
+    snap_b = b.log.snapshot()
+    a.log.commit(snap_a.version + 1, [], "WRITE")
+    with pytest.raises(ConcurrentModification):
+        b.log.commit(snap_b.version + 1, [], "WRITE")
+
+
+def test_checkpoint_replay(session, tmp_path):
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p, _t([0], [0.0]))
+    for i in range(1, 12):
+        dt.append(session.create_dataframe(_t([i], [float(i)])))
+    # version 10 crossed the checkpoint interval
+    names = os.listdir(os.path.join(p, "_delta_log"))
+    assert any(n.endswith(".checkpoint.parquet") for n in names)
+    assert "_last_checkpoint" in names
+    # replay from checkpoint + later commits sees everything
+    assert dt.to_df().count() == 12
+    # a fresh reader (checkpoint path) agrees
+    dt2 = DeltaTable.for_path(session, p)
+    assert dt2.to_df().count() == 12
+    # and time travel BEFORE the checkpoint still replays from JSON
+    assert dt2.to_df(version=3).count() == 4
+
+
+def test_vacuum_drops_unreferenced(session, tmp_path):
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p, _t([1, 2], [1., 2.]))
+    dt.delete(col("k") == lit(1))  # rewrites the file, tombstones old
+    dropped = dt.vacuum(retain_hours=0.0)
+    assert len(dropped) == 1
+    assert dt.to_df().count() == 1
