@@ -267,6 +267,16 @@ AGG_FORCE_SINGLE_PASS = conf_bool(
     "pass instead of per-batch update + merge (testing knob, reference "
     "forceSinglePassPartialSortAgg).", internal=True)
 
+UDF_COMPILER_ENABLED = conf_bool(
+    "spark.rapids.sql.udfCompiler.enabled", True,
+    "Translate simple Python UDF bytecode (arithmetic, comparisons, "
+    "conditionals, math builtins) into fused device expressions "
+    "(reference udf-compiler). Untranslatable UDFs stay on the row tier. "
+    "Semantics note (same tradeoff as the reference compiler): compiled "
+    "UDFs null-propagate instead of calling fn(None), and arithmetic "
+    "errors yield null instead of raising (non-ANSI Spark semantics) — "
+    "a row-tier UDF that RAISES on bad input behaves differently.")
+
 SKIP_AGG_PASS_RATIO = conf_float(
     "spark.rapids.sql.agg.skipAggPassReductionRatio", 1.0,
     "Skip later agg passes when a pass reduces rows by less than this ratio "
@@ -312,6 +322,10 @@ class RapidsConf:
 
     def set(self, entry_or_key, value) -> "RapidsConf":
         key = entry_or_key.key if isinstance(entry_or_key, ConfEntry) else entry_or_key
+        # string values convert through the registry exactly like
+        # constructor overrides ("false" must not read back truthy)
+        if key in _REGISTRY and isinstance(value, str):
+            value = _REGISTRY[key].conv(value)
         self._values[key] = value
         return self
 

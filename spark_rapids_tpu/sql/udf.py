@@ -131,11 +131,26 @@ class JaxColumnarUDF(Expression):
 
 
 def udf(fn: Callable = None, return_type: T.DataType = T.STRING):
-    """Row-wise Python UDF decorator/factory (CPU fallback execution)."""
+    """Row-wise Python UDF decorator/factory. Simple bodies (arithmetic,
+    comparisons, conditionals, math builtins) are TRANSLATED to fused
+    device expressions by the bytecode compiler (reference udf-compiler,
+    conf spark.rapids.sql.udfCompiler.enabled); everything else runs on
+    the CPU row tier via per-operator fallback."""
     def make(f):
         def builder(*cols):
-            from spark_rapids_tpu.expr.core import Expression as _E, col as _c
+            from spark_rapids_tpu import config as C
+            from spark_rapids_tpu.expr.core import (
+                Cast, Expression as _E, col as _c)
             es = [c if isinstance(c, _E) else _c(c) for c in cols]
+            if C.conf().get(C.UDF_COMPILER_ENABLED):
+                from spark_rapids_tpu.sql.udf_compiler import compile_udf
+                compiled = compile_udf(f, es)
+                if compiled is not None:
+                    try:
+                        same = compiled.data_type() == return_type
+                    except Exception:  # noqa: BLE001 - unresolved refs
+                        same = False
+                    return compiled if same else Cast(compiled, return_type)
             return PythonRowUDF(f, return_type, es)
         builder.__name__ = getattr(f, "__name__", "udf")
         return builder

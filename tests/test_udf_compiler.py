@@ -1,0 +1,139 @@
+"""Bytecode UDF compiler tests (reference udf-compiler/OpcodeSuite):
+supported bodies plan as fused device expressions; unsupported ones fall
+back to the row tier; both produce identical results."""
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql.udf import PythonRowUDF, udf
+from spark_rapids_tpu.sql.udf_compiler import compile_udf
+from spark_rapids_tpu.expr.core import BoundRef, col
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _refs(*dts):
+    return [BoundRef(i, dt, f"c{i}") for i, dt in enumerate(dts)]
+
+
+def test_compiles_arithmetic_and_ternary():
+    assert compile_udf(lambda x: x * 2 + 1, _refs(T.INT64)) is not None
+    assert compile_udf(lambda x, y: (x - y) / (x + y + 1),
+                       _refs(T.FLOAT64, T.FLOAT64)) is not None
+    assert compile_udf(lambda x: x if x > 0 else -x,
+                       _refs(T.INT64)) is not None
+    assert compile_udf(lambda x: abs(x) + max(x, 0) + min(x, 10),
+                       _refs(T.INT64)) is not None
+    assert compile_udf(lambda x: math.sqrt(x) + math.log(x + 1.0),
+                       _refs(T.FLOAT64)) is not None
+
+    def straight_line(a, b):
+        s = a + b
+        d = a - b
+        return s * d
+
+    assert compile_udf(straight_line, _refs(T.INT64, T.INT64)) is not None
+
+
+def test_rejects_outside_subset():
+    # loops
+    def loop(x):
+        t = 0
+        for i in range(3):
+            t += x
+        return t
+    assert compile_udf(loop, _refs(T.INT64)) is None
+    # unknown calls
+    assert compile_udf(lambda x: hash(x), _refs(T.INT64)) is None
+    # data structures
+    assert compile_udf(lambda x: [x, x], _refs(T.INT64)) is None
+
+
+def test_udf_plans_as_device_expression(session):
+    f = udf(lambda x: x * 3 + 1, return_type=T.INT64)
+    e = f(col("a"))
+    assert not isinstance(e, PythonRowUDF), "should compile to expressions"
+    t = pa.table({"a": pa.array([1, 2, None, -5], pa.int64())})
+    out = session.create_dataframe(t).select(e.alias("r")).to_pydict()
+    assert out["r"] == [4, 7, None, -14]
+    # the plan must NOT contain a CPU fallback
+    txt = session.create_dataframe(t).select(e.alias("r")).explain()
+    assert "cannot run on TPU" not in txt
+
+
+@pytest.mark.parametrize("fn,dt", [
+    (lambda x: x * x - 2 * x + 7, T.INT64),
+    (lambda x: x if x % 2 == 0 else 3 * x + 1, T.INT64),
+    (lambda x: abs(x) ** 0.5 if x > 0 else 0.0, T.FLOAT64),
+    (lambda x: math.floor(x / 3.0) + math.ceil(x / 7.0), T.FLOAT64),
+])
+def test_compiled_matches_row_tier(session, fn, dt):
+    rng = np.random.default_rng(11)
+    vals = rng.integers(-100, 100, 50).astype(np.int64)
+    t = pa.table({"a": pa.array(vals)})
+    compiled = udf(fn, return_type=T.FLOAT64)(col("a"))
+    assert not isinstance(compiled, PythonRowUDF)
+    row = PythonRowUDF(fn, T.FLOAT64, [col("a")])
+    got = session.create_dataframe(t).select(
+        compiled.alias("c")).to_pydict()["c"]
+    exp = session.create_dataframe(t).select(
+        row.alias("c")).to_pydict()["c"]
+    assert len(got) == len(exp)
+    for g, e in zip(got, exp):
+        assert (g is None) == (e is None)
+        if g is not None:
+            assert abs(g - e) <= 1e-9 * max(1.0, abs(e)), (g, e)
+
+
+def test_conf_disables_compiler(session):
+    from spark_rapids_tpu import config as C
+    old = C.conf().get(C.UDF_COMPILER_ENABLED)
+    try:
+        C.conf().set(C.UDF_COMPILER_ENABLED.key, "false")
+        e = udf(lambda x: x + 1, return_type=T.INT64)(col("a"))
+        assert isinstance(e, PythonRowUDF)
+    finally:
+        C.conf().set(C.UDF_COMPILER_ENABLED.key, str(old).lower())
+
+
+def test_string_len_and_closure_consts(session):
+    k = 10
+
+    def shifted(x):
+        return x + k
+
+    e = compile_udf(shifted, _refs(T.INT64))
+    assert e is not None
+    t = pa.table({"a": pa.array([1, 2], pa.int64()),
+                  "s": pa.array(["ab", "héllo"])})
+    f = udf(lambda s: len(s), return_type=T.INT32)
+    es = f(col("s"))
+    assert not isinstance(es, PythonRowUDF)
+    out = session.create_dataframe(t).select(es.alias("n")).to_pydict()
+    assert out["n"] == [2, 5]
+
+
+def test_python_mod_floordiv_semantics(session):
+    # Python % takes the divisor's sign; // floors — both differ from
+    # Spark's Remainder/IntegralDivide for negative operands
+    t = pa.table({"a": pa.array([-7, 7, -7, 7, 0, -1], pa.int64()),
+                  "b": pa.array([3, 3, -3, -3, 3, 2], pa.int64())})
+    fmod = udf(lambda x, y: x % y, return_type=T.INT64)
+    fdiv = udf(lambda x, y: x // y, return_type=T.INT64)
+    em, ed = fmod(col("a"), col("b")), fdiv(col("a"), col("b"))
+    assert not isinstance(em, PythonRowUDF)
+    out = session.create_dataframe(t).select(
+        em.alias("m"), ed.alias("d")).to_pydict()
+    av = [-7, 7, -7, 7, 0, -1]
+    bv = [3, 3, -3, -3, 3, 2]
+    assert out["m"] == [x % y for x, y in zip(av, bv)]
+    assert out["d"] == [x // y for x, y in zip(av, bv)]
