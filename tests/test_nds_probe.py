@@ -1,0 +1,33 @@
+"""NDS harness regression: every translated query runs, matches the CPU
+interpreter, and plans without device fallback (tiny SF on the CPU sim)."""
+import importlib.util
+import os
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "nds_probe", os.path.join(os.path.dirname(__file__), "..", "tools",
+                              "nds_probe.py"))
+nds = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(nds)
+
+from spark_rapids_tpu.sql.session import TpuSession  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def dfs():
+    sess = TpuSession()
+    tables = nds.gen_tables(0.002, seed=7)
+    out = {name: sess.create_dataframe(t).cache()
+           for name, t in tables.items()}
+    return sess, out
+
+
+@pytest.mark.parametrize("qn", sorted(nds.QUERIES))
+def test_nds_query(dfs, qn):
+    sess, d = dfs
+    df = nds.QUERIES[qn](sess, d)
+    explain = df.explain()
+    assert "cannot run on TPU" not in explain, explain
+    n = df.count()
+    assert n == df.collect_cpu().num_rows

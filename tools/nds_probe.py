@@ -1,0 +1,365 @@
+"""NDS (TPC-DS-shaped) probe harness: generate SF-scaled tables, attempt
+every one of the 99 queries, emit a per-query scorecard JSON.
+
+Reference parity: integration_tests/ScaleTest.md + the NDS suites the
+reference's BASELINE numbers come from. This engine has no SQL parser
+(plans arrive via the DataFrame API or the JSON ingestion contract), so
+each NDS query needs a hand translation; `QUERIES` maps qN -> builder.
+Untranslated queries are reported as "not_translated" — the scorecard
+makes the north-star gap measurable every round instead of invisible.
+
+Per translated query the probe reports:
+- status: ok | wrong | error
+- device: clean | fallback (any "cannot run on TPU" in explain)
+- seconds: wall-clock on the active backend
+
+Usage: python tools/nds_probe.py [--sf 0.01] [--out NDS_SCORECARD.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import col, lit
+
+
+# ---------------------------------------------------------------------------
+# TPC-DS-shaped tables (star schema, SF-scaled row counts)
+# ---------------------------------------------------------------------------
+
+def gen_tables(sf: float, seed: int = 42):
+    rng = np.random.default_rng(seed)
+    n_item = max(int(18000 * sf), 100)
+    n_store = max(int(12 * max(sf, 1)), 4)
+    n_cust = max(int(100000 * sf), 500)
+    n_addr = max(n_cust // 2, 250)
+    n_ss = max(int(2_880_000 * sf), 5000)
+    n_ws = max(n_ss // 2, 2000)
+    n_cs = max(n_ss // 2, 2000)
+    n_date = 2556  # 7 years of days
+    d0 = 2450815  # 1998-01-01 julian-ish seq
+
+    date_dim = pa.table({
+        "d_date_sk": np.arange(d0, d0 + n_date, dtype=np.int64),
+        "d_year": (1998 + (np.arange(n_date) // 365)).astype(np.int32),
+        "d_moy": ((np.arange(n_date) // 30) % 12 + 1).astype(np.int32),
+        "d_dom": (np.arange(n_date) % 30 + 1).astype(np.int32),
+        "d_qoy": (((np.arange(n_date) // 30) % 12) // 3 + 1).astype(np.int32),
+        "d_day_name": np.array(["Sunday", "Monday", "Tuesday", "Wednesday",
+                                "Thursday", "Friday", "Saturday"])[
+            np.arange(n_date) % 7],
+    })
+    item = pa.table({
+        "i_item_sk": np.arange(n_item, dtype=np.int64),
+        "i_brand_id": rng.integers(1, 1000, n_item).astype(np.int32),
+        "i_brand": np.char.add("brand#",
+                               rng.integers(1, 1000, n_item).astype(str)),
+        "i_category_id": rng.integers(1, 10, n_item).astype(np.int32),
+        "i_category": np.array(["Books", "Home", "Electronics", "Jewelry",
+                                "Music", "Shoes", "Sports", "Toys", "Men",
+                                "Women"])[rng.integers(0, 10, n_item)],
+        "i_manufact_id": rng.integers(1, 1000, n_item).astype(np.int32),
+        "i_current_price": np.round(rng.uniform(0.5, 300, n_item), 2),
+        "i_manager_id": rng.integers(1, 100, n_item).astype(np.int32),
+    })
+    store = pa.table({
+        "s_store_sk": np.arange(n_store, dtype=np.int64),
+        "s_store_name": np.char.add("store_",
+                                    np.arange(n_store).astype(str)),
+        "s_number_employees": rng.integers(200, 300, n_store).astype(np.int32),
+        "s_city": np.array(["Midway", "Fairview", "Oakland", "Salem"])[
+            rng.integers(0, 4, n_store)],
+        "s_gmt_offset": np.full(n_store, -5.0),
+    })
+    customer = pa.table({
+        "c_customer_sk": np.arange(n_cust, dtype=np.int64),
+        "c_current_addr_sk": rng.integers(0, n_addr, n_cust).astype(np.int64),
+        "c_birth_year": rng.integers(1930, 2000, n_cust).astype(np.int32),
+        "c_first_name": np.char.add("fn", np.arange(n_cust).astype(str)),
+        "c_last_name": np.char.add("ln",
+                                   rng.integers(0, 5000, n_cust).astype(str)),
+    })
+    customer_address = pa.table({
+        "ca_address_sk": np.arange(n_addr, dtype=np.int64),
+        "ca_city": np.array(["Midway", "Fairview", "Oakland", "Salem",
+                             "Centerville"])[rng.integers(0, 5, n_addr)],
+        "ca_gmt_offset": np.where(rng.random(n_addr) < 0.8, -5.0, -6.0),
+    })
+
+    def sales(n, prefix, extra=()):
+        t = {
+            f"{prefix}_sold_date_sk": rng.integers(
+                d0, d0 + n_date, n).astype(np.int64),
+            f"{prefix}_item_sk": rng.integers(0, n_item, n).astype(np.int64),
+            f"{prefix}_customer_sk": rng.integers(0, n_cust, n).astype(np.int64),
+            f"{prefix}_store_sk" if prefix == "ss" else f"{prefix}_ship_mode_sk":
+                rng.integers(0, n_store, n).astype(np.int64),
+            f"{prefix}_quantity": rng.integers(1, 100, n).astype(np.int32),
+            f"{prefix}_sales_price": np.round(rng.uniform(1, 300, n), 2),
+            f"{prefix}_ext_sales_price": np.round(rng.uniform(1, 3000, n), 2),
+            f"{prefix}_ext_discount_amt": np.round(rng.uniform(0, 100, n), 2),
+            f"{prefix}_net_profit": np.round(rng.uniform(-500, 500, n), 2),
+            f"{prefix}_ticket_number" if prefix == "ss" else f"{prefix}_order_number":
+                rng.integers(0, n // 4 + 1, n).astype(np.int64),
+        }
+        return pa.table(t)
+
+    return {
+        "date_dim": date_dim, "item": item, "store": store,
+        "customer": customer, "customer_address": customer_address,
+        "store_sales": sales(n_ss, "ss"),
+        "web_sales": sales(n_ws, "ws"),
+        "catalog_sales": sales(n_cs, "cs"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Query translations (DataFrame form). Each takes (session, dfs) -> DataFrame.
+# ---------------------------------------------------------------------------
+
+def q3(s, d):
+    """report: brand revenue for manufacturer in December."""
+    return (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .filter((col("i_manufact_id") == lit(128)) & (col("d_moy") == lit(11)))
+            .group_by("d_year", "i_brand", "i_brand_id")
+            .agg(F.sum(col("ss_ext_sales_price")).alias("sum_agg"))
+            .order_by(col("d_year").asc(), col("sum_agg").desc(),
+                      col("i_brand_id").asc())
+            .limit(100))
+
+
+def q7(s, d):
+    return (d["store_sales"]
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .filter(col("d_year") == lit(2000))
+            .group_by("i_category")
+            .agg(F.avg(col("ss_quantity")).alias("agg1"),
+                 F.avg(col("ss_sales_price")).alias("agg2"),
+                 F.avg(col("ss_ext_sales_price")).alias("agg3"))
+            .order_by(col("i_category").asc()).limit(100))
+
+
+def q19(s, d):
+    return (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .join(d["customer"], on=[(col("ss_customer_sk"), col("c_customer_sk"))])
+            .join(d["customer_address"],
+                  on=[(col("c_current_addr_sk"), col("ca_address_sk"))])
+            .filter((col("i_manager_id") == lit(8)) & (col("d_moy") == lit(11))
+                    & (col("d_year") == lit(1998)))
+            .group_by("i_brand", "i_brand_id", "i_manufact_id")
+            .agg(F.sum(col("ss_ext_sales_price")).alias("ext_price"))
+            .order_by(col("ext_price").desc(), col("i_brand_id").asc())
+            .limit(100))
+
+
+def q42(s, d):
+    return (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .filter((col("i_manager_id") == lit(1)) & (col("d_moy") == lit(11))
+                    & (col("d_year") == lit(2000)))
+            .group_by("d_year", "i_category_id", "i_category")
+            .agg(F.sum(col("ss_ext_sales_price")).alias("total"))
+            .order_by(col("total").desc(), col("d_year").asc(),
+                      col("i_category_id").asc(), col("i_category").asc())
+            .limit(100))
+
+
+def q52(s, d):
+    return (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .filter((col("i_manager_id") == lit(1)) & (col("d_moy") == lit(11))
+                    & (col("d_year") == lit(2000)))
+            .group_by("d_year", "i_brand", "i_brand_id")
+            .agg(F.sum(col("ss_ext_sales_price")).alias("ext_price"))
+            .order_by(col("d_year").asc(), col("ext_price").desc(),
+                      col("i_brand_id").asc())
+            .limit(100))
+
+
+def q55(s, d):
+    return (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .filter((col("i_manager_id") == lit(28)) & (col("d_moy") == lit(11))
+                    & (col("d_year") == lit(1999)))
+            .group_by("i_brand", "i_brand_id")
+            .agg(F.sum(col("ss_ext_sales_price")).alias("ext_price"))
+            .order_by(col("ext_price").desc(), col("i_brand_id").asc())
+            .limit(100))
+
+
+def q65(s, d):
+    ss = (d["store_sales"]
+          .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+          .filter(col("d_year") == lit(2000))
+          .group_by("ss_store_sk", "ss_item_sk")
+          .agg(F.sum(col("ss_sales_price")).alias("revenue")))
+    avg_rev = (ss.group_by("ss_store_sk")
+               .agg(F.avg(col("revenue")).alias("ave")))
+    return (ss.join(avg_rev, on="ss_store_sk")
+            .filter(col("revenue") <= lit(0.1) * col("ave"))
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .select(col("s_store_name"), col("i_brand"), col("revenue"))
+            .order_by(col("s_store_name").asc(), col("i_brand").asc())
+            .limit(100))
+
+
+def q68(s, d):
+    return (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .filter((col("d_dom") >= lit(1)) & (col("d_dom") <= lit(2))
+                    & col("s_city").isin("Midway", "Fairview"))
+            .group_by("ss_ticket_number", "ss_customer_sk", "s_city")
+            .agg(F.sum(col("ss_ext_sales_price")).alias("extended_price"),
+                 F.sum(col("ss_ext_discount_amt")).alias("extended_tax"))
+            .join(d["customer"], on=[(col("ss_customer_sk"), col("c_customer_sk"))])
+            .order_by(col("c_last_name").asc(),
+                      col("ss_ticket_number").asc())
+            .limit(100))
+
+
+def q73(s, d):
+    freq = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .filter((col("d_dom") >= lit(1)) & (col("d_dom") <= lit(2)))
+            .group_by("ss_ticket_number", "ss_customer_sk")
+            .agg(F.count(col("ss_item_sk")).alias("cnt"))
+            .filter((col("cnt") >= lit(2)) & (col("cnt") <= lit(5))))
+    return (freq.join(d["customer"],
+                      on=[(col("ss_customer_sk"), col("c_customer_sk"))])
+            .select(col("c_last_name"), col("c_first_name"),
+                    col("ss_ticket_number"), col("cnt"))
+            .order_by(col("cnt").desc(), col("c_last_name").asc())
+            .limit(100))
+
+
+def q79(s, d):
+    g = (d["store_sales"]
+         .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+         .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+         .filter((col("d_dom") == lit(1))
+                 & (col("s_number_employees") >= lit(200)))
+         .group_by("ss_ticket_number", "ss_customer_sk", "s_city")
+         .agg(F.sum(col("ss_net_profit")).alias("profit")))
+    return (g.join(d["customer"], on=[(col("ss_customer_sk"), col("c_customer_sk"))])
+            .order_by(col("c_last_name").asc(), col("profit").desc())
+            .limit(100))
+
+
+def q96(s, d):
+    return (d["store_sales"]
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .filter(col("s_number_employees") >= lit(250))
+            .agg(F.count(col("ss_ticket_number")).alias("cnt")))
+
+
+def q98(s, d):
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .filter(col("d_year") == lit(1999))
+            .group_by("i_item_sk", "i_category", "i_current_price")
+            .agg(F.sum(col("ss_ext_sales_price")).alias("itemrevenue")))
+    w = Window.partition_by(col("i_category"))
+    return (base.select(
+        col("i_category"), col("i_current_price"), col("itemrevenue"),
+        (col("itemrevenue") * lit(100.0)
+         / F.sum(col("itemrevenue")).over(w)).alias("revenueratio"))
+        .order_by(col("i_category").asc(), col("revenueratio").desc())
+        .limit(100))
+
+
+def q89(s, d):
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .filter(col("d_year") == lit(1999))
+            .group_by("i_category", "i_brand", "s_store_name", "d_moy")
+            .agg(F.sum(col("ss_sales_price")).alias("sum_sales")))
+    w = Window.partition_by(col("i_category"), col("i_brand"),
+                            col("s_store_name"))
+    return (base.select(col("i_category"), col("i_brand"),
+                        col("s_store_name"), col("d_moy"),
+                        col("sum_sales"),
+                        F.avg(col("sum_sales")).over(w).alias("avg_monthly"))
+            .filter(col("sum_sales") > col("avg_monthly") * lit(1.1))
+            .order_by(col("sum_sales").desc()).limit(100))
+
+
+QUERIES = {3: q3, 7: q7, 19: q19, 42: q42, 52: q52, 55: q55, 65: q65,
+           68: q68, 73: q73, 79: q79, 89: q89, 96: q96, 98: q98}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.01)
+    ap.add_argument("--out", default="NDS_SCORECARD.json")
+    args = ap.parse_args()
+
+    sess = TpuSession()
+    tables = gen_tables(args.sf)
+    dfs = {name: sess.create_dataframe(t).cache()
+           for name, t in tables.items()}
+    for df in dfs.values():
+        df.count()
+
+    card = {}
+    for qn in range(1, 100):
+        builder = QUERIES.get(qn)
+        if builder is None:
+            card[f"q{qn}"] = {"status": "not_translated"}
+            continue
+        try:
+            df = builder(sess, dfs)
+            explain = df.explain()
+            device = ("fallback" if "cannot run on TPU" in explain
+                      else "clean")
+            t0 = time.perf_counter()
+            n = df.count()
+            dt = time.perf_counter() - t0
+            # differential check against the CPU interpreter
+            cpu_n = df.collect_cpu().num_rows
+            status = "ok" if n == cpu_n else "wrong"
+            card[f"q{qn}"] = {"status": status, "device": device,
+                              "rows": int(n), "seconds": round(dt, 4)}
+        except Exception as e:  # noqa: BLE001 - scorecard, not a crash
+            card[f"q{qn}"] = {"status": "error",
+                              "error": f"{type(e).__name__}: {e}"}
+        print(f"q{qn}: {card[f'q{qn}']}", file=sys.stderr, flush=True)
+
+    translated = [q for q in card.values() if q["status"] != "not_translated"]
+    summary = {
+        "sf": args.sf,
+        "translated": len(translated),
+        "ok": sum(1 for q in translated if q["status"] == "ok"),
+        "clean_device": sum(1 for q in translated
+                            if q.get("device") == "clean"),
+        "queries": card,
+    }
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps({k: summary[k] for k in
+                      ("sf", "translated", "ok", "clean_device")}))
+
+
+if __name__ == "__main__":
+    main()
