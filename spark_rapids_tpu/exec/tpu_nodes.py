@@ -24,6 +24,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu import types as T
@@ -1147,7 +1148,174 @@ class _AggKernels:
                                          else ov, oval))
         return ColumnarBatch(out_cols, LazyRowCount(lay.n_groups))
 
+    #: pallas sorted-window path gate: packed key bits in [11, 24] keeps
+    #: the bucket space 2*TILE-aligned and the key-digit lanes <= 3
+    _PALLAS_SEG_MIN_BITS = 11
+    _PALLAS_SEG_MAX_BITS = 24
+
+    def _pallas_seg_eligible(self, live, state_specs, spec) -> bool:
+        from spark_rapids_tpu.ops import pallas_kernels as PK
+        if not PK.enabled():
+            return False
+        if not (self._PALLAS_SEG_MIN_BITS <= spec.total_bits
+                <= self._PALLAS_SEG_MAX_BITS):
+            return False
+        cap = live.shape[0]
+        from spark_rapids_tpu.ops.pallas_segsum import CHUNK_ROWS, TILE
+        # HBM budget: the payload plane + both cond branches live inside
+        # one fused stage; past ~8M rows the whole-query program exceeds
+        # the v5e's 16G (measured 18.6G on the 32M q3 shape)
+        if cap % TILE or cap < 4 * TILE or cap > CHUNK_ROWS:
+            return False
+        n_sums = 0
+        for op, src, sdt in state_specs:
+            if op in ("count", "count_all"):
+                continue
+            if op == "sum" and src is not None and not src.is_string                     and not src.is_nested and np.dtype(sdt.np_dtype) in (
+                        np.dtype(np.float64), np.dtype(np.float32)):
+                n_sums += 1
+                continue
+            return False
+        return 1 <= n_sums <= 2
+
+    def _pallas_seg_kernel_and_post(self, live, key_cols, state_specs,
+                                    spec, ranges):
+        """Returns (postprocess_thunk, max_cnt): the Pallas kernel runs
+        immediately (top level); the thunk builds the output batch from
+        the accumulator and is safe to call inside lax.cond."""
+        return self._pallas_seg_agg(live, key_cols, state_specs, spec,
+                                    ranges)
+
+    def _pallas_seg_agg(self, live, key_cols, state_specs, spec, ranges):
+        """Sorted-window one-hot-matmul groupby (ops/pallas_segsum):
+        ONE co-sortless 2-operand sort + 1-2 gathers + the Pallas kernel
+        replace every scatter. Output is in DENSE GROUP-ID space (front-
+        packed groups) at the same capacity as the bucket space, so the
+        lax.cond overflow fallback to the scatter path keeps identical
+        shapes (slot ORDER differs; downstream is order-free over the
+        occupied mask)."""
+        from spark_rapids_tpu.ops import pallas_segsum as PS
+        cap = live.shape[0]
+        nb = 1 << spec.total_bits
+        packed64 = R.pack_keys(spec, key_cols, ranges, live)
+        big = jnp.int32(nb + 1)
+        code = jnp.where(live, packed64.astype(jnp.int32), big)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        sk, perm = lax.sort((code, iota), num_keys=1)
+        boundary = jnp.concatenate([jnp.ones(1, jnp.bool_),
+                                    sk[1:] != sk[:-1]])
+        gid = (jnp.cumsum(boundary.astype(jnp.int32)) - 1).astype(jnp.int32)
+        live_sorted = sk < big
+
+        has_specials = jnp.zeros((), jnp.bool_)
+        lanes = [live_sorted.astype(jnp.bfloat16)]  # lane 0: live count
+        kd, kshifts = PS.int_digits(jnp.where(live_sorted, sk, 0),
+                                    spec.total_bits)
+        lanes.extend(kd)
+        plan = []  # (op, kind, lane_slices / scales)
+        for op, src, sdt in state_specs:
+            if op == "count_all":
+                plan.append(("count_all", None, None))
+                continue
+            if op == "count":
+                if src is None or src.validity is None:
+                    plan.append(("count_live", None, None))
+                else:
+                    v_s = src.validity[perm] & live_sorted
+                    lanes.append(v_s.astype(jnp.bfloat16))
+                    plan.append(("count_lane", len(lanes) - 1, None))
+                continue
+            # float sum: gather the value plane into sorted order once.
+            # NaN/Inf rows are stripped BEFORE the scale (an Inf max
+            # collapses every digit to zero) and instead force the
+            # scatter fallback, which reconstructs specials per bucket
+            # (radix.bucket_sum_f64's flag machinery).
+            vals = src.data.astype(jnp.float64)[perm]
+            valid_s = live_sorted if src.validity is None else                 (src.validity[perm] & live_sorted)
+            finite = jnp.isfinite(vals)
+            clean = jnp.where(valid_s & finite, vals, 0.0)
+            has_specials = has_specials | jnp.any(valid_s & ~finite)
+            m = jnp.max(jnp.abs(clean))
+            scale = R._exponent_scale(m) * np.float64(2.0 ** 11)
+            start = len(lanes)
+            lanes.extend(PS.float_digits(clean, scale))
+            some_lane = None
+            if src.validity is not None:
+                lanes.append(valid_s.astype(jnp.bfloat16))
+                some_lane = len(lanes) - 1
+            plan.append(("sum", (start, scale, some_lane), sdt))
+        P = -(-len(lanes) // 8) * 8
+        while len(lanes) < P:
+            lanes.append(jnp.zeros(cap, jnp.bfloat16))
+        payload = jnp.stack(lanes, axis=1)
+        # the kernel runs at TOP LEVEL (a pallas custom-call inside a
+        # lax.cond branch aborts the runtime on this toolchain); only the
+        # cheap postprocessing participates in the overflow cond
+        acc = PS.segsum_window_chunked(gid, payload, nb)
+
+        def post():
+            return self._pallas_seg_post(acc, state_specs, spec, ranges,
+                                         key_cols, plan, len(kd), kshifts,
+                                         nb)
+        return post, (jnp.max(acc[:, 0]), has_specials)
+
+    def _pallas_seg_post(self, acc, state_specs, spec, ranges, key_cols,
+                         plan, nkd, kshifts, nb):
+        from spark_rapids_tpu.ops import pallas_segsum as PS
+        counts_live = acc[:, 0]
+        key_code = PS.int_digits_to_val(
+            [acc[:, 1 + i] for i in range(nkd)], kshifts, counts_live)
+        occupied = counts_live > 0.5
+        out_cols: List[ColumnVector] = []
+        for c in R.unpack_keys(spec, key_code.astype(jnp.int64), ranges,
+                               key_cols):
+            v = c.validity & occupied if c.validity is not None else occupied
+            out_cols.append(ColumnVector(c.dtype, c.data, v,
+                                         dict_unique=c.dict_unique))
+        for (op, src, sdt), (kind, info, _sdt) in zip(state_specs, plan):
+            if kind in ("count_all", "count_live"):
+                ov = counts_live.astype(jnp.int64)
+                out_cols.append(ColumnVector(
+                    sdt, ov.astype(sdt.np_dtype), jnp.ones(nb, jnp.bool_)))
+                continue
+            if kind == "count_lane":
+                ov = acc[:, info].astype(jnp.int64)
+                out_cols.append(ColumnVector(
+                    sdt, ov.astype(sdt.np_dtype), jnp.ones(nb, jnp.bool_)))
+                continue
+            start, scale, some_lane = info
+            tot = PS.digits_to_f64(
+                [acc[:, start + i] for i in range(len(PS.SHIFTS))]) / scale
+            some = acc[:, some_lane] > 0.5 if some_lane is not None \
+                else occupied
+            out_cols.append(ColumnVector(
+                sdt, tot.astype(sdt.np_dtype), some))
+        n_groups = jnp.sum(occupied.astype(jnp.int32))
+        return ColumnarBatch(out_cols, LazyRowCount(n_groups), occupied)
+
     def _bucket_scatter_agg(self, live, key_cols, state_specs, spec, ranges):
+        if self._pallas_seg_eligible(live, state_specs, spec):
+            post, (max_cnt, has_specials) = \
+                self._pallas_seg_kernel_and_post(
+                    live, key_cols, state_specs, spec, ranges)
+            from spark_rapids_tpu.ops.pallas_segsum import MAX_GROUP_ROWS
+            # One cond over the whole batch pytree: the scatter fallback
+            # only EXECUTES when a group exceeds the digit-accumulation
+            # bound (the count lane stays trustworthy well past the
+            # threshold, so the predicate is reliable even then). Slot
+            # ORDER differs between branches (dense-gid vs bucket index),
+            # which downstream — occupied-masked and order-free — never
+            # observes.
+            return lax.cond(
+                (max_cnt <= MAX_GROUP_ROWS) & ~has_specials,
+                post,
+                lambda: self._bucket_scatter_agg_xla(
+                    live, key_cols, state_specs, spec, ranges))
+        return self._bucket_scatter_agg_xla(live, key_cols, state_specs,
+                                            spec, ranges)
+
+    def _bucket_scatter_agg_xla(self, live, key_cols, state_specs, spec,
+                                ranges):
         lay = R.bucket_layout(spec, key_cols, ranges, live)
         out_cols: List[ColumnVector] = []
         for c in R.bucket_unpack_keys(spec, ranges, key_cols):
@@ -2226,35 +2394,35 @@ class ShuffleExchangeExec(ExchangeExec):
     def _align_vocabs(batches):
         """Remap dict-string codes across shards onto ONE union vocab so
         string keys ride the fixed-width collective (VERDICT r3 #5: 'the
-        TPU-native shuffle does not work for string keys'). Host-side
-        vocab union (vocabs are small); per-batch code remap is a tiny
-        table gather."""
+        TPU-native shuffle does not work for string keys'). Builds NEW
+        batches — the inputs may alias cached/session batches whose
+        identity-keyed caches assume immutability."""
         live = [b for b in batches if b is not None]
         if not live:
             return batches
         ncols = len(live[0].columns)
+        new_cols = {i: list(b.columns) for i, b in enumerate(batches)
+                    if b is not None}
+        changed = False
         for ci in range(ncols):
             cols = [b.columns[ci] for b in live]
             if not cols[0].is_dict:
                 continue
-            same = all(K._same_array(c.data["dict_offsets"],
-                                     cols[0].data["dict_offsets"])
-                       and K._same_array(c.data["dict_bytes"],
-                                         cols[0].data["dict_bytes"])
-                       for c in cols[1:])
-            if same:
+            aligned = K.align_dict_columns(cols)
+            if aligned[0] is cols[0]:
                 continue
-            uoff, ubytes, remaps = K.unify_vocabs(cols)
-            doff = jnp.asarray(uoff)
-            dby = jnp.asarray(ubytes)
-            for b, c, remap in zip(live, cols, remaps):
-                codes = jnp.asarray(remap)[jnp.clip(
-                    c.data["codes"], 0, len(remap) - 1)]
-                b.columns[ci] = ColumnVector(
-                    c.dtype, {"codes": codes, "dict_offsets": doff,
-                              "dict_bytes": dby}, c.validity,
-                    dict_unique=True)
-        return batches
+            changed = True
+            li = 0
+            for i, b in enumerate(batches):
+                if b is None:
+                    continue
+                new_cols[i][ci] = aligned[li]
+                li += 1
+        if not changed:
+            return batches
+        return [None if b is None
+                else ColumnarBatch(new_cols[i], b.num_rows, b.row_mask)
+                for i, b in enumerate(batches)]
 
     def _repartition_ici(self, child_results):
         """One shard per device, rows moved by lax.all_to_all inside a

@@ -1,0 +1,212 @@
+"""Prototype: Pallas windowed segmented-sum over SORTED group ids vs the
+3-scatter XLA bucket path at 33M rows -> 4M groups (the q3 shape).
+
+Design: after a co-sort by packed key, group ids are MONOTONE, so each
+512-row tile touches a contiguous id span <= 512 wide. A one-hot matmul
+[2*TILE, TILE] @ [TILE, P] accumulates the tile's payload into a
+2-block output window selected by a scalar-prefetched block base —
+sequential-grid read-modify-write, no scatters at all.
+"""
+import os
+import time
+import functools
+
+import spark_rapids_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N = 1 << 24
+SPAN = 1 << 22
+TILE = 1024  # 1-D i32 blocks must match XLA's 1024-element tiling
+P = 8  # payload lanes (count, d0..d3, pad)
+
+INTERPRET = os.environ.get("SEGSUM_INTERPRET", "0") == "1"
+
+
+def make_data():
+    i = jnp.arange(N, dtype=jnp.uint32)
+    h = (i * jnp.uint32(2654435761)) ^ (i >> jnp.uint32(13))
+    key = (h % jnp.uint32(SPAN)).astype(jnp.int32)
+    h2 = (i * jnp.uint32(0x9E3779B9)) ^ (i >> jnp.uint32(7))
+    val = (h2.astype(jnp.float64) / jnp.float64(2**32)) * 1e5
+    live = (h ^ h2) % jnp.uint32(3) != 0
+    return key, val, live
+
+
+def _kernel(bases_ref, gid_ref, pay_ref, olo_ref, ohi_ref):
+    # Output blocks are NOT loaded from HBM on first visit (their VMEM
+    # content is undefined), so the accumulation protocol is: INITIALIZE
+    # on the step that first maps a block, ACCUMULATE on consecutive
+    # revisits. gid is monotone with <= TILE new groups per tile, so each
+    # buffer's block index advances by 0 or 1 — every block is first-
+    # visited exactly once and only consecutively revisited.
+    t = pl.program_id(0)
+    base = bases_ref[t]
+    base_row = base * TILE
+    g = gid_ref[...].reshape(TILE)          # [TILE] i32 (monotone)
+    local = g - base_row                    # in [0, 2*TILE)
+    iota = lax.broadcasted_iota(jnp.int32, (2 * TILE, TILE), 0)
+    oh = (iota == local[None, :]).astype(jnp.float32)
+    acc = jnp.dot(oh, pay_ref[...], preferred_element_type=jnp.float32)
+    moved = jnp.logical_or(t == 0, base != bases_ref[jnp.maximum(t - 1, 0)])
+
+    @pl.when(moved)
+    def _init_lo():
+        olo_ref[...] = acc[:TILE]
+
+    @pl.when(jnp.logical_not(moved))
+    def _acc_lo():
+        olo_ref[...] += acc[:TILE]
+
+    # the hi window (block base+1) first appears either at t == 0 or on
+    # the same step its block index changes — identical condition
+    @pl.when(moved)
+    def _init_hi():
+        ohi_ref[...] = acc[TILE:]
+
+    @pl.when(jnp.logical_not(moved))
+    def _acc_hi():
+        ohi_ref[...] += acc[TILE:]
+
+
+@functools.partial(jax.jit, static_argnames=("outcap",))
+def segsum_window(gid, payload, outcap: int):
+    """gid i32[N] sorted; payload f32[N, P] -> f32[outcap, P] sums."""
+    n = gid.shape[0]
+    T = n // TILE
+    bases = jnp.clip(gid[:: TILE] // TILE, 0, outcap // TILE - 2)
+    with jax.enable_x64(False):
+        lo, hi = pl.pallas_call(
+            _kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(T,),
+                in_specs=[
+                    pl.BlockSpec((TILE,), lambda t, b: (t,)),
+                    pl.BlockSpec((TILE, P), lambda t, b: (t, 0)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((TILE, P), lambda t, b: (b[t], 0)),
+                    pl.BlockSpec((TILE, P), lambda t, b: (b[t] + 1, 0)),
+                ],
+            ),
+            out_shape=[jax.ShapeDtypeStruct((outcap, P), jnp.float32)] * 2,
+            interpret=INTERPRET,
+        )(bases, gid.astype(jnp.int32), payload)
+    # each buffer only ever visited its own block range; everything else
+    # is VMEM garbage — mask per buffer before combining
+    slot_block = (jnp.arange(outcap, dtype=jnp.int32) // TILE)[:, None]
+    lo_keep = (slot_block >= bases[0]) & (slot_block <= bases[-1])
+    hi_keep = (slot_block >= bases[0] + 1) & (slot_block <= bases[-1] + 1)
+    return jnp.where(lo_keep, lo, 0.0) + jnp.where(hi_keep, hi, 0.0)
+
+
+@jax.jit
+def prep(key, val, live):
+    """pack -> co-sort -> gid + digit payload planes."""
+    packed = jnp.where(live, key, jnp.int32(SPAN + 1))
+    clean = jnp.where(live, val, 0.0)
+    m = jnp.max(jnp.abs(clean))
+    e = jnp.ceil(jnp.log2(jnp.maximum(m, 1e-300)))
+    scale = jnp.exp2(jnp.float64(47.0) - e)
+    s = jnp.round(clean * scale)
+    # 8-bit balanced digits: |d| <= 2^7 is exact in bf16, so the MXU
+    # one-hot matmul runs at full bf16 speed with exact accumulation
+    digs = []
+    rem = s
+    for shift in (40, 32, 24, 16, 8, 0):
+        d = jnp.round(rem / np.float64(2.0 ** shift)) if shift else \
+            jnp.round(rem)
+        if shift:
+            rem = rem - d * np.float64(2.0 ** shift)
+        digs.append(d.astype(jnp.float32))
+    cnt = jnp.where(live, 1.0, 0.0).astype(jnp.float32)
+    sk, c0, d0, d1, d2, d3, d4, d5 = lax.sort(
+        (packed, cnt, digs[0], digs[1], digs[2], digs[3], digs[4],
+         digs[5]), num_keys=1)
+    boundary = jnp.concatenate([jnp.ones(1, jnp.bool_), sk[1:] != sk[:-1]])
+    gid = (jnp.cumsum(boundary.astype(jnp.int32)) - 1).astype(jnp.int32)
+    pay = jnp.stack([c0, d0, d1, d2, d3, d4, d5,
+                     jnp.zeros_like(c0)], axis=1)
+    # representative key per gid comes from boundary rows (sk at starts)
+    return gid, pay, sk, scale
+
+
+@jax.jit
+def finish(acc, scale):
+    cnt = acc[:, 0]
+    tot = jnp.zeros(acc.shape[0], jnp.float64)
+    for i, shift in enumerate((40, 32, 24, 16, 8, 0)):
+        tot = tot + acc[:, 1 + i].astype(jnp.float64) \
+            * np.float64(2.0 ** shift)
+    tot = tot / scale
+    return cnt, tot
+
+
+def t(name, fn, reps=3):
+    float(fn())
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(fn())
+        ts.append(time.perf_counter() - t0)
+    print(f"{name}: {min(ts)*1e3:.1f} ms", flush=True)
+
+
+def main():
+    key, val, live = make_data()
+    float(jnp.sum(val))
+    OUTCAP = (1 << 22) + 2048  # gid bound: SPAN+2 groups, TILE-aligned
+
+    def full_pallas():
+        gid, pay, sk, scale = prep(key, val, live)
+        acc = segsum_window(gid, pay, OUTCAP)
+        cnt, tot = finish(acc, scale)
+        return tot[0] + cnt[1]
+
+    # reference: the current 3-scatter bucket design
+    @jax.jit
+    def scatter3():
+        sb = jnp.where(live, key, jnp.int32(SPAN))
+        counts = jax.ops.segment_sum(jnp.ones(N, jnp.int32), sb,
+                                     num_segments=SPAN + 1)[:SPAN]
+        clean = jnp.where(live, val, 0.0)
+        m = jnp.max(jnp.abs(clean))
+        e = jnp.ceil(jnp.log2(jnp.maximum(m, 1e-300)))
+        scale = jnp.exp2(47.0 - e)
+        s = clean * scale
+        d0 = jnp.round(s / np.float64(2.0 ** 24))
+        d1 = jnp.round(s - d0 * np.float64(2.0 ** 24))
+        a0 = jax.ops.segment_sum(d0.astype(jnp.int32), sb,
+                                 num_segments=SPAN + 1)[:SPAN]
+        a1 = jax.ops.segment_sum(d1.astype(jnp.int32), sb,
+                                 num_segments=SPAN + 1)[:SPAN]
+        tot = (a0.astype(jnp.float64) * np.float64(2.0 ** 24)
+               + a1.astype(jnp.float64)) / scale
+        return tot[0] + counts[1].astype(jnp.float64)
+
+    # correctness cross-check on gid-space vs key-space: compare GLOBAL sums
+    gid, pay, sk, scale = prep(key, val, live)
+    acc = segsum_window(gid, pay, OUTCAP)
+    cnt, tot = finish(acc, scale)
+    clean_sum = float(jnp.sum(jnp.where(live, val, 0.0)))
+    live_n = float(jnp.sum(live.astype(jnp.int32)))
+    # the sentinel group is included in gid space; subtract nothing: its
+    # digits are zeros (dead rows zeroed), count contributes 0
+    got_sum = float(jnp.sum(tot))
+    got_cnt = float(jnp.sum(cnt))
+    print("sum check:", got_sum, "vs", clean_sum,
+          "cnt:", got_cnt, "vs", live_n, flush=True)
+    assert abs(got_cnt - live_n) < 0.5, (got_cnt, live_n)
+    assert abs(got_sum - clean_sum) < 1e-6 * abs(clean_sum)
+
+    t("pallas sorted-window segsum (end-to-end)", full_pallas)
+    t("3-scatter bucket path", scatter3)
+
+
+if __name__ == "__main__":
+    main()
