@@ -267,6 +267,12 @@ AGG_FORCE_SINGLE_PASS = conf_bool(
     "pass instead of per-batch update + merge (testing knob, reference "
     "forceSinglePassPartialSortAgg).", internal=True)
 
+MAX_RECORDS_PER_FILE = conf_int(
+    "spark.sql.files.maxRecordsPerFile", 0,
+    "Maximum rows per output file (0 = unlimited). Writers split output "
+    "batches into numbered part files past the limit (reference "
+    "GpuFileFormatDataWriter maxRecordsPerFile).")
+
 UDF_COMPILER_ENABLED = conf_bool(
     "spark.rapids.sql.udfCompiler.enabled", True,
     "Translate simple Python UDF bytecode (arithmetic, comparisons, "

@@ -1,11 +1,13 @@
 """Columnar file writer.
 
 Reference parity: ColumnarOutputWriter.scala + GpuFileFormatDataWriter
-(dynamic partitioning, per-task part files, _SUCCESS marker) +
-GpuParquetFileFormat/GpuOrcFileFormat/GpuHiveFileFormat. Device batches
-download once per output batch (the C2R boundary) and encode host-side
-with pyarrow's native writers; writes go through the ThrottlingExecutor
-so buffered output bytes are bounded (reference io/async TrafficController).
+(dynamic partitioning, per-task part files, maxRecordsPerFile splitting,
+_SUCCESS marker) + GpuParquetFileFormat/GpuOrcFileFormat/
+GpuHiveFileFormat + BasicColumnarWriteJobStatsTracker (per-write
+numFiles/numOutputRows/numOutputBytes/numParts). Device batches download
+once per output batch (the C2R boundary) and encode host-side with
+pyarrow's native writers; writes go through the ThrottlingExecutor so
+buffered output bytes are bounded (reference io/async TrafficController).
 """
 from __future__ import annotations
 
@@ -66,6 +68,33 @@ def _partition_dirs(table: pa.Table, partition_by: List[str]):
         yield subdir, sub
 
 
+class WriteStats:
+    """BasicColumnarWriteJobStatsTracker analog: one per write job,
+    readable afterwards via DataFrameWriter.last_write_stats."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self.num_files = 0
+        self.num_output_rows = 0
+        self.num_output_bytes = 0
+        self.partition_dirs = set()
+
+    def record(self, rows: int, nbytes: int, subdir: str) -> None:
+        with self._lock:
+            self.num_files += 1
+            self.num_output_rows += rows
+            self.num_output_bytes += nbytes
+            if subdir:
+                self.partition_dirs.add(subdir)
+
+    def as_dict(self) -> dict:
+        return {"numFiles": self.num_files,
+                "numOutputRows": self.num_output_rows,
+                "numOutputBytes": self.num_output_bytes,
+                "numParts": len(self.partition_dirs)}
+
+
 class DataFrameWriter:
     """df.write.mode(...).partition_by(...).parquet(path) — the writer
     facade (reference GpuDataWritingCommandExec + InsertIntoHadoopFs)."""
@@ -75,6 +104,8 @@ class DataFrameWriter:
         self._mode = "error"
         self._partition_by: List[str] = []
         self._options: dict = {}
+        #: stats of the most recent write job (tracker analog)
+        self.last_write_stats: Optional[dict] = None
 
     def mode(self, m: str) -> "DataFrameWriter":
         assert m in ("error", "errorifexists", "overwrite", "append"), m
@@ -132,6 +163,14 @@ class DataFrameWriter:
         import uuid
         job = uuid.uuid4().hex[:8]
 
+        stats = WriteStats()
+        max_records = int(self._options.get(
+            "maxRecordsPerFile", conf.get(C.MAX_RECORDS_PER_FILE)) or 0)
+
+        def write_tracked(sub, fpath, subdir):
+            _write_one(sub, fpath, fmt, self._options)
+            stats.record(sub.num_rows, os.path.getsize(fpath), subdir)
+
         def run_partition(p: int) -> None:
             with TaskContext(partition_id=p) as tctx:
                 tables = [to_arrow(b, names)
@@ -144,10 +183,20 @@ class DataFrameWriter:
             for subdir, sub in _partition_dirs(table, self._partition_by):
                 d = os.path.join(path, subdir) if subdir else path
                 os.makedirs(d, exist_ok=True)
-                fpath = os.path.join(d, f"part-{p:05d}-{job}.{ext}")
-                with futures_lock:
-                    futures.append(pool.submit(
-                        sub.nbytes, _write_one, sub, fpath, fmt, self._options))
+                # maxRecordsPerFile: roll to a new numbered part file
+                if max_records > 0 and sub.num_rows > max_records:
+                    chunks = [sub.slice(off, min(max_records,
+                                                 sub.num_rows - off))
+                              for off in range(0, sub.num_rows, max_records)]
+                else:
+                    chunks = [sub]
+                for seq, chunk in enumerate(chunks):
+                    fpath = os.path.join(
+                        d, f"part-{p:05d}-{seq:04d}-{job}.{ext}")
+                    with futures_lock:
+                        futures.append(pool.submit(
+                            chunk.nbytes, write_tracked, chunk, fpath,
+                            subdir))
 
         try:
             nparts = exec_root.num_partitions
@@ -161,5 +210,10 @@ class DataFrameWriter:
                 f.result()
             with open(os.path.join(path, "_SUCCESS"), "w"):
                 pass
+            self.last_write_stats = stats.as_dict()
+            # df.write is a fresh builder per access: stash where callers
+            # can actually reach them afterwards
+            self._df.last_write_stats = self.last_write_stats
+            session.last_write_stats = self.last_write_stats
         finally:
             pool.shutdown()

@@ -390,3 +390,32 @@ def test_avro_aggregate(session, tmp_path):
         lambda s: s.read_avro(path).group_by(col("k")).agg(
             F.sum("v").alias("sv")),
         session, ignore_order=True)
+
+
+def test_max_records_per_file_and_write_stats(session, tmp_path):
+    # reference GpuFileFormatDataWriter maxRecordsPerFile +
+    # BasicColumnarWriteJobStatsTracker
+    import os
+    t = pa.table({"k": pa.array((np.arange(100) % 4).astype(np.int64)),
+                  "v": pa.array(np.arange(100).astype(np.float64))})
+    df = session.create_dataframe(t)
+    w = df.write.mode("overwrite").option("maxRecordsPerFile", 30)
+    p = str(tmp_path / "out")
+    w.parquet(p)
+    files = [f for f in os.listdir(p) if f.endswith(".parquet")]
+    assert len(files) == 4  # 100 rows / 30 -> 4 part files
+    st = w.last_write_stats
+    assert st["numFiles"] == 4
+    assert st["numOutputRows"] == 100
+    assert st["numOutputBytes"] > 0
+    import pyarrow.parquet as _pq
+    total = sum(_pq.ParquetFile(os.path.join(p, f)).metadata.num_rows
+                for f in files)
+    assert total == 100
+
+    # partitioned write: stats count partition dirs
+    w2 = df.write.mode("overwrite").partition_by("k")
+    p2 = str(tmp_path / "out2")
+    w2.parquet(p2)
+    assert w2.last_write_stats["numParts"] == 4
+    assert w2.last_write_stats["numOutputRows"] == 100

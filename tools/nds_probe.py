@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -305,45 +306,171 @@ def q89(s, d):
             .order_by(col("sum_sales").desc()).limit(100))
 
 
-QUERIES = {3: q3, 7: q7, 19: q19, 42: q42, 52: q52, 55: q55, 65: q65,
-           68: q68, 73: q73, 79: q79, 89: q89, 96: q96, 98: q98}
+def q12(s, d):
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["web_sales"]
+            .join(d["date_dim"], on=[(col("ws_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("ws_item_sk"), col("i_item_sk"))])
+            .filter((col("d_year") == lit(1999)) & (col("d_moy") == lit(2)))
+            .group_by("i_item_sk", "i_category", "i_current_price")
+            .agg(F.sum(col("ws_ext_sales_price")).alias("itemrevenue")))
+    w = Window.partition_by(col("i_category"))
+    return (base.select(
+        col("i_category"), col("itemrevenue"),
+        (col("itemrevenue") * lit(100.0)
+         / F.sum(col("itemrevenue")).over(w)).alias("revenueratio"))
+        .order_by(col("i_category").asc(), col("revenueratio").desc())
+        .limit(100))
+
+
+def q20(s, d):
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["catalog_sales"]
+            .join(d["date_dim"], on=[(col("cs_sold_date_sk"), col("d_date_sk"))])
+            .join(d["item"], on=[(col("cs_item_sk"), col("i_item_sk"))])
+            .filter((col("d_year") == lit(2000)) & (col("d_qoy") == lit(1)))
+            .group_by("i_item_sk", "i_category", "i_current_price")
+            .agg(F.sum(col("cs_ext_sales_price")).alias("itemrevenue")))
+    w = Window.partition_by(col("i_category"))
+    return (base.select(
+        col("i_category"), col("itemrevenue"),
+        (col("itemrevenue") * lit(100.0)
+         / F.sum(col("itemrevenue")).over(w)).alias("revenueratio"))
+        .order_by(col("i_category").asc(), col("revenueratio").desc())
+        .limit(100))
+
+
+def q26(s, d):
+    return (d["catalog_sales"]
+            .join(d["item"], on=[(col("cs_item_sk"), col("i_item_sk"))])
+            .join(d["date_dim"], on=[(col("cs_sold_date_sk"), col("d_date_sk"))])
+            .filter(col("d_year") == lit(2000))
+            .group_by("i_category")
+            .agg(F.avg(col("cs_quantity")).alias("agg1"),
+                 F.avg(col("cs_sales_price")).alias("agg2"),
+                 F.avg(col("cs_ext_sales_price")).alias("agg3"))
+            .order_by(col("i_category").asc()).limit(100))
+
+
+def q43(s, d):
+    return (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"), col("d_date_sk"))])
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .filter((col("d_year") == lit(2000))
+                    & (col("s_gmt_offset") == lit(-5.0)))
+            .group_by("s_store_name", "s_store_sk", "d_day_name")
+            .agg(F.sum(col("ss_sales_price")).alias("sales"))
+            .order_by(col("s_store_name").asc(), col("d_day_name").asc())
+            .limit(100))
+
+
+QUERIES = {3: q3, 7: q7, 12: q12, 19: q19, 20: q20, 26: q26, 42: q42,
+           43: q43, 52: q52, 55: q55, 65: q65, 68: q68, 73: q73, 79: q79,
+           89: q89, 96: q96, 98: q98}
+
+
+def _canon_rows(table):
+    """Order-insensitive canonical rows with rounded floats, so the
+    differential check compares VALUES, not just counts (most NDS
+    queries end in limit(100) — counts alone cannot catch a wrong
+    aggregate)."""
+    rows = []
+    for r in table.to_pylist():
+        vals = []
+        for k in sorted(r):
+            v = r[k]
+            if isinstance(v, float):
+                v = round(v, 6)
+            vals.append((k, v))
+        rows.append(tuple(vals))
+    return sorted(rows, key=repr)
+
+
+def run_one(sess, dfs, qn: int) -> dict:
+    df = QUERIES[qn](sess, dfs)
+    explain = df.explain()
+    device = "fallback" if "cannot run on TPU" in explain else "clean"
+    t0 = time.perf_counter()
+    tpu_table = df.collect()
+    first = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    df.count()
+    dt = time.perf_counter() - t0  # steady state (kernels cached)
+    cpu_table = df.collect_cpu()  # full differential vs CPU interpreter
+    status = "ok" if _canon_rows(tpu_table) == _canon_rows(cpu_table) \
+        else "wrong"
+    return {"status": status, "device": device,
+            "rows": int(tpu_table.num_rows),
+            "seconds": round(dt, 4), "first_run_seconds": round(first, 4)}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sf", type=float, default=0.01)
     ap.add_argument("--out", default="NDS_SCORECARD.json")
+    ap.add_argument("--query", type=int, default=0,
+                    help="child mode: run ONE query, print its JSON")
+    ap.add_argument("--inline", action="store_true",
+                    help="run queries in-process (no isolation)")
     args = ap.parse_args()
 
-    sess = TpuSession()
-    tables = gen_tables(args.sf)
-    dfs = {name: sess.create_dataframe(t).cache()
-           for name, t in tables.items()}
-    for df in dfs.values():
-        df.count()
+    if args.query:
+        t0 = time.perf_counter()
+        sess = TpuSession()
+        dfs = {name: sess.create_dataframe(t).cache()
+               for name, t in gen_tables(args.sf).items()}
+        for _df in dfs.values():
+            _df.count()
+        setup_s = round(time.perf_counter() - t0, 2)
+        try:
+            rec = run_one(sess, dfs, args.query)
+            rec["setup_seconds"] = setup_s
+            print("RESULT " + json.dumps(rec))
+        except Exception as e:  # noqa: BLE001
+            print("RESULT " + json.dumps(
+                {"status": "error", "setup_seconds": setup_s,
+                 "error": f"{type(e).__name__}: {e}"}))
+        return
 
+    per_query_s = int(os.environ.get("NDS_QUERY_TIMEOUT_S", "420"))
     card = {}
+    if args.inline:
+        sess = TpuSession()
+        dfs = {name: sess.create_dataframe(t).cache()
+               for name, t in gen_tables(args.sf).items()}
     for qn in range(1, 100):
-        builder = QUERIES.get(qn)
-        if builder is None:
+        if qn not in QUERIES:
             card[f"q{qn}"] = {"status": "not_translated"}
             continue
-        try:
-            df = builder(sess, dfs)
-            explain = df.explain()
-            device = ("fallback" if "cannot run on TPU" in explain
-                      else "clean")
-            t0 = time.perf_counter()
-            n = df.count()
-            dt = time.perf_counter() - t0
-            # differential check against the CPU interpreter
-            cpu_n = df.collect_cpu().num_rows
-            status = "ok" if n == cpu_n else "wrong"
-            card[f"q{qn}"] = {"status": status, "device": device,
-                              "rows": int(n), "seconds": round(dt, 4)}
-        except Exception as e:  # noqa: BLE001 - scorecard, not a crash
-            card[f"q{qn}"] = {"status": "error",
-                              "error": f"{type(e).__name__}: {e}"}
+        if args.inline:
+            try:
+                card[f"q{qn}"] = run_one(sess, dfs, qn)
+            except Exception as e:  # noqa: BLE001
+                card[f"q{qn}"] = {"status": "error",
+                                  "error": f"{type(e).__name__}: {e}"}
+        else:
+            # SUBPROCESS isolation: a wedged remote compile cannot be
+            # interrupted by SIGALRM (it blocks in C), so each query gets
+            # its own interpreter and a hard kill on timeout (the
+            # reference scale-test isolates queries the same way)
+            import subprocess
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--sf", str(args.sf), "--query", str(qn)]
+            # setup (data gen + cache upload) happens inside the child:
+            # give it an sf-scaled allowance on top of the query budget so
+            # a slow upload never reads as a query timeout
+            setup_allowance = 90 + int(args.sf * 600)
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=per_query_s + setup_allowance)
+                line = [l for l in r.stdout.splitlines()
+                        if l.startswith("RESULT ")]
+                card[f"q{qn}"] = (json.loads(line[-1][7:]) if line else
+                                  {"status": "error",
+                                   "error": (r.stderr or "no output")[-300:]})
+            except subprocess.TimeoutExpired:
+                card[f"q{qn}"] = {"status": "timeout",
+                                  "seconds_limit": per_query_s}
         print(f"q{qn}: {card[f'q{qn}']}", file=sys.stderr, flush=True)
 
     translated = [q for q in card.values() if q["status"] != "not_translated"]
