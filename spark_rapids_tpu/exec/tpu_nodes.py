@@ -1801,6 +1801,81 @@ class WindowExec(TpuExec):
                 return ColumnarBatch(out_cols, batch.num_rows)
             return fn
 
+        def _layout_of(pk, batch, ranges):
+            flags = [(True, True)] * nparts + \
+                [(o.ascending, o.resolved_nulls_first())
+                 for o in spec.order_specs]
+            obits = sum(pk.bits[nparts:])
+            nr = traced_rows(batch.num_rows)
+            cap = batch.capacity
+            ectx = EvalCtx(batch.columns, nr, cap, False)
+            kcols = [e.eval_tpu(ectx) for e in key_exprs]
+            live = jnp.arange(cap) < nr
+            packed = R.pack_keys_sort(pk, kcols, ranges, live, flags)
+            perm = jnp.argsort(packed, stable=True).astype(jnp.int32)
+            sp = packed[perm]
+            first = jnp.zeros(cap, jnp.bool_).at[0].set(True)
+            part_plane = sp >> jnp.int64(obits)
+            segb = first | jnp.concatenate(
+                [jnp.zeros(1, jnp.bool_),
+                 part_plane[1:] != part_plane[:-1]])
+            peerb = first | jnp.concatenate(
+                [jnp.zeros(1, jnp.bool_), sp[1:] != sp[:-1]])
+            seg_start, seg_end, peer_start, peer_end = \
+                W.segment_layout(segb, peerb)
+            seg_end = jnp.minimum(
+                seg_end, jnp.maximum(nr - 1, 0).astype(seg_end.dtype))
+            peer_end = jnp.minimum(peer_end, seg_end)
+            seg_id = jnp.cumsum(segb.astype(jnp.int32))
+            return (perm, seg_start, seg_end, peer_start, peer_end,
+                    seg_id, segb, peerb, live)
+
+        def build_sort_layout(pk):
+            def fn(batch, ranges):
+                from spark_rapids_tpu.ops import window as W  # noqa: F811
+                return _layout_of(pk, batch, ranges)
+            return fn
+
+        def build_apply_fns(pk):
+            def fn(batch, perm, seg_start, seg_end, peer_start, peer_end,
+                   seg_id, segb, peerb, live):
+                nr = traced_rows(batch.num_rows)
+                cap = batch.capacity
+                idx = jnp.arange(cap, dtype=jnp.int32)
+                sctx = EvalCtx([], nr, cap, False)
+                sctx.columns = K.LazyGatheredCols(batch.columns, perm,
+                                                  batch.num_rows)
+                out_cols = list(batch.columns)
+                for w in exprs:
+                    wc = _eval_window_fn(
+                        w, sctx, seg_start, seg_end, peer_start, peer_end,
+                        seg_id, segb, peerb, idx, live)
+                    out_cols.append(_scatter_window_output(
+                        wc, perm, cap, live, batch.num_rows))
+                return ColumnarBatch(out_cols, batch.num_rows)
+            return fn
+
+
+        from spark_rapids_tpu.expr import window as WEm
+        has_window_agg = any(isinstance(w.fn, WEm.WindowAgg) for w in exprs)
+        if pspec is not None and has_window_agg:
+            # two dispatches for frame-aggregation windows: the fully
+            # fused sort+cumsum+gather pipeline for THIS shape wedges the
+            # remote TPU compiler (observed: window-ratio NDS queries
+            # hang >10 min in compile); splitting at the sort boundary
+            # changes the fusion islands and compiles
+            kA = ("window_sortlay", tuple(e.fingerprint()
+                                          for e in key_exprs),
+                  tuple((o.ascending, o.resolved_nulls_first())
+                        for o in spec.order_specs), pspec.key)
+            kB = ("window_fns", tuple(w.fingerprint() for w in exprs),
+                  pspec.key)
+            fnA = fuse.fused(kA, lambda: build_sort_layout(pspec))
+            fnB = fuse.fused(kB, lambda: build_apply_fns(pspec))
+            with win_t.ns():
+                lay = fnA(batch, ranges)
+                yield fnB(batch, *lay)
+            return
         if pspec is not None:
             key = ("window_packed", tuple(w.fingerprint() for w in exprs),
                    pspec.key)

@@ -724,6 +724,31 @@ def unify_vocabs(cols: List[ColumnVector]):
     return uoff, np.ascontiguousarray(ubytes), remaps
 
 
+def align_dict_columns(cols: List[ColumnVector]) -> List[ColumnVector]:
+    """NEW dict columns whose codes index ONE shared union vocabulary
+    (inputs untouched). No-op (returns the same objects) when the vocab
+    planes are already identical."""
+    same = all(_same_array(c.data["dict_offsets"],
+                           cols[0].data["dict_offsets"])
+               and _same_array(c.data["dict_bytes"],
+                               cols[0].data["dict_bytes"])
+               for c in cols[1:])
+    if same:
+        return list(cols)
+    uoff, ubytes, remaps = unify_vocabs(cols)
+    doff = jnp.asarray(uoff)
+    dby = jnp.asarray(ubytes)
+    out = []
+    for c, remap in zip(cols, remaps):
+        codes = jnp.asarray(remap)[jnp.clip(c.data["codes"], 0,
+                                            len(remap) - 1)]
+        out.append(ColumnVector(c.dtype,
+                                {"codes": codes, "dict_offsets": doff,
+                                 "dict_bytes": dby}, c.validity,
+                                dict_unique=True))
+    return out
+
+
 def _concat_columns(cols: List[ColumnVector], rows: List[int], cap: int) -> ColumnVector:
     dtype = cols[0].dtype
     if any(c.is_dict for c in cols) and not all(c.is_dict for c in cols):
