@@ -37,7 +37,7 @@ WIN_ROWS = min(ROWS, int(os.environ.get("BENCH_WIN_ROWS", 10_000_000)))
 #: ~10 MB/s, so the upload is sized by column selection, not row count)
 SHFL_ROWS = min(ROWS, int(os.environ.get("BENCH_SHUFFLE_ROWS", 30_000_000)))
 SHUFFLE_PARTS = int(os.environ.get("BENCH_SHUFFLE_PARTS", 4))
-REPS = int(os.environ.get("BENCH_REPS", 3))
+REPS = int(os.environ.get("BENCH_REPS", 5))  # best-of-5: tunnel RTT varies
 BACKEND_TIMEOUT_S = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", 90))
 #: soft wall-clock budget: queries still pending when it expires are
 #: reported as skipped so the driver gets a parseable result instead of a
