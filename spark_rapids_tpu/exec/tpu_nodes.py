@@ -1162,9 +1162,11 @@ class _AggKernels:
             return False
         cap = live.shape[0]
         from spark_rapids_tpu.ops.pallas_segsum import CHUNK_ROWS, TILE
-        # HBM budget: the payload plane + both cond branches live inside
-        # one fused stage; past ~8M rows the whole-query program exceeds
-        # the v5e's 16G (measured 18.6G on the 32M q3 shape)
+        # HBM budget: the fused stage carries the sorted planes, digit
+        # lanes, accumulators, AND the cond fallback's scatter temps; the
+        # 32M q3 shape measured 18.5G against the v5e's 15.75G even with
+        # per-chunk payload stacks — large batches stay on the scatter
+        # path until the stage is split
         if cap % TILE or cap < 4 * TILE or cap > CHUNK_ROWS:
             return False
         n_sums = 0
@@ -1247,11 +1249,11 @@ class _AggKernels:
         P = -(-len(lanes) // 8) * 8
         while len(lanes) < P:
             lanes.append(jnp.zeros(cap, jnp.bfloat16))
-        payload = jnp.stack(lanes, axis=1)
         # the kernel runs at TOP LEVEL (a pallas custom-call inside a
         # lax.cond branch aborts the runtime on this toolchain); only the
         # cheap postprocessing participates in the overflow cond
-        acc = PS.segsum_window_chunked(gid, payload, nb)
+        payload = jnp.stack(lanes, axis=1)
+        acc = PS.segsum_window(gid, payload, nb)
 
         def post():
             return self._pallas_seg_post(acc, state_specs, spec, ranges,
