@@ -67,6 +67,15 @@ class _Reader:
         return self.pos >= len(self.buf)
 
 
+def _nullable_dec(base, null_index: int):
+    """Wrap a decoder for a [null, X] union branch."""
+    def dec(r: _Reader):
+        if r.long() == null_index:
+            return None
+        return base(r)
+    return dec
+
+
 def _field_decoder(ftype):
     """Returns (decode_fn(reader)->python value, arrow_type_name)."""
     import pyarrow as pa
@@ -81,7 +90,8 @@ def _field_decoder(ftype):
         null_index = ftype.index("null") if "null" in ftype else -1
         ftype = non_null[0]
     logical = None
-    if isinstance(ftype, dict):
+    if isinstance(ftype, dict) and ftype.get("type") not in ("record",
+                                                             "array"):
         logical = ftype.get("logicalType")
         ftype = ftype["type"]
 
@@ -102,6 +112,37 @@ def _field_decoder(ftype):
             return None
         raise AvroError(f"unsupported avro type {ftype!r}")
 
+    if isinstance(ftype, dict) and ftype.get("type") == "record":
+        # nested record -> python dict + arrow struct (Iceberg manifest
+        # entries carry a nested data_file record)
+        sub = [(f["name"],) + _field_decoder(f["type"])
+               for f in ftype["fields"]]
+
+        def base(r: _Reader):  # noqa: F811 - intentional override
+            return {name: dec(r) for name, dec, _ in sub}
+
+        at = pa.struct([pa.field(name, t) for name, _, t in sub])
+        return (base if not nullable
+                else _nullable_dec(base, null_index)), at
+    if isinstance(ftype, dict) and ftype.get("type") == "array":
+        item_dec, item_t = _field_decoder(ftype["items"])
+
+        def base(r: _Reader):  # noqa: F811 - intentional override
+            out = []
+            while True:
+                n = r.long()
+                if n == 0:
+                    break
+                if n < 0:
+                    r.long()  # block byte size (skippable form)
+                    n = -n
+                for _ in range(n):
+                    out.append(item_dec(r))
+            return out
+
+        at = pa.list_(item_t)
+        return (base if not nullable
+                else _nullable_dec(base, null_index)), at
     if ftype == "boolean":
         at = pa.bool_()
     elif ftype == "int":
@@ -119,8 +160,8 @@ def _field_decoder(ftype):
     elif ftype == "null":
         at = pa.null()
     else:
-        raise AvroError(f"unsupported avro type {ftype!r} (nested records/"
-                        f"arrays/maps are not supported by this reader)")
+        raise AvroError(f"unsupported avro type {ftype!r} (maps are not "
+                        f"supported by this reader)")
     if logical == "date" and ftype == "int":
         at = pa.date32()
     elif logical == "timestamp-millis" and ftype == "long":
@@ -128,16 +169,8 @@ def _field_decoder(ftype):
     elif logical == "timestamp-micros" and ftype == "long":
         at = pa.timestamp("us")
 
-    if not nullable:
-        return base, at
-
-    def dec(r: _Reader):
-        idx = r.long()
-        if idx == null_index:
-            return None
-        return base(r)
-
-    return dec, at
+    return (base if not nullable
+            else _nullable_dec(base, null_index)), at
 
 
 def read_avro(path: str):
@@ -243,6 +276,15 @@ def write_avro(path: str, table, codec: str = "null") -> None:
         if pa.types.is_timestamp(at):
             lt = "timestamp-micros" if at.unit == "us" else "timestamp-millis"
             return {"type": "long", "logicalType": lt}
+        if pa.types.is_struct(at):
+            avro_type._n = getattr(avro_type, "_n", 0) + 1
+            return {"type": "record", "name": f"r{avro_type._n}",
+                    "fields": [{"name": f.name,
+                                "type": ["null", avro_type(f.type)]}
+                               for f in at]}
+        if pa.types.is_list(at):
+            return {"type": "array",
+                    "items": ["null", avro_type(at.value_type)]}
         raise AvroError(f"cannot write arrow type {at} to avro")
 
     schema = {"type": "record", "name": "row", "fields": [
@@ -252,8 +294,19 @@ def write_avro(path: str, table, codec: str = "null") -> None:
     def enc_val(at, v) -> bytes:
         if pa.types.is_boolean(at):
             return bytes([1 if v else 0])
-        if pa.types.is_int32(at) or pa.types.is_int64(at) \
-                or pa.types.is_date32(at) or pa.types.is_timestamp(at):
+        if pa.types.is_date32(at):
+            import datetime
+            if isinstance(v, datetime.date):
+                v = (v - datetime.date(1970, 1, 1)).days
+            return _zigzag(int(v))
+        if pa.types.is_timestamp(at):
+            import datetime
+            if isinstance(v, datetime.datetime):
+                epoch = datetime.datetime(1970, 1, 1, tzinfo=v.tzinfo)
+                us = int((v - epoch).total_seconds() * 1_000_000)
+                v = us if at.unit == "us" else us // 1000
+            return _zigzag(int(v))
+        if pa.types.is_int32(at) or pa.types.is_int64(at):
             return _zigzag(int(v))
         if pa.types.is_float32(at):
             return struct.pack("<f", v)
@@ -262,6 +315,28 @@ def write_avro(path: str, table, codec: str = "null") -> None:
         if pa.types.is_string(at):
             b = v.encode("utf-8")
             return _zigzag(len(b)) + b
+        if pa.types.is_struct(at):
+            # fields mirror the top-level convention: nullable union per
+            # field, branch 1 = the value
+            out = bytearray()
+            for f in at:
+                fv = v.get(f.name) if isinstance(v, dict) else None
+                if fv is None:
+                    out += _zigzag(0)
+                else:
+                    out += _zigzag(1) + enc_val(f.type, fv)
+            return bytes(out)
+        if pa.types.is_list(at):
+            out = bytearray()
+            if v:
+                out += _zigzag(len(v))
+                for item in v:
+                    if item is None:
+                        out += _zigzag(0)
+                    else:
+                        out += _zigzag(1) + enc_val(at.value_type, item)
+            out += _zigzag(0)
+            return bytes(out)
         b = bytes(v)
         return _zigzag(len(b)) + b
 
