@@ -273,6 +273,16 @@ MAX_RECORDS_PER_FILE = conf_int(
     "batches into numbered part files past the limit (reference "
     "GpuFileFormatDataWriter maxRecordsPerFile).")
 
+PY_WORKER_POOL_ENABLED = conf_bool(
+    "spark.rapids.sql.python.workerPool.enabled", True,
+    "Evaluate large row-UDF batches on a persistent multiprocessing "
+    "worker pool (reference PySpark daemon analog). Unpicklable UDFs "
+    "and small batches stay in-process.")
+
+PY_WORKER_POOL_PARALLELISM = conf_int(
+    "spark.rapids.sql.python.workerPool.parallelism", 0,
+    "Worker processes for the python UDF pool (0 = cpu count, cap 8).")
+
 UDF_COMPILER_ENABLED = conf_bool(
     "spark.rapids.sql.udfCompiler.enabled", True,
     "Translate simple Python UDF bytecode (arithmetic, comparisons, "

@@ -1,0 +1,90 @@
+"""Python UDF worker pool.
+
+Reference parity: the reference ships a GPU-sharing PySpark daemon +
+worker pool (python/rapids/daemon.py, GpuPythonRunner family) so opaque
+Python UDFs don't serialize the whole executor. The engine analog: a
+persistent ``multiprocessing`` pool that evaluates row-UDF chunks in
+parallel worker processes, with the engine process staying free for
+device work. Workers are forked lazily on first use and reused across
+queries (daemon semantics); closures are shipped by pickle, so only
+picklable UDFs are eligible — unpicklable ones (lambdas in local scope,
+closures over open handles) silently stay on the in-process path, the
+same graceful degradation the reference's fallback rules apply.
+
+Conf: spark.rapids.sql.python.workerPool.enabled (default on) and
+spark.rapids.sql.python.workerPool.parallelism (default = cpu count,
+capped at 8).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import List, Optional
+
+_POOL = None
+_POOL_SIZE = 0
+_POOL_LOCK = threading.Lock()
+
+
+def _get_pool(size: int):
+    """SPAWN-context pool: forking a JAX-initialized, multithreaded
+    engine process would hand children locked allocator/XLA mutexes
+    (deadlock); spawned workers start clean and persist across queries.
+    Guarded by a lock — partitions evaluate on a thread pool."""
+    global _POOL, _POOL_SIZE
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_SIZE != size:
+            if _POOL is not None:
+                _POOL.terminate()
+            import multiprocessing
+            ctx = multiprocessing.get_context("spawn")
+            _POOL = ctx.Pool(processes=size)
+            _POOL_SIZE = size
+        return _POOL
+
+
+def shutdown_pool() -> None:
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is not None:
+            _POOL.terminate()
+            _POOL = None
+
+
+def _run_chunk(payload: bytes):
+    fn, rows = pickle.loads(payload)
+    return [fn(*args) for args in rows]
+
+
+def eligible(fn) -> bool:
+    """Picklable check (forked workers need to reconstruct the fn)."""
+    try:
+        pickle.dumps(fn)
+        return True
+    except Exception:  # noqa: BLE001 - any pickling failure disqualifies
+        return False
+
+
+def map_rows(fn, rows: List[tuple], parallelism: int,
+             min_rows_per_chunk: int = 2048) -> Optional[list]:
+    """Evaluate fn over arg tuples across the worker pool; None when the
+    pool declines (small input, unpicklable fn) and the caller should
+    run in-process."""
+    n = len(rows)
+    if n < 2 * min_rows_per_chunk or parallelism <= 1 or not eligible(fn):
+        return None
+    size = min(parallelism, max(os.cpu_count() or 1, 1), 8)
+    nchunks = min(size * 2, max(n // min_rows_per_chunk, 1))
+    step = -(-n // nchunks)
+    payloads = [pickle.dumps((fn, rows[off: off + step]))
+                for off in range(0, n, step)]
+    try:
+        pool = _get_pool(size)
+        out: list = []
+        for part in pool.map(_run_chunk, payloads):
+            out.extend(part)
+        return out
+    except Exception:  # noqa: BLE001 - degrade to in-process; reset pool
+        shutdown_pool()
+        return None

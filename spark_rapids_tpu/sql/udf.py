@@ -59,13 +59,20 @@ class PythonRowUDF(Expression):
     def eval_cpu(self, cols, ansi=False):
         ins = [c.eval_cpu(cols, ansi) for c in self.children]
         n = len(ins[0].values) if ins else 0
-        out, valid = [], np.ones(n, np.bool_)
-        for i in range(n):
-            args = [c.values[i] if c.valid[i] else None for c in ins]
-            r = self.fn(*args)
-            if r is None:
-                valid[i] = False
-            out.append(r)
+        rows = [tuple(c.values[i] if c.valid[i] else None for c in ins)
+                for i in range(n)]
+        out = None
+        from spark_rapids_tpu import config as C
+        if C.conf().get(C.PY_WORKER_POOL_ENABLED):
+            from spark_rapids_tpu.runtime import pyworker
+            import os as _os
+            par = C.conf().get(C.PY_WORKER_POOL_PARALLELISM) or \
+                (_os.cpu_count() or 1)
+            out = pyworker.map_rows(self.fn, rows, par)
+        if out is None:  # small batch / unpicklable fn: in-process
+            out = [self.fn(*args) for args in rows]
+        valid = np.array([r is not None for r in out], np.bool_) \
+            if n else np.ones(0, np.bool_)
         if isinstance(self.return_type, T.StringType):
             vals = np.array(out, object)
         else:

@@ -1,0 +1,43 @@
+"""Python UDF worker pool tests (reference PySpark daemon analog)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql.udf import PythonRowUDF
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.runtime import pyworker
+
+
+def _double(x):
+    return None if x is None else x * 2
+
+
+def test_pool_matches_inprocess():
+    rows = [(i,) for i in range(10000)]
+    got = pyworker.map_rows(_double, rows, parallelism=4)
+    assert got is not None, "pool should accept a picklable module fn"
+    assert got == [r[0] * 2 for r in rows]
+
+
+def test_pool_declines_small_and_unpicklable():
+    assert pyworker.map_rows(_double, [(1,)], parallelism=4) is None
+    import threading
+    lock = threading.Lock()  # unpicklable capture
+
+    def bad(x):
+        with lock:
+            return x
+    assert pyworker.map_rows(bad, [(i,) for i in range(10000)],
+                             parallelism=4) is None
+
+
+def test_udf_through_pool_end_to_end():
+    s = TpuSession()
+    n = 6000
+    t = pa.table({"a": pa.array(np.arange(n, dtype=np.int64))})
+    e = PythonRowUDF(_double, T.INT64, [col("a")])
+    out = s.create_dataframe(t).select(e.alias("r")).to_pydict()["r"]
+    assert out == [2 * i for i in range(n)]
+    pyworker.shutdown_pool()
