@@ -161,6 +161,12 @@ class SpillFramework:
         self._handles: Dict[str, SpillableHandle] = {}
         self.metrics = {"spill_to_host_bytes": 0, "spill_to_disk_bytes": 0,
                         "spill_count": 0, "oom_drains": 0}
+        #: leak audit (reference RapidsBufferCatalog leak tracking /
+        #: -Dai.rapids.refcount.debug): when enabled, registrations
+        #: record their creation stack so unreleased handles are
+        #: attributable, and leak_report() names them
+        self.leak_audit = False
+        self._origins: Dict[str, str] = {}
 
     # -- registration ------------------------------------------------------
 
@@ -177,11 +183,44 @@ class SpillFramework:
             self.drain_all()
         with self._lock:
             self._handles[h.handle_id] = h
+            if self.leak_audit:
+                import traceback
+                self._origins[h.handle_id] = "".join(
+                    traceback.format_stack(limit=8)[:-1])
         return h
 
     def unregister(self, h: SpillableHandle) -> None:
         with self._lock:
             self._handles.pop(h.handle_id, None)
+            self._origins.pop(h.handle_id, None)
+
+    # -- leak detection ----------------------------------------------------
+
+    def leak_report(self, expected_live: int = 0) -> list:
+        """Unreleased handles beyond `expected_live` (cached relations
+        legitimately stay registered for their lifetime). Returns
+        [(handle_id, bytes, origin_stack_or_None)]; callers (tests,
+        session close, the aux-subsystem audit) decide whether to raise.
+        The reference's RapidsBufferCatalog performs the same end-of-life
+        sweep with refcount debug stacks."""
+        with self._lock:
+            if len(self._handles) <= expected_live:
+                return []
+            # dict order = registration order: the OLDEST registrations
+            # are the legitimately persistent ones (cached relations
+            # register before per-query handles)
+            items = list(self._handles.items())[expected_live:]
+            return [(hid, h.size, self._origins.get(hid))
+                    for hid, h in items]
+
+    def assert_no_leaks(self, expected_live: int = 0) -> None:
+        leaks = self.leak_report(expected_live)
+        if leaks:
+            lines = [f"  {hid}: {size}B" + (f"\n{org}" if org else "")
+                     for hid, size, org in leaks]
+            raise AssertionError(
+                f"{len(leaks)} spillable handle(s) not released:\n"
+                + "\n".join(lines))
 
     # -- accounting --------------------------------------------------------
 

@@ -14,6 +14,11 @@ same graceful degradation the reference's fallback rules apply.
 Conf: spark.rapids.sql.python.workerPool.enabled (default on) and
 spark.rapids.sql.python.workerPool.parallelism (default = cpu count,
 capped at 8).
+
+Cost note: spawned workers import this package (and therefore jax) on
+startup — seconds of latency and real RSS per worker, paid ONCE per
+process lifetime because the pool persists; the row threshold is sized
+so only batches that amortize it engage the pool.
 """
 from __future__ import annotations
 
@@ -53,8 +58,14 @@ def shutdown_pool() -> None:
 
 
 def _run_chunk(payload: bytes):
-    fn, rows = pickle.loads(payload)
-    return [fn(*args) for args in rows]
+    """Worker body. UDF exceptions are RETURNED (tagged), not raised:
+    the parent must distinguish 'the UDF failed' (propagate, matching
+    in-process behavior) from 'the pool failed' (decline + fall back)."""
+    try:
+        fn, rows = pickle.loads(payload)
+        return ("ok", [fn(*args) for args in rows])
+    except Exception as e:  # noqa: BLE001
+        return ("err", f"{type(e).__name__}: {e}")
 
 
 def eligible(fn) -> bool:
@@ -67,7 +78,7 @@ def eligible(fn) -> bool:
 
 
 def map_rows(fn, rows: List[tuple], parallelism: int,
-             min_rows_per_chunk: int = 2048) -> Optional[list]:
+             min_rows_per_chunk: int = 8192) -> Optional[list]:
     """Evaluate fn over arg tuples across the worker pool; None when the
     pool declines (small input, unpicklable fn) and the caller should
     run in-process."""
@@ -77,14 +88,18 @@ def map_rows(fn, rows: List[tuple], parallelism: int,
     size = min(parallelism, max(os.cpu_count() or 1, 1), 8)
     nchunks = min(size * 2, max(n // min_rows_per_chunk, 1))
     step = -(-n // nchunks)
-    payloads = [pickle.dumps((fn, rows[off: off + step]))
-                for off in range(0, n, step)]
     try:
+        payloads = [pickle.dumps((fn, rows[off: off + step]))
+                    for off in range(0, n, step)]
         pool = _get_pool(size)
-        out: list = []
-        for part in pool.map(_run_chunk, payloads):
-            out.extend(part)
-        return out
-    except Exception:  # noqa: BLE001 - degrade to in-process; reset pool
+        parts = pool.map(_run_chunk, payloads)
+    except Exception:  # noqa: BLE001 - POOL failure: degrade + reset
         shutdown_pool()
         return None
+    out: list = []
+    for tag, part in parts:
+        if tag == "err":
+            # the UDF itself failed — propagate like the in-process path
+            raise RuntimeError(f"python UDF failed in worker: {part}")
+        out.extend(part)
+    return out

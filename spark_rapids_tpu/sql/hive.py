@@ -121,7 +121,10 @@ class HiveTable:
     # -- read ---------------------------------------------------------------
 
     def _walk(self):
-        """Yield (file_path, {partition_col: value_str})."""
+        """Yield (file_path, {partition_col: value_str}). Sibling codecs:
+        session._discover_hive (parquet partition discovery) and
+        io/writer._partition_dirs (partitioned writes) render/parse the
+        same key=value layout — changes here likely apply there too."""
         for root, _dirs, files in os.walk(self.path):
             rel = os.path.relpath(root, self.path)
             parts: Dict[str, str] = {}

@@ -136,3 +136,29 @@ def test_cache_pages_out_under_tiny_budget():
     got = df.agg(F.sum(col("x"))).to_pydict()
     assert list(got.values())[0][0] == 20000 * 19999 // 2
     reset_spill_framework()
+
+
+def test_leak_audit_reports_unreleased_handles():
+    # reference RapidsBufferCatalog leak tracking: an unreleased handle is
+    # named with its registration stack; releasing clears the report
+    from spark_rapids_tpu.runtime.memory import SpillFramework
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch, ColumnVector
+    from spark_rapids_tpu import types as T
+    import jax.numpy as jnp
+    fw = SpillFramework(1 << 20, 1 << 20)
+    fw.leak_audit = True
+    b = ColumnarBatch([ColumnVector(T.INT64, jnp.zeros(128, jnp.int64))], 128)
+    h = fw.register(b)
+    leaks = fw.leak_report()
+    assert len(leaks) == 1 and leaks[0][2] is not None
+    assert "register" in leaks[0][2] or "test_leak" in leaks[0][2]
+    import pytest as _pt
+    with _pt.raises(AssertionError, match="not released"):
+        fw.assert_no_leaks()
+    fw.unregister(h)
+    assert fw.leak_report() == []
+    fw.assert_no_leaks()
+    # expected_live tolerates legitimately persistent registrations
+    h2 = fw.register(b)
+    fw.assert_no_leaks(expected_live=1)
+    fw.unregister(h2)

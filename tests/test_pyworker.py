@@ -15,7 +15,7 @@ def _double(x):
 
 
 def test_pool_matches_inprocess():
-    rows = [(i,) for i in range(10000)]
+    rows = [(i,) for i in range(20000)]
     got = pyworker.map_rows(_double, rows, parallelism=4)
     assert got is not None, "pool should accept a picklable module fn"
     assert got == [r[0] * 2 for r in rows]
@@ -29,13 +29,13 @@ def test_pool_declines_small_and_unpicklable():
     def bad(x):
         with lock:
             return x
-    assert pyworker.map_rows(bad, [(i,) for i in range(10000)],
+    assert pyworker.map_rows(bad, [(i,) for i in range(20000)],
                              parallelism=4) is None
 
 
 def test_udf_through_pool_end_to_end():
     s = TpuSession()
-    n = 6000
+    n = 20000
     t = pa.table({"a": pa.array(np.arange(n, dtype=np.int64))})
     e = PythonRowUDF(_double, T.INT64, [col("a")])
     out = s.create_dataframe(t).select(e.alias("r")).to_pydict()["r"]
