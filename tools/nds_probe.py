@@ -117,6 +117,8 @@ def gen_tables(sf: float, seed: int = 42):
             f"{prefix}_ticket_number" if prefix == "ss" else f"{prefix}_order_number":
                 rng.integers(0, n // 4 + 1, n).astype(np.int64),
         }
+        if prefix == "ss":
+            t["ss_addr_sk"] = rng.integers(0, n_addr, n).astype(np.int64)
         return pa.table(t)
 
     return {
@@ -449,9 +451,88 @@ def q62(s, d):
             .order_by(col("ws_ship_mode_sk").asc()).limit(100))
 
 
-QUERIES = {3: q3, 7: q7, 12: q12, 19: q19, 20: q20, 26: q26, 34: q34,
-           42: q42, 43: q43, 46: q46, 52: q52, 55: q55, 62: q62, 65: q65,
-           68: q68, 73: q73, 79: q79, 89: q89, 96: q96, 97: q97, 98: q98}
+def q33(s, d):
+    def chan(sales, date_col, item_col, price_col):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col), col("d_date_sk"))])
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .filter((col("d_year") == lit(1998)) & (col("d_moy") == lit(1))
+                        & (col("i_category") == lit("Books")))
+                .group_by("i_manufact_id")
+                .agg(F.sum(col(price_col)).alias("total_sales")))
+    u = (chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+              "ss_ext_sales_price")
+         .union(chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                     "cs_ext_sales_price"))
+         .union(chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                     "ws_ext_sales_price")))
+    return (u.group_by("i_manufact_id")
+            .agg(F.sum(col("total_sales")).alias("total_sales"))
+            .order_by(col("total_sales").desc()).limit(100))
+
+
+def q48(s, d):
+    return (d["store_sales"]
+            .join(d["customer_address"],
+                  on=[(col("ss_addr_sk"), col("ca_address_sk"))])
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter((col("d_year") == lit(2000))
+                    & (col("ca_gmt_offset") == lit(-5.0))
+                    & (col("ss_net_profit") >= lit(0.0)))
+            .agg(F.sum(col("ss_quantity")).alias("total_quantity")))
+
+
+def q71(s, d):
+    def chan(sales, date_col, item_col, price_col):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col), col("d_date_sk"))])
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .filter((col("d_moy") == lit(11)) & (col("d_year") == lit(1999))
+                        & (col("i_manager_id") == lit(1)))
+                .select(col("i_brand_id"), col("i_brand"),
+                        col(price_col).alias("ext_price")))
+    u = (chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+              "ss_ext_sales_price")
+         .union(chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                     "cs_ext_sales_price"))
+         .union(chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                     "ws_ext_sales_price")))
+    return (u.group_by("i_brand_id", "i_brand")
+            .agg(F.sum(col("ext_price")).alias("ext_price"))
+            .order_by(col("ext_price").desc(), col("i_brand_id").asc())
+            .limit(100))
+
+
+def q76(s, d):
+    # channel ids are ints (1=store, 2=web, 3=catalog): unioning distinct
+    # per-branch string literals builds dict columns whose vocab union
+    # cannot happen inside a traced kernel (engine limitation, documented)
+    def chan(sales, date_col, item_col, price_col, cid):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col), col("d_date_sk"))])
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .select(lit(cid).alias("channel"), col("i_category"),
+                        col("d_year"), col("d_qoy"),
+                        col(price_col).alias("ext_sales_price")))
+    u = (chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+              "ss_ext_sales_price", 1)
+         .union(chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                     "ws_ext_sales_price", 2))
+         .union(chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                     "cs_ext_sales_price", 3)))
+    return (u.group_by("channel", "i_category", "d_year", "d_qoy")
+            .agg(F.count(col("ext_sales_price")).alias("sales_cnt"),
+                 F.sum(col("ext_sales_price")).alias("sales_amt"))
+            .order_by(col("channel").asc(), col("i_category").asc(),
+                      col("d_year").asc(), col("d_qoy").asc())
+            .limit(100))
+
+
+QUERIES = {3: q3, 7: q7, 12: q12, 19: q19, 20: q20, 26: q26, 33: q33,
+           34: q34, 42: q42, 43: q43, 46: q46, 48: q48, 52: q52, 55: q55,
+           62: q62, 65: q65, 68: q68, 71: q71, 73: q73, 76: q76, 79: q79,
+           89: q89, 96: q96, 97: q97, 98: q98}
 
 
 def _canon_rows(table):
