@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnVector
 from spark_rapids_tpu.expr.core import (
-    CpuCol, EvalCtx, Expression, SparkException, _valid_of, _wrap,
+    CpuCol, EvalCtx, Expression, Literal, SparkException, _valid_of, _wrap,
 )
 
 
@@ -468,3 +468,70 @@ class PosExplode(Explode):
 
 class PosExplodeOuter(PosExplode):
     outer = True
+
+
+class Stack(Expression):
+    """stack(n, e1..ek): n output rows per input row, ceil(k/n) columns
+    named col0..col{m-1}, short rows NULL-filled (reference GpuStack in
+    GpuOverrides.scala:3547 lowers to GpuGenerateExec). The engine
+    lowers it in DataFrame.select as a UNION of n row-projections —
+    columnar-friendly (no row expansion kernel) and exactly the
+    generator's multiset of rows."""
+
+    def __init__(self, n: int, *exprs):
+        if n <= 0:
+            raise SparkException("stack(): row count must be positive")
+        if not exprs:
+            raise SparkException("stack() needs at least one value")
+        self.n = int(n)
+        self.children = list(exprs)
+
+    def _params(self):
+        return str(self.n)
+
+    def with_children(self, children):
+        return Stack(self.n, *children)
+
+    @property
+    def ncols(self):
+        return -(-len(self.children) // self.n)
+
+    def output_fields(self):
+        cols = []
+        for j in range(self.ncols):
+            dt = self.children[j].data_type()
+            for r in range(1, self.n):
+                i = r * self.ncols + j
+                if i < len(self.children):
+                    other = self.children[i].data_type()
+                    if other != dt and not isinstance(dt, T.NullType):
+                        if isinstance(other, T.NullType):
+                            continue
+                        raise SparkException(
+                            f"stack(): column {j} mixes {dt!r} and "
+                            f"{other!r}")
+                    if isinstance(dt, T.NullType):
+                        dt = other
+            cols.append((f"col{j}", dt))
+        return cols
+
+    def row_exprs(self):
+        """The n per-row projections (typed-NULL padded)."""
+        fields = self.output_fields()
+        rows = []
+        for r in range(self.n):
+            row = []
+            for j, (_, dt) in enumerate(fields):
+                i = r * self.ncols + j
+                row.append(self.children[i] if i < len(self.children)
+                           else Literal(None, dt))
+            rows.append(row)
+        return rows
+
+    def data_type(self):
+        raise SparkException("stack() is only valid in select()")
+
+    def eval_tpu(self, ctx):
+        raise SparkException("stack() is only valid in select()")
+
+    eval_cpu = eval_tpu

@@ -12,6 +12,9 @@ from __future__ import annotations
 
 import datetime as _dt
 import hashlib
+import re as _re
+from urllib.parse import quote_plus as _quote_plus, \
+    unquote_plus as _unquote_plus
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -491,3 +494,238 @@ class JsonTuple(CpuRowFunction):
             ok.append(True)
         return CpuCol(self.result, np.array(out, object),
                       np.asarray(ok, np.bool_))
+
+
+# ---------------------------------------------------------------------------
+# Binary/codec breadth tier (reference stringFunctions.scala GpuSha1/
+# GpuHex family semantics, NumberConverter for conv)
+# ---------------------------------------------------------------------------
+
+class Sha1(CpuRowFunction):
+    name = "sha1"
+    result = T.STRING
+
+    def row_fn(self, s):
+        b = s.encode() if isinstance(s, str) else bytes(s)
+        return hashlib.sha1(b).hexdigest()
+
+
+class HexStr(CpuRowFunction):
+    """hex(): integers render as unsigned-64 uppercase hex, strings as
+    the hex of their utf-8 bytes (Spark Hex)."""
+
+    name = "hex"
+    result = T.STRING
+
+    def row_fn(self, v):
+        if isinstance(v, str):
+            return v.encode().hex().upper()
+        if isinstance(v, (bytes, bytearray)):
+            return bytes(v).hex().upper()
+        return format(int(v) & 0xFFFFFFFFFFFFFFFF, "X")
+
+
+class Unhex(CpuRowFunction):
+    """unhex(): odd-length input gets a leading zero nibble; any
+    non-hex character makes the row NULL (Spark Unhex). The decoded
+    bytes surface as a latin-1 string (the engine's binary carrier)."""
+
+    name = "unhex"
+    result = T.STRING
+
+    def row_fn(self, s):
+        if not isinstance(s, str):
+            return None
+        if len(s) % 2:
+            s = "0" + s
+        try:
+            return bytes.fromhex(s).decode("latin-1")
+        except ValueError:
+            return None
+
+
+class Bin(CpuRowFunction):
+    """bin(): Long.toBinaryString — the unsigned-64 binary rendering."""
+
+    name = "bin"
+    result = T.STRING
+
+    def row_fn(self, v):
+        return format(int(v) & 0xFFFFFFFFFFFFFFFF, "b")
+
+
+_CONV_DIGITS = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+class Conv(CpuRowFunction):
+    """conv(num, from_base, to_base): Java NumberConverter semantics —
+    case-insensitive digits, the longest valid prefix parses (empty
+    prefix is NULL), overflow CLAMPS to the unsigned-64 max (Hive's
+    converter, which Spark inherits), and a negative to_base renders
+    the SIGNED interpretation."""
+
+    name = "conv"
+    result = T.STRING
+
+    def row_fn(self, s):
+        fb, tb = self.params
+        # only TO_base may be negative (NumberConverter: fromBase must
+        # be a plain radix in [2, 36])
+        if not isinstance(s, str) or not (2 <= fb <= 36) \
+                or not (2 <= abs(tb) <= 36):
+            return None
+        s = s.strip().lower()
+        neg = s.startswith("-")
+        if neg:
+            s = s[1:]
+        v, seen, umax = 0, False, (1 << 64) - 1
+        for ch in s:
+            d = _CONV_DIGITS.find(ch)
+            if d < 0 or d >= fb:
+                break
+            v = min(v * fb + d, umax)
+            seen = True
+        if not seen:
+            return None
+        if neg:
+            v = (-v) & 0xFFFFFFFFFFFFFFFF
+        out_neg = False
+        if tb < 0 and v >= 1 << 63:  # signed rendering
+            v = (1 << 64) - v
+            out_neg = True
+        base = abs(tb)
+        digits = []
+        while True:
+            v, r = divmod(v, base)
+            digits.append(_CONV_DIGITS[r])
+            if v == 0:
+                break
+        return ("-" if out_neg else "") + "".join(reversed(digits)).upper()
+
+
+_BAD_ESCAPE = _re.compile(r"%(?![0-9a-fA-F]{2})")
+
+
+class UrlEncode(CpuRowFunction):
+    """url_encode(): java.net.URLEncoder form encoding (space -> '+';
+    '~' IS escaped, unlike python's quote which hardcodes it safe)."""
+
+    name = "url_encode"
+    result = T.STRING
+
+    def row_fn(self, s):
+        if not isinstance(s, str):
+            return None
+        return _quote_plus(s, safe="*-._").replace("~", "%7E")
+
+
+class UrlDecode(CpuRowFunction):
+    """url_decode(): inverse form decoding; malformed percent escapes
+    are an error in Spark — raised here too."""
+
+    name = "url_decode"
+    result = T.STRING
+
+    def row_fn(self, s):
+        if not isinstance(s, str):
+            return None
+        if _BAD_ESCAPE.search(s):
+            raise SparkException(f"invalid URL escape in {s!r}")
+        return _unquote_plus(s)
+
+
+class RegexpExtractAll(CpuRowFunction):
+    """regexp_extract_all(s, pattern, group) -> array<string> (reference
+    GpuRegExpExtractAll). Invalid group index raises like Spark."""
+
+    name = "regexp_extract_all"
+
+    @property
+    def result(self):
+        from spark_rapids_tpu import types as _T
+        return _T.ArrayType(_T.STRING)
+
+    def data_type(self):
+        return self.result
+
+    def row_fn(self, s):
+        import re
+        pattern, idx = self.params
+        if not hasattr(self, "_prog"):
+            self._prog = re.compile(pattern)
+            if idx < 0 or idx > self._prog.groups:
+                raise SparkException(
+                    f"regexp_extract_all: group {idx} out of range")
+        if not isinstance(s, str):
+            return None
+        out = []
+        for m in self._prog.finditer(s):
+            g = m.group(idx)
+            out.append(g if g is not None else "")
+        return out
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        n = len(c.values)
+        vals = np.empty(n, object)
+        valid = c.valid.copy()
+        for i in range(n):
+            r = self.row_fn(c.values[i]) if valid[i] else None
+            if r is None:
+                valid[i] = False
+            vals[i] = r
+        return CpuCol(self.result, vals, valid)
+
+
+class StructsToJson(CpuRowFunction):
+    """to_json(struct|map|array) (reference GpuStructsToJson). NULL
+    fields are omitted, Spark's default JacksonGenerator behavior. The
+    engine carries MAP values as [key, value] pair-lists; the declared
+    column type (not the python shape) picks the object rendering, so
+    a map renders as a JSON object, recursively."""
+
+    name = "to_json"
+    result = T.STRING
+
+    def row_fn(self, v):
+        if v is None:
+            return None
+        return self._enc_typed(v, self.children[0].data_type())
+
+    def _enc_typed(self, v, dt):
+        import json
+        if v is None:
+            return "null"
+        if isinstance(dt, T.MapType):
+            items = [(k, self._enc_typed(x, dt.value)) for k, x in v
+                     if x is not None]
+            return "{" + ",".join(f"{json.dumps(str(k))}:{x}"
+                                  for k, x in items) + "}"
+        if isinstance(dt, T.ArrayType):
+            return "[" + ",".join(self._enc_typed(x, dt.element)
+                                  for x in v) + "]"
+        if isinstance(dt, T.StructType) and isinstance(v, dict):
+            fields = {f.name: f.dtype for f in dt.fields}
+            items = [(k, self._enc_typed(x, fields.get(k)))
+                     for k, x in v.items() if x is not None]
+            return "{" + ",".join(f"{json.dumps(str(k))}:{x}"
+                                  for k, x in items) + "}"
+        return self._enc(v)
+
+    def _enc(self, v):
+        import json
+        if isinstance(v, dict):
+            items = [(k, self._enc(x)) for k, x in v.items()
+                     if x is not None]
+            return "{" + ",".join(f"{json.dumps(str(k))}:{x}"
+                                  for k, x in items) + "}"
+        if isinstance(v, (list, tuple)):
+            return "[" + ",".join(
+                "null" if x is None else self._enc(x) for x in v) + "]"
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, _dt.datetime):
+            return json.dumps(v.isoformat())
+        if isinstance(v, _dt.date):
+            return json.dumps(v.isoformat())
+        return json.dumps(v)

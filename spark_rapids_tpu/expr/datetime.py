@@ -184,6 +184,34 @@ class DayOfWeek(Expression):
         return CpuCol(T.INT32, (np.mod(days + 4, 7) + 1).astype(np.int32), c.valid)
 
 
+class WeekDay(Expression):
+    """Spark weekday: 0 = Monday ... 6 = Sunday (reference registers
+    WeekDay alongside DayOfWeek in GpuOverrides)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return T.INT32
+
+    def with_children(self, children):
+        return WeekDay(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        days = _days_of(c.data.astype(jnp.int64),
+                        isinstance(c.dtype, T.TimestampType))
+        wd = jnp.mod(days + 3, 7)  # 1970-01-01 was a Thursday (=3)
+        return ColumnVector(T.INT32, wd.astype(jnp.int32), _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        is_ts = isinstance(c.dtype, T.TimestampType)
+        days = (np.floor_divide(c.values.astype(np.int64), 86_400_000_000)
+                if is_ts else c.values.astype(np.int64))
+        return CpuCol(T.INT32, np.mod(days + 3, 7).astype(np.int32), c.valid)
+
+
 class DateAdd(Expression):
     """date_add(date, n)."""
 
@@ -412,6 +440,77 @@ class AddMonths(Expression):
                 nd = min(d.day, calendar.monthrange(ny, nm)[1])
                 out[i] = (datetime.date(ny, nm, nd) - datetime.date(1970, 1, 1)).days
         return CpuCol(T.DATE, out, valid)
+
+
+class TruncTimestamp(Expression):
+    """date_trunc(fmt, ts) -> timestamp (reference GpuOverrides registers
+    TruncTimestamp; GpuDateTimeUtils truncation levels). Sub-day levels
+    are floor-mod on microseconds; day-and-up reuses the civil-date
+    truncation and returns midnight. Unsupported fmt yields NULL rows
+    (Spark's null-on-bad-format behavior outside ANSI)."""
+
+    _US = {"microsecond": 1, "millisecond": 1_000, "second": 1_000_000,
+           "minute": 60_000_000, "hour": 3_600_000_000,
+           "day": 86_400_000_000, "dd": 86_400_000_000}
+    _CIVIL = {"week": "w", "month": "m", "mon": "m", "mm": "m",
+              "quarter": "q", "year": "y", "yyyy": "y", "yy": "y"}
+
+    def __init__(self, child, fmt: str):
+        self.children = [child]
+        self.fmt = fmt.lower()
+
+    def _params(self):
+        return self.fmt
+
+    def data_type(self):
+        return T.TIMESTAMP
+
+    def with_children(self, children):
+        return TruncTimestamp(children[0], self.fmt)
+
+    def _trunc_us(self, us, mod, floordiv, ones_like):
+        if self.fmt in self._US:
+            return us - mod(us, self._US[self.fmt])
+        day_us = 86_400_000_000
+        days = floordiv(us, day_us)
+        kind = self._CIVIL[self.fmt]
+        if kind == "w":
+            days = days - mod(days + 3, 7)
+        else:
+            y, m, d = _civil_from_days(days)
+            if kind == "y":
+                days = _days_from_civil(y, ones_like(m), ones_like(d))
+            elif kind == "m":
+                days = _days_from_civil(y, m, ones_like(d))
+            else:
+                qm = ((m - 1) // 3) * 3 + 1
+                days = _days_from_civil(y, qm, ones_like(d))
+        return days * day_us
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        us = c.data.astype(jnp.int64)
+        if not isinstance(c.dtype, T.TimestampType):
+            us = us * 86_400_000_000  # DATE child: implicit cast (days)
+        if self.fmt not in self._US and self.fmt not in self._CIVIL:
+            n = us.shape[0]
+            return ColumnVector(T.TIMESTAMP, jnp.zeros(n, jnp.int64),
+                                jnp.zeros(n, jnp.bool_))
+        out = self._trunc_us(us, jnp.mod, jnp.floor_divide, jnp.ones_like)
+        return ColumnVector(T.TIMESTAMP, out, _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        us = c.values.astype(np.int64)
+        if not isinstance(c.dtype, T.TimestampType):
+            us = us * 86_400_000_000
+        if self.fmt not in self._US and self.fmt not in self._CIVIL:
+            return CpuCol(T.TIMESTAMP, np.zeros(len(us), np.int64),
+                          np.zeros(len(us), np.bool_))
+        out = np.asarray(  # civil helpers are jnp-backed; pin numpy out
+            self._trunc_us(us, np.mod, np.floor_divide, np.ones_like),
+            np.int64)
+        return CpuCol(T.TIMESTAMP, out, c.valid)
 
 
 class TruncDate(Expression):

@@ -15,7 +15,9 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnVector
-from spark_rapids_tpu.expr.core import CpuCol, Expression, _valid_of
+from spark_rapids_tpu.expr.core import (
+    CpuCol, Expression, _promote, _promote_cpu, _valid_of,
+)
 
 
 class _UnaryDouble(Expression):
@@ -81,6 +83,23 @@ class Log2(_UnaryDouble):
     fn_tpu = staticmethod(jnp.log2)
     fn_cpu = staticmethod(np.log2)
     domain = staticmethod(lambda v: v > 0)
+
+
+class Acosh(_UnaryDouble):
+    """acosh (reference mathExpressions.scala GpuAcosh): out-of-domain
+    inputs produce NaN like Spark's log-formula evaluation, not NULL."""
+    fn_tpu = staticmethod(jnp.arccosh)
+    fn_cpu = staticmethod(np.arccosh)
+
+
+class Asinh(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.arcsinh)
+    fn_cpu = staticmethod(np.arcsinh)
+
+
+class Atanh(_UnaryDouble):
+    fn_tpu = staticmethod(jnp.arctanh)
+    fn_cpu = staticmethod(np.arctanh)
 
 
 class Sin(_UnaryDouble):
@@ -600,6 +619,42 @@ class Hypot(Expression):
                       l.valid & r.valid)
 
 
+class Logarithm(Expression):
+    """log(base, expr) (reference GpuLogarithm,
+    mathExpressions.scala): ln(expr)/ln(base), NULL when either side
+    is non-positive (non-ANSI strictness; base == 1 keeps Java's
+    divide-by-zero Inf/NaN result)."""
+
+    def __init__(self, base, child):
+        self.children = [base, child]
+
+    def data_type(self):
+        return T.FLOAT64
+
+    def with_children(self, children):
+        return Logarithm(children[0], children[1])
+
+    def eval_tpu(self, ctx):
+        b = self.children[0].eval_tpu(ctx)
+        c = self.children[1].eval_tpu(ctx)
+        bv = b.data.astype(np.float64)
+        cv = c.data.astype(np.float64)
+        ok = (bv > 0) & (cv > 0)
+        v = jnp.log(jnp.where(ok, cv, 1.0)) / jnp.log(jnp.where(ok, bv, 2.0))
+        return ColumnVector(T.FLOAT64, v,
+                            _valid_of(b, ctx) & _valid_of(c, ctx) & ok)
+
+    def eval_cpu(self, cols, ansi=False):
+        b = self.children[0].eval_cpu(cols, ansi)
+        c = self.children[1].eval_cpu(cols, ansi)
+        bv = b.values.astype(np.float64)
+        cv = c.values.astype(np.float64)
+        ok = (bv > 0) & (cv > 0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            v = np.log(np.where(ok, cv, 1.0)) / np.log(np.where(ok, bv, 2.0))
+        return CpuCol(T.FLOAT64, v, b.valid & c.valid & ok)
+
+
 #: 0!..20! fit int64 (Spark returns null outside [0, 20])
 _FACTORIALS = np.cumprod([1] + list(range(1, 21)), dtype=np.int64)
 
@@ -627,6 +682,76 @@ class Factorial(Expression):
         ok = (v >= 0) & (v <= 20)
         out = _FACTORIALS[np.clip(v, 0, 20)]
         return CpuCol(T.INT64, out, c.valid & ok)
+
+
+class Pmod(Expression):
+    """pmod(a, b): the non-negative remainder ((a % b) + b) % b
+    (reference GpuPmod); b == 0 is NULL outside ANSI."""
+
+    def __init__(self, left, right):
+        self.children = [left, right]
+
+    def data_type(self):
+        # same numeric promotion as Remainder (mixed widths/floats)
+        return T.common_type(self.children[0].data_type(),
+                             self.children[1].data_type())
+
+    def with_children(self, children):
+        return Pmod(children[0], children[1])
+
+    # Spark pmod is Java % (fmod: dividend sign) followed by ONE
+    # conditional fold: if r < 0 then r = (r + n) % n. Both operands go
+    # through the same numeric promotion as Remainder (decimal unscaled
+    # values rescale to the common type before the mod).
+
+    def eval_tpu(self, ctx):
+        l = self.children[0].eval_tpu(ctx)
+        r = self.children[1].eval_tpu(ctx)
+        out = self.data_type()
+        ld, rd = _promote(l, r, out)
+        valid = _valid_of(l, ctx) & _valid_of(r, ctx)
+        # decimals promote to unscaled int64 lanes: integer arithmetic
+        int_like = out.is_integral or isinstance(out, T.DecimalType)
+        zero = rd == 0
+        safe = jnp.where(zero, 1, rd) if int_like \
+            else jnp.where(zero, 1.0, rd)
+        rem = jnp.fmod(ld, safe)
+        rem = jnp.where(rem < 0, jnp.fmod(rem + safe, safe), rem)
+        return ColumnVector(out, jnp.where(zero, 0 if int_like else jnp.nan,
+                                           rem), valid & ~zero)
+
+    def eval_cpu(self, cols, ansi=False):
+        l = self.children[0].eval_cpu(cols, ansi)
+        r = self.children[1].eval_cpu(cols, ansi)
+        out = self.data_type()
+        ld, rd = _promote_cpu(l, r, out)
+        valid = l.valid & r.valid
+        with np.errstate(all="ignore"):
+            zero = rd == 0
+            safe = np.where(zero, 1, rd)
+            rem = np.fmod(ld, safe)
+            rem = np.where(rem < 0, np.fmod(rem + safe, safe), rem)
+            rem = np.where(zero, 0, rem)
+        return CpuCol(out, rem, valid & ~zero)
+
+
+class UnaryPositive(Expression):
+    """+expr: the identity (reference registers it as a pass-through)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def with_children(self, children):
+        return UnaryPositive(children[0])
+
+    def eval_tpu(self, ctx):
+        return self.children[0].eval_tpu(ctx)
+
+    def eval_cpu(self, cols, ansi=False):
+        return self.children[0].eval_cpu(cols, ansi)
 
 
 class NaNvl(Expression):

@@ -393,6 +393,23 @@ expr_rule(_CPUF.Soundex, Sigs.COMMON, Sigs.COMMON, "soundex",
 expr_rule(_CPUF.JsonTuple, _ARR, _ARR, "json_tuple",
           extra=_cpu_tier("json_tuple runs on CPU"))
 
+for _c, _doc in ((_CPUF.Sha1, "sha1"), (_CPUF.HexStr, "hex"),
+                 (_CPUF.Unhex, "unhex"), (_CPUF.Bin, "bin"),
+                 (_CPUF.Conv, "conv"), (_CPUF.UrlEncode, "url_encode"),
+                 (_CPUF.UrlDecode, "url_decode")):
+    expr_rule(_c, Sigs.COMMON, Sigs.COMMON, _doc,
+              extra=_cpu_tier(f"{_doc} runs on CPU"))
+expr_rule(MA.Logarithm, Sigs.COMMON, Sigs.COMMON, "log(base, expr)")
+expr_rule(CX.Stack, Sigs.COMMON, Sigs.COMMON,
+          "stack(n, ...) (lowered to a union of projections)")
+for _cls in (MA.Acosh, MA.Asinh, MA.Atanh, MA.Pmod, MA.UnaryPositive,
+             DT.WeekDay, DT.TruncTimestamp):
+    expr_rule(_cls, Sigs.COMMON, Sigs.COMMON, _cls.__name__.lower())
+expr_rule(_CPUF.RegexpExtractAll, _ARR, _ARR, "regexp_extract_all",
+          extra=_cpu_tier("regexp_extract_all runs on CPU"))
+expr_rule(_CPUF.StructsToJson, _ARR, _ARR, "to_json",
+          extra=_cpu_tier("to_json runs on CPU"))
+
 expr_rule(_MISC.Crc32, Sigs.COMMON, Sigs.COMMON, "crc32")
 expr_rule(_MISC.XxHash64, Sigs.COMMON, Sigs.COMMON,
           "xxhash64 (Spark-compatible, seed 42)",

@@ -60,9 +60,15 @@ def shutdown_pool() -> None:
 def _run_chunk(payload: bytes):
     """Worker body. UDF exceptions are RETURNED (tagged), not raised:
     the parent must distinguish 'the UDF failed' (propagate, matching
-    in-process behavior) from 'the pool failed' (decline + fall back)."""
+    in-process behavior) from 'the pool failed' (decline + fall back).
+    Unpickling failures are the POOL's problem (e.g. a __main__-defined
+    fn that pickles by reference but has no symbol in the spawn child),
+    so they get their own tag and the caller declines."""
     try:
         fn, rows = pickle.loads(payload)
+    except Exception as e:  # noqa: BLE001
+        return ("badenv", f"{type(e).__name__}: {e}")
+    try:
         return ("ok", [fn(*args) for args in rows])
     except Exception as e:  # noqa: BLE001
         return ("err", f"{type(e).__name__}: {e}")
@@ -96,6 +102,8 @@ def map_rows(fn, rows: List[tuple], parallelism: int,
     except Exception:  # noqa: BLE001 - POOL failure: degrade + reset
         shutdown_pool()
         return None
+    if any(tag == "badenv" for tag, _ in parts):
+        return None  # workers can't reconstruct the fn: fall back
     out: list = []
     for tag, part in parts:
         if tag == "err":

@@ -62,6 +62,55 @@ class DataFrame:
         from spark_rapids_tpu.expr import window as WE
         from spark_rapids_tpu.expr import complex as CX
 
+        stacks = [(i, e) for i, e in enumerate(es)
+                  if isinstance(e, CX.Stack)
+                  or (isinstance(e, E.Alias)
+                      and isinstance(e.children[0], CX.Stack))]
+        if stacks:
+            if len(stacks) > 1:
+                raise E.SparkException(
+                    "only one generator allowed per select clause")
+            i, se = stacks[0]
+            alias = se.name if isinstance(se, E.Alias) else None
+            st = se.children[0] if isinstance(se, E.Alias) else se
+            st = CX.Stack(st.n, *[P.bind_expr(c, self.plan.schema)
+                                  for c in st.children])
+            names = [n for n, _ in st.output_fields()]
+            if alias is not None:
+                if len(names) != 1:
+                    raise E.SparkException(
+                        "stack() alias needs a single output column, "
+                        f"got {len(names)}")
+                names = [alias]
+
+            def _plain(e):
+                if isinstance(e, (WE.WindowExpr, CX.Explode, CX.Stack)):
+                    return False
+                return all(_plain(c) for c in e.children)
+
+            if all(_plain(e) for e in es[:i] + es[i + 1:]):
+                # one-pass lowering onto the Expand node (multiple
+                # projections per input row, like the ROLLUP rewrite)
+                out_names = ([P.expr_name(e, j)
+                              for j, e in enumerate(es[:i])]
+                             + names
+                             + [P.expr_name(e, i + 1 + j)
+                                for j, e in enumerate(es[i + 1:])])
+                projections = [es[:i] + row + es[i + 1:]
+                               for row in st.row_exprs()]
+                return DataFrame(P.Expand(projections, out_names,
+                                          self.plan), self.session)
+            # other items carry window/explode markers that need their
+            # own lowering: fall back to one select per stack row
+            out = None
+            for row in st.row_exprs():
+                es_r = (es[:i]
+                        + [E.Alias(c, n) for c, n in zip(row, names)]
+                        + es[i + 1:])
+                part = self.select(*es_r)
+                out = part if out is None else out.union(part)
+            return out
+
         gens = [(i, e) for i, e in enumerate(es)
                 if isinstance(e, CX.Explode)
                 or (isinstance(e, E.Alias) and isinstance(e.children[0],
@@ -270,6 +319,75 @@ class GroupedData:
     def count(self) -> DataFrame:
         from spark_rapids_tpu.expr.aggregates import CountAll
         return self.agg(NamedAgg(CountAll(), "count"))
+
+    def pivot(self, pivot_col, values=None) -> "PivotedData":
+        """Spark GroupedData.pivot. The engine lowers a pivot to
+        conditional aggregation — one `agg(if(pivot = v, child, null))`
+        per value — rather than a row-shuffling pivot kernel (the
+        reference lowers to GpuPivotFirst, GpuOverrides.scala expr
+        [PivotFirst], which is the same gather-by-value idea on GPU).
+        With no explicit values the distinct set is computed eagerly,
+        like Spark, capped at 10000."""
+        pc = _e(pivot_col)
+        if values is None:
+            rows = (self.df.select(pc.alias("__pv")).distinct()
+                    .limit(10_001).collect().column("__pv").to_pylist())
+            if len(rows) > 10_000:
+                raise E.SparkException(
+                    "pivot: more than 10000 distinct values; pass an "
+                    "explicit value list")
+            # Spark keeps a NULL pivot value as its own column, sorted
+            # first (ascending nulls-first collection order)
+            values = sorted(rows, key=lambda v: (v is not None, v))
+        return PivotedData(self.keys, self.df, pc, list(values))
+
+
+class PivotedData:
+    def __init__(self, keys, df: DataFrame, pivot_col, values):
+        self.keys = keys
+        self.df = df
+        self.pivot_col = pivot_col
+        self.values = values
+
+    def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu.expr.aggregates import CountAll, Count
+        named = []
+        for i, a in enumerate(aggs):
+            if isinstance(a, NamedAgg):
+                named.append((a.fn, a.name if len(aggs) > 1 else None))
+            elif isinstance(a, AggFunction):
+                named.append((a, _default_agg_name(a, i)
+                              if len(aggs) > 1 else None))
+            else:
+                raise TypeError(f"not an aggregate: {a!r}")
+        schema = self.df.plan.schema
+        out = []
+        for v in self.values:
+            pc = P.bind_expr(self.pivot_col, schema)
+            # a NULL pivot value needs null-safe matching
+            cond = E.IsNull(pc) if v is None else pc == E.lit(v)
+            for a, suffix in named:
+                if isinstance(a, CountAll):
+                    # count(*) under a pivot counts matching rows
+                    from spark_rapids_tpu import types as T
+                    cell = Count(E.If(cond, E.lit(1),
+                                      E.Literal(None, T.INT32)))
+                else:
+                    # EVERY child is gated (min_by's ordering column
+                    # must not see other pivot cells' rows)
+                    import copy
+                    gated = []
+                    for ch in a.children:
+                        ch = P.bind_expr(ch, schema)
+                        gated.append(E.If(cond, ch,
+                                          E.Literal(None, ch.data_type())))
+                    cell = copy.copy(a)  # keeps extra params (e.g. p)
+                    cell.children = gated
+                vs = "null" if v is None else str(v)
+                name = vs if suffix is None else f"{vs}_{suffix}"
+                out.append(NamedAgg(cell, name))
+        return DataFrame(P.Aggregate(self.keys, out, self.df.plan),
+                         self.df.session)
 
 
 def _index_of(names: List[str], name: str) -> int:

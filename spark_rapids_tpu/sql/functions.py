@@ -475,8 +475,11 @@ def exp(c):
     return MA.Exp(_e(c))
 
 
-def log(c):
-    return MA.Log(_e(c))
+def log(arg1, arg2=None):
+    """log(col) is the natural log; log(base, col) is Logarithm."""
+    if arg2 is None:
+        return MA.Log(_e(arg1))
+    return MA.Logarithm(_e(arg1), _e(arg2))
 
 
 def log10(c):
@@ -965,3 +968,81 @@ def map_from_arrays(k, v):
 def str_to_map(c, pair_delim=",", kv_delim=":"):
     from spark_rapids_tpu.expr.array_ops import StrToMap
     return StrToMap(_e(c), pair_delim, kv_delim)
+
+
+def sha1(c):
+    from spark_rapids_tpu.expr.cpu_functions import Sha1
+    return Sha1(_e(c))
+
+
+def hex(c):  # noqa: A001 - Spark name
+    from spark_rapids_tpu.expr.cpu_functions import HexStr
+    return HexStr(_e(c))
+
+
+def unhex(c):
+    from spark_rapids_tpu.expr.cpu_functions import Unhex
+    return Unhex(_e(c))
+
+
+def bin(c):  # noqa: A001 - Spark name
+    from spark_rapids_tpu.expr.cpu_functions import Bin
+    return Bin(_e(c))
+
+
+def conv(c, from_base, to_base):
+    from spark_rapids_tpu.expr.cpu_functions import Conv
+    return Conv(_e(c), params=(int(from_base), int(to_base)))
+
+
+def url_encode(c):
+    from spark_rapids_tpu.expr.cpu_functions import UrlEncode
+    return UrlEncode(_e(c))
+
+
+def url_decode(c):
+    from spark_rapids_tpu.expr.cpu_functions import UrlDecode
+    return UrlDecode(_e(c))
+
+
+def stack(n, *cols):
+    from spark_rapids_tpu.expr.complex import Stack
+    return Stack(n, *[_e(c) for c in cols])
+
+
+def acosh(c):
+    return MA.Acosh(_e(c))
+
+
+def asinh(c):
+    return MA.Asinh(_e(c))
+
+
+def atanh(c):
+    return MA.Atanh(_e(c))
+
+
+def pmod(a, b):
+    return MA.Pmod(_e(a), _e(b))
+
+
+def positive(c):
+    return MA.UnaryPositive(_e(c))
+
+
+def weekday(c):
+    return DT.WeekDay(_e(c))
+
+
+def date_trunc(fmt, c):
+    return DT.TruncTimestamp(_e(c), fmt)
+
+
+def regexp_extract_all(c, pattern, idx=1):
+    from spark_rapids_tpu.expr.cpu_functions import RegexpExtractAll
+    return RegexpExtractAll(_e(c), params=(pattern, idx))
+
+
+def to_json(c):
+    from spark_rapids_tpu.expr.cpu_functions import StructsToJson
+    return StructsToJson(_e(c))

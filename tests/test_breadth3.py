@@ -1,0 +1,255 @@
+"""Round-4 breadth tier 3: codec/hash expressions, conv, log(base, x),
+stack generator (reference GpuOverrides.scala registrations for Conv,
+Logarithm, Stack; stringFunctions.scala for the codec family)."""
+import hashlib
+
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import SparkException, col, lit
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _one(df, name):
+    return df.to_pydict()[name]
+
+
+def test_sha1_md5_parity(session):
+    df = session.create_dataframe({"s": ["ab", "", "xyz"]})
+    assert _one(df.select(F.sha1(col("s")).alias("h")), "h") == [
+        hashlib.sha1(b"ab").hexdigest(), hashlib.sha1(b"").hexdigest(),
+        hashlib.sha1(b"xyz").hexdigest()]
+
+
+def test_hex_unhex_roundtrip(session):
+    df = session.create_dataframe({"i": [0, 17, -1], "s": ["Spark", "", "A"]})
+    # Spark: hex(17)='11', hex(-1)='FFFFFFFFFFFFFFFF' (unsigned 64)
+    assert _one(df.select(F.hex(col("i")).alias("h")), "h") == \
+        ["0", "11", "FFFFFFFFFFFFFFFF"]
+    assert _one(df.select(F.hex(col("s")).alias("h")), "h") == \
+        ["537061726B", "", "41"]
+    rt = df.select(F.unhex(F.hex(col("s"))).alias("u"))
+    assert _one(rt, "u") == ["Spark", "", "A"]
+    # odd length pads a leading zero; non-hex chars are NULL
+    d2 = session.create_dataframe({"x": ["F", "zz"]})
+    assert _one(d2.select(F.unhex(col("x")).alias("u")), "u") == \
+        ["\x0f", None]
+
+
+def test_bin(session):
+    df = session.create_dataframe({"i": [0, 13, -1]})
+    assert _one(df.select(F.bin(col("i")).alias("b")), "b") == \
+        ["0", "1101", "1" * 64]
+
+
+def test_conv_spark_semantics(session):
+    df = session.create_dataframe({"s": ["100", "-10", "ab", "zz", ""]})
+    # Spark: conv('100',2,10)='4'; conv('-10',16,10) is the unsigned
+    # 64-bit value; conv('-10',16,-10)='-16'; invalid prefix is NULL
+    assert _one(df.select(F.conv(col("s"), 2, 10).alias("c")), "c") == \
+        ["4", "18446744073709551614", None, None, None]
+    assert _one(df.select(F.conv(col("s"), 16, 10).alias("c")), "c") == \
+        ["256", "18446744073709551600", "171", None, None]
+    assert _one(df.select(F.conv(col("s"), 16, -10).alias("c")), "c") == \
+        ["256", "-16", "171", None, None]
+    assert _one(df.select(F.conv(col("s"), 36, 16).alias("c")), "c")[3] \
+        == "50F"  # zz base36 = 35*36+35 = 1295
+    # bases outside [2,36] are NULL
+    assert _one(df.select(F.conv(col("s"), 1, 10).alias("c")), "c") == \
+        [None] * 5
+
+
+def test_url_encode_decode(session):
+    df = session.create_dataframe({"s": ["a b&c", "100%", "x.y-z_*"]})
+    enc = _one(df.select(F.url_encode(col("s")).alias("e")), "e")
+    assert enc == ["a+b%26c", "100%25", "x.y-z_*"]
+    dec = df.select(F.url_decode(F.url_encode(col("s"))).alias("d"))
+    assert _one(dec, "d") == ["a b&c", "100%", "x.y-z_*"]
+    bad = session.create_dataframe({"s": ["%zz"]})
+    with pytest.raises(SparkException):
+        bad.select(F.url_decode(col("s")).alias("d")).collect()
+
+
+def test_logarithm(session):
+    df = session.create_dataframe({"x": [8.0, 1.0, 0.0, -2.0]})
+    got = _one(df.select(F.log(lit(2.0), col("x")).alias("l")), "l")
+    assert got[0] == 3.0 and got[1] == 0.0
+    assert got[2] is None and got[3] is None  # non-positive -> NULL
+    # single-arg log stays natural log
+    import math
+    nat = _one(df.select(F.log(col("x")).alias("l")), "l")
+    assert nat[0] == pytest.approx(math.log(8.0))
+
+
+def test_stack_basic(session):
+    df = session.create_dataframe({"a": [1, 2], "b": [10, 20]})
+    out = df.select(F.stack(2, col("a"), col("b"))).to_pydict()
+    assert sorted(out["col0"]) == [1, 2, 10, 20]
+    # ragged tail NULL-fills
+    out2 = df.select(col("a"),
+                     F.stack(2, col("a"), col("b"),
+                             col("a") + lit(100))).to_pydict()
+    assert sorted(x for x in out2["col0"]) == [1, 2, 101, 102]
+    assert sorted([x for x in out2["col1"] if x is not None]) == [10, 20]
+    assert out2["col1"].count(None) == 2
+    # passthrough column duplicates per generated row
+    assert sorted(out2["a"]) == [1, 1, 2, 2]
+
+
+def test_stack_aggregates_like_spark(session):
+    # the union lowering must behave as a generator feeding an agg
+    df = session.create_dataframe({"k": [1, 1, 2], "x": [1.0, 2.0, 3.0],
+                                   "y": [10.0, 20.0, 30.0]})
+    out = (df.select(col("k"), F.stack(2, col("x"), col("y")))
+           .group_by("k").agg(F.sum(col("col0")).alias("s"))
+           .order_by(col("k").asc()).to_pydict())
+    assert out["s"] == [33.0, 33.0]
+
+
+def test_stack_type_mismatch_raises(session):
+    df = session.create_dataframe({"a": [1], "s": ["x"]})
+    with pytest.raises(SparkException):
+        df.select(F.stack(2, col("a"), col("s"))).collect()
+
+
+def test_inverse_hyperbolic_and_pmod(session):
+    import math
+    df = session.create_dataframe({"x": [2.0, 0.5], "a": [7, -7],
+                                   "b": [3, 0]})
+    got = df.select(F.acosh(col("x")).alias("ach"),
+                    F.asinh(col("x")).alias("ash"),
+                    F.atanh(col("x")).alias("ath"),
+                    F.pmod(col("a"), col("b")).alias("p")).to_pydict()
+    assert got["ach"][0] == pytest.approx(math.acosh(2.0))
+    assert math.isnan(got["ach"][1])  # out of domain -> NaN, not NULL
+    assert got["ash"][1] == pytest.approx(math.asinh(0.5))
+    assert got["ath"][1] == pytest.approx(math.atanh(0.5))
+    assert got["p"] == [1, None]  # pmod(7,3)=1; pmod(x,0) NULL
+    # all four sign cases (Spark: Java % then one conditional +n fold;
+    # pmod(-7, -3) stays NEGATIVE)
+    sg = session.create_dataframe({"a": [-7, 7, -7], "b": [3, -3, -3]})
+    assert _one(sg.select(F.pmod(col("a"), col("b")).alias("p")), "p") \
+        == [2, 1, -1]
+    # mixed widths promote like Remainder (no int32 truncation)
+    mx = session.create_dataframe({"a": [3]})
+    assert _one(mx.select(
+        F.pmod(col("a"), lit(5_000_000_000)).alias("p")), "p") \
+        == [3]
+
+
+def test_weekday_and_date_trunc(session):
+    import datetime as dt
+    df = session.create_dataframe(
+        {"ts": [dt.datetime(2024, 5, 17, 13, 45, 31),
+                dt.datetime(1969, 12, 30, 23, 59, 59)]})
+    assert _one(df.select(F.weekday(col("ts")).alias("w")), "w") == [4, 1]
+    got = df.select(F.date_trunc("hour", col("ts")).alias("h"),
+                    F.date_trunc("quarter", col("ts")).alias("q")
+                    ).to_pydict()
+    # pre-epoch trunc must floor (not round toward zero)
+    assert got["h"] == [dt.datetime(2024, 5, 17, 13, 0),
+                        dt.datetime(1969, 12, 30, 23, 0)]
+    assert got["q"] == [dt.datetime(2024, 4, 1), dt.datetime(1969, 10, 1)]
+
+
+def test_regexp_extract_all(session):
+    df = session.create_dataframe({"s": ["a1b22c333", "none", None]})
+    got = _one(df.select(
+        F.regexp_extract_all(col("s"), r"(\d+)", 1).alias("r")), "r")
+    assert got == [["1", "22", "333"], [], None]
+    with pytest.raises(SparkException):
+        df.select(F.regexp_extract_all(col("s"), r"(\d+)", 3).alias("r")
+                  ).collect()
+
+
+def test_to_json(session):
+    df = session.create_dataframe(
+        {"m": [{"a": 1, "b": None}, {"a": 2, "b": "x"}]})
+    # NULL fields are omitted (Spark JacksonGenerator default)
+    assert _one(df.select(F.to_json(col("m")).alias("j")), "j") == \
+        ['{"a":1}', '{"a":2,"b":"x"}']
+
+
+def test_pivot_explicit_and_inferred(session):
+    df = session.create_dataframe(
+        {"k": [1, 1, 2, 2, 2], "c": ["a", "b", "a", "a", "b"],
+         "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    got = (df.group_by("k").pivot(col("c"), ["a", "b"])
+           .agg(F.sum(col("v"))).order_by(col("k").asc()).to_pydict())
+    assert got == {"k": [1, 2], "a": [1.0, 7.0], "b": [2.0, 5.0]}
+    # inferred values match the explicit list
+    inf = (df.group_by("k").pivot(col("c")).agg(F.sum(col("v")))
+           .order_by(col("k").asc()).to_pydict())
+    assert inf == got
+    # multiple aggs suffix with the agg name (Spark {value}_{name})
+    multi = (df.group_by("k").pivot(col("c"))
+             .agg(F.sum(col("v")).alias("s"),
+                  F.count(col("v")).alias("n"))
+             .order_by(col("k").asc()).to_pydict())
+    assert multi["a_s"] == [1.0, 7.0] and multi["a_n"] == [1, 2]
+    # count(*) counts matching rows; groups with no match get 0
+    cnt = (df.group_by("k").pivot(col("c")).agg(F.count())
+           .order_by(col("k").asc()).to_pydict())
+    assert cnt == {"k": [1, 2], "a": [1, 2], "b": [1, 1]}
+
+
+def test_pivot_null_value_column(session):
+    # Spark keeps a NULL pivot value as its own (first) output column
+    df = session.create_dataframe(
+        {"k": [1, 1, 1], "c": ["a", None, None], "v": [1.0, 5.0, 7.0]})
+    got = (df.group_by("k").pivot(col("c")).agg(F.sum(col("v")))
+           .to_pydict())
+    assert got["null"] == [12.0] and got["a"] == [1.0]
+
+
+def test_date_trunc_on_date_column(session):
+    import datetime as dt
+    df = session.create_dataframe({"d": [dt.date(2024, 5, 17)]})
+    got = _one(df.select(F.date_trunc("year", col("d")).alias("t")), "t")
+    # implicit date -> timestamp cast, not day-counts-as-micros
+    assert got == [dt.datetime(2024, 1, 1)]
+
+
+def test_conv_rejects_negative_from_base(session):
+    df = session.create_dataframe({"s": ["10"]})
+    assert _one(df.select(F.conv(col("s"), -10, 10).alias("c")), "c") \
+        == [None]  # only to_base may be negative (NumberConverter)
+
+
+def test_url_encode_tilde(session):
+    df = session.create_dataframe({"s": ["a~b"]})
+    # java.net.URLEncoder escapes '~' (python's quote never does)
+    assert _one(df.select(F.url_encode(col("s")).alias("e")), "e") \
+        == ["a%7Eb"]
+
+
+def test_pivot_gates_every_aggregate_child(session):
+    # min_by's ORDERING column must also be gated per pivot cell
+    df = session.create_dataframe(
+        {"g": [1, 1, 1, 1], "cat": ["A", "A", "B", "B"],
+         "x": [10.0, 20.0, 30.0, 40.0], "y": [5.0, 6.0, 1.0, 2.0]})
+    got = (df.group_by("g").pivot(col("cat"), ["A", "B"])
+           .agg(F.min_by(col("x"), col("y"))).to_pydict())
+    assert got["A"] == [10.0] and got["B"] == [30.0]
+
+
+def test_to_json_map_renders_object(session):
+    df = session.create_dataframe({"s": ["k:1,j:2"]})
+    out = _one(df.select(
+        F.to_json(F.str_to_map(col("s"))).alias("j")), "j")
+    assert out == ['{"k":"1","j":"2"}']
+
+
+def test_stack_alias_and_single_pass(session):
+    df = session.create_dataframe({"a": [1], "b": [2]})
+    got = df.select(F.stack(2, col("a"), col("b")).alias("z")).to_pydict()
+    assert sorted(got["z"]) == [1, 2]
+    # plain stack select lowers to ONE Expand pass, not a union of scans
+    from spark_rapids_tpu.plan import nodes as P
+    d2 = df.select(col("a"), F.stack(2, col("a"), col("b")))
+    assert isinstance(d2.plan, P.Expand)
