@@ -523,8 +523,15 @@ class Stack(Expression):
             row = []
             for j, (_, dt) in enumerate(fields):
                 i = r * self.ncols + j
-                row.append(self.children[i] if i < len(self.children)
-                           else Literal(None, dt))
+                if i >= len(self.children):
+                    row.append(Literal(None, dt))
+                    continue
+                c = self.children[i]
+                if isinstance(c.data_type(), T.NullType):
+                    # retype explicit NULLs to the merged column type:
+                    # Expand derives the schema from projection 0 alone
+                    c = Literal(None, dt)
+                row.append(c)
             rows.append(row)
         return rows
 

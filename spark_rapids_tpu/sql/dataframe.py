@@ -214,6 +214,64 @@ class DataFrame:
         keys = [E.col(n) for n in self.plan.schema.names]
         return DataFrame(P.Aggregate(keys, [], self.plan), self.session)
 
+    def drop_duplicates(self, subset: Optional[List[str]] = None
+                        ) -> "DataFrame":
+        """dropDuplicates: with a subset, keep one arbitrary row per key
+        (Spark keeps the partition-order first; both are 'some row')."""
+        if not subset:
+            return self.distinct()
+        # row_number over the key keeps one WHOLE input row per key
+        # (a first() per remaining column would stitch cells from
+        # different rows when the earliest value is null)
+        from spark_rapids_tpu.sql import functions as F
+        from spark_rapids_tpu.expr import window as WE
+        spec = WE.Window.partition_by(*[E.col(s) for s in subset]) \
+            .order_by(E.lit(1))
+        marked = self.select(*[E.col(n) for n in self.plan.schema.names],
+                             F.row_number().over(spec).alias("__rn"))
+        return (marked.filter(E.col("__rn") == E.lit(1))
+                .select(*[E.col(n) for n in self.plan.schema.names]))
+
+    dropDuplicates = drop_duplicates
+
+    def dropna(self, how: str = "any", thresh: Optional[int] = None,
+               subset: Optional[List[str]] = None) -> "DataFrame":
+        """DataFrameNaFunctions.drop: keep rows with enough non-null
+        cells (thresh wins over how; how='any' means all cells non-null,
+        'all' means at least one — Spark's AtLeastNNonNulls filter)."""
+        if how not in ("any", "all"):
+            raise ValueError(f"how must be 'any' or 'all', got {how!r}")
+        names = subset or list(self.plan.schema.names)
+        if thresh is None:
+            thresh = len(names) if how == "any" else 1
+        cnt = None
+        for n in names:
+            one = E.If(E.IsNotNull(E.col(n)), E.lit(1), E.lit(0))
+            cnt = one if cnt is None else cnt + one
+        return self.filter(cnt >= E.lit(int(thresh)))
+
+    def fillna(self, value, subset: Optional[List[str]] = None
+               ) -> "DataFrame":
+        """DataFrameNaFunctions.fill: replace nulls in TYPE-COMPATIBLE
+        columns (numeric value fills numeric columns, string fills
+        string — Spark's rule), others pass through untouched."""
+        names = {s.lower() for s in subset} if subset else None
+        out = []
+        for f in self.plan.schema.fields:
+            compat = (f.dtype.is_numeric
+                      if isinstance(value, (int, float))
+                      and not isinstance(value, bool)
+                      else isinstance(f.dtype, type(E.lit(value).dtype)))
+            if (names is None or f.name.lower() in names) and compat:
+                # cast the fill to the COLUMN type (Spark truncates
+                # 0.5 -> 0 for an int column and keeps the dtype)
+                out.append(E.Alias(
+                    E.Coalesce(E.col(f.name),
+                               E.Cast(E.lit(value), f.dtype)), f.name))
+            else:
+                out.append(E.col(f.name))
+        return self.select(*out)
+
     def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
         how = {"leftsemi": "left_semi", "semi": "left_semi",
                "leftanti": "left_anti", "anti": "left_anti",
@@ -360,16 +418,26 @@ class PivotedData:
                               if len(aggs) > 1 else None))
             else:
                 raise TypeError(f"not an aggregate: {a!r}")
+        from spark_rapids_tpu import types as T
+        from spark_rapids_tpu.expr.aggregates import Max
         schema = self.df.plan.schema
         out = []
-        for v in self.values:
+        post = {}   # count column -> presence-marker column
+        for vi, v in enumerate(self.values):
             pc = P.bind_expr(self.pivot_col, schema)
             # a NULL pivot value needs null-safe matching
             cond = E.IsNull(pc) if v is None else pc == E.lit(v)
+            marker = None
+            if any(isinstance(a, (CountAll, Count)) for a, _ in named):
+                # Spark's pivot leaves counts NULL (not 0) for combos
+                # with no matching rows; a presence marker separates
+                # "no rows" from "rows whose counted value is null"
+                marker = f"__present{vi}"
+                out.append(NamedAgg(
+                    Max(E.If(cond, E.lit(1), E.Literal(None, T.INT32))),
+                    marker))
             for a, suffix in named:
                 if isinstance(a, CountAll):
-                    # count(*) under a pivot counts matching rows
-                    from spark_rapids_tpu import types as T
                     cell = Count(E.If(cond, E.lit(1),
                                       E.Literal(None, T.INT32)))
                 else:
@@ -385,9 +453,22 @@ class PivotedData:
                     cell.children = gated
                 vs = "null" if v is None else str(v)
                 name = vs if suffix is None else f"{vs}_{suffix}"
+                if isinstance(a, (CountAll, Count)):
+                    post[name] = marker
                 out.append(NamedAgg(cell, name))
-        return DataFrame(P.Aggregate(self.keys, out, self.df.plan),
-                         self.df.session)
+        agged = DataFrame(P.Aggregate(self.keys, out, self.df.plan),
+                          self.df.session)
+        finals = []
+        for n in agged.plan.schema.names:
+            if n.startswith("__present"):
+                continue
+            if n in post:
+                finals.append(E.Alias(
+                    E.If(E.IsNull(E.col(post[n])),
+                         E.Literal(None, T.INT64), E.col(n)), n))
+            else:
+                finals.append(E.col(n))
+        return agged.select(*finals) if post else agged
 
 
 def _index_of(names: List[str], name: str) -> int:

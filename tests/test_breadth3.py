@@ -253,3 +253,70 @@ def test_stack_alias_and_single_pass(session):
     from spark_rapids_tpu.plan import nodes as P
     d2 = df.select(col("a"), F.stack(2, col("a"), col("b")))
     assert isinstance(d2.plan, P.Expand)
+
+
+def test_dropna_variants(session):
+    import pyarrow as pa
+    t = pa.table({"a": pa.array([1, None, 3, 1], pa.int64()),
+                  "b": pa.array([None, None, 2.0, 9.0], pa.float64()),
+                  "c": pa.array(["x", None, None, "x"], pa.string())})
+    df = session.create_dataframe(t)
+    assert df.dropna().count() == 1            # how=any: full rows only
+    assert df.dropna(how="all").count() == 3   # all-null row dropped
+    assert df.dropna(thresh=2).count() == 3
+    assert df.dropna(subset=["a"]).count() == 3
+
+
+def test_fillna_type_compat(session):
+    import pyarrow as pa
+    t = pa.table({"a": pa.array([1, None], pa.int64()),
+                  "c": pa.array([None, "y"], pa.string())})
+    df = session.create_dataframe(t)
+    got = df.fillna(0).to_pydict()
+    # numeric fill leaves string columns untouched (Spark's rule)
+    assert got == {"a": [1, 0], "c": [None, "y"]}
+    got = df.fillna("?").to_pydict()
+    assert got == {"a": [1, None], "c": ["?", "y"]}
+
+
+def test_drop_duplicates_keeps_whole_rows(session):
+    import pyarrow as pa
+    t = pa.table({"a": pa.array([1, None, 3, 1], pa.int64()),
+                  "b": pa.array([None, None, 2.0, 9.0], pa.float64())})
+    df = session.create_dataframe(t)
+    out = df.drop_duplicates(["a"]).to_pydict()
+    rows = set(zip(out["a"], out["b"]))
+    src = set(zip(*df.to_pydict().values()))
+    assert rows <= src and len(rows) == 3  # real rows, one per key
+    assert df.drop_duplicates().count() == 4  # no subset = distinct
+
+
+def test_pivot_count_null_for_absent_combo(session):
+    # Spark's pivot+count leaves NULL (not 0) when a (group, value)
+    # combo has no rows at all
+    df = session.create_dataframe(
+        {"k": [1, 2, 2], "c": ["a", "a", "b"], "v": [1.0, 2.0, 3.0]})
+    got = (df.group_by("k").pivot(col("c"), ["a", "b"]).agg(F.count())
+           .order_by(col("k").asc()).to_pydict())
+    assert got["a"] == [1, 1] and got["b"] == [None, 1]
+
+
+def test_fillna_casts_to_column_type(session):
+    import pyarrow as pa
+    df = session.create_dataframe(
+        pa.table({"a": pa.array([1, None], pa.int64())}))
+    got = df.fillna(0.5).to_pydict()
+    assert got == {"a": [1, 0]}  # 0.5 truncates; dtype stays int
+
+
+def test_stack_explicit_null_keeps_column_type(session):
+    df = session.create_dataframe({"a": [7]})
+    got = df.select(F.stack(2, lit(None), col("a"))).to_pydict()
+    assert sorted(x for x in got["col0"] if x is not None) == [7]
+    assert got["col0"].count(None) == 1
+
+
+def test_dropna_rejects_bad_how(session):
+    df = session.create_dataframe({"a": [1]})
+    with pytest.raises(ValueError):
+        df.dropna(how="Any")
