@@ -1251,6 +1251,121 @@ def _resolved(e: Expression) -> bool:
         return False
 
 
+class KnownNotNull(Expression):
+    """Catalyst's null-introspection wrapper (reference registers it as
+    a pass-through): asserts the optimizer proved the child non-null."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return KnownNotNull(children[0])
+
+    def eval_tpu(self, ctx):
+        return self.children[0].eval_tpu(ctx)
+
+    def eval_cpu(self, cols, ansi=False):
+        return self.children[0].eval_cpu(cols, ansi)
+
+
+class KnownFloatingPointNormalized(KnownNotNull):
+    """Pass-through marker: the child's NaN/-0.0 are already canonical."""
+
+    @property
+    def nullable(self):
+        return self.children[0].nullable
+
+    def with_children(self, children):
+        return KnownFloatingPointNormalized(children[0])
+
+
+class NormalizeNaNAndZero(Expression):
+    """Canonicalize floats for grouping/join keys: -0.0 -> 0.0 and any
+    NaN bit pattern -> the canonical NaN (reference
+    normalizeNansAndZeros in GpuOverrides; Catalyst inserts it under
+    First/aggregation keys)."""
+
+    def __init__(self, child):
+        self.children = [child]
+
+    def data_type(self):
+        return self.children[0].data_type()
+
+    def with_children(self, children):
+        return NormalizeNaNAndZero(children[0])
+
+    def eval_tpu(self, ctx):
+        c = self.children[0].eval_tpu(ctx)
+        v = c.data
+        # explicit compare: XLA folds v + 0.0 back to v, keeping -0.0
+        v = jnp.where(v == 0, jnp.zeros((), v.dtype), v)
+        v = jnp.where(jnp.isnan(v), jnp.nan, v)
+        return ColumnVector(c.dtype, v, _valid_of(c, ctx))
+
+    def eval_cpu(self, cols, ansi=False):
+        c = self.children[0].eval_cpu(cols, ansi)
+        v = c.values
+        with np.errstate(all="ignore"):
+            v = np.where(v == 0, np.zeros((), v.dtype), v)
+            v = np.where(np.isnan(v), np.nan, v)
+        return CpuCol(c.dtype, v, c.valid)
+
+
+class AtLeastNNonNulls(Expression):
+    """Catalyst's dropna predicate: true when >= n of the children are
+    non-null (and, for floats, non-NaN — Spark counts NaN as missing
+    here)."""
+
+    def __init__(self, n: int, *children):
+        self.n = int(n)
+        self.children = list(children)
+
+    def _params(self):
+        return str(self.n)
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return AtLeastNNonNulls(self.n, *children)
+
+    def eval_tpu(self, ctx):
+        cnt = None
+        for c in self.children:
+            cc = c.eval_tpu(ctx)
+            ok = _valid_of(cc, ctx)
+            if isinstance(cc.dtype, (T.Float32Type, T.Float64Type)):
+                ok = ok & ~jnp.isnan(cc.data)
+            one = ok.astype(jnp.int32)
+            cnt = one if cnt is None else cnt + one
+        return ColumnVector(T.BOOLEAN, cnt >= self.n,
+                            jnp.ones(cnt.shape[0], jnp.bool_))
+
+    def eval_cpu(self, cols, ansi=False):
+        cnt = None
+        for c in self.children:
+            cc = c.eval_cpu(cols, ansi)
+            ok = cc.valid
+            if isinstance(cc.dtype, (T.Float32Type, T.Float64Type)):
+                with np.errstate(all="ignore"):
+                    ok = ok & ~np.isnan(cc.values)
+            cnt = ok.astype(np.int32) if cnt is None \
+                else cnt + ok.astype(np.int32)
+        return CpuCol(T.BOOLEAN, cnt >= self.n,
+                      np.ones(len(cnt), np.bool_))
+
+
 class Coalesce(Expression):
     def __init__(self, *exprs):
         self.children = list(exprs)

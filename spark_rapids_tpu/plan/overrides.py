@@ -410,6 +410,10 @@ expr_rule(_CPUF.RegexpExtractAll, _ARR, _ARR, "regexp_extract_all",
 expr_rule(_CPUF.StructsToJson, _ARR, _ARR, "to_json",
           extra=_cpu_tier("to_json runs on CPU"))
 
+for _cls in (E.KnownNotNull, E.KnownFloatingPointNormalized,
+             E.NormalizeNaNAndZero, E.AtLeastNNonNulls):
+    expr_rule(_cls, Sigs.COMMON, Sigs.COMMON, _cls.__name__)
+
 expr_rule(_MISC.Crc32, Sigs.COMMON, Sigs.COMMON, "crc32")
 expr_rule(_MISC.XxHash64, Sigs.COMMON, Sigs.COMMON,
           "xxhash64 (Spark-compatible, seed 42)",

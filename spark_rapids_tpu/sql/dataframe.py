@@ -244,11 +244,9 @@ class DataFrame:
         names = subset or list(self.plan.schema.names)
         if thresh is None:
             thresh = len(names) if how == "any" else 1
-        cnt = None
-        for n in names:
-            one = E.If(E.IsNotNull(E.col(n)), E.lit(1), E.lit(0))
-            cnt = one if cnt is None else cnt + one
-        return self.filter(cnt >= E.lit(int(thresh)))
+        # Catalyst's predicate (NaN counts as missing, like Spark)
+        return self.filter(E.AtLeastNNonNulls(
+            int(thresh), *[E.col(n) for n in names]))
 
     def fillna(self, value, subset: Optional[List[str]] = None
                ) -> "DataFrame":

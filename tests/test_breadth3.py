@@ -320,3 +320,27 @@ def test_dropna_rejects_bad_how(session):
     df = session.create_dataframe({"a": [1]})
     with pytest.raises(ValueError):
         df.dropna(how="Any")
+
+
+def test_dropna_counts_nan_as_missing(session):
+    import pyarrow as pa
+    df = session.create_dataframe(
+        pa.table({"b": pa.array([float("nan"), 2.0], pa.float64())}))
+    # Spark's AtLeastNNonNulls treats NaN like NULL for dropna
+    assert df.dropna().count() == 1
+
+
+def test_normalize_nan_and_zero(session):
+    import pyarrow as pa
+    from spark_rapids_tpu.expr.core import NormalizeNaNAndZero
+    df = session.create_dataframe(
+        pa.table({"x": pa.array([-0.0, 1.0], pa.float64())}))
+    got = df.select(
+        E_alias(NormalizeNaNAndZero(col("x")), "n")).to_pydict()
+    import math
+    assert math.copysign(1.0, got["n"][0]) == 1.0  # -0.0 -> +0.0
+
+
+def E_alias(e, name):
+    from spark_rapids_tpu.expr.core import Alias
+    return Alias(e, name)
