@@ -9,6 +9,7 @@ from spark_rapids_tpu import config as C
 from spark_rapids_tpu.expr import core as E
 from spark_rapids_tpu.expr.aggregates import AggFunction, NamedAgg
 from spark_rapids_tpu.plan import nodes as P
+from spark_rapids_tpu import types as T
 
 
 def _e(x):
@@ -324,6 +325,207 @@ class DataFrame:
     @property
     def columns(self) -> List[str]:
         return self.plan.schema.names
+
+    # -- pyspark convenience surface ---------------------------------------
+
+    def head(self, n: Optional[int] = None):
+        """pyspark surface: head() is one row (or None); head(n) — even
+        head(1) — is a list."""
+        rows = self.limit(n if n is not None else 1).collect().to_pylist()
+        if n is None:
+            return rows[0] if rows else None
+        return rows
+
+    def take(self, n: int):
+        return self.limit(n).collect().to_pylist()
+
+    def first(self):
+        return self.head(1)
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    toPandas = to_pandas
+
+    def sample(self, fraction: float, seed: int = 0,
+               with_replacement: bool = False) -> "DataFrame":
+        """Bernoulli row sample: rand(seed) < fraction per row, Spark's
+        without-replacement sampler. With-replacement (Poisson counts)
+        is not implemented."""
+        if with_replacement:
+            raise E.SparkException(
+                "sample(withReplacement=True) is not implemented")
+        from spark_rapids_tpu.expr.misc import Rand
+        return self.filter(Rand(seed) < E.lit(float(fraction)))
+
+    def random_split(self, weights: List[float], seed: int = 0
+                     ) -> List["DataFrame"]:
+        """Split by disjoint rand(seed) ranges proportional to weights
+        (each split re-evaluates the same deterministic rand stream, so
+        the splits partition the input exactly)."""
+        from spark_rapids_tpu.expr.misc import Rand
+        total = float(sum(weights))
+        out, lo = [], 0.0
+        for i, w in enumerate(weights):
+            hi = 1.0 if i == len(weights) - 1 else lo + w / total
+            r = Rand(seed)
+            out.append(self.filter((r >= E.lit(lo)) & (r < E.lit(hi))))
+            lo = hi
+        return out
+
+    randomSplit = random_split
+
+    def _null_safe_on(self):
+        """EXCEPT/INTERSECT compare NULL as equal to NULL: each column
+        becomes an (is-null flag, null-coalesced value) key pair, which
+        matches exactly when the null-safe equality would."""
+        on = []
+        for f in self.plan.schema.fields:
+            c = E.col(f.name)
+            flag = E.If(E.IsNull(c), E.lit(1), E.lit(0))
+            default = E.lit("") if isinstance(f.dtype, T.StringType) \
+                else E.Cast(E.lit(0), f.dtype)
+            coal = E.Coalesce(c, default)
+            on.append((flag, flag))
+            on.append((coal, coal))
+        return on
+
+    def _align_positional(self, other: "DataFrame") -> "DataFrame":
+        """EXCEPT/INTERSECT pair columns by POSITION (Spark): rename
+        other's columns to self's names first."""
+        mine = self.plan.schema.names
+        theirs = other.plan.schema.names
+        if len(mine) != len(theirs):
+            raise E.SparkException(
+                f"set operation needs the same number of columns: "
+                f"{len(mine)} vs {len(theirs)}")
+        return other.select(*[E.Alias(E.col(t), m)
+                              for t, m in zip(theirs, mine)])
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """EXCEPT DISTINCT: distinct rows of self absent from other."""
+        return self.distinct().join(self._align_positional(other),
+                                    on=self._null_safe_on(),
+                                    how="left_anti")
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """INTERSECT DISTINCT."""
+        return self.distinct().join(self._align_positional(other),
+                                    on=self._null_safe_on(),
+                                    how="left_semi")
+
+    def describe(self, *cols) -> "DataFrame":
+        """count/mean/stddev/min/max summary rows over numeric columns
+        (string rendering like Spark's describe)."""
+        from spark_rapids_tpu.sql import functions as F
+        import pyarrow as pa
+        fields = {f.name: f for f in self.plan.schema.fields}
+        names = list(cols) or [f.name for f in self.plan.schema.fields
+                               if f.dtype.is_numeric
+                               or isinstance(f.dtype, T.StringType)]
+        for n in names:
+            if n not in fields:
+                raise E.SparkException(f"describe: no column {n!r}")
+            if n == "summary":
+                raise E.SparkException(
+                    "describe over a column named 'summary' is not "
+                    "supported (it collides with the stat-label column)")
+        stats = ["count", "mean", "stddev", "min", "max"]
+        if not names:
+            return self.session.create_dataframe(
+                pa.table({"summary": stats}))
+        aggs = []
+        for n in names:
+            numeric = fields[n].dtype.is_numeric
+            aggs += [NamedAgg(F.count(E.col(n)), f"__cnt_{n}"),
+                     NamedAgg(F.min(E.col(n)), f"__min_{n}"),
+                     NamedAgg(F.max(E.col(n)), f"__max_{n}")]
+            if numeric:  # Spark: strings get count/min/max only
+                aggs += [NamedAgg(F.avg(E.col(n)), f"__avg_{n}"),
+                         NamedAgg(F.stddev(E.col(n)), f"__std_{n}")]
+        row = self.agg(*aggs).collect().to_pylist()[0]
+
+        def fmt(v):
+            return None if v is None else str(v)
+        data = {"summary": stats}
+        for n in names:
+            data[n] = [fmt(row.get(f"__{k}_{n}"))
+                       for k in ("cnt", "avg", "std", "min", "max")]
+        return self.session.create_dataframe(pa.table(data))
+
+    def corr(self, c1: str, c2: str) -> float:
+        """Pearson correlation (df.stat.corr)."""
+        import math
+        m = self._moments(c1, c2)
+        # E[x^2]-mean^2 can round a hair negative for constant columns
+        den = math.sqrt(max(m["vx"], 0.0) * max(m["vy"], 0.0))
+        return float("nan") if den == 0 else m["cov"] / den
+
+    def cov(self, c1: str, c2: str) -> float:
+        """Sample covariance (df.stat.cov, n-1 denominator)."""
+        m = self._moments(c1, c2)
+        n = m["n"]
+        return 0.0 if n < 2 else m["cov_sum"] / (n - 1)
+
+    def _moments(self, c1: str, c2: str):
+        from spark_rapids_tpu.sql import functions as F
+        # pairwise-complete rows only (Spark's covar_samp/corr): gate
+        # BOTH columns on both being non-null
+        both = E.IsNotNull(E.col(c1)) & E.IsNotNull(E.col(c2))
+        fx = self.plan.schema.fields[
+            [f.name for f in self.plan.schema.fields].index(c1)]
+        x = E.If(both, E.col(c1), E.Literal(None, fx.dtype))
+        fy = self.plan.schema.fields[
+            [f.name for f in self.plan.schema.fields].index(c2)]
+        y = E.If(both, E.col(c2), E.Literal(None, fy.dtype))
+        row = self.agg(
+            NamedAgg(F.count(x), "n"), NamedAgg(F.sum(x), "sx"),
+            NamedAgg(F.sum(y), "sy"), NamedAgg(F.sum(x * y), "sxy"),
+            NamedAgg(F.sum(x * x), "sxx"),
+            NamedAgg(F.sum(y * y), "syy")).collect().to_pylist()[0]
+        n = row["n"] or 0
+        if n == 0:
+            return {"n": 0, "cov": 0.0, "cov_sum": 0.0, "vx": 0.0,
+                    "vy": 0.0}
+        sx, sy = float(row["sx"]), float(row["sy"])
+        cov_sum = float(row["sxy"]) - sx * sy / n
+        return {"n": n, "cov_sum": cov_sum, "cov": cov_sum / n,
+                "vx": float(row["sxx"]) / n - (sx / n) ** 2,
+                "vy": float(row["syy"]) / n - (sy / n) ** 2}
+
+    def crosstab(self, c1: str, c2: str) -> "DataFrame":
+        """Pairwise frequency table (df.stat.crosstab): one row per c1
+        value, one column per c2 value, 0 for absent combos (Spark's
+        crosstab fills 0, unlike pivot+count)."""
+        from spark_rapids_tpu.sql import functions as F
+        # reserved key name so a c2 VALUE equal to the c1 column name
+        # cannot collide with the key column in the pivot output
+        key = "__crosstab_key"
+        piv = (self.select(E.Alias(E.col(c1), key), E.col(c2))
+               .group_by(E.col(key)).pivot(E.col(c2)).agg(F.count()))
+        out = []
+        for n in piv.plan.schema.names:
+            if n == key:
+                out.append(E.Alias(E.col(n), f"{c1}_{c2}"))
+            else:
+                out.append(E.Alias(
+                    E.Coalesce(E.col(n), E.lit(0)), n))
+        return piv.select(*out)
+
+    def approx_quantile(self, col_name: str, probabilities: List[float],
+                        relative_error: float = 1e-4):
+        """df.stat.approxQuantile over one column: one engine pass
+        collects the non-null values, then every probability reads the
+        same sorted array (Spark's rank interpolation; exact, which
+        approxQuantile permits for any relative_error)."""
+        import numpy as np
+        tbl = (self.select(E.col(col_name)).dropna().collect()
+               .column(0).to_numpy(zero_copy_only=False))
+        if tbl.size == 0:
+            return [float("nan")] * len(probabilities)
+        return [float(np.quantile(tbl, p)) for p in probabilities]
+
+    approxQuantile = approx_quantile
 
     def collect(self):
         """Execute with the TPU engine (per-op CPU fallback as tagged)."""

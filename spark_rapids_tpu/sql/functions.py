@@ -23,7 +23,10 @@ def sum(c):  # noqa: A001
 
 
 def count(c="*"):
-    if c == "*":
+    # NB: Expression.__eq__ builds an EqualTo node (truthy), so the
+    # "*" probe must be an isinstance check — `c == "*"` on a column
+    # silently turned every count(expr) into count(*)
+    if isinstance(c, str) and c == "*":
         return A.CountAll()
     return A.Count(_e(c))
 

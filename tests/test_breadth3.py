@@ -344,3 +344,116 @@ def test_normalize_nan_and_zero(session):
 def E_alias(e, name):
     from spark_rapids_tpu.expr.core import Alias
     return Alias(e, name)
+
+
+def test_stat_and_convenience_surface(session):
+    import numpy as np
+    import pyarrow as pa
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, 2000)
+    y = 2 * x + rng.normal(0, 0.1, 2000)
+    df = session.create_dataframe(pa.table({"x": x, "y": y}))
+    assert df.head() is not None and len(df.take(3)) == 3
+    assert df.corr("x", "y") == pytest.approx(1.0, abs=0.01)
+    assert df.cov("x", "y") / np.cov(x, y, ddof=1)[0][1] \
+        == pytest.approx(1.0, abs=1e-9)
+    desc = df.describe("x").to_pydict()
+    assert desc["summary"] == ["count", "mean", "stddev", "min", "max"]
+    assert desc["x"][0] == "2000"
+    q = df.approx_quantile("x", [0.25, 0.5, 0.75])
+    assert q[0] < q[1] < q[2]
+
+
+def test_sample_and_random_split(session):
+    import pyarrow as pa
+    import numpy as np
+    df = session.create_dataframe(
+        pa.table({"i": np.arange(4000, dtype=np.int64)}))
+    s1 = df.sample(0.5, seed=7).count()
+    assert abs(s1 - 2000) < 250
+    a, b = df.random_split([0.75, 0.25], seed=9)
+    # the two splits PARTITION the input (same deterministic stream)
+    assert a.count() + b.count() == 4000
+    assert abs(a.count() - 3000) < 250
+
+
+def test_subtract_intersect_crosstab(session):
+    d1 = session.create_dataframe({"k": [1, 2, 3, 3]})
+    d2 = session.create_dataframe({"k": [2, 3, 4]})
+    assert sorted(d1.subtract(d2).to_pydict()["k"]) == [1]
+    assert sorted(d1.intersect(d2).to_pydict()["k"]) == [2, 3]
+    ct = session.create_dataframe({"a": [1, 1, 2], "b": ["x", "y", "x"]})
+    got = ct.crosstab("a", "b").order_by(col("a_b").asc()).to_pydict()
+    # crosstab fills 0 for absent combos (unlike pivot+count)
+    assert got == {"a_b": [1, 2], "x": [1, 1], "y": [1, 0]}
+
+
+def test_cov_pairwise_complete(session):
+    import pyarrow as pa
+    df = session.create_dataframe(pa.table({
+        "x": pa.array([1.0, 2.0, 3.0], pa.float64()),
+        "y": pa.array([1.0, None, 3.0], pa.float64())}))
+    assert df.cov("x", "y") == pytest.approx(2.0)  # rows (1,1),(3,3)
+    assert df.corr("x", "y") == pytest.approx(1.0)
+
+
+def test_subtract_intersect_null_safe(session):
+    import pyarrow as pa
+    d1 = session.create_dataframe(
+        pa.table({"k": pa.array([None, 1], pa.int64())}))
+    d2 = session.create_dataframe(
+        pa.table({"k": pa.array([None], pa.int64())}))
+    assert d1.subtract(d2).to_pydict()["k"] == [1]
+    assert d1.intersect(d2).to_pydict()["k"] == [None]
+
+
+def test_crosstab_value_named_like_key(session):
+    df = session.create_dataframe({"a": ["a", "x"], "b": ["a", "x"]})
+    got = df.crosstab("a", "b").order_by(col("a_b").asc()).to_pydict()
+    assert got == {"a_b": ["a", "x"], "a": [1, 0], "x": [0, 1]}
+
+
+def test_approx_quantile_all_null(session):
+    import math
+    import pyarrow as pa
+    df = session.create_dataframe(
+        pa.table({"v": pa.array([None, None], pa.float64())}))
+    assert math.isnan(df.approx_quantile("v", [0.5])[0])
+
+
+def test_describe_string_column(session):
+    df = session.create_dataframe({"s": ["b", "a"]})
+    got = df.describe().to_pydict()
+    assert got["s"] == ["2", None, None, "a", "b"]
+
+
+def test_count_expression_skips_nulls(session):
+    # F.count(expr) must be Count, not CountAll: Expression.__eq__
+    # builds a node, so the old `c == "*"` probe was always truthy
+    import pyarrow as pa
+    df = session.create_dataframe(pa.table({
+        "g": pa.array([1, 1, 1], pa.int64()),
+        "y": pa.array([1.0, None, 3.0], pa.float64())}))
+    assert df.agg(F.count(col("y")).alias("n")).to_pydict()["n"] == [2]
+    assert (df.group_by("g").agg(F.count(col("y")).alias("n"))
+            .to_pydict()["n"] == [2])
+    assert df.agg(F.count().alias("n")).to_pydict()["n"] == [3]
+
+
+def test_corr_constant_column_nan(session):
+    import math
+    df = session.create_dataframe({"x": [0.1, 0.1, 0.1],
+                                   "y": [1.0, 2.0, 3.0]})
+    assert math.isnan(df.corr("x", "y"))
+
+
+def test_subtract_positional(session):
+    d1 = session.create_dataframe({"k": [1, 2]})
+    d2 = session.create_dataframe({"j": [2]})  # different name: positional
+    assert d1.subtract(d2).to_pydict()["k"] == [1]
+
+
+def test_head_pyspark_shapes(session):
+    df = session.create_dataframe({"a": [1, 2]})
+    assert isinstance(df.head(), dict)     # no-arg: one row
+    assert isinstance(df.head(1), list)    # explicit n: a list
