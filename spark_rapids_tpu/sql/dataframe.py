@@ -152,7 +152,9 @@ class DataFrame:
         return DataFrame(P.Project(bound, self.plan), self.session)
 
     def with_column(self, name: str, expr) -> "DataFrame":
-        existing = [E.col(n) for n in self.plan.schema.names if n != name]
+        # case-insensitive replace, like Spark's default resolver
+        existing = [E.col(n) for n in self.plan.schema.names
+                    if n.lower() != name.lower()]
         return self.select(*existing, _e(expr).alias(name))
 
     def filter(self, condition) -> "DataFrame":
@@ -327,6 +329,83 @@ class DataFrame:
         return self.plan.schema.names
 
     # -- pyspark convenience surface ---------------------------------------
+
+    def drop(self, *cols) -> "DataFrame":
+        """Drop columns by name (unknown names are ignored, like
+        pyspark)."""
+        gone = {(c if isinstance(c, str) else c.name).lower()
+                for c in cols}
+        keep = [E.col(n) for n in self.plan.schema.names
+                if n.lower() not in gone]
+        if not keep:
+            raise E.SparkException("drop() would remove every column")
+        return self.select(*keep)
+
+    def with_column_renamed(self, existing: str, new: str) -> "DataFrame":
+        out = [E.Alias(E.col(n), new) if n.lower() == existing.lower()
+               else E.col(n) for n in self.plan.schema.names]
+        return self.select(*out)
+
+    withColumnRenamed = with_column_renamed
+
+    withColumn = with_column
+
+    @property
+    def dtypes(self):
+        return [(f.name, repr(f.dtype)) for f in self.plan.schema.fields]
+
+    def print_schema(self) -> None:
+        print("root")
+        for f in self.plan.schema.fields:
+            null = "true" if f.nullable else "false"
+            print(f" |-- {f.name}: {f.dtype!r} (nullable = {null})")
+
+    printSchema = print_schema
+
+    def show(self, n: int = 20, truncate=True) -> None:
+        """Render the first n rows as pyspark's ASCII grid. truncate
+        may be a bool (20-char default cut) or an int width."""
+        tbl = self.limit(n + 1).collect()
+        more = tbl.num_rows > n
+        tbl = tbl.slice(0, n)
+        names = list(self.plan.schema.names)
+        if isinstance(truncate, bool):
+            width = 20 if truncate else 0
+        else:
+            width = int(truncate)
+
+        def cell(v):
+            if v is None:
+                s = "NULL"
+            elif v is True:
+                s = "true"
+            elif v is False:
+                s = "false"
+            else:
+                s = str(v)
+            if width and len(s) > width:
+                s = s[: max(width - 3, 0)] + "..."
+            return s
+        # positional column access: duplicate output names must each
+        # show their own values
+        cols = [tbl.column(i).to_pylist()
+                for i in range(tbl.num_columns)]
+        grid = [[cell(cols[i][r]) for i in range(len(names))]
+                for r in range(tbl.num_rows)]
+        widths = [max(len(c), *(len(g[i]) for g in grid)) if grid
+                  else len(c) for i, c in enumerate(names)]
+        sep = "+" + "+".join("-" * w for w in widths) + "+"
+        print(sep)
+        print("|" + "|".join(c.rjust(w)
+                             for c, w in zip(names, widths)) + "|")
+        print(sep)
+        for g in grid:
+            print("|" + "|".join(c.rjust(w)
+                                 for c, w in zip(g, widths)) + "|")
+        print(sep)
+        if more:
+            print(f"only showing top {n} rows")
+
 
     def head(self, n: Optional[int] = None):
         """pyspark surface: head() is one row (or None); head(n) — even

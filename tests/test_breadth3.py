@@ -457,3 +457,32 @@ def test_head_pyspark_shapes(session):
     df = session.create_dataframe({"a": [1, 2]})
     assert isinstance(df.head(), dict)     # no-arg: one row
     assert isinstance(df.head(1), list)    # explicit n: a list
+
+
+def test_show_drop_rename_schema(session, capsys):
+    df = session.create_dataframe({"k": [1, 2], "name": ["alpha", None]})
+    df.show()
+    out = capsys.readouterr().out
+    assert "|alpha|" in out and "| NULL|" in out and out.count("+") >= 6
+    assert df.drop("name").columns == ["k"]
+    assert df.drop("nope").columns == ["k", "name"]  # unknown ignored
+    assert df.with_column_renamed("k", "id").columns == ["id", "name"]
+    assert df.dtypes[0][0] == "k"
+    df.print_schema()
+    assert "root" in capsys.readouterr().out
+    long = session.create_dataframe({"s": ["x" * 40]})
+    long.show()
+    assert "..." in capsys.readouterr().out  # 20-char truncation
+
+
+def test_show_duplicate_names_and_int_truncate(session, capsys):
+    df = session.create_dataframe({"a": [1], "b": [10]})
+    df.select(col("a").alias("x"), col("b").alias("x")).show()
+    out = capsys.readouterr().out
+    assert "|1|10|" in out  # positional cells, not name-collapsed
+    session.create_dataframe({"s": ["y" * 30]}).show(truncate=25)
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if "..." in l][0]
+    assert len(line.strip("|")) == 25  # integer truncate form
+    df2 = session.create_dataframe({"k": [1]})
+    assert df2.with_column("K", lit(9)).columns == ["K"]  # replaces
