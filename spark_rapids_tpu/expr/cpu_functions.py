@@ -729,3 +729,24 @@ class StructsToJson(CpuRowFunction):
         if isinstance(v, _dt.date):
             return json.dumps(v.isoformat())
         return json.dumps(v)
+
+
+class Luhncheck(CpuRowFunction):
+    """luhn_check(str): credit-card checksum validity (Spark 3.5)."""
+
+    name = "luhn_check"
+    result = T.BOOLEAN
+
+    def row_fn(self, s):
+        if not isinstance(s, str) or not s \
+                or not (s.isascii() and s.isdigit()):
+            return False  # ASCII digits only (Spark rejects U+0660 etc)
+        total = 0
+        for i, ch in enumerate(reversed(s)):
+            d = ord(ch) - 48
+            if i % 2:
+                d *= 2
+                if d > 9:
+                    d -= 9
+            total += d
+        return total % 10 == 0

@@ -754,6 +754,51 @@ class UnaryPositive(Expression):
         return self.children[0].eval_cpu(cols, ansi)
 
 
+class WidthBucket(Expression):
+    """width_bucket(v, lo, hi, n): 1-based equi-width histogram bucket;
+    0 below, n+1 above; NULL for invalid n or lo == hi with NaN rules
+    (Spark WidthBucket semantics)."""
+
+    def __init__(self, value, lo, hi, nb):
+        self.children = [value, lo, hi, nb]
+
+    def data_type(self):
+        return T.INT64
+
+    def with_children(self, children):
+        return WidthBucket(*children)
+
+    @staticmethod
+    def _compute(xp, v, lo, hi, nb):
+        ok = (nb > 0) & (lo != hi) & xp.isfinite(v) & xp.isfinite(lo) \
+            & xp.isfinite(hi)
+        span = xp.where(ok, hi - lo, 1.0)
+        raw = xp.floor((v - lo) / span * nb) + 1
+        # descending ranges (lo > hi) bucket in reverse, like Spark
+        raw = xp.clip(raw, 0, nb + 1)
+        return ok, raw
+
+    def eval_tpu(self, ctx):
+        v, lo, hi, nb = [c.eval_tpu(ctx) for c in self.children]
+        vals = [v.data.astype(np.float64), lo.data.astype(np.float64),
+                hi.data.astype(np.float64), nb.data.astype(np.float64)]
+        ok, raw = self._compute(jnp, *vals)
+        valid = ok
+        for c in (v, lo, hi, nb):
+            valid = valid & _valid_of(c, ctx)
+        return ColumnVector(T.INT64, raw.astype(np.int64), valid)
+
+    def eval_cpu(self, cols, ansi=False):
+        cs = [c.eval_cpu(cols, ansi) for c in self.children]
+        with np.errstate(all="ignore"):
+            ok, raw = self._compute(
+                np, *[c.values.astype(np.float64) for c in cs])
+        valid = ok
+        for c in cs:
+            valid = valid & c.valid
+        return CpuCol(T.INT64, raw.astype(np.int64), valid)
+
+
 class NaNvl(Expression):
     """nanvl(a, b): b where a is NaN."""
 

@@ -400,6 +400,9 @@ for _c, _doc in ((_CPUF.Sha1, "sha1"), (_CPUF.HexStr, "hex"),
     expr_rule(_c, Sigs.COMMON, Sigs.COMMON, _doc,
               extra=_cpu_tier(f"{_doc} runs on CPU"))
 expr_rule(MA.Logarithm, Sigs.COMMON, Sigs.COMMON, "log(base, expr)")
+expr_rule(MA.WidthBucket, Sigs.COMMON, Sigs.COMMON, "width_bucket")
+expr_rule(_CPUF.Luhncheck, Sigs.COMMON, Sigs.COMMON, "luhn_check",
+          extra=_cpu_tier("luhn_check runs on CPU"))
 expr_rule(CX.Stack, Sigs.COMMON, Sigs.COMMON,
           "stack(n, ...) (lowered to a union of projections)")
 for _cls in (MA.Acosh, MA.Asinh, MA.Atanh, MA.Pmod, MA.UnaryPositive,

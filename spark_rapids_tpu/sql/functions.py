@@ -1049,3 +1049,12 @@ def regexp_extract_all(c, pattern, idx=1):
 def to_json(c):
     from spark_rapids_tpu.expr.cpu_functions import StructsToJson
     return StructsToJson(_e(c))
+
+
+def width_bucket(v, lo, hi, nb):
+    return MA.WidthBucket(_e(v), _e(lo), _e(hi), _e(nb))
+
+
+def luhn_check(c):
+    from spark_rapids_tpu.expr.cpu_functions import Luhncheck
+    return Luhncheck(_e(c))

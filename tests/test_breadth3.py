@@ -486,3 +486,31 @@ def test_show_duplicate_names_and_int_truncate(session, capsys):
     assert len(line.strip("|")) == 25  # integer truncate form
     df2 = session.create_dataframe({"k": [1]})
     assert df2.with_column("K", lit(9)).columns == ["K"]  # replaces
+
+
+def test_width_bucket_and_luhn(session):
+    df = session.create_dataframe(
+        {"v": [5.35, 0.0, 10.0, -1.0, 11.0],
+         "c": ["4111111111111111", "4111111111111112", "79927398713",
+               "x", ""]})
+    got = df.select(
+        F.width_bucket(col("v"), lit(0.0), lit(10.0), lit(5)).alias("b"),
+        F.luhn_check(col("c")).alias("l")).to_pydict()
+    assert got["b"] == [3, 1, 6, 0, 6]  # Spark: v==hi -> n+1, below -> 0
+    assert got["l"] == [True, False, True, False, False]
+    # descending range buckets via the same algebra (Spark semantics)
+    d2 = session.create_dataframe({"v": [8.0]})
+    assert _one(d2.select(F.width_bucket(
+        col("v"), lit(10.0), lit(0.0), lit(5)).alias("b")), "b") == [2]
+    # invalid bucket count is NULL
+    assert _one(df.select(F.width_bucket(
+        col("v"), lit(0.0), lit(10.0), lit(0)).alias("b")), "b") \
+        == [None] * 5
+    # infinite bounds are NULL (Spark), not a garbage bucket
+    assert _one(df.select(F.width_bucket(
+        col("v"), lit(float("inf")), lit(10.0), lit(5)).alias("b")),
+        "b") == [None] * 5
+    # non-ASCII digits are rejected by luhn_check
+    d3 = session.create_dataframe({"c": ["\u0666"]})
+    assert _one(d3.select(F.luhn_check(col("c")).alias("l")), "l") \
+        == [False]
