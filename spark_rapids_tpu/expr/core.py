@@ -182,6 +182,11 @@ class Expression:
     def cast(self, dtype): return Cast(self, dtype)
     def isin(self, *vals): return In(self, [_wrap(v) for v in vals])
 
+    def substr(self, pos, length):
+        """pyspark Column.substr (1-based)."""
+        from spark_rapids_tpu.expr.strings import Substring
+        return Substring(self, pos, length)
+
     # Complex-type sugar (Spark Column.getItem/getField).
     def get_item(self, key):
         from spark_rapids_tpu.expr import complex as CX

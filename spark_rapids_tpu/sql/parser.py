@@ -1,0 +1,535 @@
+"""SQL string frontend: a recursive-descent parser for the query subset
+the engine's DataFrame algebra covers.
+
+Reference parity: the reference is a Spark plugin, so SQL arrives parsed
+by Catalyst for free; a standalone framework must carry its own parser
+(SURVEY.md §2's user surface). This parser targets the analytic shape
+the rest of the engine optimizes: SELECT projections with expressions /
+aggregates / aliases, FROM with INNER/LEFT/RIGHT/FULL/SEMI/ANTI JOIN ..
+ON equi-conditions, WHERE, GROUP BY, HAVING, ORDER BY .. ASC/DESC
+[NULLS FIRST|LAST], LIMIT, UNION ALL, and scalar expression grammar
+(arithmetic, comparisons, AND/OR/NOT, BETWEEN, IN, LIKE, IS NULL,
+CASE WHEN, CAST(x AS type), function calls routed through
+sql.functions). Queries outside the subset raise SparkException with
+the offending token — parse-or-reject, never silently misread.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.expr import core as E
+from spark_rapids_tpu.expr.core import SparkException
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<num>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
+    | (?P<op><=|>=|<>|!=|\|\||[-+*/%(),.<>=])
+    )""", re.VERBOSE)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "as", "and", "or", "not", "in", "between", "like", "is", "null",
+    "case", "when", "then", "else", "end", "cast", "join", "inner",
+    "left", "right", "full", "outer", "semi", "anti", "cross", "on",
+    "asc", "desc", "union", "all", "distinct", "true", "false", "nulls",
+    "first", "last",
+}
+
+_TYPES = {
+    "int": T.INT32, "integer": T.INT32, "bigint": T.INT64,
+    "long": T.INT64, "smallint": T.INT16, "tinyint": T.INT8,
+    "double": T.FLOAT64, "float": T.FLOAT32, "string": T.STRING,
+    "boolean": T.BOOLEAN, "date": T.DATE, "timestamp": T.TIMESTAMP,
+}
+
+
+def _tokenize(text: str):
+    out, pos = [], 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if not m or m.end() == pos:
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise SparkException(f"SQL: cannot tokenize at {rest[:20]!r}")
+        pos = m.end()
+        if m.group("num") is not None:
+            out.append(("num", m.group("num")))
+        elif m.group("str") is not None:
+            out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("id") is not None:
+            word = m.group("id")
+            kind = "kw" if word.lower() in _KEYWORDS else "id"
+            out.append((kind, word))
+        else:
+            out.append(("op", m.group("op")))
+    out.append(("eof", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, text: str, session):
+        self.toks = _tokenize(text)
+        self.i = 0
+        self.session = session
+
+    # -- token plumbing -----------------------------------------------------
+
+    def peek(self, k: int = 0):
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def kw(self, *words) -> bool:
+        """Consume the keyword sequence if it is next (case-insensitive)."""
+        for j, w in enumerate(words):
+            k, v = self.peek(j)
+            if k != "kw" or v.lower() != w:
+                return False
+        self.i += len(words)
+        return True
+
+    def op(self, sym: str) -> bool:
+        k, v = self.peek()
+        if k == "op" and v == sym:
+            self.i += 1
+            return True
+        return False
+
+    def expect_op(self, sym: str):
+        if not self.op(sym):
+            raise SparkException(
+                f"SQL: expected {sym!r}, got {self.peek()[1]!r}")
+
+    def ident(self) -> str:
+        k, v = self.next()
+        if k not in ("id", "kw"):
+            raise SparkException(f"SQL: expected identifier, got {v!r}")
+        return v
+
+    # -- expressions --------------------------------------------------------
+
+    def expr(self):
+        return self._or()
+
+    def _or(self):
+        e = self._and()
+        while self.kw("or"):
+            e = E.Or(e, self._and())
+        return e
+
+    def _and(self):
+        e = self._not()
+        while self.kw("and"):
+            e = E.And(e, self._not())
+        return e
+
+    def _not(self):
+        if self.kw("not"):
+            return E.Not(self._not())
+        return self._cmp()
+
+    def _cmp(self):
+        e = self._add()
+        neg = self.kw("not")
+        if self.kw("between"):
+            lo = self._add()
+            if not self.kw("and"):
+                raise SparkException("SQL: BETWEEN needs AND")
+            hi = self._add()
+            out = E.And(E.GreaterThanOrEqual(e, lo),
+                        E.LessThanOrEqual(e, hi))
+            return E.Not(out) if neg else out
+        if self.kw("in"):
+            self.expect_op("(")
+            vals = [self.expr()]
+            while self.op(","):
+                vals.append(self.expr())
+            self.expect_op(")")
+            out = E.In(e, vals)
+            return E.Not(out) if neg else out
+        if self.kw("like"):
+            k, v = self.next()
+            if k != "str":
+                raise SparkException("SQL: LIKE needs a string pattern")
+            from spark_rapids_tpu.expr.strings import Like
+            out = Like(e, v)
+            return E.Not(out) if neg else out
+        if neg:
+            raise SparkException("SQL: dangling NOT")
+        if self.kw("is", "not", "null"):
+            return E.IsNotNull(e)
+        if self.kw("is", "null"):
+            return E.IsNull(e)
+        for sym, cls in (("<=", E.LessThanOrEqual),
+                         (">=", E.GreaterThanOrEqual),
+                         ("<>", None), ("!=", None), ("=", E.EqualTo),
+                         ("<", E.LessThan), (">", E.GreaterThan)):
+            if self.op(sym):
+                r = self._add()
+                if cls is None:
+                    return E.Not(E.EqualTo(e, r))
+                return cls(e, r)
+        return e
+
+    def _add(self):
+        e = self._mul()
+        while True:
+            if self.op("+"):
+                e = E.Add(e, self._mul())
+            elif self.op("-"):
+                e = E.Subtract(e, self._mul())
+            elif self.op("||"):
+                from spark_rapids_tpu.expr.strings import (
+                    ConcatStrings)
+                e = ConcatStrings(e, self._mul())
+            else:
+                return e
+
+    def _mul(self):
+        e = self._unary()
+        while True:
+            if self.op("*"):
+                e = E.Multiply(e, self._unary())
+            elif self.op("/"):
+                e = E.Divide(e, self._unary())
+            elif self.op("%"):
+                e = E.Remainder(e, self._unary())
+            else:
+                return e
+
+    def _unary(self):
+        if self.op("-"):
+            return E.UnaryMinus(self._unary())
+        if self.op("+"):
+            return self._unary()
+        return self._primary()
+
+    def _case(self):
+        branches = []
+        while self.kw("when"):
+            cond = self.expr()
+            if not self.kw("then"):
+                raise SparkException("SQL: CASE WHEN needs THEN")
+            branches.append((cond, self.expr()))
+        default = self.expr() if self.kw("else") else None
+        if not self.kw("end"):
+            raise SparkException("SQL: CASE needs END")
+        if not branches:
+            raise SparkException("SQL: CASE needs at least one WHEN")
+        return E.CaseWhen(branches, default)
+
+    def _call(self, name: str):
+        """Function call routed through sql.functions (lower-cased)."""
+        from spark_rapids_tpu.sql import functions as F
+        args: List = []
+        if name.lower() == "count" and self.op("*"):
+            self.expect_op(")")
+            return F.count()
+        distinct = self.kw("distinct")
+        if not self.op(")"):
+            args.append(self.expr())
+            while self.op(","):
+                args.append(self._scalar_or_expr())
+            self.expect_op(")")
+        if distinct:
+            raise SparkException(
+                f"SQL: DISTINCT inside {name}() is not supported")
+        fn = getattr(F, name.lower(), None)
+        if fn is None or not callable(fn):
+            raise SparkException(f"SQL: unknown function {name!r}")
+        return fn(*args)
+
+    def _scalar_or_expr(self):
+        """Trailing function args: plain (optionally negative) numeric
+        and string literals stay python values, because many function
+        signatures take ints/strs (substring pos, conv bases)."""
+        k, v = self.peek()
+        sign = 1
+        if k == "op" and v == "-" and self.peek(1)[0] == "num" \
+                and self.peek(2)[1] in (",", ")"):
+            self.next()
+            k, v = self.peek()
+            sign = -1
+        if k == "num" and self.peek(1)[1] in (",", ")"):
+            self.next()
+            return sign * (float(v) if ("." in v or "e" in v.lower())
+                           else int(v))
+        if k == "str" and self.peek(1)[1] in (",", ")"):
+            self.next()
+            return v
+        return self.expr()
+
+    def _primary(self):
+        k, v = self.peek()
+        if k == "num":
+            self.next()
+            return E.lit(float(v) if ("." in v or "e" in v.lower())
+                         else int(v))
+        if k == "str":
+            self.next()
+            return E.lit(v)
+        if self.kw("true"):
+            return E.lit(True)
+        if self.kw("false"):
+            return E.lit(False)
+        if self.kw("null"):
+            return E.Literal(None, T.NULL)
+        if self.kw("case"):
+            return self._case()
+        if self.kw("cast"):
+            self.expect_op("(")
+            e = self.expr()
+            if not self.kw("as"):
+                raise SparkException("SQL: CAST needs AS")
+            tname = self.ident().lower()
+            if tname not in _TYPES:
+                raise SparkException(f"SQL: unknown type {tname!r}")
+            self.expect_op(")")
+            return E.Cast(e, _TYPES[tname])
+        if self.op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if k in ("id", "kw"):
+            name = self.ident()
+            if self.op("("):
+                return self._call(name)
+            if self.op("."):
+                # qualified a.b: the engine resolves by column name only
+                return E.col(self.ident())
+            return E.col(name)
+        raise SparkException(f"SQL: unexpected token {v!r}")
+
+    # -- query --------------------------------------------------------------
+
+    def _table(self):
+        name = self.ident()
+        df = self.session.table(name)
+        # optional alias (resolution stays name-based)
+        k, v = self.peek()
+        if k == "id" or (k == "kw" and self.kw("as")):
+            if k == "id":
+                self.next()
+            else:
+                self.ident()
+        return df
+
+    def _from(self):
+        df = self._table()
+        while True:
+            how = None
+            if self.kw("inner", "join") or self.kw("join"):
+                how = "inner"
+            elif self.kw("left", "semi", "join"):
+                how = "left_semi"
+            elif self.kw("left", "anti", "join"):
+                how = "left_anti"
+            elif self.kw("left", "outer", "join") or self.kw("left", "join"):
+                how = "left"
+            elif self.kw("right", "outer", "join") \
+                    or self.kw("right", "join"):
+                how = "right"
+            elif self.kw("full", "outer", "join") or self.kw("full", "join"):
+                how = "full"
+            elif self.kw("cross", "join"):
+                how = "cross"
+            else:
+                return df
+            right = self._table()
+            if how == "cross":
+                df = df.join(right, on=None, how="cross")
+                continue
+            if not self.kw("on"):
+                raise SparkException("SQL: JOIN needs ON")
+            cond = self.expr()
+            pairs = self._equi_pairs(cond)
+            df = df.join(right, on=pairs, how=how)
+
+    def _equi_pairs(self, cond):
+        """Flatten `a = b AND c = d ...` into join key pairs."""
+        if isinstance(cond, E.And):
+            return self._equi_pairs(cond.children[0]) + \
+                self._equi_pairs(cond.children[1])
+        if isinstance(cond, E.EqualTo):
+            return [(cond.children[0], cond.children[1])]
+        raise SparkException(
+            "SQL: only equi-join ON conditions (a = b AND ...) are "
+            f"supported, got {cond!r}")
+
+    def _select_core(self):
+        if not self.kw("select"):
+            raise SparkException("SQL: expected SELECT")
+        distinct = self.kw("distinct")
+        items, stars = [], False
+        while True:
+            if self.op("*"):
+                stars = True
+            else:
+                e = self.expr()
+                if self.kw("as"):
+                    e = e.alias(self.ident())
+                elif self.peek()[0] == "id":
+                    e = e.alias(self.ident())
+                items.append(e)
+            if not self.op(","):
+                break
+        if not self.kw("from"):
+            raise SparkException("SQL: expected FROM")
+        df = self._from()
+        if self.kw("where"):
+            df = df.filter(self.expr())
+        group_keys = None
+        if self.kw("group", "by"):
+            group_keys = [self.expr()]
+            while self.op(","):
+                group_keys.append(self.expr())
+        having = self.expr() if self.kw("having") else None
+
+        from spark_rapids_tpu.expr.aggregates import AggFunction, NamedAgg
+        from spark_rapids_tpu.plan.nodes import expr_name  # noqa: F401
+
+        def agg_of(e):
+            if isinstance(e, NamedAgg):  # AggFunction.alias() result
+                return e.fn, e.name
+            if isinstance(e, AggFunction):
+                return e, None
+            if isinstance(e, E.Alias) and isinstance(e.children[0],
+                                                     AggFunction):
+                return e.children[0], e.name
+            return None, None
+
+        if group_keys is not None:
+            aggs, out_names = [], []
+            for j, it in enumerate(items):
+                fn, nm = agg_of(it)
+                if fn is not None:
+                    nm = nm or expr_name(it, j)
+                    aggs.append(NamedAgg(fn, nm))
+                    out_names.append(E.col(nm))
+                else:
+                    out_names.append(it)
+
+            def fold_agg(e):
+                """HAVING aggregates read the agg output: reuse a
+                SELECT agg with the same fingerprint or add a hidden
+                one (dropped by the final projection)."""
+                if isinstance(e, AggFunction):
+                    fp = e.fingerprint()
+                    for na in aggs:
+                        if na.fn.fingerprint() == fp:
+                            return E.col(na.name)
+                    nm = f"__having{len(aggs)}"
+                    aggs.append(NamedAgg(e, nm))
+                    return E.col(nm)
+                return e.with_children(
+                    [fold_agg(c) for c in e.children])
+
+            if having is not None:
+                having = fold_agg(having)
+            df = df.group_by(*group_keys).agg(*aggs)
+            if having is not None:
+                df = df.filter(having)
+            if not stars:
+                df = df.select(*out_names)
+            else:
+                keep = [E.col(n) for n in df.plan.schema.names
+                        if not n.startswith("__having")]
+                df = df.select(*keep)
+        else:
+            if any(agg_of(it)[0] is not None for it in items):
+                aggs = []
+                for j, it in enumerate(items):
+                    fn, nm = agg_of(it)
+                    if fn is None:
+                        raise SparkException(
+                            "SQL: mixing aggregates and plain columns "
+                            "needs GROUP BY")
+                    aggs.append(NamedAgg(fn, nm or expr_name(it, j)))
+
+                def fold_global(e):
+                    if isinstance(e, AggFunction):
+                        fp = e.fingerprint()
+                        for na in aggs:
+                            if na.fn.fingerprint() == fp:
+                                return E.col(na.name)
+                        nm = f"__having{len(aggs)}"
+                        aggs.append(NamedAgg(e, nm))
+                        return E.col(nm)
+                    return e.with_children(
+                        [fold_global(c) for c in e.children])
+
+                if having is not None:
+                    having = fold_global(having)
+                keep = [E.col(na.name) for na in aggs
+                        if not na.name.startswith("__having")]
+                df = df.agg(*aggs)
+                if having is not None:
+                    df = df.filter(having).select(*keep)
+            elif having is not None:
+                raise SparkException("SQL: HAVING needs aggregates")
+            elif not stars:
+                df = df.select(*items)
+            elif items:
+                raise SparkException(
+                    "SQL: SELECT *, expr mixing is not supported")
+        if distinct:
+            df = df.distinct()
+        return df
+
+    def select(self):
+        """One [SELECT .. UNION ..]* chain with trailing ORDER BY /
+        LIMIT applying to the COMBINED result (SQL scoping)."""
+        df = self._select_core()
+        while True:
+            if self.kw("union", "all"):
+                df = df.union(self._select_core())
+            elif self.kw("union"):
+                # bare UNION deduplicates
+                df = df.union(self._select_core()).distinct()
+            else:
+                break
+        if self.kw("order", "by"):
+            orders = [self._sort_item()]
+            while self.op(","):
+                orders.append(self._sort_item())
+            df = df.order_by(*orders)
+        if self.kw("limit"):
+            k, v = self.next()
+            if k != "num":
+                raise SparkException("SQL: LIMIT needs a number")
+            df = df.limit(int(v))
+        return df
+
+    def _sort_item(self):
+        from spark_rapids_tpu.plan.nodes import SortOrder
+        e = self.expr()
+        asc = True
+        if self.kw("desc"):
+            asc = False
+        else:
+            self.kw("asc")
+        nulls_first = asc
+        if self.kw("nulls", "first"):
+            nulls_first = True
+        elif self.kw("nulls", "last"):
+            nulls_first = False
+        return SortOrder(e, ascending=asc, nulls_first=nulls_first)
+
+    def parse(self):
+        df = self.select()
+        if self.peek()[0] != "eof":
+            raise SparkException(
+                f"SQL: trailing input at {self.peek()[1]!r}")
+        return df
+
+
+def parse_sql(text: str, session):
+    return _Parser(text, session).parse()

@@ -11,6 +11,7 @@ import pyarrow as pa
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import to_arrow
+from spark_rapids_tpu.expr.core import SparkException
 from spark_rapids_tpu.plan import nodes as P
 from spark_rapids_tpu.runtime.task import TaskContext
 from spark_rapids_tpu.sql.dataframe import DataFrame
@@ -56,6 +57,7 @@ def _discover_hive(root: str):
 class TpuSession:
     def __init__(self, conf_overrides: Optional[Dict] = None):
         self.conf = C.RapidsConf(conf_overrides)
+        self._views: Dict = {}
         self._last_meta = None
         from spark_rapids_tpu.ops import pallas_kernels as PK
         PK.set_enabled(self.conf.get(C.PALLAS_ENABLED))
@@ -67,6 +69,23 @@ class TpuSession:
         set_session_conf(self.conf)
 
     # -- sources -----------------------------------------------------------
+    def create_or_replace_temp_view(self, name: str, df) -> None:
+        """Register a DataFrame for session.sql() FROM resolution."""
+        self._views[name.lower()] = df
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
+    def table(self, name: str):
+        if name.lower() not in self._views:
+            raise SparkException(f"table or view not found: {name}")
+        return self._views[name.lower()]
+
+    def sql(self, query: str):
+        """Run a SQL string over registered temp views (the analytic
+        subset grammar — sql/parser.py)."""
+        from spark_rapids_tpu.sql.parser import parse_sql
+        return parse_sql(query, self)
+
     def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
         self._activate()
         if isinstance(data, dict):

@@ -514,3 +514,9 @@ def test_width_bucket_and_luhn(session):
     d3 = session.create_dataframe({"c": ["\u0666"]})
     assert _one(d3.select(F.luhn_check(col("c")).alias("l")), "l") \
         == [False]
+
+
+def test_column_substr(session):
+    df = session.create_dataframe({"s": ["85001", "12345"]})
+    got = _one(df.select(col("s").substr(1, 2).alias("p")), "p")
+    assert got == ["85", "12"]

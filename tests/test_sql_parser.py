@@ -1,0 +1,169 @@
+"""SQL string frontend tests: each query differentially checked against
+the equivalent DataFrame-algebra build (sql/parser.py; the reference
+receives SQL via Catalyst, a standalone engine parses its own)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr.core import SparkException, col, lit
+
+
+@pytest.fixture
+def session():
+    s = TpuSession()
+    rng = np.random.default_rng(11)
+    s.create_or_replace_temp_view("t", s.create_dataframe(
+        {"k": rng.integers(0, 5, 300).tolist(),
+         "v": np.round(rng.uniform(0, 10, 300), 3).tolist(),
+         "name": [f"n{i % 17}" for i in range(300)]}))
+    s.create_or_replace_temp_view("d", s.create_dataframe(
+        {"k": [0, 1, 2, 3, 4], "label": ["a", "b", "c", "d", "e"]}))
+    return s
+
+
+def test_select_where_group_having_order_limit(session):
+    got = session.sql(
+        "SELECT k, SUM(v) AS sv, COUNT(*) AS n FROM t WHERE v > 2.0 "
+        "GROUP BY k HAVING COUNT(*) > 10 ORDER BY sv DESC LIMIT 3"
+    ).to_pydict()
+    t = session.table("t")
+    want = (t.filter(col("v") > lit(2.0)).group_by("k")
+            .agg(F.sum(col("v")).alias("sv"), F.count().alias("n"))
+            .filter(col("n") > lit(10))
+            .select(col("k"), col("sv"), col("n"))
+            .order_by(col("sv").desc()).limit(3).to_pydict())
+    assert got == want
+
+
+def test_join_and_expressions(session):
+    got = session.sql(
+        "SELECT t.k, label, v * 2 + 1 AS x FROM t JOIN d ON t.k = d.k "
+        "WHERE name LIKE 'n1%' AND v BETWEEN 1.0 AND 9.0 "
+        "ORDER BY x ASC, label ASC LIMIT 20").to_pydict()
+    t, d = session.table("t"), session.table("d")
+    from spark_rapids_tpu.expr.strings import Like
+    want = (t.join(d, on=[(col("k"), col("k"))])
+            .filter(Like(col("name"), "n1%")
+                    & (col("v") >= lit(1.0)) & (col("v") <= lit(9.0)))
+            .select(col("k"), col("label"),
+                    (col("v") * lit(2) + lit(1)).alias("x"))
+            .order_by(col("x").asc(), col("label").asc())
+            .limit(20).to_pydict())
+    assert got == want
+
+
+def test_case_cast_distinct_union(session):
+    got = session.sql(
+        "SELECT DISTINCT CASE WHEN v >= 5.0 THEN 'hi' ELSE 'lo' END AS b "
+        "FROM t ORDER BY b ASC").to_pydict()
+    assert got["b"] == ["hi", "lo"]
+    got = session.sql("SELECT CAST(v AS bigint) AS iv FROM t "
+                      "ORDER BY iv DESC LIMIT 1").to_pydict()
+    assert isinstance(got["iv"][0], int)
+    u = session.sql("SELECT k FROM d WHERE k < 1 "
+                    "UNION ALL SELECT k FROM d WHERE k > 3").to_pydict()
+    assert sorted(u["k"]) == [0, 4]
+
+
+def test_scalar_functions_and_in(session):
+    got = session.sql(
+        "SELECT upper(name) AS u, substring(name, 1, 2) AS p FROM t "
+        "WHERE k IN (1, 3) LIMIT 5").to_pydict()
+    assert all(s == s.upper() for s in got["u"])
+    assert all(len(s) <= 2 for s in got["p"])
+
+
+def test_global_agg_and_star(session):
+    got = session.sql("SELECT avg(v) AS m, min(k) AS lo FROM t"
+                      ).to_pydict()
+    t = session.table("t")
+    want = t.agg(F.avg(col("v")).alias("m"),
+                 F.min(col("k")).alias("lo")).to_pydict()
+    assert got == want
+    assert session.sql("SELECT * FROM d ORDER BY k ASC").to_pydict()[
+        "label"] == ["a", "b", "c", "d", "e"]
+
+
+def test_semi_anti_joins(session):
+    semi = session.sql("SELECT k FROM d LEFT SEMI JOIN t ON d.k = t.k "
+                       "ORDER BY k ASC").to_pydict()
+    anti = session.sql("SELECT k FROM d LEFT ANTI JOIN t ON d.k = t.k "
+                       ).to_pydict()
+    present = set(session.table("t").to_pydict()["k"])
+    assert set(semi["k"]) == present & {0, 1, 2, 3, 4}
+    assert set(anti["k"]) == {0, 1, 2, 3, 4} - present
+
+
+def test_null_handling_and_not(session):
+    s2 = TpuSession()
+    import pyarrow as pa
+    s2.create_or_replace_temp_view("n", s2.create_dataframe(
+        pa.table({"x": pa.array([1.0, None, 3.0], pa.float64())})))
+    assert s2.sql("SELECT x FROM n WHERE x IS NULL").to_pydict()["x"] \
+        == [None]
+    assert sorted(s2.sql(
+        "SELECT x FROM n WHERE x IS NOT NULL").to_pydict()["x"]) \
+        == [1.0, 3.0]
+    assert s2.sql("SELECT x FROM n WHERE NOT x = 1.0").to_pydict()["x"] \
+        == [3.0]
+    assert s2.sql("SELECT x FROM n WHERE x NOT IN (1.0)").to_pydict()[
+        "x"] == [3.0]
+
+
+def test_parse_errors_are_loud(session):
+    for bad in ("SELECT FROM t",
+                "SELECT k FROM t WHERE",
+                "SELECT k FROM nosuch",
+                "SELECT k, SUM(v) FROM t",       # agg without GROUP BY
+                "SELECT nosuchfn(k) FROM t",
+                "SELECT k FROM t ORDER BY k ASC extra"):
+        with pytest.raises((SparkException, KeyError)):
+            session.sql(bad).collect()
+
+
+def test_order_by_nulls_placement(session):
+    import pyarrow as pa
+    s2 = TpuSession()
+    s2.create_or_replace_temp_view("n", s2.create_dataframe(
+        pa.table({"x": pa.array([2.0, None, 1.0], pa.float64())})))
+    asc = s2.sql("SELECT x FROM n ORDER BY x ASC NULLS LAST"
+                 ).to_pydict()["x"]
+    assert asc == [1.0, 2.0, None]
+    desc = s2.sql("SELECT x FROM n ORDER BY x DESC NULLS FIRST"
+                  ).to_pydict()["x"]
+    assert desc == [None, 2.0, 1.0]
+
+
+def test_union_scoping_and_dedup(session):
+    # ORDER BY / LIMIT bind to the WHOLE union, not the last branch
+    got = session.sql(
+        "SELECT k FROM d WHERE k < 1 UNION ALL "
+        "SELECT k FROM d WHERE k > 3 ORDER BY k DESC LIMIT 1"
+    ).to_pydict()
+    assert got["k"] == [4]
+    # bare UNION deduplicates
+    u = session.sql("SELECT k FROM d UNION SELECT k FROM d").to_pydict()
+    assert sorted(u["k"]) == [0, 1, 2, 3, 4]
+
+
+def test_having_without_group_by(session):
+    # global aggregate: HAVING filters the single row
+    got = session.sql("SELECT count(*) AS n FROM t "
+                      "HAVING count(*) > 1000000").to_pydict()
+    assert got["n"] == []
+    with pytest.raises(SparkException):
+        session.sql("SELECT k FROM t HAVING k > 1").collect()
+
+
+def test_scientific_notation_and_negative_args(session):
+    got = session.sql("SELECT v * 1e3 AS x FROM t ORDER BY x ASC LIMIT 1"
+                      ).to_pydict()
+    t = session.table("t")
+    want = (t.select((col("v") * lit(1000.0)).alias("x"))
+            .order_by(col("x").asc()).limit(1).to_pydict())
+    assert got == want
+    got = session.sql("SELECT substring(name, -2, 2) AS tail FROM t "
+                      "LIMIT 3").to_pydict()
+    names = session.table("t").limit(3).to_pydict()["name"]
+    assert got["tail"] == [n[-2:] for n in names]

@@ -66,6 +66,8 @@ def gen_tables(sf: float, seed: int = 42):
     })
     item = pa.table({
         "i_item_sk": np.arange(n_item, dtype=np.int64),
+        "i_item_id": np.char.add("AAAAAAAA",
+                                 np.arange(n_item).astype(str)),
         "i_brand_id": rng.integers(1, 1000, n_item).astype(np.int32),
         "i_brand": np.char.add("brand#",
                                rng.integers(1, 1000, n_item).astype(str)),
@@ -98,7 +100,17 @@ def gen_tables(sf: float, seed: int = 42):
         "ca_address_sk": np.arange(n_addr, dtype=np.int64),
         "ca_city": np.array(["Midway", "Fairview", "Oakland", "Salem",
                              "Centerville"])[rng.integers(0, 5, n_addr)],
+        "ca_zip": np.char.zfill(
+            rng.integers(10000, 99999, n_addr).astype(str), 5),
         "ca_gmt_offset": np.where(rng.random(n_addr) < 0.8, -5.0, -6.0),
+    })
+    n_inv = max(n_item * 8, 4000)
+    inventory = pa.table({
+        "inv_date_sk": rng.integers(d0, d0 + n_date,
+                                    n_inv).astype(np.int64),
+        "inv_item_sk": rng.integers(0, n_item, n_inv).astype(np.int64),
+        "inv_quantity_on_hand": rng.integers(
+            0, 1000, n_inv).astype(np.int32),
     })
 
     def sales(n, prefix, extra=()):
@@ -124,6 +136,7 @@ def gen_tables(sf: float, seed: int = 42):
     return {
         "date_dim": date_dim, "item": item, "store": store,
         "customer": customer, "customer_address": customer_address,
+        "inventory": inventory,
         "store_sales": sales(n_ss, "ss"),
         "web_sales": sales(n_ws, "ws"),
         "catalog_sales": sales(n_cs, "cs"),
@@ -529,9 +542,70 @@ def q76(s, d):
             .limit(100))
 
 
+def q45(s, d):
+    """web sales by customer zip/city for a quarter (zip-prefix list)."""
+    return (d["web_sales"]
+            .join(d["customer"], on=[(col("ws_customer_sk"),
+                                      col("c_customer_sk"))])
+            .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                             col("ca_address_sk"))])
+            .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter((col("d_qoy") == lit(2)) & (col("d_year") == lit(2000))
+                    & col("ca_zip").substr(1, 2).isin(
+                        "85", "86", "87", "88", "89"))
+            .group_by("ca_zip", "ca_city")
+            .agg(F.sum(col("ws_sales_price")).alias("total"))
+            .order_by(col("ca_zip").asc(), col("ca_city").asc())
+            .limit(100))
+
+
+def q60(s, d):
+    """per-item-id September Music sales across the three channels."""
+    def chan(sales, date_col, item_col, price_col):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col), col("d_date_sk"))])
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .filter((col("d_year") == lit(1999)) & (col("d_moy") == lit(9))
+                        & (col("i_category") == lit("Music")))
+                .group_by("i_item_id")
+                .agg(F.sum(col(price_col)).alias("total_sales")))
+    u = (chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+              "ss_ext_sales_price")
+         .union(chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                     "cs_ext_sales_price"))
+         .union(chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                     "ws_ext_sales_price")))
+    return (u.group_by("i_item_id")
+            .agg(F.sum(col("total_sales")).alias("total_sales"))
+            .order_by(col("i_item_id").asc(),
+                      col("total_sales").asc()).limit(100))
+
+
+def q82(s, d):
+    """items in stock (100..500 on hand) in a price band that sold in
+    stores: inventory semi-joined against store_sales."""
+    eligible = (d["item"]
+                .join(d["inventory"], on=[(col("i_item_sk"),
+                                           col("inv_item_sk"))])
+                .join(d["date_dim"], on=[(col("inv_date_sk"),
+                                          col("d_date_sk"))])
+                .filter((col("i_current_price") >= lit(30.0))
+                        & (col("i_current_price") <= lit(60.0))
+                        & (col("inv_quantity_on_hand") >= lit(100))
+                        & (col("inv_quantity_on_hand") <= lit(500))
+                        & (col("d_year") == lit(2000))))
+    sold = eligible.join(d["store_sales"],
+                         on=[(col("i_item_sk"), col("ss_item_sk"))],
+                         how="left_semi")
+    return (sold.select(col("i_item_id"), col("i_current_price"))
+            .distinct()
+            .order_by(col("i_item_id").asc()).limit(100))
+
+
 QUERIES = {3: q3, 7: q7, 12: q12, 19: q19, 20: q20, 26: q26, 33: q33,
-           34: q34, 42: q42, 43: q43, 46: q46, 48: q48, 52: q52, 55: q55,
-           62: q62, 65: q65, 68: q68, 71: q71, 73: q73, 76: q76, 79: q79,
+           34: q34, 42: q42, 43: q43, 45: q45, 46: q46, 48: q48, 52: q52, 55: q55,
+           60: q60, 62: q62, 65: q65, 68: q68, 71: q71, 73: q73, 76: q76, 79: q79, 82: q82,
            89: q89, 96: q96, 97: q97, 98: q98}
 
 
