@@ -36,7 +36,7 @@ _KEYWORDS = {
     "case", "when", "then", "else", "end", "cast", "join", "inner",
     "left", "right", "full", "outer", "semi", "anti", "cross", "on",
     "asc", "desc", "union", "all", "distinct", "true", "false", "nulls",
-    "first", "last",
+    "first", "last", "with",
 }
 
 _TYPES = {
@@ -76,6 +76,7 @@ class _Parser:
         self.toks = _tokenize(text)
         self.i = 0
         self.session = session
+        self.ctes = {}  # WITH-clause name -> DataFrame, query-scoped
 
     # -- token plumbing -----------------------------------------------------
 
@@ -311,8 +312,15 @@ class _Parser:
     # -- query --------------------------------------------------------------
 
     def _table(self):
-        name = self.ident()
-        df = self.session.table(name)
+        if self.op("("):
+            # derived table: FROM (SELECT ...) [AS] alias
+            df = self.select()
+            self.expect_op(")")
+        else:
+            name = self.ident()
+            df = self.ctes.get(name.lower())
+            if df is None:
+                df = self.session.table(name)
         # optional alias (resolution stays name-based)
         k, v = self.peek()
         if k == "id" or (k == "kw" and self.kw("as")):
@@ -437,12 +445,15 @@ class _Parser:
             df = df.group_by(*group_keys).agg(*aggs)
             if having is not None:
                 df = df.filter(having)
+            final_items = out_names if not stars else None
             if not stars:
-                df = df.select(*out_names)
+                def projector(d):
+                    return d.select(*out_names)
             else:
-                keep = [E.col(n) for n in df.plan.schema.names
-                        if not n.startswith("__having")]
-                df = df.select(*keep)
+                def projector(d):
+                    keep = [E.col(n) for n in d.plan.schema.names
+                            if not n.startswith("__having")]
+                    return d.select(*keep)
         else:
             if any(agg_of(it)[0] is not None for it in items):
                 aggs = []
@@ -472,35 +483,102 @@ class _Parser:
                         if not na.name.startswith("__having")]
                 df = df.agg(*aggs)
                 if having is not None:
-                    df = df.filter(having).select(*keep)
+                    df = df.filter(having)
+
+                final_items = keep
+
+                def projector(d):
+                    return d.select(*keep)
             elif having is not None:
                 raise SparkException("SQL: HAVING needs aggregates")
             elif not stars:
-                df = df.select(*items)
+                final_items = items
+
+                def projector(d):
+                    return d.select(*items)
             elif items:
                 raise SparkException(
                     "SQL: SELECT *, expr mixing is not supported")
+            else:
+                final_items = None
+
+                def projector(d):
+                    return d
         if distinct:
-            df = df.distinct()
-        return df
+            base = projector
+
+            def projector(d):  # noqa: F811 - deliberate wrap
+                return base(d).distinct()
+        # the projection is DEFERRED so ORDER BY can reference
+        # non-projected source columns (standard SQL scoping)
+        return df, projector, distinct, final_items
 
     def select(self):
         """One [SELECT .. UNION ..]* chain with trailing ORDER BY /
         LIMIT applying to the COMBINED result (SQL scoping)."""
-        df = self._select_core()
+        pre, proj, distinct, final_items = self._select_core()
+        df = proj(pre)
+        unioned = False
         while True:
             if self.kw("union", "all"):
-                df = df.union(self._select_core())
+                p2, j2, _, _ = self._select_core()
+                df = df.union(j2(p2))
+                unioned = True
             elif self.kw("union"):
-                # bare UNION deduplicates
-                df = df.union(self._select_core()).distinct()
+                p2, j2, _, _ = self._select_core()
+                df = df.union(j2(p2)).distinct()  # bare UNION dedups
+                unioned = True
             else:
                 break
         if self.kw("order", "by"):
             orders = [self._sort_item()]
             while self.op(","):
                 orders.append(self._sort_item())
-            df = df.order_by(*orders)
+            try:
+                df = df.order_by(*orders)
+            except KeyError as ke:
+                # ORDER BY a non-projected source column: sort a
+                # WIDENED frame (source columns + projected aliases)
+                # then project, so aliases and hidden columns mix
+                # (unions and DISTINCT expose output columns only)
+                if unioned or distinct or final_items is None:
+                    raise SparkException(
+                        f"SQL: ORDER BY column not in output: {ke}; "
+                        "DISTINCT/UNION results sort by output columns "
+                        "only") from None
+                df = self._order_widened(pre, final_items, orders)
+        if self.kw("limit"):
+            k, v = self.next()
+            if k != "num":
+                raise SparkException("SQL: LIMIT needs a number")
+            df = df.limit(int(v))
+        return df
+
+    def _order_widened(self, pre, final_items, orders):
+        from spark_rapids_tpu.plan.nodes import expr_name
+        src = pre.plan.schema.names
+        lower = {n.lower() for n in src}
+        add, names = [], []
+        for j, it in enumerate(final_items):
+            nm = expr_name(it, j)
+            names.append(nm)
+            if nm.lower() in lower:
+                plain = isinstance(it, E.Col) and it.name.lower() == \
+                    nm.lower()
+                if not plain:
+                    raise SparkException(
+                        f"SQL: ORDER BY with alias {nm!r} shadowing a "
+                        "source column is not supported")
+            else:
+                add.append(it if isinstance(it, E.Alias)
+                           else E.Alias(it, nm))
+        wide = pre.select(*[E.col(n) for n in src], *add)
+        try:
+            wide = wide.order_by(*orders)
+        except KeyError as ke:
+            raise SparkException(
+                f"SQL: ORDER BY column not found: {ke}") from None
+        return wide.select(*[E.col(n) for n in names])
         if self.kw("limit"):
             k, v = self.next()
             if k != "num":
@@ -524,6 +602,16 @@ class _Parser:
         return SortOrder(e, ascending=asc, nulls_first=nulls_first)
 
     def parse(self):
+        if self.kw("with"):
+            while True:
+                name = self.ident()
+                if not self.kw("as"):
+                    raise SparkException("SQL: WITH needs AS")
+                self.expect_op("(")
+                self.ctes[name.lower()] = self.select()
+                self.expect_op(")")
+                if not self.op(","):
+                    break
         df = self.select()
         if self.peek()[0] != "eof":
             raise SparkException(

@@ -167,3 +167,41 @@ def test_scientific_notation_and_negative_args(session):
                       "LIMIT 3").to_pydict()
     names = session.table("t").limit(3).to_pydict()["name"]
     assert got["tail"] == [n[-2:] for n in names]
+
+
+def test_cte_and_derived_table(session):
+    got = session.sql(
+        "WITH agg AS (SELECT k, SUM(v) AS sv FROM t GROUP BY k), "
+        "top AS (SELECT k FROM agg ORDER BY sv DESC LIMIT 2) "
+        "SELECT count(*) AS n FROM t JOIN top ON t.k = top.k"
+    ).to_pydict()
+    t = session.table("t")
+    top = (t.group_by("k").agg(F.sum(col("v")).alias("sv"))
+           .order_by(col("sv").desc()).limit(2).select(col("k")))
+    want = t.join(top, on=[(col("k"), col("k"))]).count()
+    assert got["n"] == [want]
+    sub = session.sql(
+        "SELECT k FROM (SELECT k, MAX(v) AS mx FROM t GROUP BY k) s "
+        "WHERE mx > 9.0 ORDER BY k ASC").to_pydict()
+    want2 = (t.group_by("k").agg(F.max(col("v")).alias("mx"))
+             .filter(col("mx") > lit(9.0)).select(col("k"))
+             .order_by(col("k").asc()).to_pydict())
+    assert sub == want2
+    # a CTE name must not leak across queries
+    with pytest.raises(SparkException):
+        session.sql("SELECT k FROM agg").collect()
+
+
+def test_order_by_alias_plus_hidden_column(session):
+    # valid SQL: one sort key is an output alias, the other is a
+    # non-projected source column
+    got = session.sql("SELECT v AS val FROM t ORDER BY val ASC, k ASC "
+                      "LIMIT 5").to_pydict()
+    t = session.table("t")
+    want = (t.order_by(col("v").asc(), col("k").asc())
+            .select(col("v").alias("val")).limit(5).to_pydict())
+    assert got == want
+    # DISTINCT exposes output columns only — loud SparkException,
+    # not a raw KeyError
+    with pytest.raises(SparkException):
+        session.sql("SELECT DISTINCT k FROM t ORDER BY v").collect()
