@@ -36,7 +36,9 @@ _KEYWORDS = {
     "case", "when", "then", "else", "end", "cast", "join", "inner",
     "left", "right", "full", "outer", "semi", "anti", "cross", "on",
     "asc", "desc", "union", "all", "distinct", "true", "false", "nulls",
-    "first", "last", "with",
+    "first", "last", "with", "over", "partition", "rows",
+    "range", "unbounded", "preceding", "following", "current",
+    "row",
 }
 
 _TYPES = {
@@ -246,7 +248,63 @@ class _Parser:
         fn = getattr(F, name.lower(), None)
         if fn is None or not callable(fn):
             raise SparkException(f"SQL: unknown function {name!r}")
-        return fn(*args)
+        out = fn(*args)
+        if self.kw("over"):
+            out = self._over(out)
+        return out
+
+    def _frame_bound(self, default):
+        if self.kw("unbounded", "preceding") \
+                or self.kw("unbounded", "following"):
+            return None
+        if self.kw("current", "row"):
+            return 0
+        k, v = self.peek()
+        sign = 1
+        if k == "op" and v == "-":
+            self.next()
+            sign = -1
+            k, v = self.peek()
+        if k == "num":
+            self.next()
+            n = sign * int(v)
+            if self.kw("preceding"):
+                return -abs(n)
+            if self.kw("following"):
+                return abs(n)
+            raise SparkException(
+                "SQL: frame bound needs PRECEDING/FOLLOWING")
+        return default
+
+    def _over(self, fn):
+        """fn(...) OVER (PARTITION BY .. ORDER BY .. [ROWS BETWEEN ..])
+        -> WindowExpr; aggregates become windowed aggregates."""
+        from spark_rapids_tpu.expr import window as WE
+        from spark_rapids_tpu.expr.aggregates import AggFunction
+        self.expect_op("(")
+        spec = WE.WindowSpec()
+        if self.kw("partition", "by"):
+            parts = [self.expr()]
+            while self.op(","):
+                parts.append(self.expr())
+            spec = spec.partition_by(*parts)
+        if self.kw("order", "by"):
+            orders = [self._sort_item()]
+            while self.op(","):
+                orders.append(self._sort_item())
+            spec = spec.order_by(*orders)
+        if self.kw("rows"):
+            if not self.kw("between"):
+                raise SparkException("SQL: ROWS needs BETWEEN")
+            lo = self._frame_bound(None)
+            if not self.kw("and"):
+                raise SparkException("SQL: frame needs AND")
+            hi = self._frame_bound(None)
+            spec = spec.rows_between(lo, hi)
+        self.expect_op(")")
+        if isinstance(fn, AggFunction):
+            return WE.over(fn, spec)
+        return fn.over(spec)
 
     def _scalar_or_expr(self):
         """Trailing function args: plain (optionally negative) numeric
