@@ -284,14 +284,15 @@ PY_WORKER_POOL_PARALLELISM = conf_int(
     "Worker processes for the python UDF pool (0 = cpu count, cap 8).")
 
 UDF_COMPILER_ENABLED = conf_bool(
-    "spark.rapids.sql.udfCompiler.enabled", True,
+    "spark.rapids.sql.udfCompiler.enabled", False,
     "Translate simple Python UDF bytecode (arithmetic, comparisons, "
     "conditionals, math builtins) into fused device expressions "
     "(reference udf-compiler). Untranslatable UDFs stay on the row tier. "
     "Semantics note (same tradeoff as the reference compiler): compiled "
     "UDFs null-propagate instead of calling fn(None), and arithmetic "
     "errors yield null instead of raising (non-ANSI Spark semantics) — "
-    "a row-tier UDF that RAISES on bad input behaves differently.")
+    "a row-tier UDF that RAISES on bad input behaves differently. "
+    "Off by default for that reason (matching the reference).")
 
 SKIP_AGG_PASS_RATIO = conf_float(
     "spark.rapids.sql.agg.skipAggPassReductionRatio", 1.0,

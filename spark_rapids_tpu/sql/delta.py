@@ -87,6 +87,44 @@ def _schema_string(schema: pa.Schema) -> str:
                        "fields": [field_json(f) for f in schema]})
 
 
+#: Delta spec checkpoint schema (the subset of action fields this writer
+#: emits; struct columns, null when the row is a different action kind).
+_MAP_SS = pa.map_(pa.string(), pa.string())
+_CHECKPOINT_SCHEMA = pa.schema([
+    ("protocol", pa.struct([("minReaderVersion", pa.int32()),
+                            ("minWriterVersion", pa.int32())])),
+    ("metaData", pa.struct([
+        ("id", pa.string()), ("name", pa.string()),
+        ("description", pa.string()),
+        ("format", pa.struct([("provider", pa.string()),
+                              ("options", _MAP_SS)])),
+        ("schemaString", pa.string()),
+        ("partitionColumns", pa.list_(pa.string())),
+        ("configuration", _MAP_SS),
+        ("createdTime", pa.int64())])),
+    ("add", pa.struct([
+        ("path", pa.string()), ("partitionValues", _MAP_SS),
+        ("size", pa.int64()), ("modificationTime", pa.int64()),
+        ("dataChange", pa.bool_()), ("stats", pa.string())])),
+    ("remove", pa.struct([
+        ("path", pa.string()), ("deletionTimestamp", pa.int64()),
+        ("dataChange", pa.bool_())])),
+])
+
+
+def _typed_metadata(meta: dict) -> dict:
+    """metaData action dict → checkpoint row (maps as key/value pairs)."""
+    fmt = meta.get("format") or {}
+    return {"id": meta.get("id"), "name": meta.get("name"),
+            "description": meta.get("description"),
+            "format": {"provider": fmt.get("provider", "parquet"),
+                       "options": sorted((fmt.get("options") or {}).items())},
+            "schemaString": meta.get("schemaString"),
+            "partitionColumns": meta.get("partitionColumns") or [],
+            "configuration": sorted((meta.get("configuration") or {}).items()),
+            "createdTime": meta.get("createdTime")}
+
+
 class DeltaLog:
     """Replay + commit machinery for one table directory."""
 
@@ -105,8 +143,24 @@ class DeltaLog:
         with open(lc) as f:
             v = int(json.load(f)["version"])
         t = pq.read_table(os.path.join(self.log_path, _checkpoint_name(v)))
-        actions = [{row["kind"]: json.loads(row["payload"])}
-                   for row in t.to_pylist()]
+        if "kind" in t.schema.names and "payload" in t.schema.names:
+            # pre-round-5 checkpoint layout (kind + JSON payload columns)
+            return v, [{row["kind"]: json.loads(row["payload"])}
+                       for row in t.to_pylist()]
+        actions = []
+        for row in t.to_pylist():
+            for kind in ("protocol", "metaData", "add", "remove"):
+                a = row.get(kind)
+                if a is not None:
+                    if "partitionValues" in a:
+                        a["partitionValues"] = dict(
+                            a["partitionValues"] or [])
+                    if kind == "metaData":
+                        a["configuration"] = dict(a["configuration"] or [])
+                        if a.get("format"):
+                            a["format"]["options"] = dict(
+                                a["format"]["options"] or [])
+                    actions.append({kind: a})
         return v, actions
 
     def versions_on_disk(self) -> List[int]:
@@ -169,19 +223,24 @@ class DeltaLog:
             self._write_checkpoint(version)
 
     def _write_checkpoint(self, version: int) -> None:
-        # One action per row. Action payloads are stored as JSON columns
-        # (the spec's typed-struct checkpoint schema chokes parquet
-        # writers on empty structs like format.options; JSON columns keep
-        # the checkpoint self-describing and byte-stable — a documented
-        # deviation from the Delta checkpoint schema).
+        # One action per row in the Delta spec's typed checkpoint schema
+        # (protocol / metaData / add struct columns, non-applicable
+        # columns null) so external Delta readers that follow
+        # _last_checkpoint can replay it.
         snap = self.snapshot(version)
-        rows = [{"kind": "protocol", "payload": json.dumps(snap.protocol)},
-                {"kind": "metaData", "payload": json.dumps(snap.metadata)}]
+        rows = [{"protocol": snap.protocol},
+                {"metaData": _typed_metadata(snap.metadata)}]
         for add in snap.files.values():
-            rows.append({"kind": "add", "payload": json.dumps(add)})
-        pq.write_table(pa.Table.from_pylist(rows),
-                       os.path.join(self.log_path,
-                                    _checkpoint_name(version)))
+            a = dict(add)
+            a["partitionValues"] = sorted(
+                (a.get("partitionValues") or {}).items())
+            rows.append({"add": {k: a.get(k) for k in
+                                 ("path", "partitionValues", "size",
+                                  "modificationTime", "dataChange",
+                                  "stats")}})
+        pq.write_table(
+            pa.Table.from_pylist(rows, schema=_CHECKPOINT_SCHEMA),
+            os.path.join(self.log_path, _checkpoint_name(version)))
         with open(os.path.join(self.log_path, _LAST_CHECKPOINT), "w") as f:
             json.dump({"version": version, "size": len(rows)}, f)
 
@@ -297,7 +356,10 @@ class DeltaTable:
             fp = os.path.join(self.path, rel)
             table = pq.read_table(fp)
             df = self.session.create_dataframe(table)
-            kept = df.filter(~_as_pred(condition)).collect()
+            # DELETE removes only rows where the condition is TRUE; rows
+            # where it evaluates to NULL are kept (Spark DeleteCommand).
+            pred = _as_pred(condition)
+            kept = df.filter(pred.is_null() | ~pred).collect()
             if kept.num_rows == table.num_rows:
                 continue  # file untouched
             deleted += table.num_rows - kept.num_rows

@@ -68,6 +68,24 @@ def _iceberg_schema(schema: pa.Schema) -> dict:
                        for i, f in enumerate(schema)]}
 
 
+_FROM_ICEBERG_TYPE = {
+    "long": pa.int64(), "int": pa.int32(), "double": pa.float64(),
+    "float": pa.float32(), "boolean": pa.bool_(), "date": pa.date32(),
+    "timestamp": pa.timestamp("us"), "string": pa.string()}
+
+
+def _arrow_schema(ice_schema: dict) -> pa.Schema:
+    fields = []
+    for f in ice_schema["fields"]:
+        if f["type"] not in _FROM_ICEBERG_TYPE:
+            # only foreign tables can hit this: _iceberg_schema never
+            # writes other type names
+            raise SparkException(
+                f"unsupported iceberg type {f['type']!r} for {f['name']!r}")
+        fields.append(pa.field(f["name"], _FROM_ICEBERG_TYPE[f["type"]]))
+    return pa.schema(fields)
+
+
 class IcebergTable:
     """Read/write an Iceberg v1-subset table directory."""
 
@@ -234,7 +252,9 @@ class IcebergTable:
     def to_df(self, snapshot_id: Optional[int] = None):
         files = self.data_files(snapshot_id)
         if not files:
-            raise SparkException("empty iceberg snapshot")
+            # Empty snapshot: the metadata carries the schema.
+            schema = _arrow_schema(self._metadata()["schema"])
+            return self.session.create_dataframe(schema.empty_table())
         table = pa.concat_tables([
             pq.read_table(os.path.join(self.path, f["file_path"]))
             for f in files])

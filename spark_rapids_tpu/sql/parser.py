@@ -637,12 +637,6 @@ class _Parser:
             raise SparkException(
                 f"SQL: ORDER BY column not found: {ke}") from None
         return wide.select(*[E.col(n) for n in names])
-        if self.kw("limit"):
-            k, v = self.next()
-            if k != "num":
-                raise SparkException("SQL: LIMIT needs a number")
-            df = df.limit(int(v))
-        return df
 
     def _sort_item(self):
         from spark_rapids_tpu.plan.nodes import SortOrder

@@ -126,3 +126,39 @@ def test_vacuum_drops_unreferenced(session, tmp_path):
     dropped = dt.vacuum(retain_hours=0.0)
     assert len(dropped) == 1
     assert dt.to_df().count() == 1
+
+
+def test_delete_null_condition_keeps_rows(session, tmp_path):
+    """DELETE only removes rows where the condition is TRUE; NULL
+    evaluations keep the row (Spark DeleteCommand semantics)."""
+    p = str(tmp_path / "tbl")
+    t = pa.table({"k": pa.array([1, 2, None, 4], pa.int64()),
+                  "v": pa.array([1., 2., 3., 4.], pa.float64())})
+    dt = DeltaTable.create(session, p, t)
+    n = dt.delete(col("k") >= lit(3))   # NULL >= 3 is NULL, row kept
+    assert n == 1
+    got = sorted(r["v"] for r in dt.to_df().collect().to_pylist())
+    assert got == [1.0, 2.0, 3.0]
+
+
+def test_checkpoint_is_spec_typed_schema(session, tmp_path):
+    """The parquet checkpoint uses the Delta spec's typed action-struct
+    columns so a foreign reader following _last_checkpoint can replay."""
+    import pyarrow.parquet as pq
+    p = str(tmp_path / "tbl")
+    dt = DeltaTable.create(session, p, _t([0], [0.0]))
+    for i in range(1, 11):
+        dt.append(session.create_dataframe(_t([i], [float(i)])))
+    cp = [n for n in os.listdir(os.path.join(p, "_delta_log"))
+          if n.endswith(".checkpoint.parquet")]
+    t = pq.read_table(os.path.join(p, "_delta_log", cp[0]))
+    assert {"protocol", "metaData", "add", "remove"} <= set(t.schema.names)
+    for name in ("protocol", "metaData", "add"):
+        assert pa.types.is_struct(t.schema.field(name).type), name
+    rows = t.to_pylist()
+    assert sum(1 for r in rows if r["protocol"] is not None) == 1
+    meta = next(r["metaData"] for r in rows if r["metaData"] is not None)
+    assert json.loads(meta["schemaString"])["type"] == "struct"
+    adds = [r["add"] for r in rows if r["add"] is not None]
+    assert len(adds) == 11 and all(a["path"].endswith(".parquet")
+                                   for a in adds)

@@ -16,9 +16,23 @@ from spark_rapids_tpu.expr.core import BoundRef, col
 from asserts import assert_tpu_and_cpu_are_equal_collect
 
 
+@pytest.fixture(autouse=True)
+def _enable_compiler():
+    # The compiler is off by default (matching the reference conf); these
+    # tests exercise it, so turn it on for the module.
+    from spark_rapids_tpu import config as C
+    old = C.conf().get(C.UDF_COMPILER_ENABLED)
+    C.conf().set(C.UDF_COMPILER_ENABLED.key, "true")
+    yield
+    C.conf().set(C.UDF_COMPILER_ENABLED.key, str(old).lower())
+
+
 @pytest.fixture
 def session():
-    return TpuSession()
+    # session-level conf too: _activate() republishes the session conf on
+    # every dataframe op, which would otherwise mask the global set above
+    return TpuSession(
+        conf_overrides={"spark.rapids.sql.udfCompiler.enabled": "true"})
 
 
 def _refs(*dts):
