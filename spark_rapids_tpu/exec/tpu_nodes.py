@@ -1153,6 +1153,18 @@ class _AggKernels:
     _PALLAS_SEG_MIN_BITS = 11
     _PALLAS_SEG_MAX_BITS = 24
 
+    def _pallas_ops_ok(self, state_specs) -> bool:
+        n_sums = 0
+        for op, src, sdt in state_specs:
+            if op in ("count", "count_all"):
+                continue
+            if op == "sum" and src is not None and not src.is_string                     and not src.is_nested and np.dtype(sdt.np_dtype) in (
+                        np.dtype(np.float64), np.dtype(np.float32)):
+                n_sums += 1
+                continue
+            return False
+        return 1 <= n_sums <= 2
+
     def _pallas_seg_eligible(self, live, state_specs, spec) -> bool:
         from spark_rapids_tpu.ops import pallas_kernels as PK
         if not PK.enabled():
@@ -1164,21 +1176,103 @@ class _AggKernels:
         from spark_rapids_tpu.ops.pallas_segsum import CHUNK_ROWS, TILE
         # HBM budget: the fused stage carries the sorted planes, digit
         # lanes, accumulators, AND the cond fallback's scatter temps; the
-        # 32M q3 shape measured 18.5G against the v5e's 15.75G even with
-        # per-chunk payload stacks — large batches stay on the scatter
-        # path until the stage is split
+        # 32M q3 shape measured 18.5G against the v5e's 15.75G —
+        # larger batches take the CHUNKED kernel path (below) when the
+        # partial merge is cheap, else the scatter path
         if cap % TILE or cap < 4 * TILE or cap > CHUNK_ROWS:
             return False
-        n_sums = 0
-        for op, src, sdt in state_specs:
-            if op in ("count", "count_all"):
-                continue
-            if op == "sum" and src is not None and not src.is_string                     and not src.is_nested and np.dtype(sdt.np_dtype) in (
-                        np.dtype(np.float64), np.dtype(np.float32)):
-                n_sums += 1
-                continue
-            return False
-        return 1 <= n_sums <= 2
+        return self._pallas_ops_ok(state_specs)
+
+    def _pallas_chunk_plan(self, live, state_specs, spec) -> int:
+        """Chunk count for the chunked kernel path (0 = ineligible).
+        Batches past the kernel's whole-stage HBM ceiling run it per
+        CHUNK_ROWS slice and sum-merge the k small dense partials; only
+        worthwhile when that merge (k * 2^bits rows) is itself cheap."""
+        from spark_rapids_tpu.ops import pallas_kernels as PK
+        if not PK.enabled():
+            return 0
+        if not (self._PALLAS_SEG_MIN_BITS <= spec.total_bits
+                <= self._PALLAS_SEG_MAX_BITS):
+            return 0
+        if not self._pallas_ops_ok(state_specs):
+            return 0
+        cap = live.shape[0]
+        from spark_rapids_tpu.ops.pallas_segsum import CHUNK_ROWS
+        if cap <= CHUNK_ROWS or cap % CHUNK_ROWS:
+            return 0
+        k = cap // CHUNK_ROWS
+        if k * (1 << spec.total_bits) > CHUNK_ROWS:
+            return 0
+        return k
+
+    def _chunked_pallas_agg(self, live, key_cols, state_specs, spec,
+                            ranges, k: int) -> ColumnarBatch:
+        """Run the Pallas sorted-window groupby per CHUNK_ROWS slice and
+        merge the k dense partials with one recursive bucket agg — the
+        stage split that unlocks the kernel at 30M-row shapes (the
+        recursive re-aggregation pattern of GpuAggregateExec.scala:
+        208-315, done by chunking instead of repartitioning)."""
+        from spark_rapids_tpu.ops.pallas_segsum import (CHUNK_ROWS,
+                                                        MAX_GROUP_ROWS)
+
+        def cv_rows(c, off):
+            if c is None:
+                return None
+            if c.is_dict:
+                data = {"codes": c.data["codes"][off:off + CHUNK_ROWS],
+                        "dict_offsets": c.data["dict_offsets"],
+                        "dict_bytes": c.data["dict_bytes"]}
+            else:
+                data = c.data[off:off + CHUNK_ROWS]
+            v = None if c.validity is None \
+                else c.validity[off:off + CHUNK_ROWS]
+            return ColumnVector(c.dtype, data, v,
+                                dict_unique=c.dict_unique, bounds=c.bounds)
+
+        nkeys = len(key_cols)
+        parts: List[ColumnarBatch] = []
+        for i in range(k):
+            off = i * CHUNK_ROWS
+            live_c = live[off:off + CHUNK_ROWS]
+            keys_c = [cv_rows(c, off) for c in key_cols]
+            specs_c = [(op, cv_rows(src, off), sdt)
+                       for op, src, sdt in state_specs]
+            post, (max_cnt, has_specials) = \
+                self._pallas_seg_kernel_and_post(live_c, keys_c, specs_c,
+                                                 spec, ranges)
+
+            def fallback(lc=live_c, kc=keys_c, sc=specs_c):
+                return self._bucket_scatter_agg_xla(lc, kc, sc, spec,
+                                                    ranges)
+            parts.append(lax.cond(
+                (max_cnt <= MAX_GROUP_ROWS) & ~has_specials,
+                post, fallback))
+        # concatenate the k equal-capacity partials (dict key vocab
+        # planes are shared across chunks) and sum-merge per bucket:
+        # sum states merge by sum, count states by integer sum
+        cat_cols: List[ColumnVector] = []
+        for ci in range(nkeys + len(state_specs)):
+            cvs = [p.columns[ci] for p in parts]
+            c0 = cvs[0]
+            if c0.is_dict:
+                data = {"codes": jnp.concatenate(
+                            [c.data["codes"] for c in cvs]),
+                        "dict_offsets": c0.data["dict_offsets"],
+                        "dict_bytes": c0.data["dict_bytes"]}
+            else:
+                data = jnp.concatenate([c.data for c in cvs])
+            if any(c.validity is not None for c in cvs):
+                val = jnp.concatenate([c.validity_or_default(c.capacity)
+                                       for c in cvs])
+            else:
+                val = None
+            cat_cols.append(ColumnVector(c0.dtype, data, val,
+                                         dict_unique=c0.dict_unique))
+        cat_live = jnp.concatenate([p.live_mask() for p in parts])
+        merge_specs = [("sum", cat_cols[nkeys + j], sdt)
+                       for j, (_op, _src, sdt) in enumerate(state_specs)]
+        return self._bucket_scatter_agg(cat_live, cat_cols[:nkeys],
+                                        merge_specs, spec, ranges)
 
     def _pallas_seg_kernel_and_post(self, live, key_cols, state_specs,
                                     spec, ranges):
@@ -1313,6 +1407,10 @@ class _AggKernels:
                 post,
                 lambda: self._bucket_scatter_agg_xla(
                     live, key_cols, state_specs, spec, ranges))
+        k = self._pallas_chunk_plan(live, state_specs, spec)
+        if k:
+            return self._chunked_pallas_agg(live, key_cols, state_specs,
+                                            spec, ranges, k)
         return self._bucket_scatter_agg_xla(live, key_cols, state_specs,
                                             spec, ranges)
 
