@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnVector
-from spark_rapids_tpu.expr.core import CpuCol, Expression
+from spark_rapids_tpu.expr.core import CpuCol, Expression, SparkException
 
 
 class AggFunction:
@@ -657,3 +657,36 @@ class ApproxPercentile(Percentile):
     def __init__(self, child, percentage: float, accuracy: int = 10000):
         super().__init__(child, percentage)
         self.accuracy = accuracy
+
+
+class GroupingMarker(AggFunction):
+    """grouping(col) / grouping_id(): pseudo-aggregates valid only under
+    ROLLUP/CUBE/GROUPING SETS. GroupedData.agg resolves them to bit
+    reads of the Expand-introduced __grouping_id key (the same rewrite
+    Catalyst applies before the reference sees the plan; reference
+    GpuExpandExec consumes the already-lowered form). They never reach
+    the aggregation kernels."""
+
+    def __init__(self, *children: Expression):
+        super().__init__(*children)
+
+    def state_schema(self):
+        raise SparkException(
+            "grouping()/grouping_id() is only valid with "
+            "ROLLUP/CUBE/GROUPING SETS")
+
+
+class Grouping(GroupingMarker):
+    """grouping(col): 1 when the key is aggregated away in this output
+    row, else 0 (Spark ByteType)."""
+
+    def result_type(self):
+        return T.INT8
+
+
+class GroupingID(GroupingMarker):
+    """grouping_id(): the full bitmask over the group-by keys
+    (Spark LongType)."""
+
+    def result_type(self):
+        return T.INT64

@@ -167,6 +167,43 @@ class DataFrame:
 
     groupBy = group_by
 
+    def rollup(self, *keys) -> "GroupedData":
+        """Hierarchical grouping sets (full, drop-last, ..., grand
+        total) lowered onto Expand (reference GpuExpandExec — the
+        Catalyst ROLLUP rewrite done in-engine)."""
+        ks = [_e(k) for k in keys]
+        sets = [tuple(range(i)) for i in range(len(ks), -1, -1)]
+        return GroupedData(ks, self, grouping_sets=sets)
+
+    def cube(self, *keys) -> "GroupedData":
+        """All 2^n grouping-set combinations, lowered onto Expand."""
+        ks = [_e(k) for k in keys]
+        n = len(ks)
+        sets = [tuple(j for j in range(n) if not (m >> (n - 1 - j)) & 1)
+                for m in range(1 << n)]
+        return GroupedData(ks, self, grouping_sets=sets)
+
+    def grouping_sets(self, sets, *keys) -> "GroupedData":
+        """Explicit GROUPING SETS: `sets` is a list of key-index tuples
+        (or key-name/expr lists matched against `keys`)."""
+        ks = [_e(k) for k in keys]
+        fps = [k.fingerprint() for k in ks]
+        norm = []
+        for s in sets:
+            idx = []
+            for item in s:
+                if isinstance(item, int):
+                    idx.append(item)
+                else:
+                    fp = _e(item).fingerprint()
+                    if fp not in fps:
+                        raise E.SparkException(
+                            f"GROUPING SETS item {item!r} is not a "
+                            "group-by key")
+                    idx.append(fps.index(fp))
+            norm.append(tuple(idx))
+        return GroupedData(ks, self, grouping_sets=norm)
+
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData([], self).agg(*aggs)
 
@@ -637,9 +674,12 @@ class DataFrame:
 
 
 class GroupedData:
-    def __init__(self, keys: List[E.Expression], df: DataFrame):
+    def __init__(self, keys: List[E.Expression], df: DataFrame,
+                 grouping_sets=None):
         self.keys = keys
         self.df = df
+        #: list of tuples of key indices INCLUDED per grouping set
+        self.grouping_sets = grouping_sets
 
     def agg(self, *aggs) -> DataFrame:
         named: List[NamedAgg] = []
@@ -650,8 +690,78 @@ class GroupedData:
                 named.append(NamedAgg(a, _default_agg_name(a, i)))
             else:
                 raise TypeError(f"not an aggregate: {a!r}")
+        if self.grouping_sets is not None:
+            return self._agg_grouping_sets(named)
         return DataFrame(P.Aggregate(self.keys, named, self.df.plan),
                          self.df.session)
+
+    def _agg_grouping_sets(self, named: List[NamedAgg]) -> DataFrame:
+        """ROLLUP/CUBE/GROUPING SETS lowering (the Catalyst Expand
+        rewrite, reference GpuExpandExec consumes its output): replicate
+        each row once per grouping set with excluded keys nulled and a
+        __grouping_id bitmask key, aggregate over keys + id, then
+        resolve grouping()/grouping_id() markers to bit reads of the
+        id and drop it from the output."""
+        from spark_rapids_tpu.expr.aggregates import (Grouping,
+                                                      GroupingMarker,
+                                                      GroupingID)
+        df, keys, sets = self.df, self.keys, self.grouping_sets
+        nk = len(keys)
+        src = df.columns
+        gk = [f"__gkey{j}" for j in range(nk)]
+        pre = df.select(*[E.col(n) for n in src],
+                        *[E.Alias(k, gk[j]) for j, k in enumerate(keys)])
+        ktypes = {f.name: f.dtype for f in pre.schema.fields}
+        projections, names = [], src + gk + ["__grouping_id"]
+        for s in sets:
+            gid = 0
+            row: List[E.Expression] = [E.col(n) for n in src]
+            for j in range(nk):
+                if j in s:
+                    row.append(E.col(gk[j]))
+                else:
+                    row.append(E.Cast(E.Literal(None, T.NULL),
+                                      ktypes[gk[j]]))
+                    gid |= 1 << (nk - 1 - j)
+            row.append(E.Cast(E.lit(gid), T.INT64))
+            projections.append(row)
+        expanded = DataFrame(P.Expand(projections, names, pre.plan),
+                             df.session)
+
+        key_fps = [k.fingerprint() for k in keys]
+
+        def marker_expr(fn: GroupingMarker) -> E.Expression:
+            from spark_rapids_tpu.expr.math import BitwiseAnd, ShiftRight
+            if isinstance(fn, GroupingID):
+                return E.col("__grouping_id")
+            child = fn.children[0]
+            fp = child.fingerprint()
+            if fp in key_fps:
+                j = key_fps.index(fp)
+            elif isinstance(child, E.Col) and child.name in gk:
+                j = gk.index(child.name)
+            else:
+                raise E.SparkException(
+                    f"grouping() argument {child!r} is not a "
+                    "group-by key")
+            return E.Cast(BitwiseAnd(
+                ShiftRight(E.col("__grouping_id"),
+                           E.Cast(E.lit(nk - 1 - j), T.INT32)),
+                E.Cast(E.lit(1), T.INT64)), T.INT8)
+
+        real, post = [], []
+        for na in named:
+            if isinstance(na.fn, GroupingMarker):
+                post.append(E.Alias(marker_expr(na.fn), na.name))
+            else:
+                real.append(na)
+                post.append(E.col(na.name))
+        grouped = DataFrame(
+            P.Aggregate([E.col(n) for n in gk] + [E.col("__grouping_id")],
+                        real, expanded.plan), df.session)
+        out_keys = [E.Alias(E.col(gk[j]), P.expr_name(keys[j], j))
+                    for j in range(nk)]
+        return grouped.select(*out_keys, *post)
 
     def count(self) -> DataFrame:
         from spark_rapids_tpu.expr.aggregates import CountAll

@@ -22,6 +22,16 @@ def sum(c):  # noqa: A001
     return A.Sum(_e(c))
 
 
+def grouping(c):
+    """1 when the key is aggregated away in a ROLLUP/CUBE output row."""
+    return A.Grouping(_e(c))
+
+
+def grouping_id():
+    """The grouping-set bitmask over the group-by keys."""
+    return A.GroupingID()
+
+
 def count(c="*"):
     # NB: Expression.__eq__ builds an EqualTo node (truthy), so the
     # "*" probe must be an isinstance check — `c == "*"` on a column

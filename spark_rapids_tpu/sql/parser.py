@@ -38,7 +38,8 @@ _KEYWORDS = {
     "asc", "desc", "union", "all", "distinct", "true", "false", "nulls",
     "first", "last", "with", "over", "partition", "rows",
     "range", "unbounded", "preceding", "following", "current",
-    "row",
+    "row", "rollup", "cube", "grouping", "sets", "exists",
+    "intersect", "except", "minus",
 }
 
 _TYPES = {
@@ -70,6 +71,73 @@ def _tokenize(text: str):
         else:
             out.append(("op", m.group("op")))
     out.append(("eof", ""))
+    return out
+
+
+class _QCol(E.Col):
+    """Qualified column reference (alias.name). The engine resolves by
+    bare name, but the parser needs the qualifier to classify
+    subquery-correlation predicates (t.k = d.k must NOT collapse to
+    k = k)."""
+
+    def __init__(self, name: str, qualifier: str):
+        super().__init__(name)
+        self.qualifier = qualifier
+
+
+class _SubSpec:
+    """A parsed-but-unbuilt subquery: WHERE conjuncts are kept unapplied
+    so correlated predicates (references to OUTER columns) can be
+    classified and turned into join keys at lowering time."""
+
+    def __init__(self, items, star, df, conjs, group_keys, having, scope):
+        self.items = items          # SELECT item expressions
+        self.star = star            # SELECT * ?
+        self.df = df                # FROM (joins applied)
+        self.conjs = conjs          # WHERE conjuncts, unapplied
+        self.group_keys = group_keys
+        self.having = having
+        self.scope = scope          # alias -> column-name set (FROM)
+
+
+class _SubqueryMarker(E.Expression):
+    """Parser-internal [NOT] EXISTS/IN-subquery placeholder. Lowered to
+    a left semi/anti join by _apply_where (the engine's analog of
+    Spark's RewritePredicateSubquery; the reference then sees the
+    already-lowered joins, GpuBroadcastHashJoinExec etc). Never reaches
+    binding."""
+
+    def __init__(self, sub: _SubSpec, in_expr=None):
+        self.children = []
+        self.sub = sub
+        self.in_expr = in_expr      # outer-side expr for IN, None=EXISTS
+
+    def data_type(self):
+        return T.BOOLEAN
+
+    def fingerprint(self):
+        return f"_SubqueryMarker@{id(self)}"
+
+
+def _split_and(e):
+    if isinstance(e, E.And):
+        return _split_and(e.children[0]) + _split_and(e.children[1])
+    return [e]
+
+
+def _has_marker(e):
+    if isinstance(e, _SubqueryMarker):
+        return True
+    fn = getattr(e, "fn", None)  # NamedAgg wraps without .children
+    if fn is not None and _has_marker(fn):
+        return True
+    return any(_has_marker(c) for c in getattr(e, "children", []))
+
+
+def _and_all(conjs):
+    out = conjs[0]
+    for c in conjs[1:]:
+        out = E.And(out, c)
     return out
 
 
@@ -152,6 +220,11 @@ class _Parser:
             return E.Not(out) if neg else out
         if self.kw("in"):
             self.expect_op("(")
+            if self.peek()[1].lower() == "select":
+                sub = self._sub_query_spec()
+                self.expect_op(")")
+                out = _SubqueryMarker(sub, in_expr=e)
+                return E.Not(out) if neg else out
             vals = [self.expr()]
             while self.op(","):
                 vals.append(self.expr())
@@ -343,6 +416,11 @@ class _Parser:
             return E.Literal(None, T.NULL)
         if self.kw("case"):
             return self._case()
+        if self.kw("exists"):
+            self.expect_op("(")
+            sub = self._sub_query_spec()
+            self.expect_op(")")
+            return _SubqueryMarker(sub)
         if self.kw("cast"):
             self.expect_op("(")
             e = self.expr()
@@ -354,6 +432,8 @@ class _Parser:
             self.expect_op(")")
             return E.Cast(e, _TYPES[tname])
         if self.op("("):
+            if self.peek()[1].lower() == "select":
+                return self._scalar_subquery()
             e = self.expr()
             self.expect_op(")")
             return e
@@ -362,33 +442,257 @@ class _Parser:
             if self.op("("):
                 return self._call(name)
             if self.op("."):
-                # qualified a.b: the engine resolves by column name only
-                return E.col(self.ident())
+                # qualified a.b: the engine resolves by column name, but
+                # the qualifier is kept for subquery-correlation scoping
+                return _QCol(self.ident(), name.lower())
             return E.col(name)
         raise SparkException(f"SQL: unexpected token {v!r}")
+
+    # -- subqueries ---------------------------------------------------------
+
+    def _sub_query_spec(self) -> _SubSpec:
+        """Parse a predicate subquery WITHOUT applying its WHERE clause
+        (correlated conjuncts reference outer columns and must become
+        join keys, not filters)."""
+        if not self.kw("select"):
+            raise SparkException("SQL: subquery must start with SELECT")
+        self.kw("distinct")  # semi/anti join semantics make it a no-op
+        items, star = [], False
+        while True:
+            if self.op("*"):
+                star = True
+            else:
+                e = self.expr()
+                if self.kw("as") or self.peek()[0] == "id":
+                    self.ident()  # aliases are irrelevant to the join
+                items.append(e)
+            if not self.op(","):
+                break
+        if not self.kw("from"):
+            raise SparkException("SQL: subquery needs FROM")
+        df = self._from()
+        scope = self._scope
+        conjs = []
+        if self.kw("where"):
+            conjs = _split_and(self.expr())
+        group_keys = None
+        if self.kw("group", "by"):
+            group_keys = [self.expr()]
+            while self.op(","):
+                group_keys.append(self.expr())
+        having = self.expr() if self.kw("having") else None
+        return _SubSpec(items, star, df, conjs, group_keys, having, scope)
+
+    def _scalar_subquery(self):
+        """(SELECT <single value>): evaluated EAGERLY to a literal (the
+        engine analog of Spark's uncorrelated ScalarSubquery, which also
+        executes before the main query; correlated scalar subqueries
+        raise at build when the outer column fails to resolve)."""
+        df = self.select()
+        self.expect_op(")")
+        tbl = df.limit(2).collect()
+        if tbl.num_columns != 1:
+            raise SparkException(
+                "SQL: scalar subquery must return one column")
+        if tbl.num_rows > 1:
+            raise SparkException(
+                "SQL: scalar subquery returned more than one row")
+        dt = T.from_arrow(tbl.schema.field(0).type)
+        if tbl.num_rows == 0:
+            return E.Literal(None, dt)
+        v = tbl.column(0)[0].as_py()
+        if v is None:
+            return E.Literal(None, dt)
+        return E.Cast(E.lit(v), dt)
+
+    def _apply_where(self, df, cond, outer_scope):
+        """WHERE lowering: plain conjuncts filter; [NOT] EXISTS/IN
+        subquery conjuncts become left semi/anti joins (Spark's
+        RewritePredicateSubquery)."""
+        plain, subs = [], []
+        for c in _split_and(cond):
+            neg, inner = False, c
+            while isinstance(inner, E.Not) and _has_marker(inner):
+                neg = not neg
+                inner = inner.children[0]
+            if isinstance(inner, _SubqueryMarker):
+                subs.append((inner, neg))
+            elif _has_marker(c):
+                raise SparkException(
+                    "SQL: EXISTS/IN subqueries are only supported as "
+                    "top-level AND conjuncts of WHERE")
+            else:
+                plain.append(c)
+        if plain:
+            df = df.filter(_and_all(plain))
+        for m, neg in subs:
+            df = self._apply_subquery(df, m, neg, outer_scope)
+        return df
+
+    @staticmethod
+    def _ref_side(e, sub_cols, sub_scope, outer_cols, outer_scope):
+        """'sub' / 'outer' / 'mixed' for one conjunct expression.
+        Qualified references resolve innermost-first (the subquery's
+        FROM aliases shadow the outer query's), so t.k = d.k keeps its
+        two sides apart even though both columns are named k."""
+        sides = set()
+
+        def walk(x):
+            if isinstance(x, _QCol):
+                q = x.qualifier
+                if q in sub_scope and x.name.lower() in sub_scope[q]:
+                    sides.add("sub")
+                elif q in outer_scope and \
+                        x.name.lower() in outer_scope[q]:
+                    sides.add("outer")
+                else:
+                    raise SparkException(
+                        f"SQL: cannot resolve {q}.{x.name} in the "
+                        "subquery or outer scope")
+                return
+            if isinstance(x, E.Col):
+                nm = x.name.lower()
+                if nm in sub_cols:
+                    sides.add("sub")
+                elif nm in outer_cols:
+                    sides.add("outer")
+                else:
+                    raise SparkException(
+                        f"SQL: cannot resolve column {x.name!r}")
+                return
+            for c in x.children:
+                walk(c)
+
+        walk(e)
+        if sides <= {"sub"}:
+            return "sub"
+        if sides == {"outer"}:
+            return "outer"
+        return "mixed"
+
+    def _apply_subquery(self, df, m: _SubqueryMarker, neg: bool,
+                        outer_scope):
+        spec = m.sub
+        outer_cols = {n.lower() for n in df.columns}
+        sub_df = spec.df
+        sub_cols = {n.lower() for n in sub_df.columns}
+        local, pairs = [], []
+        for c in spec.conjs:
+            side = self._ref_side(c, sub_cols, spec.scope, outer_cols,
+                                  outer_scope)
+            if side == "sub":
+                local.append(c)
+                continue
+            if isinstance(c, E.EqualTo):
+                l, r = c.children
+                ls = self._ref_side(l, sub_cols, spec.scope, outer_cols,
+                                    outer_scope)
+                rs = self._ref_side(r, sub_cols, spec.scope, outer_cols,
+                                    outer_scope)
+                if ls == "sub" and rs == "outer":
+                    pairs.append((r, l))
+                    continue
+                if rs == "sub" and ls == "outer":
+                    pairs.append((l, r))
+                    continue
+            raise SparkException(
+                "SQL: unsupported correlated subquery predicate "
+                f"{c!r} (only equality correlation to outer columns)")
+        if local:
+            sub_df = sub_df.filter(_and_all(local))
+        if spec.group_keys is not None:
+            if pairs:
+                raise SparkException(
+                    "SQL: correlated grouped subqueries are not "
+                    "supported")
+            sub_df = self._grouped_sub(sub_df, spec)
+        if m.in_expr is not None:
+            if spec.star or len(spec.items) != 1:
+                raise SparkException(
+                    "SQL: IN subquery must select exactly one item")
+            item = spec.items[0]
+            if isinstance(item, E.Alias):
+                item = item.children[0]
+            if neg:
+                # NOT IN is null-aware: any NULL in the subquery makes
+                # every row UNKNOWN (dropped), and NULL probes only
+                # qualify against an EMPTY subquery (no comparisons
+                # happen) — the shape the reference handles as a
+                # null-aware anti join
+                if sub_df.limit(1).count() == 0:
+                    return df
+                has_null = sub_df.filter(
+                    E.IsNull(item)).limit(1).count() > 0
+                if has_null:
+                    return df.filter(E.lit(False))
+                df = df.filter(E.IsNotNull(m.in_expr))
+            pairs = [(m.in_expr, item)] + pairs
+        if not pairs:
+            # uncorrelated EXISTS: emptiness decides for every row
+            nonempty = sub_df.limit(1).count() > 0
+            return df.filter(E.lit(nonempty != neg))
+        how = "left_anti" if neg else "left_semi"
+        return df.join(sub_df, on=pairs, how=how)
+
+    def _grouped_sub(self, sub_df, spec: _SubSpec):
+        """Uncorrelated grouped IN-subquery: GROUP BY + HAVING with the
+        single select item preserved."""
+        from spark_rapids_tpu.expr.aggregates import AggFunction, NamedAgg
+        from spark_rapids_tpu.plan.nodes import expr_name
+        aggs = []
+
+        def fold(e):
+            if isinstance(e, AggFunction):
+                nm = f"__subagg{len(aggs)}"
+                aggs.append(NamedAgg(e, nm))
+                return E.col(nm)
+            return e.with_children([fold(c) for c in e.children])
+
+        having = fold(spec.having) if spec.having is not None else None
+        item = spec.items[0] if len(spec.items) == 1 and not spec.star \
+            else None
+        item_is_agg = isinstance(item, AggFunction) or (
+            isinstance(item, E.Alias)
+            and isinstance(item.children[0], AggFunction))
+        if item_is_agg:
+            fn = item.children[0] if isinstance(item, E.Alias) else item
+            nm = expr_name(item, 0)
+            aggs.append(NamedAgg(fn, nm))
+            spec.items = [E.col(nm)]
+        out = sub_df.group_by(*spec.group_keys).agg(*aggs)
+        if having is not None:
+            out = out.filter(having)
+        return out
 
     # -- query --------------------------------------------------------------
 
     def _table(self):
+        alias = None
         if self.op("("):
             # derived table: FROM (SELECT ...) [AS] alias
             df = self.select()
             self.expect_op(")")
         else:
             name = self.ident()
+            alias = name.lower()
             df = self.ctes.get(name.lower())
             if df is None:
                 df = self.session.table(name)
-        # optional alias (resolution stays name-based)
+        # optional alias (resolution stays name-based; recorded for
+        # subquery-correlation scoping)
         k, v = self.peek()
         if k == "id" or (k == "kw" and self.kw("as")):
             if k == "id":
                 self.next()
+                alias = v.lower()
             else:
-                self.ident()
+                alias = self.ident().lower()
+        if alias is not None:
+            self._scope[alias] = {n.lower() for n in df.columns}
         return df
 
     def _from(self):
+        self._scope = {}
         df = self._table()
         while True:
             how = None
@@ -450,14 +754,56 @@ class _Parser:
         if not self.kw("from"):
             raise SparkException("SQL: expected FROM")
         df = self._from()
+        outer_scope = self._scope
+        for it in items:
+            if _has_marker(it):
+                raise SparkException(
+                    "SQL: EXISTS/IN subqueries are only supported in "
+                    "WHERE")
         if self.kw("where"):
-            df = df.filter(self.expr())
-        group_keys = None
+            df = self._apply_where(df, self.expr(), outer_scope)
+        group_keys, group_mode = None, None
         if self.kw("group", "by"):
-            group_keys = [self.expr()]
-            while self.op(","):
-                group_keys.append(self.expr())
+            if self.kw("rollup") or self.kw("cube"):
+                group_mode = self.toks[self.i - 1][1].lower()
+                self.expect_op("(")
+                group_keys = [self.expr()]
+                while self.op(","):
+                    group_keys.append(self.expr())
+                self.expect_op(")")
+            elif self.kw("grouping", "sets"):
+                self.expect_op("(")
+                raw_sets = []
+                while True:
+                    self.expect_op("(")
+                    s = []
+                    if not self.op(")"):
+                        s.append(self.expr())
+                        while self.op(","):
+                            s.append(self.expr())
+                        self.expect_op(")")
+                    raw_sets.append(s)
+                    if not self.op(","):
+                        break
+                self.expect_op(")")
+                # keys = union of set members, first-appearance order
+                group_keys, fps = [], []
+                for s in raw_sets:
+                    for e in s:
+                        fp = e.fingerprint()
+                        if fp not in fps:
+                            fps.append(fp)
+                            group_keys.append(e)
+                group_mode = [tuple(fps.index(e.fingerprint())
+                                    for e in s) for s in raw_sets]
+            else:
+                group_keys = [self.expr()]
+                while self.op(","):
+                    group_keys.append(self.expr())
         having = self.expr() if self.kw("having") else None
+        if having is not None and _has_marker(having):
+            raise SparkException(
+                "SQL: EXISTS/IN subqueries are only supported in WHERE")
 
         from spark_rapids_tpu.expr.aggregates import AggFunction, NamedAgg
         from spark_rapids_tpu.plan.nodes import expr_name  # noqa: F401
@@ -500,7 +846,15 @@ class _Parser:
 
             if having is not None:
                 having = fold_agg(having)
-            df = df.group_by(*group_keys).agg(*aggs)
+            if group_mode == "rollup":
+                gd = df.rollup(*group_keys)
+            elif group_mode == "cube":
+                gd = df.cube(*group_keys)
+            elif isinstance(group_mode, list):
+                gd = df.grouping_sets(group_mode, *group_keys)
+            else:
+                gd = df.group_by(*group_keys)
+            df = gd.agg(*aggs)
             if having is not None:
                 df = df.filter(having)
             final_items = out_names if not stars else None
@@ -578,16 +932,30 @@ class _Parser:
         df = proj(pre)
         unioned = False
         while True:
+            # set ops parse left-associative at one precedence level (a
+            # documented deviation from the standard's INTERSECT-binds-
+            # tighter rule; NDS chains are homogeneous so it is moot)
             if self.kw("union", "all"):
-                p2, j2, _, _ = self._select_core()
-                df = df.union(j2(p2))
-                unioned = True
+                op = "ua"
             elif self.kw("union"):
-                p2, j2, _, _ = self._select_core()
-                df = df.union(j2(p2)).distinct()  # bare UNION dedups
-                unioned = True
+                op = "u"
+            elif self.kw("intersect"):
+                op = "i"
+            elif self.kw("except") or self.kw("minus"):
+                op = "e"
             else:
                 break
+            p2, j2, _, _ = self._select_core()
+            r = j2(p2)
+            if op == "ua":
+                df = df.union(r)
+            elif op == "u":
+                df = df.union(r).distinct()  # bare UNION dedups
+            elif op == "i":
+                df = df.intersect(r)
+            else:
+                df = df.subtract(r)
+            unioned = True
         if self.kw("order", "by"):
             orders = [self._sort_item()]
             while self.op(","):

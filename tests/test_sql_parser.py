@@ -205,3 +205,191 @@ def test_order_by_alias_plus_hidden_column(session):
     # not a raw KeyError
     with pytest.raises(SparkException):
         session.sql("SELECT DISTINCT k FROM t ORDER BY v").collect()
+
+
+# -- round-5 surface: subqueries, set ops, grouping sets ---------------------
+
+
+def _rows(df):
+    return sorted(df.collect().to_pylist(), key=str)
+
+
+def test_exists_and_not_exists_subquery(session):
+    got = _rows(session.sql(
+        "SELECT k, label FROM d WHERE EXISTS "
+        "(SELECT * FROM t WHERE t.k = d.k AND v > 9.0)"))
+    t, d = session.table("t"), session.table("d")
+    keep = t.filter(col("v") > lit(9.0))
+    want = _rows(d.join(keep, on=[(col("k"), col("k"))], how="left_semi"))
+    assert got == want
+    got_n = _rows(session.sql(
+        "SELECT k FROM d WHERE NOT EXISTS "
+        "(SELECT * FROM t WHERE t.k = d.k AND v > 9.0)"))
+    want_n = _rows(d.join(keep, on=[(col("k"), col("k"))],
+                          how="left_anti").select(col("k")))
+    assert got_n == want_n
+    assert len(got) + len(got_n) == 5
+
+
+def test_in_subquery_and_not_in(session):
+    got = _rows(session.sql(
+        "SELECT label FROM d WHERE k IN "
+        "(SELECT k FROM t WHERE v > 9.5)"))
+    hot = {r["k"] for r in session.table("t").filter(
+        col("v") > lit(9.5)).collect().to_pylist()}
+    want = sorted([{"label": l} for k, l in
+                   zip([0, 1, 2, 3, 4], ["a", "b", "c", "d", "e"])
+                   if k in hot], key=str)
+    assert got == want
+    got_n = _rows(session.sql(
+        "SELECT label FROM d WHERE k NOT IN "
+        "(SELECT k FROM t WHERE v > 9.5)"))
+    want_n = sorted([{"label": l} for k, l in
+                     zip([0, 1, 2, 3, 4], ["a", "b", "c", "d", "e"])
+                     if k not in hot], key=str)
+    assert got_n == want_n
+
+
+def test_not_in_subquery_null_aware(session):
+    # any NULL in the subquery result empties a NOT IN (three-valued
+    # logic); Spark handles this as a null-aware anti join
+    s = session
+    s.create_or_replace_temp_view("withnull", s.create_dataframe(
+        {"x": [1, None, 2]}))
+    got = s.sql("SELECT k FROM d WHERE k NOT IN "
+                "(SELECT x FROM withnull)").collect()
+    assert got.num_rows == 0
+    got2 = _rows(s.sql("SELECT k FROM d WHERE k IN "
+                       "(SELECT x FROM withnull)"))
+    assert got2 == [{"k": 1}, {"k": 2}]
+
+
+def test_scalar_subquery(session):
+    got = _rows(session.sql(
+        "SELECT k FROM d WHERE k > (SELECT AVG(k) FROM t)"))
+    avg = np.mean([r["k"] for r in
+                   session.table("t").collect().to_pylist()])
+    want = sorted([{"k": k} for k in [0, 1, 2, 3, 4] if k > avg],
+                  key=str)
+    assert got == want
+
+
+def test_grouped_in_subquery_with_having(session):
+    got = _rows(session.sql(
+        "SELECT label FROM d WHERE k IN "
+        "(SELECT k FROM t GROUP BY k HAVING COUNT(*) >= 55)"))
+    counts = {}
+    for r in session.table("t").collect().to_pylist():
+        counts[r["k"]] = counts.get(r["k"], 0) + 1
+    keep = {k for k, n in counts.items() if n >= 55}
+    want = sorted([{"label": l} for k, l in
+                   zip([0, 1, 2, 3, 4], ["a", "b", "c", "d", "e"])
+                   if k in keep], key=str)
+    assert got == want and 0 < len(got) < 5
+
+
+def test_intersect_and_except(session):
+    s = session
+    s.create_or_replace_temp_view("left5", s.create_dataframe(
+        {"x": [1, 2, 2, 3, 4]}))
+    s.create_or_replace_temp_view("right3", s.create_dataframe(
+        {"x": [2, 3, 3, 5]}))
+    assert _rows(s.sql("SELECT x FROM left5 INTERSECT "
+                       "SELECT x FROM right3")) == [{"x": 2}, {"x": 3}]
+    assert _rows(s.sql("SELECT x FROM left5 EXCEPT "
+                       "SELECT x FROM right3")) == [{"x": 1}, {"x": 4}]
+    assert _rows(s.sql("SELECT x FROM left5 MINUS "
+                       "SELECT x FROM right3")) == [{"x": 1}, {"x": 4}]
+
+
+def test_rollup_sql_matches_manual_union(session):
+    got = _rows(session.sql(
+        "SELECT k, name, SUM(v) AS sv, COUNT(*) AS n, "
+        "GROUPING(name) AS gn, GROUPING_ID() AS gid "
+        "FROM t GROUP BY ROLLUP(k, name)"))
+    t = session.table("t")
+    rows = t.collect().to_pylist()
+    import collections
+    fine = collections.defaultdict(lambda: [0.0, 0])
+    sub = collections.defaultdict(lambda: [0.0, 0])
+    tot = [0.0, 0]
+    for r in rows:
+        for acc in (fine[(r["k"], r["name"])], sub[r["k"]], tot):
+            acc[0] += r["v"]
+            acc[1] += 1
+    want = []
+    for (k, nm), (sv, n) in fine.items():
+        want.append({"k": k, "name": nm, "sv": sv, "n": n,
+                     "gn": 0, "gid": 0})
+    for k, (sv, n) in sub.items():
+        want.append({"k": k, "name": None, "sv": sv, "n": n,
+                     "gn": 1, "gid": 1})
+    want.append({"k": None, "name": None, "sv": tot[0], "n": tot[1],
+                 "gn": 1, "gid": 3})
+    for w in want:
+        w["sv"] = round(w["sv"], 6)
+    for g in got:
+        g["sv"] = round(g["sv"], 6)
+    assert got == sorted(want, key=str)
+
+
+def test_cube_and_grouping_sets_row_counts(session):
+    t_rows = session.table("t").collect().to_pylist()
+    ks = {r["k"] for r in t_rows}
+    names = {r["name"] for r in t_rows}
+    pairs = {(r["k"], r["name"]) for r in t_rows}
+    cube = session.sql(
+        "SELECT k, name, COUNT(*) AS n FROM t GROUP BY CUBE(k, name)"
+    ).collect()
+    assert cube.num_rows == len(pairs) + len(ks) + len(names) + 1
+    gs = session.sql(
+        "SELECT k, name, COUNT(*) AS n FROM t "
+        "GROUP BY GROUPING SETS((k), (name))").collect()
+    assert gs.num_rows == len(ks) + len(names)
+
+
+def test_rollup_dataframe_api_differential(session):
+    from asserts import assert_tpu_and_cpu_are_equal_collect
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.table("t").rollup("k", "name")
+        .agg(F.sum(col("v")).alias("sv"), F.count("*").alias("n"),
+             F.grouping(col("k")).alias("gk"),
+             F.grouping_id().alias("gid")),
+        session, approx_float=1e-9, ignore_order=True)
+
+
+def test_correlated_exists_same_column_name(session):
+    # t.k = d.k must stay a CORRELATION even though both columns are
+    # named k (qualified refs must not collapse to k = k)
+    s = TpuSession()
+    s.create_or_replace_temp_view("tt", s.create_dataframe(
+        {"k": [0, 0, 1], "v": [9.5, 1.0, 1.0]}))
+    s.create_or_replace_temp_view("dd", s.create_dataframe(
+        {"k": [0, 1]}))
+    got = _rows(s.sql("SELECT k FROM dd WHERE EXISTS "
+                      "(SELECT * FROM tt WHERE tt.k = dd.k AND v > 9.0)"))
+    assert got == [{"k": 0}]
+    got_n = _rows(s.sql(
+        "SELECT k FROM dd WHERE NOT EXISTS "
+        "(SELECT * FROM tt WHERE tt.k = dd.k AND v > 9.0)"))
+    assert got_n == [{"k": 1}]
+
+
+def test_not_in_empty_subquery_keeps_null_probe(session):
+    # NULL NOT IN (empty set) is TRUE: no comparisons happen
+    s = TpuSession()
+    s.create_or_replace_temp_view("dn", s.create_dataframe(
+        {"k": [1, None]}))
+    s.create_or_replace_temp_view("src", s.create_dataframe(
+        {"x": [200, 300]}))
+    got = _rows(s.sql("SELECT k FROM dn WHERE k NOT IN "
+                      "(SELECT x FROM src WHERE x > 500)"))
+    assert got == sorted([{"k": 1}, {"k": None}], key=str)
+
+
+def test_subquery_outside_where_is_rejected(session):
+    with pytest.raises(SparkException):
+        session.sql("SELECT EXISTS(SELECT * FROM t) AS e FROM t")
+    with pytest.raises(SparkException):
+        session.sql("SELECT k, COUNT(*) FROM t GROUP BY k "
+                    "HAVING EXISTS(SELECT * FROM t)")
