@@ -34,7 +34,15 @@ _jax.config.update("jax_enable_x64", True)
 # kernels in every process; first-compile on TPU is tens of seconds.
 import os as _os
 
-if not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+_plats = str(getattr(_jax.config, "jax_platforms", None)
+             or _os.environ.get("JAX_PLATFORMS", "") or "")
+if "cpu" in _plats.split(","):
+    # NO persistent cache on the CPU simulator: XLA:CPU executable
+    # serialization (the AOT path the cache uses) embeds host machine
+    # features and has SIGSEGV'd in both serialize and deserialize on
+    # this image; CPU compiles are cheap enough to redo per process.
+    pass
+elif not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
     _cache = f"/tmp/spark_rapids_tpu_jit_cache_{_os.getuid()}"
     _os.makedirs(_cache, exist_ok=True)
     _jax.config.update("jax_compilation_cache_dir", _cache)

@@ -1427,12 +1427,14 @@ class _AggKernels:
         ones = jnp.ones(1 << spec.total_bits, jnp.bool_)
         for op, src, sdt in state_specs:
             if src is not None:
-                if src.is_string or src.is_nested:
+                if (src.is_string or src.is_nested) and \
+                        op not in ("count", "count_all"):
                     raise NotImplementedError(
                         "string/nested agg state on device")
                 valid = live if src.validity is None \
                     else (src.validity & live)
-                vals = src.data
+                vals = src.data if not (src.is_string or src.is_nested) \
+                    else jnp.zeros(live.shape[0], sdt.np_dtype)
             else:
                 valid = live
                 vals = jnp.zeros(live.shape[0], sdt.np_dtype)
@@ -1488,11 +1490,15 @@ class _AggKernels:
     def _packed_op(self, op, src, sdt, live, lay):
         cap = lay.cap
         if src is not None:
-            if src.is_string or src.is_nested:
-                raise NotImplementedError("string/nested agg state on device")
+            if (src.is_string or src.is_nested) and \
+                    op not in ("count", "count_all"):
+                raise NotImplementedError(
+                    "string/nested agg state on device")
             valid = (live if src.validity is None
                      else (src.validity & live))[lay.perm]
-            vals = src.data[lay.perm]
+            vals = src.data[lay.perm] \
+                if not (src.is_string or src.is_nested) \
+                else jnp.zeros(cap, sdt.np_dtype)
         else:
             valid = live[lay.perm]
             vals = jnp.zeros(cap, sdt.np_dtype)
@@ -1601,11 +1607,15 @@ class _AggKernels:
                                                    a.fn.update_ops()):
                     if idx >= 0:
                         src = input_cols[ai][idx]
-                        if src.is_string:
-                            raise NotImplementedError("string agg state on device")
-                        vals = src.data
-                        if vals.dtype != sdt.np_dtype:
-                            vals = vals.astype(sdt.np_dtype)
+                        if src.is_string or src.is_nested:
+                            if op not in ("count", "count_all"):
+                                raise NotImplementedError(
+                                    "string agg state on device")
+                            vals = jnp.zeros(cap, sdt.np_dtype)
+                        else:
+                            vals = src.data
+                            if vals.dtype != sdt.np_dtype:
+                                vals = vals.astype(sdt.np_dtype)
                         ov, oval = G.global_agg(op, vals, col_valid(src))
                     else:
                         ov, oval = G.global_agg(op, jnp.zeros(cap, sdt.np_dtype), live)
@@ -1636,7 +1646,8 @@ class _AggKernels:
         out_cols: List[ColumnVector] = []
         if nkeys:
             out_key_cols = G.gather_group_keys(key_cols, perm, boundary,
-                                               n_groups, batch.num_rows)
+                                               n_groups, batch.num_rows,
+                                               live=live)
             for c in out_key_cols:
                 out_cols.append(_resize_col(c, out_cap))
         nrows = traced_rows(batch.num_rows)
@@ -1649,14 +1660,21 @@ class _AggKernels:
             for (sname, sdt), (op, idx) in zip(a.fn.state_schema(), a.fn.update_ops()):
                 if idx >= 0:
                     src = input_cols[ai][idx]
-                    vals = src.data if not src.is_string else None
-                    if src.is_string:
-                        # min/max/first/last over strings: handled via host
-                        # fallback by tagging; sum/count never string
-                        raise NotImplementedError("string agg state on device")
-                    vals = vals.astype(sdt.np_dtype) if vals.dtype != sdt.np_dtype else vals
-                    sorted_vals = vals[perm]
-                    sorted_valid = col_valid(src)[perm]
+                    if src.is_string or src.is_nested:
+                        if op not in ("count", "count_all"):
+                            # min/max/first/last over strings: handled via
+                            # host fallback by tagging; sum never string
+                            raise NotImplementedError(
+                                "string agg state on device")
+                        # count reads only the validity plane
+                        sorted_vals = jnp.zeros(cap, sdt.np_dtype)
+                        sorted_valid = col_valid(src)[perm]
+                    else:
+                        vals = src.data
+                        vals = vals.astype(sdt.np_dtype) \
+                            if vals.dtype != sdt.np_dtype else vals
+                        sorted_vals = vals[perm]
+                        sorted_valid = col_valid(src)[perm]
                 else:
                     sorted_vals = jnp.zeros(cap, sdt.np_dtype)
                     sorted_valid = live[perm]
@@ -1739,9 +1757,13 @@ class _AggKernels:
             for (sname, sdt), (op, idx) in zip(a.fn.state_schema(), a.fn.update_ops()):
                 if idx >= 0:
                     src = input_cols[ai][idx]
-                    if src.is_string:
-                        raise NotImplementedError("string agg state on device")
-                    vals = src.data
+                    if (src.is_string or src.is_nested) and \
+                            op not in ("count", "count_all"):
+                        raise NotImplementedError(
+                            "string agg state on device")
+                    vals = src.data \
+                        if not (src.is_string or src.is_nested) \
+                        else jnp.zeros(batch.capacity, sdt.np_dtype)
                     vals = vals.astype(sdt.np_dtype) if vals.dtype != sdt.np_dtype else vals
                     valid = live if src.validity is None else (src.validity & live)
                 else:
@@ -1785,7 +1807,7 @@ class _AggKernels:
         out_cols = []
         if nkeys:
             for c in G.gather_group_keys(key_cols, perm, boundary, n_groups,
-                                         batch.num_rows):
+                                         batch.num_rows, live=live):
                 out_cols.append(_resize_col(c, out_cap))
         ci = nkeys
         for a in self.aggs:
@@ -2676,7 +2698,7 @@ class ShuffleExchangeExec(ExchangeExec):
             ectx = EvalCtx(b.columns, traced_rows(b.num_rows), b.capacity,
                            False, live=b.live_mask())
             key_cols = [e.eval_tpu(ectx) for e in self.keys]
-            h = K.spark_murmur3_batch(key_cols, b.num_rows, live=b.live_mask())
+            h = K.partition_hash_batch(key_cols, b.num_rows, live=b.live_mask())
             pid = _pmod(h, n)
             lv = b.live_mask()
             count_parts.append(jax.ops.segment_sum(
@@ -2744,7 +2766,7 @@ class ShuffleExchangeExec(ExchangeExec):
                 ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
                                batch.capacity, False, live=live)
                 key_cols = [e.eval_tpu(ectx) for e in keys]
-                h = K.spark_murmur3_batch(key_cols, batch.num_rows, live=live)
+                h = K.partition_hash_batch(key_cols, batch.num_rows, live=live)
                 pid = _pmod(h, n_out)
                 subs = []
                 for p in range(n_out):
@@ -3042,7 +3064,7 @@ class _HashJoinBase(TpuExec):
         (seed 107 — the reference's agg-repartition seed)."""
         key_cols = compiled.run_stage(keys, batch)
         live = batch.live_mask()
-        h = K.spark_murmur3_batch(key_cols, batch.num_rows, seed=seed, live=live)
+        h = K.partition_hash_batch(key_cols, batch.num_rows, seed=seed, live=live)
         b = _pmod(h, k)
         out = []
         for i in range(k):

@@ -298,9 +298,9 @@ class VarianceSamp(_MomentAgg):
     ddof = 1
 
     def evaluate_tpu(self, state_cols, n_groups):
+        # n == 1 -> NULL (Spark 3.1+ default, legacy NaN mode off)
         n, denom, var = self._moments(state_cols)
-        return ColumnVector(T.FLOAT64, jnp.where(denom <= 0, jnp.nan, var),
-                            (n > 0))
+        return ColumnVector(T.FLOAT64, var, (n > 0) & (denom > 0))
 
     def pandas_spec(self):
         return "var"
@@ -321,9 +321,10 @@ class StddevSamp(_MomentAgg):
     ddof = 1
 
     def evaluate_tpu(self, state_cols, n_groups):
+        # n == 1 -> NULL (Spark 3.1+ default, legacy NaN mode off)
         n, denom, var = self._moments(state_cols)
-        return ColumnVector(T.FLOAT64,
-                            jnp.where(denom <= 0, jnp.nan, jnp.sqrt(var)), (n > 0))
+        return ColumnVector(T.FLOAT64, jnp.sqrt(var),
+                            (n > 0) & (denom > 0))
 
     def pandas_spec(self):
         return "std"

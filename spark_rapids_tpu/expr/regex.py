@@ -859,3 +859,110 @@ def nfa_extract(t: TaggedNFA, offsets: jax.Array, raw: jax.Array):
     g1 = jnp.where(has & (bge >= 0), bge, 0)
     g1 = jnp.maximum(g1, g0)
     return has, g0, g1
+
+
+# ---------------------------------------------------------------------------
+# Replace-all spans (regexp_replace): leftmost-greedy non-overlapping
+# ---------------------------------------------------------------------------
+
+
+def compile_replace(pattern: str) -> TaggedNFA:
+    """Compile for replace-all. The tagged whole-match subset, minus
+    patterns that can match the empty string (Java inserts a replacement
+    at every position for those — reject to the CPU tier rather than
+    emulate) and $-anchoring (inherited from compile_extract)."""
+    t = compile_extract(pattern, 0)
+    if t.nfa.nullable:
+        raise RegexUnsupported("pattern matches the empty string")
+    return t
+
+
+def nfa_match_spans(t: TaggedNFA, offsets: jax.Array, raw: jax.Array):
+    """Per-BYTE match layout for replace-all: (start_flags bool[nbytes],
+    span_len i32[nbytes]) where start_flags marks the first byte of each
+    committed match and span_len its byte length.
+
+    One vectorized left-to-right pass (rows in parallel): per-state
+    MATCH-START registers merge by minimum (leftmost wins), a candidate
+    (start, end) extends greedily while any thread with that start is
+    alive, and commits — one scatter into the byte planes — the moment
+    no alive thread could produce an equal-or-earlier start, or at end
+    of row. The cursor then jumps past the match (non-overlapping, like
+    Java's appendReplacement loop)."""
+    nfa = t.nfa
+    n = nfa.n
+    nrows = offsets.shape[0] - 1
+    starts = offsets[:-1].astype(jnp.int32)
+    lens = (offsets[1:] - offsets[:-1]).astype(jnp.int32)
+    maxlen = jnp.max(lens)
+    nbytes = int(raw.shape[0])
+    B = jnp.asarray(_byte_table(nfa))
+    BIG = jnp.int32(np.iinfo(np.int32).max)
+
+    preds = [[] for _ in range(n + 1)]
+    for i in range(1, n + 1):
+        if nfa.first & (1 << i):
+            preds[i].append(0)
+        for f in range(1, n + 1):
+            if nfa.follow[f] & (1 << i):
+                preds[i].append(f)
+    accepting = [i for i in range(1, n + 1) if nfa.last & (1 << i)]
+
+    def step(pos, carry):
+        ms, cand_s, cand_e, cursor, flags, slen = carry
+        idx = jnp.clip(starts + pos, 0, nbytes - 1)
+        byte = raw[idx].astype(jnp.int32)
+        in_row = pos < lens
+        hit_bits = B[byte]
+        new_ms = []
+        for to in range(1, n + 1):
+            to_hit = (hit_bits >> jnp.uint32(to)) & jnp.uint32(1) != 0
+            best = jnp.full(nrows, BIG, jnp.int32)
+            for f in preds[to]:
+                if f == 0:
+                    seed_ok = (pos >= cursor) & (
+                        jnp.full(nrows, pos == 0, jnp.bool_)
+                        if nfa.anchored_start
+                        else jnp.ones(nrows, jnp.bool_))
+                    cand = jnp.where(seed_ok, jnp.full(nrows, pos,
+                                                       jnp.int32), BIG)
+                else:
+                    cand = ms[f - 1]
+                best = jnp.minimum(best, cand)
+            new_ms.append(jnp.where(to_hit & in_row, best, BIG))
+        # accept: minimal start among accepting states
+        acc = jnp.full(nrows, BIG, jnp.int32)
+        for i in accepting:
+            acc = jnp.minimum(acc, new_ms[i - 1])
+        better = acc < cand_s
+        extend = acc == cand_s
+        cand_e = jnp.where((better | extend) & (acc < BIG),
+                           pos + 1, cand_e)
+        cand_s = jnp.where(better, acc, cand_s)
+        # commit when no alive thread can reach an <= start, or row end
+        min_alive = jnp.full(nrows, BIG, jnp.int32)
+        for i in range(1, n + 1):
+            min_alive = jnp.minimum(min_alive, new_ms[i - 1])
+        done_row = (pos + 1) >= lens
+        commit = (cand_s < BIG) & ((min_alive > cand_s) | done_row)
+        tgt = jnp.where(commit, starts + cand_s, nbytes)  # pad slot
+        flags = flags.at[tgt].add(commit.astype(jnp.int32))
+        slen = slen.at[tgt].add(jnp.where(commit, cand_e - cand_s, 0))
+        cursor = jnp.where(commit, cand_e, cursor)
+        # kill threads inside the committed span; a fresh accept this
+        # same step at/after the new cursor becomes the next candidate
+        ms = [jnp.where(m < cursor, BIG, m) for m in new_ms]
+        resee = commit & (acc >= cursor) & (acc < BIG)
+        cand_s = jnp.where(commit, jnp.where(resee, acc, BIG), cand_s)
+        cand_e = jnp.where(commit, jnp.where(resee, pos + 1, -1), cand_e)
+        return ms, cand_s, cand_e, cursor, flags, slen
+
+    ms0 = [jnp.full(nrows, BIG, jnp.int32) for _ in range(n)]
+    carry0 = (ms0, jnp.full(nrows, BIG, jnp.int32),
+              jnp.full(nrows, -1, jnp.int32),
+              jnp.zeros(nrows, jnp.int32),
+              jnp.zeros(nbytes + 1, jnp.int32),
+              jnp.zeros(nbytes + 1, jnp.int32))
+    out = lax.fori_loop(0, maxlen, step, carry0)
+    flags, slen = out[4][:nbytes], out[5][:nbytes]
+    return flags > 0, slen

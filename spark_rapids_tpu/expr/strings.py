@@ -677,16 +677,94 @@ class RegexpExtract(_RegexCpuBase):
 
 
 class RegexpReplace(_RegexCpuBase):
+    """regexp_replace: replace-all. Patterns in the tagged-NFA subset
+    with a LITERAL replacement (<= 8 bytes, no $n backrefs) run ON
+    DEVICE: one match-span scan (expr/regex.py nfa_match_spans) plus a
+    byte-plane splice — the transpile-or-reject discipline of the
+    reference's RegexParser.scala. Backrefs and everything outside the
+    subset fall back to the CPU tier."""
+
+    _MAX_DEVICE_REPL = 8
+
     def __init__(self, child, pattern: str, replacement: str):
         self.children = [child]
         self.pattern = pattern
         self.replacement = replacement
+        self._tagged = None
+        self._nfa_err = None
+        import re as _re
+        if _re.search(r"\$\d", replacement):
+            self._nfa_err = "backref in replacement"
+        elif len(replacement.encode()) > self._MAX_DEVICE_REPL:
+            self._nfa_err = "replacement too long for device splice"
+        else:
+            from spark_rapids_tpu.expr.regex import (
+                RegexUnsupported, compile_replace)
+            try:
+                self._tagged = compile_replace(pattern)
+            except RegexUnsupported as e:
+                self._nfa_err = str(e)
 
     def _params(self):
         return f"{self.pattern!r},{self.replacement!r}"
 
     def with_children(self, children):
         return RegexpReplace(children[0], self.pattern, self.replacement)
+
+    def supported_on_tpu(self):
+        return self._tagged is not None
+
+    def eval_tpu(self, ctx):
+        from spark_rapids_tpu.expr.regex import nfa_match_spans
+        if self._tagged is None:
+            raise NotImplementedError(
+                f"regexp_replace {self.pattern!r} on device: "
+                f"{self._nfa_err}")
+        c = self.children[0].eval_tpu(ctx)
+        t = self._tagged
+        rep = np.frombuffer(self.replacement.encode(), np.uint8)
+        R = int(rep.shape[0])
+
+        def compute(flat, cap):
+            off = flat.data["offsets"][: cap + 1].astype(jnp.int32)
+            raw = flat.data["bytes"]
+            nbytes = int(raw.shape[0])
+            flags, slen = nfa_match_spans(t, off, raw)
+            fi = flags.astype(jnp.int32)
+            # in-match mask via the range-delta trick (spans never
+            # cross row boundaries)
+            delta = jnp.zeros(nbytes + 1, jnp.int32)
+            b_idx = jnp.arange(nbytes, dtype=jnp.int32)
+            delta = delta.at[jnp.where(flags, b_idx, nbytes)].add(fi)
+            delta = delta.at[jnp.where(flags, b_idx + slen, nbytes)].add(
+                -fi)
+            inm = jnp.cumsum(delta[:nbytes]) > 0
+            keep = ~inm & (b_idx < off[cap])
+            # output layout: per byte, kept-bytes-so-far and
+            # matches-so-far (exclusive prefix sums)
+            kept_x = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                      jnp.cumsum(keep.astype(jnp.int32))])
+            m_x = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                                   jnp.cumsum(fi)])
+            new_off = kept_x[off] + R * m_x[off]
+            new_off = new_off - new_off[0]
+            out_cap = max(int(nbytes) * max(1, R), 8)
+            row = jnp.clip(jnp.searchsorted(
+                off, b_idx, side="right").astype(jnp.int32) - 1,
+                0, cap - 1)
+            out_base = new_off[row] + (kept_x[b_idx] - kept_x[off[row]]) \
+                + R * (m_x[b_idx] - m_x[off[row]])
+            out = jnp.zeros(out_cap, jnp.uint8)
+            out = out.at[jnp.where(keep, out_base, out_cap)].set(
+                raw, mode="drop")
+            for j in range(R):
+                out = out.at[jnp.where(flags, out_base + j, out_cap)].set(
+                    jnp.uint8(rep[j]), mode="drop")
+            return ColumnVector(T.STRING,
+                                {"offsets": new_off.astype(jnp.int32),
+                                 "bytes": out}, None)
+
+        return _lift_unary(ctx, c, compute)
 
     def eval_cpu(self, cols, ansi=False):
         import re

@@ -295,15 +295,18 @@ def _invert_float_bits(bits_u64: jax.Array, width: int, vdt):
 
 
 def gather_group_keys(key_cols: List[ColumnVector], perm: jax.Array,
-                      boundary: jax.Array, n_groups: int, num_rows: int
-                      ) -> List[ColumnVector]:
+                      boundary: jax.Array, n_groups: int, num_rows: int,
+                      live=None) -> List[ColumnVector]:
     """Representative key row per group = first sorted row of each segment.
     Sync-free: compacts boundary positions at full capacity (callers carry
-    the true group count, possibly lazily)."""
+    the true group count, possibly lazily). `live` is the SOURCE batch's
+    selection mask — without it a masked batch's live rows past the live
+    COUNT would gather as null (positional validity_or_default is only
+    valid for front-packed batches)."""
     cap = boundary.shape[0]
     first_idx = K._compact_indices(boundary, cap, cap)
     out = []
     for c in key_cols:
-        sorted_col = K.gather_column(c, perm, num_rows)
+        sorted_col = K.gather_column(c, perm, num_rows, src_live=live)
         out.append(K.gather_column(sorted_col, first_idx, num_rows))
     return out

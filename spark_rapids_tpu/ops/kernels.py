@@ -172,11 +172,40 @@ def spark_hash_column(col: ColumnVector, num_rows: int, seed: jax.Array,
 
 def spark_murmur3_batch(cols: Sequence[ColumnVector], num_rows: int,
                         seed: int = SPARK_MURMUR3_SEED, live=None) -> jax.Array:
-    """Chained per-row hash over columns = Spark Murmur3Hash(cols, 42)."""
-    cap = cols[0].capacity
-    h = jnp.full((cap,), np.uint32(seed))
+    """Chained per-row hash over columns = Spark Murmur3Hash(cols, 42).
+    The seed stays SCALAR until the first column hashes it into a
+    vector, so a leading dict-string column takes the vocab-lift path
+    instead of flattening."""
+    h = jnp.uint32(seed)
     for c in cols:
         h = spark_hash_column(c, num_rows, h, live=live)
+    if h.ndim == 0:
+        h = jnp.full((cols[0].capacity,), h)
+    return h.astype(jnp.int32)
+
+
+def partition_hash_batch(cols: Sequence[ColumnVector], num_rows: int,
+                         seed: int = SPARK_MURMUR3_SEED,
+                         live=None) -> jax.Array:
+    """Exchange/bucket partitioning hash. Spark murmur3 EXCEPT that a
+    dict-string column in a non-leading position mixes its vocab-lifted
+    entry hash as an int32 instead of flattening the whole column
+    (which is bound-limited inside a trace). NOT Spark-hash-compatible
+    for that one case — use only where the hash picks a partition and
+    is never user-visible (the reference has the same freedom in its
+    internal GpuHashPartitioning)."""
+    h = jnp.uint32(seed)
+    for c in cols:
+        if c.is_dict and h.ndim != 0:
+            vh = murmur3_bytes(c.data["dict_offsets"], c.data["dict_bytes"],
+                               jnp.uint32(SPARK_MURMUR3_SEED))
+            lifted = ColumnVector(
+                T.INT32, vh[c.data["codes"]].astype(jnp.int32), c.validity)
+            h = spark_hash_column(lifted, num_rows, h, live=live)
+        else:
+            h = spark_hash_column(c, num_rows, h, live=live)
+    if h.ndim == 0:
+        h = jnp.full((cols[0].capacity,), h)
     return h.astype(jnp.int32)
 
 

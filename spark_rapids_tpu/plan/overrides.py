@@ -168,9 +168,18 @@ def _extract_check(e):
 expr_rule(S.RegexpExtract, Sigs.COMMON, Sigs.COMMON,
           "regex capture extract (tagged device NFA; rejects fall back)",
           extra=_extract_check)
+def _replace_check(e):
+    if not e.supported_on_tpu():
+        return (f"regexp_replace pattern {e.pattern!r} outside the device "
+                f"replace subset: {e._nfa_err} (reference RegexParser "
+                f"reject strategy)")
+    return None
+
+
 expr_rule(S.RegexpReplace, Sigs.COMMON, Sigs.COMMON,
-          "regex replace (CPU: needs backtracking groups)",
-          extra=lambda e: "capture-group regex runs on CPU")
+          "regex replace-all (tagged device NFA span scan + byte "
+          "splice; backrefs and rejects fall back)",
+          extra=_replace_check)
 
 # complex types (reference complexTypeExtractors.scala / complexTypeCreator /
 # collectionOperations / GpuGenerateExec expressions)

@@ -720,8 +720,9 @@ class GroupedData:
                 if j in s:
                     row.append(E.col(gk[j]))
                 else:
-                    row.append(E.Cast(E.Literal(None, T.NULL),
-                                      ktypes[gk[j]]))
+                    # typed null (NOT a cast-from-null: Literal evals
+                    # natively on device for every type incl. strings)
+                    row.append(E.Literal(None, ktypes[gk[j]]))
                     gid |= 1 << (nk - 1 - j)
             row.append(E.Cast(E.lit(gid), T.INT64))
             projections.append(row)

@@ -1,0 +1,169 @@
+"""Golden Catalyst physical-plan corpus, driven end-to-end: the real
+Spark `executedPlan.toJSON` wire format (preorder TreeNode arrays,
+Partial/Final aggregate pairs, exchanges, AQE wrappers) through
+plan/catalyst.py -> planner -> execution -> Arrow, differentially
+asserted against pyarrow/pandas-computed expectations (VERDICT r4 #2;
+reference Plugin.scala:53-60 / GpuOverrides.scala:4744)."""
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.plan.catalyst import ingest_catalyst
+from spark_rapids_tpu.sql.session import TpuSession
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden_plans")
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    data = tmp_path_factory.mktemp("catalyst_data")
+    rng = np.random.default_rng(31)
+    n = 4000
+    li = pa.table({
+        "l_orderkey": rng.integers(0, 300, n),
+        "l_quantity": np.round(rng.uniform(1, 100, n), 2),
+        "l_extendedprice": np.round(rng.uniform(1, 1000, n), 2),
+        "l_discount": np.round(rng.uniform(0, 0.1, n), 3),
+        "l_shipdate": rng.integers(0, 200, n).astype(np.int32),
+        "l_flag": np.array(["A", "B", "C"])[rng.integers(0, 3, n)],
+    })
+    od = pa.table({
+        "o_orderkey": np.arange(300, dtype=np.int64),
+        "o_orderdate": rng.integers(0, 200, 300).astype(np.int32),
+        "o_prio": np.array(["HIGH", "LOW"])[rng.integers(0, 2, 300)],
+    })
+    pq.write_table(li, str(data / "lineitem.parquet"))
+    pq.write_table(od, str(data / "orders.parquet"))
+    return TpuSession(), str(data), li.to_pandas(), od.to_pandas()
+
+
+def run(env, name):
+    sess, data, li, od = env
+    with open(os.path.join(GOLDEN, name + ".json")) as f:
+        raw = f.read().replace("$DATA", data)
+    df = ingest_catalyst(raw, sess)
+    return df, li, od
+
+
+def test_q6_filter_agg(env):
+    df, li, od = run(env, "q6_filter_agg")
+    got = df.collect().to_pylist()[0]["revenue"]
+    m = li[(li.l_shipdate >= 100) & (li.l_quantity < 24.0)]
+    want = float((m.l_extendedprice * m.l_discount).sum())
+    assert got == pytest.approx(want, rel=1e-9)
+
+
+def test_project_filter(env):
+    df, li, od = run(env, "project_filter")
+    got = df.collect()
+    assert got.schema.names == ["l_orderkey", "qplus"]
+    assert got.num_rows == len(li)
+    assert sorted(got["qplus"].to_pylist())[0] == pytest.approx(
+        float(li.l_quantity.min()) + 1.0)
+
+
+def test_q3_join_agg_topn(env):
+    df, li, od = run(env, "q3_join_agg_topn")
+    got = df.collect().to_pylist()
+    m = li[li.l_shipdate > 50].merge(
+        od[od.o_orderdate < 150], left_on="l_orderkey",
+        right_on="o_orderkey")
+    g = (m.groupby("l_orderkey")["l_extendedprice"].sum()
+         .reset_index().sort_values(["l_extendedprice", "l_orderkey"],
+                                    ascending=[False, True]).head(10))
+    want = [{"l_orderkey": int(r.l_orderkey),
+             "rev": pytest.approx(float(r.l_extendedprice), rel=1e-9)}
+            for r in g.itertuples()]
+    assert got == want
+
+
+def test_sort_limit(env):
+    df, li, od = run(env, "sort_limit")
+    got = [r["l_extendedprice"] for r in df.collect().to_pylist()]
+    want = sorted(li.l_extendedprice, reverse=True)[:5]
+    assert got == pytest.approx(want)
+
+
+def test_union_filters(env):
+    df, li, od = run(env, "union_filters")
+    got = df.collect()
+    want = int((li.l_quantity < 5.0).sum() + (li.l_quantity > 95.0).sum())
+    assert got.num_rows == want
+
+
+def test_semi_join(env):
+    df, li, od = run(env, "semi_join")
+    got = df.collect()
+    high = set(od[od.o_prio == "HIGH"].o_orderkey)
+    assert got.num_rows == int(li.l_orderkey.isin(high).sum())
+    assert got.schema.names == [c for c in li.columns]
+
+
+def test_bhj_condition(env):
+    df, li, od = run(env, "bhj_condition")
+    got = df.collect()
+    m = li.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+    want = int((m.l_shipdate > m.o_orderdate).sum())
+    assert got.num_rows == want
+
+
+def test_expand_rollup_agg(env):
+    df, li, od = run(env, "expand_rollup_agg")
+    got = {(r["flag_e"], r["spark_grouping_id"]):
+           round(r["sum_qty"], 6) for r in df.collect().to_pylist()}
+    want = {(k, 0): round(float(v), 6)
+            for k, v in li.groupby("l_flag")["l_quantity"].sum().items()}
+    want[(None, 1)] = round(float(li.l_quantity.sum()), 6)
+    assert got == want
+
+
+def test_expr_breadth(env):
+    df, li, od = run(env, "expr_breadth")
+    got = df.collect().to_pylist()
+    assert df.collect().schema.names == ["bucket", "in3", "f1", "isa",
+                                         "qlong"]
+    for r, (_, src) in zip(got, li.iterrows()):
+        assert r["bucket"] == ("low" if src.l_quantity < 10.0 else "high")
+        assert r["in3"] == (src.l_shipdate in (1, 2, 3))
+        assert r["f1"] == src.l_flag[0]
+        assert r["isa"] == src.l_flag.startswith("A")
+        assert r["qlong"] == int(src.l_quantity)
+
+
+def test_count_star(env):
+    df, li, od = run(env, "count_star")
+    assert df.collect().to_pylist() == [{"count(1)": len(li)}]
+
+
+def test_multi_agg(env):
+    df, li, od = run(env, "multi_agg")
+    got = {r["l_flag"]: r for r in df.collect().to_pylist()}
+    g = li.groupby("l_flag")
+    for flag, grp in g:
+        assert got[flag]["avg_q"] == pytest.approx(
+            float(grp.l_quantity.mean()), rel=1e-9)
+        assert got[flag]["min_p"] == pytest.approx(
+            float(grp.l_extendedprice.min()))
+        assert got[flag]["max_d"] == pytest.approx(
+            float(grp.l_discount.max()))
+
+
+def test_anti_join_aqe(env):
+    df, li, od = run(env, "anti_join_aqe")
+    got = df.collect()
+    want = int((~li.l_orderkey.isin(set(od.o_orderkey))).sum())
+    assert got.num_rows == want
+
+
+def test_unsupported_class_rejects(env):
+    sess, data, li, od = env
+    from spark_rapids_tpu.expr.core import SparkException
+    bad = [{"class": "org.apache.spark.sql.execution.python."
+            "ArrowEvalPythonExec", "num-children": 0}]
+    with pytest.raises(SparkException, match="ArrowEvalPythonExec"):
+        ingest_catalyst(json.dumps(bad), sess)

@@ -161,3 +161,56 @@ def test_regexp_extract_rejects_to_cpu(session):
         lambda s: s.create_dataframe(t).select(
             RegexpExtract(col("s"), r"(foo|bar)x", 1).alias("x")),
         session)
+
+
+# -- device regexp_replace (round 5: tagged-NFA span scan + byte splice) ----
+
+
+_REPLACE_ROWS = ["abab", "xxabx", "", "aabb", "no match", "a1b22c333",
+                 None, "aaab", "café ab café", "ababab",
+                 "edge ab", "ab edge"]
+
+
+@pytest.mark.parametrize("pattern,rep", [
+    ("ab", "_"),            # adjacent matches
+    ("[0-9]+", "N"),        # greedy class repeat
+    ("a+b", "<>"),          # growing replacement
+    ("b", ""),              # deletion
+    ("xyz", "Q"),           # no matches anywhere
+    ("^ab", "S"),           # anchored start
+    ("ab*c?", "*"),         # optional tails
+])
+def test_regexp_replace_device(session, pattern, rep):
+    from spark_rapids_tpu.expr.strings import RegexpReplace
+    e = RegexpReplace(col("s"), pattern, rep)
+    assert e.supported_on_tpu(), e._nfa_err
+    t = pa.table({"s": pa.array(_REPLACE_ROWS, pa.string())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            RegexpReplace(col("s"), pattern, rep).alias("x")),
+        session)
+
+
+def test_regexp_replace_matches_python_re(session):
+    # ground truth independent of the CPU tier
+    from spark_rapids_tpu.expr.strings import RegexpReplace
+    t = pa.table({"s": pa.array(_REPLACE_ROWS, pa.string())})
+    got = (session.create_dataframe(t)
+           .select(RegexpReplace(col("s"), "a+b", "[X]").alias("x"))
+           .collect().to_pylist())
+    want = [None if s is None else re.sub("a+b", "[X]", s)
+            for s in _REPLACE_ROWS]
+    assert [r["x"] for r in got] == want
+
+
+def test_regexp_replace_rejects_to_cpu(session):
+    from spark_rapids_tpu.expr.strings import RegexpReplace
+    # backrefs, empty-matching patterns, long replacements -> CPU tier
+    for pat, rep in [("a(b)", "$1"), ("a*", "X"), ("ab", "R" * 20)]:
+        e = RegexpReplace(col("s"), pat, rep)
+        assert not e.supported_on_tpu(), (pat, rep)
+    t = pa.table({"s": pa.array(["abab", "zz", None])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.create_dataframe(t).select(
+            RegexpReplace(col("s"), "a(b)", "($1)").alias("x")),
+        session)

@@ -1,0 +1,390 @@
+"""Golden Catalyst physical-plan corpus generator.
+
+Writes tests/golden_plans/*.json in the EXACT wire shape Spark 3.x's
+`df.queryExecution.executedPlan.toJSON` emits (TreeNode.scala jsonValue:
+preorder node arrays, child-index fields, ExprId products, enum
+objects). The environment has no JVM, so these are format-faithful
+reconstructions of the serializer's output for each query — the same
+role the reference's golden-file tests play for its shims — consumed by
+spark_rapids_tpu/plan/catalyst.py and differentially executed in
+tests/test_catalyst_plans.py. Paths use the $DATA placeholder the test
+substitutes.
+
+Run: python tools/gen_golden_plans.py
+"""
+from __future__ import annotations
+
+import json
+import os
+
+X = "org.apache.spark.sql.execution"
+C = "org.apache.spark.sql.catalyst.expressions"
+A = C + ".aggregate"
+JVM = "5f20ae84-5a76-4a11-8f74-a712a524e3f2"
+
+_ids = {}
+
+
+def _eid(name):
+    if name not in _ids:
+        _ids[name] = len(_ids) + 1
+    return {"product-class": C + ".ExprId", "id": _ids[name],
+            "jvmId": JVM}
+
+
+def attr(name, dt):
+    return [{"class": C + ".AttributeReference", "num-children": 0,
+             "name": name, "dataType": dt, "nullable": True,
+             "metadata": {}, "exprId": _eid(name), "qualifier": []}]
+
+
+def lit(value, dt):
+    return [{"class": C + ".Literal", "num-children": 0,
+             "value": None if value is None else str(value),
+             "dataType": dt}]
+
+
+def _node(cls, nkids, **fields):
+    d = {"class": cls, "num-children": nkids}
+    d.update(fields)
+    return d
+
+
+def binop(cls_name, left, right):
+    return [_node(C + "." + cls_name, 2, left=0, right=1)] + left + right
+
+
+def unop(cls_name, child, **extra):
+    return [_node(C + "." + cls_name, 1, child=0, **extra)] + child
+
+
+def alias(child, name):
+    return [_node(C + ".Alias", 1, child=0, name=name, exprId=_eid(name),
+                  qualifier=[], explicitMetadata=None,
+                  nonInheritableMetadataKeys=[])] + child
+
+
+def cast(child, dt):
+    return [_node(C + ".Cast", 1, child=0, dataType=dt,
+                  timeZoneId="UTC")] + child
+
+
+def case_when(branches, default=None):
+    kids = []
+    for cond, val in branches:
+        kids.append(cond)
+        kids.append(val)
+    if default is not None:
+        kids.append(default)
+    out = [_node(C + ".CaseWhen", len(kids))]
+    for k in kids:
+        out += k
+    return out
+
+
+def in_list(probe, values):
+    out = [_node(C + ".In", 1 + len(values), value=0,
+                 list=list(range(1, 1 + len(values))))]
+    out += probe
+    for v in values:
+        out += v
+    return out
+
+
+def substring(child, pos, length):
+    return [_node(C + ".Substring", 3, str=0, pos=1, len=2)] + \
+        child + lit(pos, "integer") + lit(length, "integer")
+
+
+def like(child, pattern):
+    return [_node(C + ".Like", 2, left=0, right=1, escapeChar="\\")] + \
+        child + lit(pattern, "string")
+
+
+def agg_expr(fn_cls, children, mode, distinct=False):
+    fn = [_node(A + "." + fn_cls, len(children),
+                **({"failOnError": False} if fn_cls == "Sum" else {}))]
+    for ch in children:
+        fn += ch
+    return [_node(C + ".AggregateExpression", 1, aggregateFunction=0,
+                  mode={"object": A + "." + mode + "$"},
+                  isDistinct=distinct, filter=None,
+                  resultId=_eid(f"res_{fn_cls}_{len(_ids)}"))] + fn
+
+
+def sort_order(child, asc=True, nulls_first=None):
+    if nulls_first is None:
+        nulls_first = asc
+    return [_node(C + ".SortOrder", 1, child=0,
+                  direction={"object": C + "." +
+                             ("Ascending" if asc else "Descending") + "$"},
+                  nullOrdering={"object": C + "." +
+                                ("NullsFirst" if nulls_first
+                                 else "NullsLast") + "$"},
+                  sameOrderExpressions=[])] + child
+
+
+# -- plan-level builders (preorder arrays of PLAN nodes; expression
+#    fields hold the nested arrays built above) -----------------------------
+
+def scan(table, cols):
+    return [_node(
+        X + ".FileSourceScanExec", 0,
+        output=[attr(n, t) for n, t in cols],
+        requiredSchema={"type": "struct", "fields": [
+            {"name": n, "type": t, "nullable": True, "metadata": {}}
+            for n, t in cols]},
+        partitionFilters=[], dataFilters=[],
+        metadata={"Location": f"InMemoryFileIndex[file:$DATA/{table}]",
+                  "Format": "Parquet", "Batched": "true",
+                  "PushedFilters": "[]"},
+        tableIdentifier=None, disableBucketedScan=False)]
+
+
+def filter_(cond, child):
+    return [_node(X + ".FilterExec", 1, condition=cond)] + child
+
+
+def project(exprs, child):
+    return [_node(X + ".ProjectExec", 1, projectList=exprs)] + child
+
+
+def hash_agg(keys, aggs, results, mode, child):
+    return [_node(X + ".aggregate.HashAggregateExec", 1,
+                  requiredChildDistributionExpressions=None,
+                  isStreaming=False, numShufflePartitions=None,
+                  groupingExpressions=keys,
+                  aggregateExpressions=[agg_expr(f, ch, mode)
+                                        for f, ch in aggs],
+                  aggregateAttributes=[],
+                  initialInputBufferOffset=0,
+                  resultExpressions=results)] + child
+
+
+def exchange(child):
+    return [_node(X + ".exchange.ShuffleExchangeExec", 1,
+                  outputPartitioning={"product-class":
+                                      "org.apache.spark.sql.catalyst."
+                                      "plans.physical.UnknownPartitioning",
+                                      "numPartitions": 200},
+                  shuffleOrigin={"object": X +
+                                 ".exchange.ENSURE_REQUIREMENTS$"})] + child
+
+
+def bcast_exchange(child):
+    return [_node(X + ".exchange.BroadcastExchangeExec", 1,
+                  mode={"product-class": "org.apache.spark.sql.catalyst."
+                        "plans.physical.BroadcastMode"})] + child
+
+
+def wsc(child, cid=1):
+    return [_node(X + ".WholeStageCodegenExec", 1,
+                  codegenStageId=cid)] + child
+
+
+def smj(lk, rk, how, left, right, cond=None):
+    return [_node(X + ".joins.SortMergeJoinExec", 2, leftKeys=lk,
+                  rightKeys=rk,
+                  joinType={"object":
+                            f"org.apache.spark.sql.catalyst.plans."
+                            f"{how}$"},
+                  condition=cond, isSkewJoin=False)] + left + right
+
+
+def bhj(lk, rk, how, left, right, cond=None, build="BuildRight"):
+    return [_node(X + ".joins.BroadcastHashJoinExec", 2, leftKeys=lk,
+                  rightKeys=rk,
+                  joinType={"object":
+                            f"org.apache.spark.sql.catalyst.plans."
+                            f"{how}$"},
+                  buildSide={"object": X + f".joins.{build}$"},
+                  condition=cond, isNullAwareAntiJoin=False)] + \
+        left + right
+
+
+def sort(orders, child, global_=True):
+    n = _node(X + ".SortExec", 1, sortOrder=orders, testSpillFrequency=0)
+    n["global"] = global_
+    return [n] + child
+
+
+def limit(n, child, cls="GlobalLimitExec"):
+    return [_node(X + "." + cls, 1, limit=n, offset=0)] + child
+
+
+def take_ordered(n, orders, projlist, child):
+    return [_node(X + ".TakeOrderedAndProjectExec", 1, limit=n,
+                  sortOrder=orders, projectList=projlist, offset=0)] + child
+
+
+def union(children):
+    out = [_node(X + ".UnionExec", len(children))]
+    for ch in children:
+        out += ch
+    return out
+
+
+def expand(projections, output, child):
+    return [_node(X + ".ExpandExec", 1, projections=projections,
+                  output=output)] + child
+
+
+LINEITEM = [("l_orderkey", "long"), ("l_quantity", "double"),
+            ("l_extendedprice", "double"), ("l_discount", "double"),
+            ("l_shipdate", "integer"), ("l_flag", "string")]
+ORDERS = [("o_orderkey", "long"), ("o_orderdate", "integer"),
+          ("o_prio", "string")]
+
+
+def build_corpus():
+    li = scan("lineitem.parquet", LINEITEM)
+    od = scan("orders.parquet", ORDERS)
+    plans = {}
+
+    # 1. q6: filter + partial/final agg of sum(price*discount)
+    cond = binop("And",
+                 binop("GreaterThanOrEqual", attr("l_shipdate", "integer"),
+                       lit(100, "integer")),
+                 binop("LessThan", attr("l_quantity", "double"),
+                       lit(24.0, "double")))
+    revenue = binop("Multiply", attr("l_extendedprice", "double"),
+                    attr("l_discount", "double"))
+    partial = hash_agg([], [("Sum", [revenue])], [], "Partial",
+                       wsc(filter_(cond, li)))
+    plans["q6_filter_agg"] = hash_agg(
+        [], [("Sum", [revenue])], [alias(attr("sum_rev", "double"),
+                                         "revenue")],
+        "Final", exchange(partial))
+
+    # 2. project over filter
+    plans["project_filter"] = project(
+        [attr("l_orderkey", "long"),
+         alias(binop("Add", attr("l_quantity", "double"),
+                     lit(1.0, "double")), "qplus")],
+        wsc(filter_(unop("IsNotNull", attr("l_quantity", "double")), li)))
+
+    # 3. join + group agg + take-ordered (q3 shape)
+    j = smj([attr("l_orderkey", "long")], [attr("o_orderkey", "long")],
+            "Inner",
+            sort([sort_order(attr("l_orderkey", "long"))],
+                 exchange(filter_(binop("GreaterThan",
+                                        attr("l_shipdate", "integer"),
+                                        lit(50, "integer")), li))),
+            sort([sort_order(attr("o_orderkey", "long"))],
+                 exchange(filter_(binop("LessThan",
+                                        attr("o_orderdate", "integer"),
+                                        lit(150, "integer")), od))))
+    gp = hash_agg([attr("l_orderkey", "long")],
+                  [("Sum", [attr("l_extendedprice", "double")])],
+                  [], "Partial", j)
+    gf = hash_agg([attr("l_orderkey", "long")],
+                  [("Sum", [attr("l_extendedprice", "double")])],
+                  [alias(attr("sum_p", "double"), "rev")],
+                  "Final", exchange(gp))
+    plans["q3_join_agg_topn"] = take_ordered(
+        10, [sort_order(attr("rev", "double"), asc=False),
+             sort_order(attr("l_orderkey", "long"))],
+        [attr("l_orderkey", "long"), attr("rev", "double")], gf)
+
+    # 4. sort + limits
+    plans["sort_limit"] = limit(
+        5, limit(5, sort([sort_order(attr("l_extendedprice", "double"),
+                                     asc=False)], li),
+                 cls="LocalLimitExec"))
+
+    # 5. union of two filters
+    plans["union_filters"] = union([
+        filter_(binop("LessThan", attr("l_quantity", "double"),
+                      lit(5.0, "double")), li),
+        filter_(binop("GreaterThan", attr("l_quantity", "double"),
+                      lit(95.0, "double")),
+                scan("lineitem.parquet", LINEITEM))])
+
+    # 6. left semi broadcast join
+    plans["semi_join"] = bhj(
+        [attr("l_orderkey", "long")], [attr("o_orderkey", "long")],
+        "LeftSemi", li,
+        bcast_exchange(filter_(binop("EqualTo", attr("o_prio", "string"),
+                                     lit("HIGH", "string")), od)))
+
+    # 7. broadcast inner join with residual condition
+    plans["bhj_condition"] = bhj(
+        [attr("l_orderkey", "long")], [attr("o_orderkey", "long")],
+        "Inner", li, bcast_exchange(od),
+        cond=binop("GreaterThan", attr("l_shipdate", "integer"),
+                   attr("o_orderdate", "integer")))
+
+    # 8. rollup-shaped Expand + aggregate
+    ex = expand(
+        [[attr("l_flag", "string"), attr("l_quantity", "double"),
+          lit(0, "long")],
+         [lit(None, "string"), attr("l_quantity", "double"),
+          lit(1, "long")]],
+        [attr("flag_e", "string"), attr("q_e", "double"),
+         attr("spark_grouping_id", "long")], li)
+    ep = hash_agg([attr("flag_e", "string"),
+                   attr("spark_grouping_id", "long")],
+                  [("Sum", [attr("q_e", "double")])], [], "Partial", ex)
+    plans["expand_rollup_agg"] = hash_agg(
+        [attr("flag_e", "string"), attr("spark_grouping_id", "long")],
+        [("Sum", [attr("q_e", "double")])],
+        [alias(attr("sq", "double"), "sum_qty")], "Final", exchange(ep))
+
+    # 9. expression breadth: case/in/substring/like/cast
+    plans["expr_breadth"] = project(
+        [alias(case_when(
+            [(binop("LessThan", attr("l_quantity", "double"),
+                    lit(10.0, "double")), lit("low", "string"))],
+            lit("high", "string")), "bucket"),
+         alias(in_list(attr("l_shipdate", "integer"),
+                       [lit(1, "integer"), lit(2, "integer"),
+                        lit(3, "integer")]), "in3"),
+         alias(substring(attr("l_flag", "string"), 1, 1), "f1"),
+         alias(like(attr("l_flag", "string"), "A%"), "isa"),
+         alias(cast(attr("l_quantity", "double"), "long"), "qlong")],
+        li)
+
+    # 10. global count(*) + collect limit
+    cp = hash_agg([], [("Count", [lit(1, "integer")])], [], "Partial", li)
+    plans["count_star"] = limit(
+        1, hash_agg([], [("Count", [lit(1, "integer")])],
+                    [alias(attr("cnt", "long"), "count(1)")],
+                    "Final", exchange(cp)), cls="CollectLimitExec")
+
+    # 11. multi-agg grouped (avg/min/max)
+    mp = hash_agg([attr("l_flag", "string")],
+                  [("Average", [attr("l_quantity", "double")]),
+                   ("Min", [attr("l_extendedprice", "double")]),
+                   ("Max", [attr("l_discount", "double")])],
+                  [], "Partial", li)
+    plans["multi_agg"] = hash_agg(
+        [attr("l_flag", "string")],
+        [("Average", [attr("l_quantity", "double")]),
+         ("Min", [attr("l_extendedprice", "double")]),
+         ("Max", [attr("l_discount", "double")])],
+        [alias(attr("a", "double"), "avg_q"),
+         alias(attr("mi", "double"), "min_p"),
+         alias(attr("ma", "double"), "max_d")], "Final", exchange(mp))
+
+    # 12. anti join through AQE wrapper
+    plans["anti_join_aqe"] = [_node(
+        X + ".adaptive.AdaptiveSparkPlanExec", 1,
+        isFinalPlan=True)] + bhj(
+        [attr("l_orderkey", "long")], [attr("o_orderkey", "long")],
+        "LeftAnti", li, bcast_exchange(od))
+
+    return plans
+
+
+def main():
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "..", "tests", "golden_plans")
+    os.makedirs(out_dir, exist_ok=True)
+    for name, arr in build_corpus().items():
+        with open(os.path.join(out_dir, name + ".json"), "w") as f:
+            json.dump(arr, f, indent=1)
+        print("wrote", name, f"({len(arr)} plan nodes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,9 @@ import numpy as np
 import pyarrow as pa
 
 from spark_rapids_tpu.sql.session import TpuSession
+from spark_rapids_tpu import types as T
 from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.expr import core as E
 from spark_rapids_tpu.expr.core import col, lit
 
 
@@ -63,6 +65,8 @@ def gen_tables(sf: float, seed: int = 42):
         "d_day_name": np.array(["Sunday", "Monday", "Tuesday", "Wednesday",
                                 "Thursday", "Friday", "Saturday"])[
             np.arange(n_date) % 7],
+        "d_week_seq": (np.arange(n_date) // 7).astype(np.int32),
+        "d_dow": (np.arange(n_date) % 7).astype(np.int32),
     })
     item = pa.table({
         "i_item_sk": np.arange(n_item, dtype=np.int64),
@@ -76,6 +80,8 @@ def gen_tables(sf: float, seed: int = 42):
                                 "Music", "Shoes", "Sports", "Toys", "Men",
                                 "Women"])[rng.integers(0, 10, n_item)],
         "i_manufact_id": rng.integers(1, 1000, n_item).astype(np.int32),
+        "i_class": np.char.add("class", rng.integers(1, 16,
+                                                     n_item).astype(str)),
         "i_current_price": np.round(rng.uniform(0.5, 300, n_item), 2),
         "i_manager_id": rng.integers(1, 100, n_item).astype(np.int32),
     })
@@ -91,6 +97,8 @@ def gen_tables(sf: float, seed: int = 42):
     customer = pa.table({
         "c_customer_sk": np.arange(n_cust, dtype=np.int64),
         "c_current_addr_sk": rng.integers(0, n_addr, n_cust).astype(np.int64),
+        "c_current_cdemo_sk": rng.integers(0, 19208, n_cust).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(0, 7200, n_cust).astype(np.int64),
         "c_birth_year": rng.integers(1930, 2000, n_cust).astype(np.int32),
         "c_first_name": np.char.add("fn", np.arange(n_cust).astype(str)),
         "c_last_name": np.char.add("ln",
@@ -103,14 +111,17 @@ def gen_tables(sf: float, seed: int = 42):
         "ca_zip": np.char.zfill(
             rng.integers(10000, 99999, n_addr).astype(str), 5),
         "ca_gmt_offset": np.where(rng.random(n_addr) < 0.8, -5.0, -6.0),
+        "ca_state": np.array(["CA", "NY", "TX", "WA", "GA", "TN", "SD",
+                              "FL"])[rng.integers(0, 8, n_addr)],
     })
-    n_inv = max(n_item * 8, 4000)
+    n_inv = max(n_item * 60, 20000)
     inventory = pa.table({
         "inv_date_sk": rng.integers(d0, d0 + n_date,
                                     n_inv).astype(np.int64),
         "inv_item_sk": rng.integers(0, n_item, n_inv).astype(np.int64),
         "inv_quantity_on_hand": rng.integers(
             0, 1000, n_inv).astype(np.int32),
+        "inv_warehouse_sk": rng.integers(0, 5, n_inv).astype(np.int64),
     })
 
     def sales(n, prefix, extra=()):
@@ -133,13 +144,129 @@ def gen_tables(sf: float, seed: int = 42):
             t["ss_addr_sk"] = rng.integers(0, n_addr, n).astype(np.int64)
         return pa.table(t)
 
+    store_sales = sales(n_ss, "ss")
+    web_sales = sales(n_ws, "ws")
+    catalog_sales = sales(n_cs, "cs")
+
+    def returns(sold, prefix, src_prefix, frac=0.1):
+        """~frac of sales rows come back as returns (keys subsampled
+        from the sales table so joins hit)."""
+        n = max(int(sold.num_rows * frac), 200)
+        idx = rng.integers(0, sold.num_rows, n)
+        t = {
+            f"{prefix}_returned_date_sk":
+                sold[f"{src_prefix}_sold_date_sk"].to_numpy()[idx]
+                + rng.integers(1, 60, n),
+            f"{prefix}_item_sk":
+                sold[f"{src_prefix}_item_sk"].to_numpy()[idx],
+            f"{prefix}_customer_sk":
+                sold[f"{src_prefix}_customer_sk"].to_numpy()[idx],
+            f"{prefix}_return_amt": np.round(rng.uniform(1, 500, n), 2),
+            f"{prefix}_return_quantity":
+                rng.integers(1, 20, n).astype(np.int32),
+            f"{prefix}_net_loss": np.round(rng.uniform(0, 200, n), 2),
+            f"{prefix}_reason_sk": rng.integers(0, 35, n).astype(np.int64),
+        }
+        order_col = ("ss_ticket_number" if src_prefix == "ss"
+                     else f"{src_prefix}_order_number")
+        t[f"{prefix}_{'ticket_number' if src_prefix == 'ss' else 'order_number'}"] = \
+            sold[order_col].to_numpy()[idx]
+        if src_prefix == "ss":
+            t["sr_store_sk"] = sold["ss_store_sk"].to_numpy()[idx]
+        return pa.table(t)
+
+    n_hd = 7200
+    household_demographics = pa.table({
+        "hd_demo_sk": np.arange(n_hd, dtype=np.int64),
+        "hd_dep_count": rng.integers(0, 10, n_hd).astype(np.int32),
+        "hd_vehicle_count": rng.integers(0, 5, n_hd).astype(np.int32),
+        "hd_buy_potential": np.array([">10000", "5001-10000", "1001-5000",
+                                      "501-1000", "0-500",
+                                      "Unknown"])[rng.integers(0, 6, n_hd)],
+    })
+    n_cd = 1920800 // 100
+    customer_demographics = pa.table({
+        "cd_demo_sk": np.arange(n_cd, dtype=np.int64),
+        "cd_gender": np.array(["M", "F"])[rng.integers(0, 2, n_cd)],
+        "cd_marital_status": np.array(["M", "S", "D", "W", "U"])[
+            rng.integers(0, 5, n_cd)],
+        "cd_education_status": np.array(
+            ["Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown"])[
+            rng.integers(0, 7, n_cd)],
+        "cd_dep_count": rng.integers(0, 10, n_cd).astype(np.int32),
+    })
+    n_promo = max(int(300 * max(sf, 0.1)), 30)
+    promotion = pa.table({
+        "p_promo_sk": np.arange(n_promo, dtype=np.int64),
+        "p_channel_email": np.array(["Y", "N"])[
+            (rng.random(n_promo) < 0.1).astype(int) ^ 1],
+        "p_channel_event": np.array(["Y", "N"])[
+            (rng.random(n_promo) < 0.5).astype(int) ^ 1],
+        "p_channel_tv": np.array(["Y", "N"])[
+            (rng.random(n_promo) < 0.5).astype(int) ^ 1],
+    })
+    n_wh = 5
+    warehouse = pa.table({
+        "w_warehouse_sk": np.arange(n_wh, dtype=np.int64),
+        "w_warehouse_name": np.char.add("warehouse_",
+                                        np.arange(n_wh).astype(str)),
+        "w_state": np.array(["CA", "NY", "TX", "WA", "GA"])[:n_wh],
+    })
+    time_dim = pa.table({
+        "t_time_sk": np.arange(86400, dtype=np.int64),
+        "t_hour": (np.arange(86400) // 3600).astype(np.int32),
+        "t_minute": ((np.arange(86400) % 3600) // 60).astype(np.int32),
+    })
+    reason = pa.table({
+        "r_reason_sk": np.arange(35, dtype=np.int64),
+        "r_reason_desc": np.char.add("reason ",
+                                     np.arange(35).astype(str)),
+    })
+    # per-row demographic / promo / time / warehouse keys for the facts
+    def widen(t, prefix, tick=False):
+        n = t.num_rows
+        cols = {
+            f"{prefix}_hdemo_sk": rng.integers(0, n_hd, n).astype(np.int64),
+            f"{prefix}_cdemo_sk": rng.integers(0, n_cd, n).astype(np.int64),
+            f"{prefix}_promo_sk": rng.integers(0, n_promo,
+                                               n).astype(np.int64),
+            f"{prefix}_sold_time_sk": rng.integers(25200, 75600,
+                                                   n).astype(np.int64),
+            f"{prefix}_wholesale_cost": np.round(rng.uniform(1, 100, n), 2),
+            f"{prefix}_list_price": np.round(rng.uniform(1, 300, n), 2),
+            f"{prefix}_coupon_amt": np.round(rng.uniform(0, 50, n), 2),
+        }
+        if prefix != "ss":
+            cols[f"{prefix}_warehouse_sk"] = rng.integers(
+                0, n_wh, n).astype(np.int64)
+            cols[f"{prefix}_ship_date_sk"] = (
+                t[f"{prefix}_sold_date_sk"].to_numpy()
+                + rng.integers(1, 120, n))
+        for name, arr in cols.items():
+            t = t.append_column(name, pa.array(arr))
+        return t
+
+    store_sales = widen(store_sales, "ss")
+    web_sales = widen(web_sales, "ws")
+    catalog_sales = widen(catalog_sales, "cs")
+
     return {
         "date_dim": date_dim, "item": item, "store": store,
         "customer": customer, "customer_address": customer_address,
         "inventory": inventory,
-        "store_sales": sales(n_ss, "ss"),
-        "web_sales": sales(n_ws, "ws"),
-        "catalog_sales": sales(n_cs, "cs"),
+        "store_sales": store_sales,
+        "web_sales": web_sales,
+        "catalog_sales": catalog_sales,
+        "store_returns": returns(store_sales, "sr", "ss"),
+        "web_returns": returns(web_sales, "wr", "ws"),
+        "catalog_returns": returns(catalog_sales, "cr", "cs"),
+        "household_demographics": household_demographics,
+        "customer_demographics": customer_demographics,
+        "promotion": promotion,
+        "warehouse": warehouse,
+        "time_dim": time_dim,
+        "reason": reason,
     }
 
 
@@ -603,7 +730,1838 @@ def q82(s, d):
             .order_by(col("i_item_id").asc()).limit(100))
 
 
-QUERIES = {3: q3, 7: q7, 12: q12, 19: q19, 20: q20, 26: q26, 33: q33,
+def q1(s, d):
+    """customers returning more than 1.2x their store's average (the
+    correlated scalar subquery, decorrelated into a per-store avg join
+    — Spark's own DecorrelateInnerQuery shape)."""
+    ctr = (d["store_returns"]
+           .join(d["date_dim"], on=[(col("sr_returned_date_sk"),
+                                     col("d_date_sk"))])
+           .filter(col("d_year") == lit(2000))
+           .group_by("sr_customer_sk", "sr_store_sk")
+           .agg(F.sum(col("sr_return_amt")).alias("ctr_total_return")))
+    avg = (ctr.group_by("sr_store_sk")
+           .agg(F.avg(col("ctr_total_return")).alias("avg_ret")))
+    return (ctr.join(avg, on="sr_store_sk")
+            .filter(col("ctr_total_return") > col("avg_ret") * lit(1.2))
+            .join(d["customer"], on=[(col("sr_customer_sk"),
+                                      col("c_customer_sk"))])
+            .select(col("c_first_name"), col("c_last_name"),
+                    col("ctr_total_return"))
+            .order_by(col("c_last_name").asc(), col("c_first_name").asc(),
+                      col("ctr_total_return").asc())
+            .limit(100))
+
+
+def q5(s, d):
+    """channel sales/returns/profit ROLLUP report."""
+    def leg(df, date_col, chan, id_col, sales_col, profit_col):
+        return (df.join(d["date_dim"], on=[(col(date_col),
+                                            col("d_date_sk"))])
+                .filter(col("d_year") == lit(2000))
+                .select(lit(chan).alias("channel"),
+                        col(id_col).alias("id"),
+                        col(sales_col).alias("sales"),
+                        lit(0.0).alias("returns_amt"),
+                        col(profit_col).alias("profit")))
+
+    def ret_leg(df, date_col, chan, id_col, amt_col, loss_col):
+        return (df.join(d["date_dim"], on=[(col(date_col),
+                                            col("d_date_sk"))])
+                .filter(col("d_year") == lit(2000))
+                .select(lit(chan).alias("channel"),
+                        col(id_col).alias("id"),
+                        lit(0.0).alias("sales"),
+                        col(amt_col).alias("returns_amt"),
+                        (lit(0.0) - col(loss_col)).alias("profit")))
+
+    u = (leg(d["store_sales"], "ss_sold_date_sk", "store channel",
+             "ss_store_sk", "ss_ext_sales_price", "ss_net_profit")
+         .union(ret_leg(d["store_returns"], "sr_returned_date_sk",
+                        "store channel", "sr_store_sk",
+                        "sr_return_amt", "sr_net_loss"))
+         .union(leg(d["catalog_sales"], "cs_sold_date_sk",
+                    "catalog channel", "cs_warehouse_sk",
+                    "cs_ext_sales_price", "cs_net_profit"))
+         .union(leg(d["web_sales"], "ws_sold_date_sk", "web channel",
+                    "ws_warehouse_sk", "ws_ext_sales_price",
+                    "ws_net_profit")))
+    return (u.rollup("channel", "id")
+            .agg(F.sum(col("sales")).alias("sales"),
+                 F.sum(col("returns_amt")).alias("returns_amt"),
+                 F.sum(col("profit")).alias("profit"))
+            .order_by(col("channel").asc(), col("id").asc())
+            .limit(100))
+
+
+def q6(s, d):
+    """cities whose customers buy items priced 1.2x over the category
+    average (correlated scalar decorrelated to a category-avg join)."""
+    cat_avg = (d["item"].group_by("i_category_id")
+               .agg(F.avg(col("i_current_price")).alias("cat_avg")))
+    hot = (d["item"].join(cat_avg, on="i_category_id")
+           .filter(col("i_current_price") > lit(1.2) * col("cat_avg")))
+    return (d["store_sales"]
+            .join(hot, on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .join(d["customer"], on=[(col("ss_customer_sk"),
+                                      col("c_customer_sk"))])
+            .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                             col("ca_address_sk"))])
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter((col("d_year") == lit(2001)) & (col("d_moy") == lit(1)))
+            .group_by("ca_city").agg(F.count("*").alias("cnt"))
+            .filter(col("cnt") >= lit(10))
+            .order_by(col("cnt").asc(), col("ca_city").asc()).limit(100))
+
+
+def q8(s, d):
+    """store sales for stores whose customers live in preferred zips:
+    an INTERSECT of a zip list with customer-dense zips."""
+    zip_list = (d["customer_address"]
+                .filter(col("ca_zip").substr(1, 1).isin("1", "2", "3"))
+                .select(col("ca_zip")))
+    dense = (d["customer"]
+             .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                              col("ca_address_sk"))])
+             .group_by("ca_zip").agg(F.count("*").alias("cnt"))
+             .filter(col("cnt") > lit(2)).select(col("ca_zip")))
+    zips = zip_list.intersect(dense)
+    cust = (d["customer"]
+            .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                             col("ca_address_sk"))])
+            .join(zips, on="ca_zip", how="left_semi"))
+    return (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter((col("d_qoy") == lit(2)) & (col("d_year") == lit(1998)))
+            .join(cust, on=[(col("ss_customer_sk"), col("c_customer_sk"))],
+                  how="left_semi")
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .group_by("s_store_name")
+            .agg(F.sum(col("ss_net_profit")).alias("net_profit"))
+            .order_by(col("s_store_name").asc()).limit(100))
+
+
+def q9(s, d):
+    """five quantity-bucket statistics in one pass (the reference plans
+    the CASE WHEN scalar subqueries; one conditional-agg pass is the
+    columnar equivalent)."""
+    aggs = []
+    for i, (lo, hi) in enumerate([(1, 20), (21, 40), (41, 60), (61, 80),
+                                  (81, 100)], 1):
+        cond = (col("ss_quantity") >= lit(lo)) & \
+            (col("ss_quantity") <= lit(hi))
+        aggs.append(F.count(F.when(cond, lit(1)))
+                    .alias(f"cnt{i}"))
+        aggs.append(F.avg(F.when(cond, col("ss_ext_discount_amt")))
+                    .alias(f"avg_disc{i}"))
+        aggs.append(F.avg(F.when(cond, col("ss_net_profit")))
+                    .alias(f"avg_profit{i}"))
+    return d["store_sales"].agg(*aggs)
+
+
+def q10(s, d):
+    """demographics of city customers active in stores AND (web OR
+    catalog) — the EXISTS pair lowered to semi joins."""
+    c = (d["customer"]
+         .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                          col("ca_address_sk"))])
+         .filter(col("ca_city").isin("Midway", "Fairview")))
+    ss = (d["store_sales"]
+          .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter((col("d_year") == lit(2000))
+                  & (col("d_qoy") <= lit(2))))
+    c = c.join(ss, on=[(col("c_customer_sk"), col("ss_customer_sk"))],
+               how="left_semi")
+    other = (d["web_sales"].select(col("ws_customer_sk").alias("k"))
+             .union(d["catalog_sales"]
+                    .select(col("cs_customer_sk").alias("k"))))
+    c = c.join(other, on=[(col("c_customer_sk"), col("k"))],
+               how="left_semi")
+    return (c.join(d["customer_demographics"],
+                   on=[(col("c_current_cdemo_sk"), col("cd_demo_sk"))])
+            .group_by("cd_gender", "cd_marital_status",
+                      "cd_education_status")
+            .agg(F.count("*").alias("cnt"))
+            .order_by(col("cd_gender").asc(), col("cd_marital_status").asc(),
+                      col("cd_education_status").asc())
+            .limit(100))
+
+
+def q13(s, d):
+    """store sales averages under OR'd demographic/address branches."""
+    return (d["store_sales"]
+            .join(d["customer_demographics"],
+                  on=[(col("ss_cdemo_sk"), col("cd_demo_sk"))])
+            .join(d["household_demographics"],
+                  on=[(col("ss_hdemo_sk"), col("hd_demo_sk"))])
+            .join(d["customer_address"], on=[(col("ss_addr_sk"),
+                                             col("ca_address_sk"))])
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter(col("d_year") == lit(2001))
+            .filter(((col("cd_marital_status") == lit("M"))
+                     & (col("cd_education_status") == lit("College"))
+                     & (col("ss_sales_price") >= lit(100.0)))
+                    | ((col("cd_marital_status") == lit("S"))
+                       & (col("ss_sales_price") <= lit(150.0)))
+                    | (col("ca_state").isin("CA", "NY", "TX")
+                       & (col("hd_dep_count") >= lit(3))))
+            .agg(F.avg(col("ss_quantity")).alias("avg_qty"),
+                 F.avg(col("ss_ext_sales_price")).alias("avg_price"),
+                 F.avg(col("ss_ext_discount_amt")).alias("avg_disc"),
+                 F.sum(col("ss_net_profit")).alias("sum_profit")))
+
+
+def q15(s, d):
+    """catalog sales by customer zip for a quarter (zip/state gate)."""
+    return (d["catalog_sales"]
+            .join(d["customer"], on=[(col("cs_customer_sk"),
+                                      col("c_customer_sk"))])
+            .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                             col("ca_address_sk"))])
+            .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter((col("d_qoy") == lit(1)) & (col("d_year") == lit(2001)))
+            .filter(col("ca_zip").substr(1, 2).isin("85", "86", "87",
+                                                    "88", "89")
+                    | col("ca_state").isin("CA", "WA", "GA")
+                    | (col("cs_sales_price") > lit(250.0)))
+            .group_by("ca_zip")
+            .agg(F.sum(col("cs_sales_price")).alias("total"))
+            .order_by(col("ca_zip").asc()).limit(100))
+
+
+def q16(s, d):
+    """catalog orders shipped from more than one warehouse with no
+    return: the EXISTS/NOT EXISTS pair as group-derived semi + anti
+    joins."""
+    cs = (d["catalog_sales"]
+          .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter((col("d_year") == lit(2000))
+                  & col("d_moy").isin(3, 4)))
+    multi_wh = (cs.group_by("cs_order_number")
+                .agg(F.min(col("cs_warehouse_sk")).alias("wmin"),
+                     F.max(col("cs_warehouse_sk")).alias("wmax"))
+                .filter(col("wmin") < col("wmax"))
+                .select(col("cs_order_number").alias("o")))
+    kept = (cs.join(multi_wh, on=[(col("cs_order_number"), col("o"))],
+                    how="left_semi")
+            .join(d["catalog_returns"]
+                  .select(col("cr_order_number").alias("r")),
+                  on=[(col("cs_order_number"), col("r"))],
+                  how="left_anti"))
+    orders = kept.select(col("cs_order_number")).distinct() \
+        .agg(F.count(col("cs_order_number")).alias("order_count"))
+    totals = kept.agg(
+        F.sum(col("cs_ext_sales_price")).alias("total_shipping_cost"),
+        F.sum(col("cs_net_profit")).alias("total_net_profit"))
+    return orders.join(totals, on=None, how="cross")
+
+
+def q17(s, d):
+    """items bought in store, returned, re-bought via catalog: the
+    three-fact join with mean/stddev stats."""
+    j = (d["store_sales"]
+         .join(d["store_returns"],
+               on=[(col("ss_ticket_number"), col("sr_ticket_number")),
+                   (col("ss_item_sk"), col("sr_item_sk"))])
+         .join(d["catalog_sales"],
+               on=[(col("sr_customer_sk"), col("cs_customer_sk")),
+                   (col("sr_item_sk"), col("cs_item_sk"))])
+         .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+         .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))]))
+    return (j.group_by("i_item_id", "s_city")
+            .agg(F.count(col("ss_quantity")).alias("store_sales_cnt"),
+                 F.avg(col("ss_quantity")).alias("store_sales_mean"),
+                 F.stddev(col("ss_quantity")).alias("store_sales_stdev"),
+                 F.avg(col("sr_return_quantity")).alias("return_mean"),
+                 F.avg(col("cs_quantity")).alias("catalog_mean"))
+            .order_by(col("i_item_id").asc(), col("s_city").asc())
+            .limit(100))
+
+
+def q18(s, d):
+    """catalog averages by demographic over a ROLLUP hierarchy."""
+    return (d["catalog_sales"]
+            .join(d["customer_demographics"],
+                  on=[(col("cs_cdemo_sk"), col("cd_demo_sk"))])
+            .filter((col("cd_gender") == lit("F"))
+                    & (col("cd_education_status") == lit("College")))
+            .join(d["customer"], on=[(col("cs_customer_sk"),
+                                      col("c_customer_sk"))])
+            .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                             col("ca_address_sk"))])
+            .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter(col("d_year") == lit(1998))
+            .join(d["item"], on=[(col("cs_item_sk"), col("i_item_sk"))])
+            .rollup("i_item_id", "ca_state", "ca_city")
+            .agg(F.avg(col("cs_quantity")).alias("agg1"),
+                 F.avg(col("cs_list_price")).alias("agg2"),
+                 F.avg(col("cs_coupon_amt")).alias("agg3"),
+                 F.avg(col("cs_net_profit")).alias("agg4"))
+            .order_by(col("i_item_id").asc(), col("ca_state").asc(),
+                      col("ca_city").asc())
+            .limit(100))
+
+
+def q21(s, d):
+    """warehouse inventory balance around a pivot date."""
+    pivot = lit(2450815 + 730)
+    j = (d["inventory"]
+         .join(d["warehouse"], on=[(col("inv_warehouse_sk"),
+                                    col("w_warehouse_sk"))])
+         .join(d["item"], on=[(col("inv_item_sk"), col("i_item_sk"))])
+         .join(d["date_dim"], on=[(col("inv_date_sk"), col("d_date_sk"))])
+         .filter((col("i_current_price") >= lit(0.99))
+                 & (col("i_current_price") <= lit(200.0))))
+    g = (j.group_by("w_warehouse_name", "i_item_id")
+         .agg(F.sum(F.when(col("d_date_sk") < pivot,
+                           col("inv_quantity_on_hand"))
+                    .otherwise(lit(0))).alias("inv_before"),
+              F.sum(F.when(col("d_date_sk") >= pivot,
+                           col("inv_quantity_on_hand"))
+                    .otherwise(lit(0))).alias("inv_after")))
+    return (g.filter((col("inv_before") > lit(0))
+                     & (col("inv_after") * lit(1.0)
+                        / col("inv_before") >= lit(0.5))
+                     & (col("inv_after") * lit(1.0)
+                        / col("inv_before") <= lit(2.0)))
+            .order_by(col("w_warehouse_name").asc(), col("i_item_id").asc())
+            .limit(100))
+
+
+def q22(s, d):
+    """inventory quantity-on-hand averages over a ROLLUP hierarchy."""
+    return (d["inventory"]
+            .join(d["date_dim"], on=[(col("inv_date_sk"),
+                                      col("d_date_sk"))])
+            .join(d["item"], on=[(col("inv_item_sk"), col("i_item_sk"))])
+            .filter((col("d_year") >= lit(1999))
+                    & (col("d_year") <= lit(2000)))
+            .rollup("i_category", "i_brand", "i_class")
+            .agg(F.avg(col("inv_quantity_on_hand")).alias("qoh"))
+            .order_by(col("qoh").asc(), col("i_category").asc(),
+                      col("i_brand").asc(), col("i_class").asc())
+            .limit(100))
+
+
+def q25(s, d):
+    """q17-shaped three-fact join aggregating net profit/loss."""
+    j = (d["store_sales"]
+         .join(d["store_returns"],
+               on=[(col("ss_ticket_number"), col("sr_ticket_number")),
+                   (col("ss_item_sk"), col("sr_item_sk"))])
+         .join(d["catalog_sales"],
+               on=[(col("sr_customer_sk"), col("cs_customer_sk")),
+                   (col("sr_item_sk"), col("cs_item_sk"))])
+         .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+         .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))]))
+    return (j.group_by("i_item_id", "s_store_name")
+            .agg(F.max(col("ss_net_profit")).alias("store_sales_profit"),
+                 F.max(col("sr_net_loss")).alias("store_returns_loss"),
+                 F.max(col("cs_net_profit")).alias("catalog_sales_profit"))
+            .order_by(col("i_item_id").asc(), col("s_store_name").asc())
+            .limit(100))
+
+
+def q27(s, d):
+    """store sales averages by demographic over ROLLUP(i_item_id,
+    s_city) with grouping()."""
+    return (d["store_sales"]
+            .join(d["customer_demographics"],
+                  on=[(col("ss_cdemo_sk"), col("cd_demo_sk"))])
+            .filter((col("cd_gender") == lit("M"))
+                    & (col("cd_marital_status") == lit("S")))
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter(col("d_year") == lit(2002))
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .rollup("i_item_id", "s_city")
+            .agg(F.avg(col("ss_quantity")).alias("agg1"),
+                 F.avg(col("ss_list_price")).alias("agg2"),
+                 F.avg(col("ss_coupon_amt")).alias("agg3"),
+                 F.avg(col("ss_sales_price")).alias("agg4"),
+                 F.grouping(col("s_city")).alias("g_city"))
+            .order_by(col("i_item_id").asc(), col("s_city").asc())
+            .limit(100))
+
+
+def q28(s, d):
+    """six list-price-bucket stats in one conditional-agg pass."""
+    aggs = []
+    for i, (lo, hi) in enumerate([(0, 50), (51, 100), (101, 150),
+                                  (151, 200), (201, 250), (251, 300)], 1):
+        cond = (col("ss_list_price") >= lit(float(lo))) & \
+            (col("ss_list_price") <= lit(float(hi)))
+        aggs.append(F.avg(F.when(cond, col("ss_list_price")))
+                    .alias(f"b{i}_lp"))
+        aggs.append(F.count(F.when(cond, col("ss_list_price")))
+                    .alias(f"b{i}_cnt"))
+    return d["store_sales"].agg(*aggs)
+
+
+def q29(s, d):
+    """q17-shaped join with quantity sums by month windows."""
+    j = (d["store_sales"]
+         .join(d["store_returns"],
+               on=[(col("ss_ticket_number"), col("sr_ticket_number")),
+                   (col("ss_item_sk"), col("sr_item_sk"))])
+         .join(d["catalog_sales"],
+               on=[(col("sr_customer_sk"), col("cs_customer_sk")),
+                   (col("sr_item_sk"), col("cs_item_sk"))])
+         .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+         .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))]))
+    return (j.group_by("i_item_id", "i_item_id", "s_store_name")
+            .agg(F.sum(col("ss_quantity")).alias("store_sales_quantity"),
+                 F.sum(col("sr_return_quantity")).alias("return_quantity"),
+                 F.sum(col("cs_quantity")).alias("catalog_quantity"))
+            .order_by(col("i_item_id").asc(), col("s_store_name").asc())
+            .limit(100))
+
+
+def q30(s, d):
+    """web customers returning over 1.2x their state's average
+    (decorrelated per-state avg join)."""
+    ctr = (d["web_returns"]
+           .join(d["date_dim"], on=[(col("wr_returned_date_sk"),
+                                     col("d_date_sk"))])
+           .filter(col("d_year") == lit(2000))
+           .join(d["customer"], on=[(col("wr_customer_sk"),
+                                     col("c_customer_sk"))])
+           .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                            col("ca_address_sk"))])
+           .group_by("wr_customer_sk", "ca_state")
+           .agg(F.sum(col("wr_return_amt")).alias("ctr_total_return")))
+    avg = (ctr.group_by("ca_state")
+           .agg(F.avg(col("ctr_total_return")).alias("avg_ret")))
+    return (ctr.join(avg, on="ca_state")
+            .filter(col("ctr_total_return") > col("avg_ret") * lit(1.2))
+            .join(d["customer"], on=[(col("wr_customer_sk"),
+                                      col("c_customer_sk"))])
+            .select(col("c_first_name"), col("c_last_name"),
+                    col("ca_state"), col("ctr_total_return"))
+            .order_by(col("c_last_name").asc(), col("c_first_name").asc(),
+                      col("ctr_total_return").asc())
+            .limit(100))
+
+
+def q32(s, d):
+    """catalog sales with discount over 1.3x the item's average
+    (decorrelated per-item avg join)."""
+    window = (d["catalog_sales"]
+              .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                        col("d_date_sk"))])
+              .filter(col("d_year") == lit(2000)))
+    item_avg = (window.group_by("cs_item_sk")
+                .agg(F.avg(col("cs_ext_discount_amt")).alias("avg_disc")))
+    return (window
+            .join(item_avg.select(col("cs_item_sk").alias("k"),
+                                  col("avg_disc")),
+                  on=[(col("cs_item_sk"), col("k"))])
+            .filter(col("cs_ext_discount_amt")
+                    > col("avg_disc") * lit(1.3))
+            .agg(F.sum(col("cs_ext_discount_amt"))
+                 .alias("excess_discount_amount")))
+
+
+def q35(s, d):
+    """q10-shaped: store buyers also active on web or catalog, grouped
+    by demographics with count/avg/max stats."""
+    c = d["customer"]
+    ss = (d["store_sales"]
+          .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter((col("d_year") == lit(1999))
+                  & (col("d_qoy") < lit(4))))
+    c = c.join(ss, on=[(col("c_customer_sk"), col("ss_customer_sk"))],
+               how="left_semi")
+    other = (d["web_sales"].select(col("ws_customer_sk").alias("k"))
+             .union(d["catalog_sales"]
+                    .select(col("cs_customer_sk").alias("k"))))
+    c = c.join(other, on=[(col("c_customer_sk"), col("k"))],
+               how="left_semi")
+    return (c.join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                              col("ca_address_sk"))])
+            .join(d["customer_demographics"],
+                  on=[(col("c_current_cdemo_sk"), col("cd_demo_sk"))])
+            .group_by("ca_state", "cd_gender", "cd_marital_status",
+                      "cd_dep_count")
+            .agg(F.count("*").alias("cnt"),
+                 F.avg(col("cd_dep_count")).alias("avg_dep"),
+                 F.max(col("cd_dep_count")).alias("max_dep"),
+                 F.sum(col("cd_dep_count")).alias("sum_dep"))
+            .order_by(col("ca_state").asc(), col("cd_gender").asc(),
+                      col("cd_marital_status").asc(),
+                      col("cd_dep_count").asc())
+            .limit(100))
+
+
+def q36(s, d):
+    """gross-margin ROLLUP(i_category, i_class) ranked within each
+    grouping level."""
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter(col("d_year") == lit(2001))
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .rollup("i_category", "i_class")
+            .agg(F.sum(col("ss_net_profit")).alias("profit"),
+                 F.sum(col("ss_ext_sales_price")).alias("sales"),
+                 F.grouping(col("i_category")).alias("g_cat"),
+                 F.grouping(col("i_class")).alias("g_cls")))
+    w = Window.partition_by(col("lochierarchy")) \
+        .order_by(col("margin").asc())
+    return (base.select(col("i_category"), col("i_class"),
+                        (col("g_cat") + col("g_cls")).alias("lochierarchy"),
+                        (col("profit") / col("sales")).alias("margin"))
+            .select(col("i_category"), col("i_class"),
+                    col("lochierarchy"), col("margin"),
+                    F.rank().over(w).alias("rank_within_parent"))
+            .order_by(col("lochierarchy").desc(), col("i_category").asc(),
+                      col("rank_within_parent").asc())
+            .limit(100))
+
+
+def q37(s, d):
+    """q82 for the catalog channel."""
+    eligible = (d["item"]
+                .join(d["inventory"], on=[(col("i_item_sk"),
+                                           col("inv_item_sk"))])
+                .join(d["date_dim"], on=[(col("inv_date_sk"),
+                                          col("d_date_sk"))])
+                .filter((col("i_current_price") >= lit(20.0))
+                        & (col("i_current_price") <= lit(50.0))
+                        & (col("inv_quantity_on_hand") >= lit(100))
+                        & (col("inv_quantity_on_hand") <= lit(500))
+                        & (col("d_year") == lit(2001))))
+    sold = eligible.join(d["catalog_sales"],
+                         on=[(col("i_item_sk"), col("cs_item_sk"))],
+                         how="left_semi")
+    return (sold.select(col("i_item_id"), col("i_current_price"))
+            .distinct()
+            .order_by(col("i_item_id").asc()).limit(100))
+
+
+def q38(s, d):
+    """customers active in ALL three channels in one year: a 3-way
+    INTERSECT then count."""
+    def chan(sales, date_col, cust_col):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .filter(col("d_year") == lit(2000))
+                .join(d["customer"], on=[(col(cust_col),
+                                          col("c_customer_sk"))])
+                .select(col("c_first_name"), col("c_last_name")))
+    u = (chan("store_sales", "ss_sold_date_sk", "ss_customer_sk")
+         .intersect(chan("catalog_sales", "cs_sold_date_sk",
+                         "cs_customer_sk"))
+         .intersect(chan("web_sales", "ws_sold_date_sk",
+                         "ws_customer_sk")))
+    return u.agg(F.count("*").alias("cnt"))
+
+
+def q39(s, d):
+    """inventory coefficient-of-variation pairs for consecutive months."""
+    base = (d["inventory"]
+            .join(d["date_dim"], on=[(col("inv_date_sk"),
+                                      col("d_date_sk"))])
+            .filter(col("d_year") == lit(2000))
+            .group_by("inv_warehouse_sk", "inv_item_sk", "d_moy")
+            .agg(F.avg(col("inv_quantity_on_hand")).alias("mean"),
+                 F.stddev(col("inv_quantity_on_hand")).alias("stdev")))
+    cov = (base.filter((col("mean") > lit(0.0))
+                       & (col("stdev") / col("mean") > lit(0.4)))
+           .select(col("inv_warehouse_sk"), col("inv_item_sk"),
+                   col("d_moy"), (col("stdev") / col("mean")).alias("cov")))
+    m1 = cov.select(col("inv_warehouse_sk").alias("w1"),
+                    col("inv_item_sk").alias("i1"),
+                    col("d_moy").alias("m1"), col("cov").alias("cov1"))
+    m2 = cov.select(col("inv_warehouse_sk").alias("w2"),
+                    col("inv_item_sk").alias("i2"),
+                    col("d_moy").alias("m2"), col("cov").alias("cov2"))
+    return (m1.join(m2, on=[(col("w1"), col("w2")),
+                            (col("i1"), col("i2"))])
+            .filter(col("m2") == col("m1") + lit(1))
+            .order_by(col("w1").asc(), col("i1").asc(), col("m1").asc(),
+                      col("cov2").asc())
+            .limit(100))
+
+
+def q40(s, d):
+    """catalog sales value before/after a pivot date by warehouse state,
+    return-adjusted via a left join on catalog_returns."""
+    pivot = lit(2450815 + 730)
+    cr = d["catalog_returns"].select(
+        col("cr_order_number").alias("r_ord"),
+        col("cr_item_sk").alias("r_item"),
+        col("cr_return_amt"))
+    j = (d["catalog_sales"]
+         .join(cr, on=[(col("cs_order_number"), col("r_ord")),
+                       (col("cs_item_sk"), col("r_item"))], how="left")
+         .join(d["warehouse"], on=[(col("cs_warehouse_sk"),
+                                    col("w_warehouse_sk"))])
+         .join(d["item"], on=[(col("cs_item_sk"), col("i_item_sk"))])
+         .filter((col("i_current_price") >= lit(0.99))
+                 & (col("i_current_price") <= lit(200.0)))
+         .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                   col("d_date_sk"))]))
+    net = (col("cs_sales_price")
+           - F.coalesce(col("cr_return_amt"), lit(0.0)))
+    return (j.group_by("w_state", "i_item_id")
+            .agg(F.sum(F.when(col("d_date_sk") < pivot, net)
+                       .otherwise(lit(0.0))).alias("sales_before"),
+                 F.sum(F.when(col("d_date_sk") >= pivot, net)
+                       .otherwise(lit(0.0))).alias("sales_after"))
+            .order_by(col("w_state").asc(), col("i_item_id").asc())
+            .limit(100))
+
+
+def q44(s, d):
+    """best and worst performing items by store average net profit."""
+    from spark_rapids_tpu.expr.window import Window
+    perf = (d["store_sales"]
+            .group_by("ss_item_sk")
+            .agg(F.avg(col("ss_net_profit")).alias("rank_col")))
+    w_best = Window.partition_by(lit(1)).order_by(col("rank_col").desc())
+    w_worst = Window.partition_by(lit(1)).order_by(col("rank_col").asc())
+    ranked = perf.select(col("ss_item_sk"), col("rank_col"),
+                         F.rank().over(w_best).alias("rnk_best"),
+                         F.rank().over(w_worst).alias("rnk_worst"))
+    best = (ranked.filter(col("rnk_best") <= lit(10))
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .select(col("rnk_best").alias("rnk"),
+                    col("i_item_id").alias("best_performing")))
+    worst = (ranked.filter(col("rnk_worst") <= lit(10))
+             .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+             .select(col("rnk_worst").alias("rnk"),
+                     col("i_item_id").alias("worst_performing")))
+    return (best.join(worst, on="rnk")
+            .order_by(col("rnk").asc()).limit(100))
+
+
+def q47(s, d):
+    """monthly brand/store sales vs their yearly average, with the
+    previous and next month alongside (lag/lead windows)."""
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .filter(col("d_year") == lit(1999))
+            .group_by("i_category", "i_brand", "s_store_name", "d_year",
+                      "d_moy")
+            .agg(F.sum(col("ss_sales_price")).alias("sum_sales")))
+    w_avg = Window.partition_by(col("i_category"), col("i_brand"),
+                                col("s_store_name"), col("d_year"))
+    w_seq = Window.partition_by(col("i_category"), col("i_brand"),
+                                col("s_store_name")) \
+        .order_by(col("d_year"), col("d_moy"))
+    out = base.select(
+        col("i_category"), col("i_brand"), col("s_store_name"),
+        col("d_year"), col("d_moy"), col("sum_sales"),
+        F.avg(col("sum_sales")).over(w_avg).alias("avg_monthly_sales"),
+        F.lag(col("sum_sales")).over(w_seq).alias("psum"),
+        F.lead(col("sum_sales")).over(w_seq).alias("nsum"))
+    return (out.filter((col("avg_monthly_sales") > lit(0.0))
+                       & ((col("sum_sales") - col("avg_monthly_sales"))
+                          / col("avg_monthly_sales") > lit(0.1)))
+            .order_by(col("sum_sales").desc(), col("s_store_name").asc(),
+                      col("d_moy").asc())
+            .limit(100))
+
+
+def q50(s, d):
+    """days-to-return buckets per store."""
+    j = (d["store_sales"]
+         .join(d["store_returns"],
+               on=[(col("ss_ticket_number"), col("sr_ticket_number")),
+                   (col("ss_item_sk"), col("sr_item_sk"))])
+         .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))]))
+    lag_days = col("sr_returned_date_sk") - col("ss_sold_date_sk")
+    return (j.group_by("s_store_name", "s_city")
+            .agg(F.sum(F.when(lag_days <= lit(30), lit(1))
+                       .otherwise(lit(0))).alias("d30"),
+                 F.sum(F.when((lag_days > lit(30))
+                              & (lag_days <= lit(60)), lit(1))
+                       .otherwise(lit(0))).alias("d31_60"),
+                 F.sum(F.when(lag_days > lit(60), lit(1))
+                       .otherwise(lit(0))).alias("d60plus"))
+            .order_by(col("s_store_name").asc(), col("s_city").asc())
+            .limit(100))
+
+
+def q51(s, d):
+    """cumulative web vs store revenue crossover by item over time."""
+    from spark_rapids_tpu.expr.window import Window
+    ws = (d["web_sales"]
+          .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter(col("d_year") == lit(2000))
+          .group_by("ws_item_sk", "d_week_seq")
+          .agg(F.sum(col("ws_sales_price")).alias("sales")))
+    ss = (d["store_sales"]
+          .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter(col("d_year") == lit(2000))
+          .group_by("ss_item_sk", "d_week_seq")
+          .agg(F.sum(col("ss_sales_price")).alias("sales")))
+    wsr = ws.select(col("ws_item_sk").alias("item_sk"),
+                    col("d_week_seq").alias("wk"),
+                    col("sales").alias("web_sales"))
+    ssr = ss.select(col("ss_item_sk").alias("s_item_sk"),
+                    col("d_week_seq").alias("s_wk"),
+                    col("sales").alias("store_sales_v"))
+    j = wsr.join(ssr, on=[(col("item_sk"), col("s_item_sk")),
+                          (col("wk"), col("s_wk"))])
+    w = Window.partition_by(col("item_sk")).order_by(col("wk")) \
+        .rows_between(Window.unboundedPreceding, Window.currentRow)
+    out = j.select(col("item_sk"), col("wk"),
+                   F.sum(col("web_sales")).over(w).alias("cume_web"),
+                   F.sum(col("store_sales_v")).over(w).alias("cume_store"))
+    return (out.filter(col("cume_web") > col("cume_store"))
+            .order_by(col("item_sk").asc(), col("wk").asc())
+            .limit(100))
+
+
+def q53(s, d):
+    """quarterly manufacturer sales vs their average (q89 shape by
+    manufacturer)."""
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .filter(col("d_year") == lit(2000))
+            .group_by("i_manufact_id", "d_qoy")
+            .agg(F.sum(col("ss_sales_price")).alias("sum_sales")))
+    w = Window.partition_by(col("i_manufact_id"))
+    out = base.select(col("i_manufact_id"), col("d_qoy"),
+                      col("sum_sales"),
+                      F.avg(col("sum_sales")).over(w)
+                      .alias("avg_quarterly_sales"))
+    return (out.filter((col("avg_quarterly_sales") > lit(0.0))
+                       & ((col("sum_sales") - col("avg_quarterly_sales"))
+                          / col("avg_quarterly_sales") > lit(0.1)))
+            .order_by(col("avg_quarterly_sales").asc(),
+                      col("sum_sales").asc(), col("i_manufact_id").asc())
+            .limit(100))
+
+
+def q56(s, d):
+    """q60 shape gated by address gmt offset."""
+    def chan(sales, date_col, item_col, cust_col, price_col):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .join(d["customer"], on=[(col(cust_col),
+                                          col("c_customer_sk"))])
+                .join(d["customer_address"],
+                      on=[(col("c_current_addr_sk"),
+                           col("ca_address_sk"))])
+                .filter((col("d_year") == lit(2000))
+                        & (col("d_moy") == lit(2))
+                        & (col("ca_gmt_offset") == lit(-5.0))
+                        & (col("i_category") == lit("Music")))
+                .group_by("i_item_id")
+                .agg(F.sum(col(price_col)).alias("total_sales")))
+    u = (chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+              "ss_customer_sk", "ss_ext_sales_price")
+         .union(chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                     "cs_customer_sk", "cs_ext_sales_price"))
+         .union(chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                     "ws_customer_sk", "ws_ext_sales_price")))
+    return (u.group_by("i_item_id")
+            .agg(F.sum(col("total_sales")).alias("total_sales"))
+            .order_by(col("total_sales").asc(), col("i_item_id").asc())
+            .limit(100))
+
+
+def q58(s, d):
+    """items whose revenue is within 10% across all three channels."""
+    def chan(sales, date_col, item_col, price_col, out):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .filter(col("d_year") == lit(2000))
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .group_by("i_item_id")
+                .agg(F.sum(col(price_col)).alias(out)))
+    ss = chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+              "ss_ext_sales_price", "ss_item_rev")
+    cs = (chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+               "cs_ext_sales_price", "cs_item_rev")
+          .with_column_renamed("i_item_id", "c_item_id"))
+    ws = (chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+               "ws_ext_sales_price", "ws_item_rev")
+          .with_column_renamed("i_item_id", "w_item_id"))
+    j = (ss.join(cs, on=[(col("i_item_id"), col("c_item_id"))])
+         .join(ws, on=[(col("i_item_id"), col("w_item_id"))]))
+    avg3 = ((col("ss_item_rev") + col("cs_item_rev") + col("ws_item_rev"))
+            / lit(3.0))
+    band = lambda c: (c >= avg3 * lit(0.7)) & (c <= avg3 * lit(1.3))  # noqa: E731
+    return (j.filter(band(col("ss_item_rev")) & band(col("cs_item_rev"))
+                     & band(col("ws_item_rev")))
+            .select(col("i_item_id"), col("ss_item_rev"),
+                    col("cs_item_rev"), col("ws_item_rev"),
+                    avg3.alias("average"))
+            .order_by(col("i_item_id").asc(), col("ss_item_rev").asc())
+            .limit(100))
+
+
+def q59(s, d):
+    """weekly store sales year-over-year by day of week."""
+    wk = (d["store_sales"]
+          .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .group_by("d_week_seq", "ss_store_sk")
+          .agg(*[F.sum(F.when(col("d_day_name") == lit(day),
+                              col("ss_sales_price"))
+                       .otherwise(lit(0.0))).alias(day.lower() + "_sales")
+                 for day in ["Sunday", "Monday", "Wednesday", "Friday"]]))
+    y1 = wk.filter((col("d_week_seq") >= lit(104))
+                   & (col("d_week_seq") < lit(156)))
+    y2 = (wk.filter((col("d_week_seq") >= lit(156))
+                    & (col("d_week_seq") < lit(208)))
+          .select(col("d_week_seq").alias("wk2"),
+                  col("ss_store_sk").alias("st2"),
+                  *[col(day + "_sales").alias(day + "2")
+                    for day in ["sunday", "monday", "wednesday",
+                                "friday"]]))
+    j = y1.join(y2, on=[(col("d_week_seq") + lit(52), col("wk2")),
+                        (col("ss_store_sk"), col("st2"))])
+    return (j.select(
+        col("ss_store_sk"), col("d_week_seq"),
+        *[(col(day + "_sales") / col(day + "2")).alias(day + "_ratio")
+          for day in ["sunday", "monday", "wednesday", "friday"]])
+        .order_by(col("ss_store_sk").asc(), col("d_week_seq").asc())
+        .limit(100))
+
+
+def q63(s, d):
+    """q53 by manager."""
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .filter(col("d_year") == lit(2001))
+            .group_by("i_manager_id", "d_moy")
+            .agg(F.sum(col("ss_sales_price")).alias("sum_sales")))
+    w = Window.partition_by(col("i_manager_id"))
+    out = base.select(col("i_manager_id"), col("d_moy"), col("sum_sales"),
+                      F.avg(col("sum_sales")).over(w)
+                      .alias("avg_monthly_sales"))
+    return (out.filter((col("avg_monthly_sales") > lit(0.0))
+                       & ((col("sum_sales") - col("avg_monthly_sales"))
+                          / col("avg_monthly_sales") > lit(0.1)))
+            .order_by(col("i_manager_id").asc(),
+                      col("avg_monthly_sales").asc(),
+                      col("sum_sales").asc())
+            .limit(100))
+
+
+def q66(s, d):
+    """warehouse shipping by month, web + catalog united, with
+    time-of-day gates."""
+    def chan(sales, date_col, time_col, wh_col, price_col, qty_col):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .join(d["time_dim"], on=[(col(time_col),
+                                          col("t_time_sk"))])
+                .filter((col("d_year") == lit(2000))
+                        & (col("t_hour") >= lit(8))
+                        & (col("t_hour") <= lit(16)))
+                .join(d["warehouse"], on=[(col(wh_col),
+                                           col("w_warehouse_sk"))])
+                .group_by("w_warehouse_name", "w_state", "d_moy")
+                .agg(F.sum(col(price_col)).alias("sales"),
+                     F.sum(col(qty_col)).alias("qty")))
+    u = (chan("web_sales", "ws_sold_date_sk", "ws_sold_time_sk",
+              "ws_warehouse_sk", "ws_ext_sales_price", "ws_quantity")
+         .union(chan("catalog_sales", "cs_sold_date_sk",
+                     "cs_sold_time_sk", "cs_warehouse_sk",
+                     "cs_ext_sales_price", "cs_quantity")))
+    return (u.group_by("w_warehouse_name", "w_state", "d_moy")
+            .agg(F.sum(col("sales")).alias("sales"),
+                 F.sum(col("qty")).alias("qty"))
+            .order_by(col("w_warehouse_name").asc(), col("d_moy").asc())
+            .limit(100))
+
+
+def q69(s, d):
+    """demographics of store buyers NOT active on web or catalog (the
+    NOT EXISTS pair as anti joins)."""
+    c = d["customer"]
+    ss = (d["store_sales"]
+          .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter((col("d_year") == lit(2001))
+                  & (col("d_qoy") <= lit(2))))
+    c = c.join(ss, on=[(col("c_customer_sk"), col("ss_customer_sk"))],
+               how="left_semi")
+    ws = (d["web_sales"]
+          .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter((col("d_year") == lit(2001))
+                  & (col("d_qoy") <= lit(2)))
+          .select(col("ws_customer_sk").alias("k")))
+    cs = (d["catalog_sales"]
+          .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter((col("d_year") == lit(2001))
+                  & (col("d_qoy") <= lit(2)))
+          .select(col("cs_customer_sk").alias("k")))
+    c = (c.join(ws, on=[(col("c_customer_sk"), col("k"))],
+                how="left_anti")
+         .join(cs, on=[(col("c_customer_sk"), col("k"))],
+               how="left_anti"))
+    return (c.join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                              col("ca_address_sk"))])
+            .filter(col("ca_state").isin("CA", "TX", "NY"))
+            .join(d["customer_demographics"],
+                  on=[(col("c_current_cdemo_sk"), col("cd_demo_sk"))])
+            .group_by("cd_gender", "cd_marital_status",
+                      "cd_education_status")
+            .agg(F.count("*").alias("cnt"))
+            .order_by(col("cd_gender").asc(),
+                      col("cd_marital_status").asc(),
+                      col("cd_education_status").asc())
+            .limit(100))
+
+
+def q2(s, d):
+    """web+catalog weekly sales ratios year over year by day name."""
+    u = (d["web_sales"].select(col("ws_sold_date_sk").alias("sold"),
+                               col("ws_ext_sales_price").alias("price"))
+         .union(d["catalog_sales"]
+                .select(col("cs_sold_date_sk").alias("sold"),
+                        col("cs_ext_sales_price").alias("price"))))
+    wk = (u.join(d["date_dim"], on=[(col("sold"), col("d_date_sk"))])
+          .group_by("d_week_seq")
+          .agg(*[F.sum(F.when(col("d_day_name") == lit(day), col("price"))
+                       .otherwise(lit(0.0))).alias(day.lower())
+                 for day in ["Sunday", "Monday", "Tuesday", "Wednesday",
+                             "Thursday", "Friday", "Saturday"]]))
+    y1 = wk.filter((col("d_week_seq") >= lit(104))
+                   & (col("d_week_seq") < lit(156)))
+    y2 = wk.select(col("d_week_seq").alias("wk2"),
+                   *[col(day).alias(day + "2")
+                     for day in ["sunday", "monday", "tuesday",
+                                 "wednesday", "thursday", "friday",
+                                 "saturday"]])
+    j = y1.join(y2, on=[(col("d_week_seq") + lit(52), col("wk2"))])
+    return (j.select(col("d_week_seq"),
+                     *[(col(day) / col(day + "2")).alias("r_" + day)
+                       for day in ["sunday", "monday", "tuesday",
+                                   "wednesday", "thursday", "friday",
+                                   "saturday"]])
+            .order_by(col("d_week_seq").asc()).limit(100))
+
+
+def q23(s, d):
+    """best customers buying frequent items: two IN-subquery semi
+    joins feeding a global sum."""
+    freq_items = (d["store_sales"]
+                  .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                            col("d_date_sk"))])
+                  .filter(col("d_year").isin(2000, 2001))
+                  .group_by("ss_item_sk")
+                  .agg(F.count("*").alias("cnt"))
+                  .filter(col("cnt") > lit(4))
+                  .select(col("ss_item_sk").alias("fi")))
+    spend = (d["store_sales"]
+             .group_by("ss_customer_sk")
+             .agg(F.sum(col("ss_sales_price") * col("ss_quantity"))
+                  .alias("spend")))
+    thresh = float(spend.agg(F.max(col("spend")).alias("m"))
+                   .collect().to_pylist()[0]["m"]) * 0.5
+    best = (spend.filter(col("spend") > lit(thresh))
+            .select(col("ss_customer_sk").alias("bc")))
+    return (d["catalog_sales"]
+            .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter((col("d_year") == lit(2000)) & (col("d_moy") == lit(2)))
+            .join(freq_items, on=[(col("cs_item_sk"), col("fi"))],
+                  how="left_semi")
+            .join(best, on=[(col("cs_customer_sk"), col("bc"))],
+                  how="left_semi")
+            .agg(F.sum(col("cs_quantity") * col("cs_sales_price"))
+                 .alias("total")))
+
+
+def q31(s, d):
+    """store vs web quarterly sales growth by city."""
+    def chan(sales, date_col, cust_col, price_col, name):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .filter((col("d_year") == lit(2000))
+                        & col("d_qoy").isin(1, 2))
+                .join(d["customer"], on=[(col(cust_col),
+                                          col("c_customer_sk"))])
+                .join(d["customer_address"],
+                      on=[(col("c_current_addr_sk"),
+                           col("ca_address_sk"))])
+                .group_by("ca_city")
+                .agg(F.sum(F.when(col("d_qoy") == lit(1), col(price_col))
+                           .otherwise(lit(0.0))).alias(name + "1"),
+                     F.sum(F.when(col("d_qoy") == lit(2), col(price_col))
+                           .otherwise(lit(0.0))).alias(name + "2")))
+    ss = chan("store_sales", "ss_sold_date_sk", "ss_customer_sk",
+              "ss_ext_sales_price", "ss")
+    ws = (chan("web_sales", "ws_sold_date_sk", "ws_customer_sk",
+               "ws_ext_sales_price", "ws")
+          .with_column_renamed("ca_city", "w_city"))
+    j = ss.join(ws, on=[(col("ca_city"), col("w_city"))])
+    return (j.filter((col("ss1") > lit(0.0)) & (col("ws1") > lit(0.0)))
+            .select(col("ca_city"),
+                    (col("ws2") / col("ws1")).alias("web_growth"),
+                    (col("ss2") / col("ss1")).alias("store_growth"))
+            .filter(col("web_growth") > col("store_growth"))
+            .order_by(col("ca_city").asc()).limit(100))
+
+
+def q41(s, d):
+    """distinct items from manufacturers with several distinct classes
+    (grouped IN-subquery shape)."""
+    manuf = (d["item"]
+             .group_by("i_category_id")
+             .agg(F.count(col("i_class")).alias("item_cnt"))
+             .filter(col("item_cnt") > lit(2))
+             .select(col("i_category_id").alias("m")))
+    return (d["item"]
+            .filter((col("i_current_price") >= lit(50.0))
+                    & (col("i_current_price") <= lit(100.0)))
+            .join(manuf, on=[(col("i_category_id"), col("m"))],
+                  how="left_semi")
+            .select(col("i_item_id")).distinct()
+            .order_by(col("i_item_id").asc()).limit(100))
+
+
+def q49(s, d):
+    """worst return ratios per channel, rank-windowed."""
+    from spark_rapids_tpu.expr.window import Window
+
+    def chan(name, sales, ret, s_item, s_ord, s_qty, r_item, r_ord,
+             r_qty):
+        r = d[ret].select(col(r_item).alias("ri"), col(r_ord).alias("ro"),
+                          col(r_qty).alias("rq"))
+        j = (d[sales]
+             .join(r, on=[(col(s_item), col("ri")),
+                          (col(s_ord), col("ro"))], how="left")
+             .group_by(s_item)
+             .agg(F.sum(F.coalesce(col("rq"), lit(0))).alias("ret_q"),
+                  F.sum(col(s_qty)).alias("sold_q"))
+             .filter(col("sold_q") > lit(0)))
+        ratio = (col("ret_q") * lit(1.0)) / col("sold_q")
+        w = Window.partition_by(lit(1)).order_by(col("ratio").desc())
+        return (j.select(lit(name).alias("channel"),
+                         col(s_item).alias("item"),
+                         ratio.alias("ratio"))
+                .select(col("channel"), col("item"), col("ratio"),
+                        F.rank().over(w).alias("rnk"))
+                .filter(col("rnk") <= lit(10)))
+    u = (chan("web", "web_sales", "web_returns", "ws_item_sk",
+              "ws_order_number", "ws_quantity", "wr_item_sk",
+              "wr_order_number", "wr_return_quantity")
+         .union(chan("catalog", "catalog_sales", "catalog_returns",
+                     "cs_item_sk", "cs_order_number", "cs_quantity",
+                     "cr_item_sk", "cr_order_number",
+                     "cr_return_quantity"))
+         .union(chan("store", "store_sales", "store_returns",
+                     "ss_item_sk", "ss_ticket_number", "ss_quantity",
+                     "sr_item_sk", "sr_ticket_number",
+                     "sr_return_quantity")))
+    return u.order_by(col("channel").asc(), col("rnk").asc(),
+                      col("item").asc()).limit(100)
+
+
+def q57(s, d):
+    """q47 for the catalog channel by warehouse."""
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["catalog_sales"]
+            .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .join(d["item"], on=[(col("cs_item_sk"), col("i_item_sk"))])
+            .join(d["warehouse"], on=[(col("cs_warehouse_sk"),
+                                       col("w_warehouse_sk"))])
+            .filter(col("d_year") == lit(1999))
+            .group_by("i_category", "i_brand", "w_warehouse_name",
+                      "d_year", "d_moy")
+            .agg(F.sum(col("cs_sales_price")).alias("sum_sales")))
+    w_avg = Window.partition_by(col("i_category"), col("i_brand"),
+                                col("w_warehouse_name"), col("d_year"))
+    w_seq = Window.partition_by(col("i_category"), col("i_brand"),
+                                col("w_warehouse_name")) \
+        .order_by(col("d_year"), col("d_moy"))
+    out = base.select(
+        col("i_category"), col("i_brand"), col("w_warehouse_name"),
+        col("d_year"), col("d_moy"), col("sum_sales"),
+        F.avg(col("sum_sales")).over(w_avg).alias("avg_monthly_sales"),
+        F.lag(col("sum_sales")).over(w_seq).alias("psum"),
+        F.lead(col("sum_sales")).over(w_seq).alias("nsum"))
+    return (out.filter((col("avg_monthly_sales") > lit(0.0))
+                       & ((col("sum_sales") - col("avg_monthly_sales"))
+                          / col("avg_monthly_sales") > lit(0.1)))
+            .order_by(col("sum_sales").desc(),
+                      col("w_warehouse_name").asc(), col("d_moy").asc())
+            .limit(100))
+
+
+def q61(s, d):
+    """promotional vs total store sales ratio (two single-row aggs
+    cross-joined)."""
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter((col("d_year") == lit(1998))
+                    & (col("d_moy") == lit(11))))
+    promo = (base.join(d["promotion"], on=[(col("ss_promo_sk"),
+                                            col("p_promo_sk"))])
+             .filter((col("p_channel_email") == lit("Y"))
+                     | (col("p_channel_event") == lit("Y")))
+             .agg(F.sum(col("ss_ext_sales_price")).alias("promotions")))
+    total = base.agg(F.sum(col("ss_ext_sales_price")).alias("total"))
+    return (promo.join(total, on=None, how="cross")
+            .select(col("promotions"), col("total"),
+                    (col("promotions") / col("total") * lit(100.0))
+                    .alias("ratio")))
+
+
+def q67(s, d):
+    """store sales ROLLUP over the full item/time hierarchy, top-ranked
+    per category."""
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter(col("d_year") == lit(2000))
+            .join(d["item"], on=[(col("ss_item_sk"), col("i_item_sk"))])
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .rollup("i_category", "i_class", "i_brand", "d_qoy",
+                    "s_store_name")
+            .agg(F.sum(col("ss_sales_price") * col("ss_quantity"))
+                 .alias("sumsales")))
+    w = Window.partition_by(col("i_category")) \
+        .order_by(col("sumsales").desc())
+    return (base.select(col("i_category"), col("i_class"), col("i_brand"),
+                        col("d_qoy"), col("s_store_name"),
+                        col("sumsales"))
+            .select(col("i_category"), col("i_class"), col("i_brand"),
+                    col("d_qoy"), col("s_store_name"), col("sumsales"),
+                    F.rank().over(w).alias("rk"))
+            .filter(col("rk") <= lit(10))
+            .order_by(col("i_category").asc(), col("rk").asc(),
+                      col("sumsales").desc(), col("i_class").asc(),
+                      col("i_brand").asc(), col("d_qoy").asc(),
+                      col("s_store_name").asc())
+            .limit(100))
+
+
+def q70(s, d):
+    """store profit ROLLUP(s_city, s_store_name) ranked within each
+    grouping level (q36 shape for stores)."""
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["store_sales"]
+            .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter(col("d_year") == lit(1999))
+            .join(d["store"], on=[(col("ss_store_sk"), col("s_store_sk"))])
+            .rollup("s_city", "s_store_name")
+            .agg(F.sum(col("ss_net_profit")).alias("total_sum"),
+                 F.grouping(col("s_city")).alias("g_city"),
+                 F.grouping(col("s_store_name")).alias("g_store")))
+    w = Window.partition_by(col("lochierarchy")) \
+        .order_by(col("total_sum").desc())
+    return (base.select(col("s_city"), col("s_store_name"),
+                        col("total_sum"),
+                        (col("g_city") + col("g_store"))
+                        .alias("lochierarchy"))
+            .select(col("s_city"), col("s_store_name"), col("total_sum"),
+                    col("lochierarchy"),
+                    F.rank().over(w).alias("rank_within_parent"))
+            .order_by(col("lochierarchy").desc(),
+                      col("rank_within_parent").asc(),
+                      col("s_city").asc())
+            .limit(100))
+
+
+def q72(s, d):
+    """catalog orders where inventory on hand is short of the ordered
+    quantity, by item and week."""
+    j = (d["catalog_sales"]
+         .join(d["inventory"], on=[(col("cs_item_sk"),
+                                    col("inv_item_sk"))])
+         .filter(col("inv_quantity_on_hand") < col("cs_quantity"))
+         .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                   col("d_date_sk"))])
+         .filter(col("d_year") == lit(2000))
+         .join(d["item"], on=[(col("cs_item_sk"), col("i_item_sk"))]))
+    return (j.group_by("i_item_id", "d_week_seq")
+            .agg(F.count("*").alias("no_promo"))
+            .order_by(col("no_promo").desc(), col("i_item_id").asc(),
+                      col("d_week_seq").asc())
+            .limit(100))
+
+
+def q75(s, d):
+    """brand sales quantity/amount year-over-year decline across the
+    three channels."""
+    def chan(sales, date_col, item_col, qty, price):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .filter(col("d_year").isin(1999, 2000))
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .select(col("d_year"), col("i_brand_id"),
+                        col(qty).alias("qty"), col(price).alias("amt")))
+    u = (chan("store_sales", "ss_sold_date_sk", "ss_item_sk",
+              "ss_quantity", "ss_ext_sales_price")
+         .union(chan("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                     "cs_quantity", "cs_ext_sales_price"))
+         .union(chan("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                     "ws_quantity", "ws_ext_sales_price")))
+    g = (u.group_by("d_year", "i_brand_id")
+         .agg(F.sum(col("qty")).alias("qty"), F.sum(col("amt")).alias("amt")))
+    prev = g.filter(col("d_year") == lit(1999)).select(
+        col("i_brand_id").alias("pb"), col("qty").alias("pqty"),
+        col("amt").alias("pamt"))
+    curr = g.filter(col("d_year") == lit(2000))
+    j = curr.join(prev, on=[(col("i_brand_id"), col("pb"))])
+    return (j.filter(col("qty") < col("pqty"))
+            .select(col("i_brand_id"), col("pqty"), col("pamt"),
+                    col("qty"), col("amt"),
+                    (col("qty") - col("pqty")).alias("qty_diff"))
+            .order_by(col("qty_diff").asc(), col("i_brand_id").asc())
+            .limit(100))
+
+
+def q77(s, d):
+    """q5-shaped channel profit/returns ROLLUP(channel, id) over 30
+    days."""
+    def sales_leg(df, date_col, chan, id_col, price, profit):
+        return (df.join(d["date_dim"], on=[(col(date_col),
+                                            col("d_date_sk"))])
+                .filter((col("d_year") == lit(2000))
+                        & (col("d_moy") == lit(8)))
+                .group_by(id_col)
+                .agg(F.sum(col(price)).alias("sales"),
+                     F.sum(col(profit)).alias("profit"))
+                .select(lit(chan).alias("channel"),
+                        col(id_col).alias("id"), col("sales"),
+                        lit(0.0).alias("returns_amt"), col("profit")))
+
+    def ret_leg(df, date_col, chan, id_col, amt, loss):
+        g = (df.join(d["date_dim"], on=[(col(date_col),
+                                         col("d_date_sk"))])
+             .filter((col("d_year") == lit(2000))
+                     & (col("d_moy") == lit(8))))
+        return (g.group_by(id_col)
+                .agg(F.sum(col(amt)).alias("returns_amt"),
+                     F.sum(col(loss)).alias("loss"))
+                .select(lit(chan).alias("channel"),
+                        col(id_col).alias("id"), lit(0.0).alias("sales"),
+                        col("returns_amt"),
+                        (lit(0.0) - col("loss")).alias("profit")))
+    u = (sales_leg(d["store_sales"], "ss_sold_date_sk", "store",
+                   "ss_store_sk", "ss_ext_sales_price", "ss_net_profit")
+         .union(ret_leg(d["store_returns"], "sr_returned_date_sk",
+                        "store", "sr_store_sk", "sr_return_amt",
+                        "sr_net_loss"))
+         .union(sales_leg(d["catalog_sales"], "cs_sold_date_sk",
+                          "catalog", "cs_warehouse_sk",
+                          "cs_ext_sales_price", "cs_net_profit"))
+         .union(sales_leg(d["web_sales"], "ws_sold_date_sk", "web",
+                          "ws_warehouse_sk", "ws_ext_sales_price",
+                          "ws_net_profit")))
+    return (u.rollup("channel", "id")
+            .agg(F.sum(col("sales")).alias("sales"),
+                 F.sum(col("returns_amt")).alias("returns_amt"),
+                 F.sum(col("profit")).alias("profit"))
+            .order_by(col("channel").asc(), col("id").asc())
+            .limit(100))
+
+
+def q78(s, d):
+    """store vs web yearly item/customer sales EXCLUDING returned
+    tickets (anti joins on the returns tables)."""
+    sr = d["store_returns"].select(col("sr_ticket_number").alias("rt"),
+                                   col("sr_item_sk").alias("ri"))
+    ss = (d["store_sales"]
+          .join(sr, on=[(col("ss_ticket_number"), col("rt")),
+                        (col("ss_item_sk"), col("ri"))], how="left_anti")
+          .join(d["date_dim"], on=[(col("ss_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter(col("d_year") == lit(2000))
+          .group_by("ss_item_sk", "ss_customer_sk")
+          .agg(F.sum(col("ss_quantity")).alias("ss_qty"),
+               F.sum(col("ss_sales_price")).alias("ss_amt")))
+    wr = d["web_returns"].select(col("wr_order_number").alias("rt"),
+                                 col("wr_item_sk").alias("ri"))
+    ws = (d["web_sales"]
+          .join(wr, on=[(col("ws_order_number"), col("rt")),
+                        (col("ws_item_sk"), col("ri"))], how="left_anti")
+          .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter(col("d_year") == lit(2000))
+          .group_by("ws_item_sk", "ws_customer_sk")
+          .agg(F.sum(col("ws_quantity")).alias("ws_qty"),
+               F.sum(col("ws_sales_price")).alias("ws_amt")))
+    j = ss.join(ws, on=[(col("ss_item_sk"), col("ws_item_sk")),
+                        (col("ss_customer_sk"), col("ws_customer_sk"))])
+    return (j.filter(col("ws_qty") > lit(0))
+            .select(col("ss_item_sk"), col("ss_customer_sk"),
+                    col("ss_qty"), col("ss_amt"), col("ws_qty"),
+                    (col("ss_qty") * lit(1.0)
+                     / col("ws_qty")).alias("ratio"))
+            .order_by(col("ratio").desc(), col("ss_item_sk").asc(),
+                      col("ss_customer_sk").asc())
+            .limit(100))
+
+
+def q81(s, d):
+    """q30 for catalog returns."""
+    ctr = (d["catalog_returns"]
+           .join(d["date_dim"], on=[(col("cr_returned_date_sk"),
+                                     col("d_date_sk"))])
+           .filter(col("d_year") == lit(2000))
+           .join(d["customer"], on=[(col("cr_customer_sk"),
+                                     col("c_customer_sk"))])
+           .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                            col("ca_address_sk"))])
+           .group_by("cr_customer_sk", "ca_state")
+           .agg(F.sum(col("cr_return_amt")).alias("ctr_total_return")))
+    avg = (ctr.group_by("ca_state")
+           .agg(F.avg(col("ctr_total_return")).alias("avg_ret")))
+    return (ctr.join(avg, on="ca_state")
+            .filter(col("ctr_total_return") > col("avg_ret") * lit(1.2))
+            .join(d["customer"], on=[(col("cr_customer_sk"),
+                                      col("c_customer_sk"))])
+            .select(col("c_first_name"), col("c_last_name"),
+                    col("ca_state"), col("ctr_total_return"))
+            .order_by(col("c_last_name").asc(), col("c_first_name").asc(),
+                      col("ctr_total_return").asc())
+            .limit(100))
+
+
+def q83(s, d):
+    """returned quantity per item across the three return channels."""
+    def chan(ret, item_col, qty_col, out):
+        return (d[ret]
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .group_by("i_item_id")
+                .agg(F.sum(col(qty_col)).alias(out)))
+    sr = chan("store_returns", "sr_item_sk", "sr_return_quantity",
+              "sr_qty")
+    cr = (chan("catalog_returns", "cr_item_sk", "cr_return_quantity",
+               "cr_qty").with_column_renamed("i_item_id", "c_id"))
+    wr = (chan("web_returns", "wr_item_sk", "wr_return_quantity",
+               "wr_qty").with_column_renamed("i_item_id", "w_id"))
+    j = (sr.join(cr, on=[(col("i_item_id"), col("c_id"))])
+         .join(wr, on=[(col("i_item_id"), col("w_id"))]))
+    total = (col("sr_qty") + col("cr_qty") + col("wr_qty"))
+    return (j.select(col("i_item_id"), col("sr_qty"), col("cr_qty"),
+                     col("wr_qty"), (total / lit(3.0)).alias("average"))
+            .order_by(col("i_item_id").asc(), col("sr_qty").asc())
+            .limit(100))
+
+
+def q84(s, d):
+    """customers in a city with low-income-ish households, via
+    store_returns activity."""
+    c = (d["customer"]
+         .join(d["customer_address"], on=[(col("c_current_addr_sk"),
+                                          col("ca_address_sk"))])
+         .filter(col("ca_city") == lit("Midway"))
+         .join(d["household_demographics"],
+               on=[(col("c_current_hdemo_sk"), col("hd_demo_sk"))])
+         .filter(col("hd_buy_potential").isin("0-500", "501-1000")))
+    return (c.join(d["store_returns"],
+                   on=[(col("c_customer_sk"), col("sr_customer_sk"))],
+                  how="left_semi")
+            .select(col("c_customer_sk"), col("c_first_name"),
+                    col("c_last_name"))
+            .order_by(col("c_customer_sk").asc())
+            .limit(100))
+
+
+def q86(s, d):
+    """web sales ROLLUP(i_category, i_class) ranked within grouping
+    level."""
+    from spark_rapids_tpu.expr.window import Window
+    base = (d["web_sales"]
+            .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                      col("d_date_sk"))])
+            .filter(col("d_year") == lit(2000))
+            .join(d["item"], on=[(col("ws_item_sk"), col("i_item_sk"))])
+            .rollup("i_category", "i_class")
+            .agg(F.sum(col("ws_net_profit")).alias("total_sum"),
+                 F.grouping(col("i_category")).alias("g_cat"),
+                 F.grouping(col("i_class")).alias("g_cls")))
+    w = Window.partition_by(col("lochierarchy")) \
+        .order_by(col("total_sum").desc())
+    return (base.select(col("i_category"), col("i_class"),
+                        col("total_sum"),
+                        (col("g_cat") + col("g_cls"))
+                        .alias("lochierarchy"))
+            .select(col("i_category"), col("i_class"), col("total_sum"),
+                    col("lochierarchy"),
+                    F.rank().over(w).alias("rank_within_parent"))
+            .order_by(col("lochierarchy").desc(),
+                      col("rank_within_parent").asc(),
+                      col("i_category").asc())
+            .limit(100))
+
+
+def q87(s, d):
+    """store customers NOT in catalog and NOT in web (EXCEPT chain),
+    counted."""
+    def chan(sales, date_col, cust_col):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .filter(col("d_year") == lit(2000))
+                .join(d["customer"], on=[(col(cust_col),
+                                          col("c_customer_sk"))])
+                .select(col("c_first_name"), col("c_last_name")))
+    u = (chan("store_sales", "ss_sold_date_sk", "ss_customer_sk")
+         .subtract(chan("catalog_sales", "cs_sold_date_sk",
+                        "cs_customer_sk"))
+         .subtract(chan("web_sales", "ws_sold_date_sk",
+                        "ws_customer_sk")))
+    return u.agg(F.count("*").alias("cnt"))
+
+
+def q88(s, d):
+    """store-hour traffic counts for eight half-hour windows in one
+    conditional-agg pass."""
+    j = (d["store_sales"]
+         .join(d["time_dim"], on=[(col("ss_sold_time_sk"),
+                                   col("t_time_sk"))])
+         .join(d["household_demographics"],
+               on=[(col("ss_hdemo_sk"), col("hd_demo_sk"))])
+         .filter(col("hd_dep_count") >= lit(3)))
+    aggs = []
+    for i, hr in enumerate([8, 9, 10, 11, 12, 13, 14, 15]):
+        cond = (col("t_hour") == lit(hr))
+        aggs.append(F.count(F.when(cond, lit(1))).alias(f"h{hr}"))
+    return j.agg(*aggs)
+
+
+def q90(s, d):
+    """web sales AM/PM ratio (two single-row conditional counts)."""
+    j = (d["web_sales"]
+         .join(d["time_dim"], on=[(col("ws_sold_time_sk"),
+                                   col("t_time_sk"))])
+         .join(d["household_demographics"],
+               on=[(col("ws_hdemo_sk"), col("hd_demo_sk"))])
+         .filter(col("hd_dep_count") >= lit(2)))
+    out = j.agg(
+        F.count(F.when((col("t_hour") >= lit(8))
+                       & (col("t_hour") < lit(12)), lit(1)))
+        .alias("amc"),
+        F.count(F.when((col("t_hour") >= lit(14))
+                       & (col("t_hour") < lit(18)), lit(1)))
+        .alias("pmc"))
+    return out.select(col("amc"), col("pmc"),
+                      (col("amc") * lit(1.0) / col("pmc"))
+                      .alias("am_pm_ratio"))
+
+
+def q91(s, d):
+    """catalog returns by demographic segment for one month."""
+    return (d["catalog_returns"]
+            .join(d["date_dim"], on=[(col("cr_returned_date_sk"),
+                                      col("d_date_sk"))])
+            .filter((col("d_year") == lit(1998))
+                    & (col("d_moy") == lit(11)))
+            .join(d["customer"], on=[(col("cr_customer_sk"),
+                                      col("c_customer_sk"))])
+            .join(d["customer_demographics"],
+                  on=[(col("c_current_cdemo_sk"), col("cd_demo_sk"))])
+            .join(d["household_demographics"],
+                  on=[(col("c_current_hdemo_sk"), col("hd_demo_sk"))])
+            .filter(col("hd_buy_potential").isin(">10000", "Unknown"))
+            .group_by("cd_gender", "cd_marital_status",
+                      "cd_education_status")
+            .agg(F.sum(col("cr_net_loss")).alias("returns_loss"))
+            .order_by(col("returns_loss").desc()).limit(100))
+
+
+def q92(s, d):
+    """q32 for web sales."""
+    window = (d["web_sales"]
+              .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                        col("d_date_sk"))])
+              .filter(col("d_year") == lit(2000)))
+    item_avg = (window.group_by("ws_item_sk")
+                .agg(F.avg(col("ws_ext_discount_amt")).alias("avg_disc")))
+    return (window
+            .join(item_avg.select(col("ws_item_sk").alias("k"),
+                                  col("avg_disc")),
+                  on=[(col("ws_item_sk"), col("k"))])
+            .filter(col("ws_ext_discount_amt")
+                    > col("avg_disc") * lit(1.3))
+            .agg(F.sum(col("ws_ext_discount_amt"))
+                 .alias("excess_discount_amount")))
+
+
+def q93(s, d):
+    """store net sales after subtracting returns for a given reason."""
+    r = (d["reason"].filter(col("r_reason_desc") == lit("reason 28"))
+         .select(col("r_reason_sk").alias("rs")))
+    sr = (d["store_returns"]
+          .join(r, on=[(col("sr_reason_sk"), col("rs"))], how="left_semi")
+          .select(col("sr_ticket_number").alias("rt"),
+                  col("sr_item_sk").alias("ri"),
+                  col("sr_return_quantity")))
+    j = (d["store_sales"]
+         .join(sr, on=[(col("ss_ticket_number"), col("rt")),
+                       (col("ss_item_sk"), col("ri"))], how="left"))
+    act = F.when(
+        col("sr_return_quantity").is_not_null(),
+        (col("ss_quantity") - col("sr_return_quantity"))
+        * col("ss_sales_price")).otherwise(
+        col("ss_quantity") * col("ss_sales_price"))
+    return (j.group_by("ss_customer_sk")
+            .agg(F.sum(act).alias("sumsales"))
+            .order_by(col("sumsales").desc(),
+                      col("ss_customer_sk").asc())
+            .limit(100))
+
+
+def q94(s, d):
+    """q16 for web sales."""
+    ws = (d["web_sales"]
+          .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter((col("d_year") == lit(2000))
+                  & col("d_moy").isin(1, 2)))
+    multi_wh = (ws.group_by("ws_order_number")
+                .agg(F.min(col("ws_warehouse_sk")).alias("wmin"),
+                     F.max(col("ws_warehouse_sk")).alias("wmax"))
+                .filter(col("wmin") < col("wmax"))
+                .select(col("ws_order_number").alias("o")))
+    kept = (ws.join(multi_wh, on=[(col("ws_order_number"), col("o"))],
+                    how="left_semi")
+            .join(d["web_returns"]
+                  .select(col("wr_order_number").alias("r")),
+                  on=[(col("ws_order_number"), col("r"))],
+                  how="left_anti"))
+    orders = kept.select(col("ws_order_number")).distinct() \
+        .agg(F.count(col("ws_order_number")).alias("order_count"))
+    totals = kept.agg(
+        F.sum(col("ws_ext_sales_price")).alias("total_shipping_cost"),
+        F.sum(col("ws_net_profit")).alias("total_net_profit"))
+    return orders.join(totals, on=None, how="cross")
+
+
+def q95(s, d):
+    """web orders in the multi-warehouse set WITH a return (semi joins
+    both ways)."""
+    ws = (d["web_sales"]
+          .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                    col("d_date_sk"))])
+          .filter(col("d_year") == lit(2000)))
+    multi_wh = (ws.group_by("ws_order_number")
+                .agg(F.min(col("ws_warehouse_sk")).alias("wmin"),
+                     F.max(col("ws_warehouse_sk")).alias("wmax"))
+                .filter(col("wmin") < col("wmax"))
+                .select(col("ws_order_number").alias("o")))
+    kept = (ws.join(multi_wh, on=[(col("ws_order_number"), col("o"))],
+                    how="left_semi")
+            .join(d["web_returns"]
+                  .select(col("wr_order_number").alias("r")),
+                  on=[(col("ws_order_number"), col("r"))],
+                  how="left_semi"))
+    orders = kept.select(col("ws_order_number")).distinct() \
+        .agg(F.count(col("ws_order_number")).alias("order_count"))
+    totals = kept.agg(
+        F.sum(col("ws_ext_sales_price")).alias("total_shipping_cost"),
+        F.sum(col("ws_net_profit")).alias("total_net_profit"))
+    return orders.join(totals, on=None, how="cross")
+
+
+def q99(s, d):
+    """catalog days-to-ship buckets by warehouse."""
+    lag_days = col("cs_ship_date_sk") - col("cs_sold_date_sk")
+    return (d["catalog_sales"]
+            .join(d["warehouse"], on=[(col("cs_warehouse_sk"),
+                                       col("w_warehouse_sk"))])
+            .group_by("w_warehouse_name")
+            .agg(F.sum(F.when(lag_days <= lit(30), lit(1))
+                       .otherwise(lit(0))).alias("d30"),
+                 F.sum(F.when((lag_days > lit(30))
+                              & (lag_days <= lit(60)), lit(1))
+                       .otherwise(lit(0))).alias("d31_60"),
+                 F.sum(F.when((lag_days > lit(60))
+                              & (lag_days <= lit(90)), lit(1))
+                       .otherwise(lit(0))).alias("d61_90"),
+                 F.sum(F.when(lag_days > lit(90), lit(1))
+                       .otherwise(lit(0))).alias("d90plus"))
+            .order_by(col("w_warehouse_name").asc()).limit(100))
+
+
+def _year_totals(d, sales, date_col, cust_col, price_col):
+    return (d[sales]
+            .join(d["date_dim"], on=[(col(date_col), col("d_date_sk"))])
+            .filter(col("d_year").isin(1999, 2000))
+            .group_by(cust_col, "d_year")
+            .agg(F.sum(col(price_col)).alias("tot")))
+
+
+def _growth_join(d, first, second, f_cust, s_cust, f_name, s_name):
+    """(customer, first-channel growth, second-channel growth) for
+    customers with positive base-year totals in both channels."""
+    def split(g, cust, name):
+        y1 = g.filter(col("d_year") == lit(1999)).select(
+            col(cust).alias(name + "_c1"), col("tot").alias(name + "1"))
+        y2 = g.filter(col("d_year") == lit(2000)).select(
+            col(cust).alias(name + "_c2"), col("tot").alias(name + "2"))
+        return (y1.join(y2, on=[(col(name + "_c1"), col(name + "_c2"))])
+                .filter(col(name + "1") > lit(0.0)))
+    a = split(first, f_cust, f_name)
+    b = split(second, s_cust, s_name)
+    return a.join(b, on=[(col(f_name + "_c1"), col(s_name + "_c1"))])
+
+
+def q4(s, d):
+    """customers whose catalog spend grows faster than store spend
+    (the 3-self-join year-over-year shape, catalog vs store)."""
+    ss = _year_totals(d, "store_sales", "ss_sold_date_sk",
+                      "ss_customer_sk", "ss_ext_sales_price")
+    cs = _year_totals(d, "catalog_sales", "cs_sold_date_sk",
+                      "cs_customer_sk", "cs_ext_sales_price")
+    j = _growth_join(d, ss, cs, "ss_customer_sk", "cs_customer_sk",
+                     "s", "c")
+    j = j.filter(col("c2") / col("c1") > col("s2") / col("s1"))
+    return (j.join(d["customer"], on=[(col("s_c1"),
+                                       col("c_customer_sk"))])
+            .select(col("c_customer_sk"), col("c_first_name"),
+                    col("c_last_name"))
+            .order_by(col("c_customer_sk").asc()).limit(100))
+
+
+def q11(s, d):
+    """q4 for web vs store."""
+    ss = _year_totals(d, "store_sales", "ss_sold_date_sk",
+                      "ss_customer_sk", "ss_ext_sales_price")
+    ws = _year_totals(d, "web_sales", "ws_sold_date_sk",
+                      "ws_customer_sk", "ws_ext_sales_price")
+    j = _growth_join(d, ss, ws, "ss_customer_sk", "ws_customer_sk",
+                     "s", "w")
+    j = j.filter(col("w2") / col("w1") > col("s2") / col("s1"))
+    return (j.join(d["customer"], on=[(col("s_c1"),
+                                       col("c_customer_sk"))])
+            .select(col("c_customer_sk"), col("c_first_name"),
+                    col("c_last_name"))
+            .order_by(col("c_customer_sk").asc()).limit(100))
+
+
+def q74(s, d):
+    """q11 with quantity-based totals."""
+    ss = _year_totals(d, "store_sales", "ss_sold_date_sk",
+                      "ss_customer_sk", "ss_quantity")
+    ws = _year_totals(d, "web_sales", "ws_sold_date_sk",
+                      "ws_customer_sk", "ws_quantity")
+    j = _growth_join(d, ss, ws, "ss_customer_sk", "ws_customer_sk",
+                     "s", "w")
+    j = j.filter(col("w2") * col("s1") > col("s2") * col("w1"))
+    return (j.join(d["customer"], on=[(col("s_c1"),
+                                       col("c_customer_sk"))])
+            .select(col("c_customer_sk"), col("c_first_name"),
+                    col("c_last_name"))
+            .order_by(col("c_customer_sk").asc()).limit(100))
+
+
+def q14(s, d):
+    """cross-channel items (3-way INTERSECT) with per-channel ROLLUP
+    sales over an average-sales gate."""
+    def chan_items(sales, date_col, item_col):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .filter(col("d_year").isin(1999, 2000))
+                .select(col(item_col).alias("item_sk")))
+    cross = (chan_items("store_sales", "ss_sold_date_sk", "ss_item_sk")
+             .intersect(chan_items("catalog_sales", "cs_sold_date_sk",
+                                   "cs_item_sk"))
+             .intersect(chan_items("web_sales", "ws_sold_date_sk",
+                                   "ws_item_sk")))
+    avg_sales = float(
+        d["store_sales"].agg(F.avg(col("ss_ext_sales_price"))
+                             .alias("a")).collect().to_pylist()[0]["a"])
+
+    def leg(sales, date_col, item_col, price_col, qty_col, chan):
+        return (d[sales]
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .filter((col("d_year") == lit(2000))
+                        & (col("d_moy") == lit(11)))
+                .join(cross, on=[(col(item_col), col("item_sk"))],
+                      how="left_semi")
+                .join(d["item"], on=[(col(item_col), col("i_item_sk"))])
+                .select(lit(chan).alias("channel"), col("i_brand_id"),
+                        (col(price_col) * lit(1.0)).alias("sales"),
+                        col(qty_col).alias("number_sales")))
+    u = (leg("store_sales", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price", "ss_quantity", "store")
+         .union(leg("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                    "cs_ext_sales_price", "cs_quantity", "catalog"))
+         .union(leg("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                    "ws_ext_sales_price", "ws_quantity", "web")))
+    return (u.rollup("channel", "i_brand_id")
+            .agg(F.sum(col("sales")).alias("sum_sales"),
+                 F.sum(col("number_sales")).alias("number_sales"))
+            .filter(col("sum_sales") > lit(avg_sales))
+            .order_by(col("channel").asc(), col("i_brand_id").asc())
+            .limit(100))
+
+
+def q24(s, d):
+    """store-returned purchases by customer name/city over an
+    average-gate (decorrelated scalar subquery)."""
+    base = (d["store_sales"]
+            .join(d["store_returns"],
+                  on=[(col("ss_ticket_number"), col("sr_ticket_number")),
+                      (col("ss_item_sk"), col("sr_item_sk"))])
+            .join(d["store"], on=[(col("ss_store_sk"),
+                                   col("s_store_sk"))])
+            .join(d["customer"], on=[(col("ss_customer_sk"),
+                                      col("c_customer_sk"))])
+            .group_by("c_last_name", "c_first_name", "s_city")
+            .agg(F.sum(col("ss_net_profit")).alias("netpaid")))
+    thresh = float(base.agg(F.avg(col("netpaid")).alias("a"))
+                   .collect().to_pylist()[0]["a"]) * 1.05
+    return (base.filter(col("netpaid") > lit(thresh))
+            .order_by(col("c_last_name").asc(), col("c_first_name").asc(),
+                      col("s_city").asc())
+            .limit(100))
+
+
+def q54(s, d):
+    """customers buying target-category items on web/catalog in a
+    month, bucketed by their store revenue."""
+    buyers = (d["web_sales"]
+              .join(d["item"], on=[(col("ws_item_sk"),
+                                    col("i_item_sk"))])
+              .join(d["date_dim"], on=[(col("ws_sold_date_sk"),
+                                        col("d_date_sk"))])
+              .filter((col("i_category") == lit("Music"))
+                      & (col("d_year") == lit(2000)))
+              .select(col("ws_customer_sk").alias("k"))
+              .union(d["catalog_sales"]
+                     .join(d["item"], on=[(col("cs_item_sk"),
+                                           col("i_item_sk"))])
+                     .join(d["date_dim"], on=[(col("cs_sold_date_sk"),
+                                               col("d_date_sk"))])
+                     .filter((col("i_category") == lit("Music"))
+                             & (col("d_year") == lit(2000)))
+                     .select(col("cs_customer_sk").alias("k"))))
+    rev = (d["store_sales"]
+           .join(buyers.distinct(),
+                 on=[(col("ss_customer_sk"), col("k"))], how="left_semi")
+           .group_by("ss_customer_sk")
+           .agg(F.sum(col("ss_ext_sales_price")).alias("revenue")))
+    bucket = E.Cast(col("revenue") / lit(50.0), T.INT64)
+    return (rev.select(bucket.alias("segment"))
+            .group_by("segment")
+            .agg(F.count("*").alias("num_customers"))
+            .order_by(col("segment").asc()).limit(100))
+
+
+def q80(s, d):
+    """q77 with per-row return adjustment via order-number joins."""
+    def leg(sales, ret, date_col, id_col, item, price, profit, ordr,
+            r_item, r_ord, r_amt, r_loss, chan):
+        r = d[ret].select(col(r_item).alias("ri"), col(r_ord).alias("ro"),
+                          col(r_amt).alias("ramt"),
+                          col(r_loss).alias("rloss"))
+        return (d[sales]
+                .join(r, on=[(col(item), col("ri")),
+                             (col(ordr), col("ro"))], how="left")
+                .join(d["date_dim"], on=[(col(date_col),
+                                          col("d_date_sk"))])
+                .filter(col("d_year") == lit(2000))
+                .group_by(id_col)
+                .agg(F.sum(col(price)).alias("sales"),
+                     F.sum(F.coalesce(col("ramt"), lit(0.0)))
+                     .alias("returns_amt"),
+                     F.sum(col(profit)
+                           - F.coalesce(col("rloss"), lit(0.0)))
+                     .alias("profit"))
+                .select(lit(chan).alias("channel"),
+                        col(id_col).alias("id"), col("sales"),
+                        col("returns_amt"), col("profit")))
+    u = (leg("store_sales", "store_returns", "ss_sold_date_sk",
+             "ss_store_sk", "ss_item_sk", "ss_ext_sales_price",
+             "ss_net_profit", "ss_ticket_number", "sr_item_sk",
+             "sr_ticket_number", "sr_return_amt", "sr_net_loss",
+             "store")
+         .union(leg("catalog_sales", "catalog_returns",
+                    "cs_sold_date_sk", "cs_warehouse_sk", "cs_item_sk",
+                    "cs_ext_sales_price", "cs_net_profit",
+                    "cs_order_number", "cr_item_sk", "cr_order_number",
+                    "cr_return_amt", "cr_net_loss", "catalog"))
+         .union(leg("web_sales", "web_returns", "ws_sold_date_sk",
+                    "ws_warehouse_sk", "ws_item_sk",
+                    "ws_ext_sales_price", "ws_net_profit",
+                    "ws_order_number", "wr_item_sk", "wr_order_number",
+                    "wr_return_amt", "wr_net_loss", "web")))
+    return (u.rollup("channel", "id")
+            .agg(F.sum(col("sales")).alias("sales"),
+                 F.sum(col("returns_amt")).alias("returns_amt"),
+                 F.sum(col("profit")).alias("profit"))
+            .order_by(col("channel").asc(), col("id").asc())
+            .limit(100))
+
+
+def q85(s, d):
+    """web returns by reason with quantity-bucket gates and
+    demographics."""
+    j = (d["web_returns"]
+         .join(d["customer"], on=[(col("wr_customer_sk"),
+                                   col("c_customer_sk"))])
+         .join(d["customer_demographics"],
+               on=[(col("c_current_cdemo_sk"), col("cd_demo_sk"))])
+         .join(d["reason"], on=[(col("wr_reason_sk"),
+                                 col("r_reason_sk"))])
+         .filter(((col("cd_marital_status") == lit("M"))
+                  & (col("wr_return_quantity") >= lit(5)))
+                 | ((col("cd_marital_status") == lit("S"))
+                    & (col("wr_return_quantity") < lit(5)))))
+    return (j.group_by("r_reason_desc")
+            .agg(F.avg(col("wr_return_quantity")).alias("avg_qty"),
+                 F.avg(col("wr_return_amt")).alias("avg_amt"),
+                 F.count("*").alias("cnt"))
+            .order_by(col("r_reason_desc").asc()).limit(100))
+
+
+QUERIES = {1: q1, 3: q3, 5: q5, 6: q6, 7: q7, 8: q8, 9: q9, 10: q10,
+           12: q12, 13: q13, 15: q15, 16: q16, 17: q17, 18: q18,
+           19: q19, 20: q20, 21: q21, 22: q22, 25: q25, 26: q26,
+           27: q27, 28: q28, 29: q29, 30: q30, 32: q32, 33: q33,
+           35: q35, 36: q36, 37: q37, 38: q38, 39: q39, 40: q40,
+           41: q41, 44: q44, 47: q47, 49: q49, 50: q50, 51: q51,
+           53: q53, 56: q56, 57: q57, 58: q58, 59: q59, 61: q61,
+           63: q63, 66: q66, 67: q67, 69: q69, 70: q70, 72: q72,
+           75: q75, 77: q77, 78: q78, 81: q81, 83: q83, 84: q84,
+           86: q86, 87: q87, 88: q88, 90: q90, 91: q91, 92: q92,
+           93: q93, 94: q94, 95: q95, 99: q99,
+           2: q2, 23: q23, 31: q31, 4: q4, 11: q11, 14: q14,
+           24: q24, 54: q54, 74: q74, 80: q80, 85: q85,
            34: q34, 42: q42, 43: q43, 45: q45, 46: q46, 48: q48, 52: q52, 55: q55,
            60: q60, 62: q62, 65: q65, 68: q68, 71: q71, 73: q73, 76: q76, 79: q79, 82: q82,
            89: q89, 96: q96, 97: q97, 98: q98}
