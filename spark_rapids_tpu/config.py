@@ -176,6 +176,18 @@ SHUFFLE_MODE = conf_str(
     "disk overflow — the cross-host-capable path) "
     "(reference RapidsConf.scala:1767 UCX|CACHE_ONLY|MULTITHREADED).")
 
+SHUFFLE_PARTITIONING = conf_str(
+    "spark.rapids.shuffle.partitioning", "compact",
+    "Device repartition strategy for hash/round-robin/range exchanges. "
+    "'compact': ONE fused counting-sort kernel per input batch permutes "
+    "rows so each target partition is contiguous, a single host fetch of "
+    "the n_out+1 offsets vector sizes the outputs, and downstream "
+    "operators see right-sized sub-batches (the analog of cudf's "
+    "hash-partition kernel returning a table plus offsets). 'masked': "
+    "legacy zero-copy selection-mask slicing emitting n_out full-capacity "
+    "sub-batches per input batch (escape hatch; costs n_out deferred "
+    "count syncs and n_out*capacity downstream work per batch).")
+
 SHUFFLE_WRITER_THREADS = conf_int(
     "spark.rapids.shuffle.multiThreaded.writer.threads", 8,
     "Threads in the executor-wide shuffle writer pool "

@@ -41,6 +41,7 @@ from spark_rapids_tpu.ops import groupby as G
 from spark_rapids_tpu.ops import join as J
 from spark_rapids_tpu.ops import kernels as K
 from spark_rapids_tpu.ops import radix as R
+from spark_rapids_tpu.ops import repartition as RP
 from spark_rapids_tpu.plan import nodes as P
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime.semaphore import get_semaphore
@@ -2428,12 +2429,34 @@ def _resize_plane(vals, valid, dtype, cap: int) -> ColumnVector:
 # Exchanges (stage barriers)
 # ---------------------------------------------------------------------------
 
+def _partitioning_mode(conf) -> str:
+    """spark.rapids.shuffle.partitioning: 'compact' (counting-sort, the
+    default) or 'masked' (legacy mask-sliced sub-batches)."""
+    v = str(conf.get(C.SHUFFLE_PARTITIONING)).strip().lower()
+    if v not in ("compact", "masked"):
+        raise ValueError(
+            "spark.rapids.shuffle.partitioning must be 'compact' or "
+            f"'masked', got {v!r}")
+    return v
+
+
 class ExchangeExec(TpuExec):
     """Base: materialize child partitions as concurrent tasks, re-partition,
     serve. Plays the role of Spark shuffle for the reference
     (RapidsShuffleInternalManagerBase MULTITHREADED mode runs parallel
     serialization through thread pools; here batches stay on device --
-    the CACHE_ONLY/UCX 'stay on device' design, SURVEY §2.7)."""
+    the CACHE_ONLY/UCX 'stay on device' design, SURVEY §2.7).
+
+    Two device partitioning strategies share the emit helpers below
+    (spark.rapids.shuffle.partitioning): 'compact' counting-sorts each
+    input batch by target partition in ONE fused dispatch and fetches the
+    offsets vector ONCE, yielding contiguous right-sized sub-batches;
+    'masked' emits n_out full-capacity selection-mask slices whose row
+    counts each sync lazily. The partitionDispatches / partitionHostFetches
+    metrics record exactly that asymmetry — partitioning-KERNEL launches
+    and sizing round trips, not the compact path's per-slice assembly
+    gathers (those are O(output rows)) — so tests can assert the O(1)
+    contract instead of eyeballing profiles."""
 
     def __init__(self, plan, children, conf):
         super().__init__(plan, children, conf)
@@ -2467,6 +2490,58 @@ class ExchangeExec(TpuExec):
 
     def _repartition(self, child_results) -> List[List[ColumnarBatch]]:
         raise NotImplementedError
+
+    def _partition_metrics(self):
+        return (self.metrics.metric(M.PARTITION_DISPATCHES),
+                self.metrics.metric(M.PARTITION_HOST_FETCHES),
+                self.metrics.metric(M.NUM_OUTPUT_ROWS))
+
+    def _repartition_passthrough(self, child_results):
+        """n_out == 1: every row lands in the single output partition —
+        emit the batches unchanged. No partition kernel, no data
+        movement, no sizing fetch (either strategy would only have
+        reshuffled rows onto themselves)."""
+        rows_m = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        flat = []
+        for part in child_results:
+            for b in part:
+                rows_m.add(b.num_rows)
+                flat.append(b)
+        return [flat]
+
+    def _emit_compact(self, batch, fused_out, out) -> None:
+        """Compact-path emission: `fused_out` is (sorted_batch, offsets)
+        from ONE counting-sort dispatch; the single offsets fetch here is
+        the entire host synchronization for partitioning this batch.
+        Column bounds re-attach host-side (they are not pytree leaves and
+        stay valid under any row subset); empty partitions emit nothing."""
+        disp, fetch, rows_m = self._partition_metrics()
+        sorted_b, off_dev = fused_out
+        disp.add(1)
+        offsets = np.asarray(jax.device_get(off_dev))
+        fetch.add(1)
+        for p, sub in enumerate(
+                RP.compact_slices(sorted_b, offsets, self.n_out)):
+            if sub is None:
+                continue
+            for ic, oc in zip(batch.columns, sub.columns):
+                oc.bounds = ic.bounds
+            rows_m.add(int(sub.num_rows))
+            out[p].append(sub)
+
+    def _emit_masked(self, batch, subs, out) -> None:
+        """Masked-path emission with the bookkeeping the compact path gets
+        for free: each input batch costs n_out full-capacity sub-batch
+        computations and n_out deferred count syncs (the LazyRowCounts
+        materialize one by one downstream)."""
+        disp, fetch, rows_m = self._partition_metrics()
+        disp.add(self.n_out)
+        fetch.add(self.n_out)
+        for p, sub in enumerate(subs):
+            for ic, oc in zip(batch.columns, sub.columns):
+                oc.bounds = ic.bounds
+            rows_m.add(sub.num_rows)
+            out[p].append(sub)
 
     def execute_partition(self, ctx, pidx):
         out = self._materialize()
@@ -2518,7 +2593,47 @@ class ShuffleExchangeExec(ExchangeExec):
                 return out
         if mode == "SERIALIZED":
             return self._repartition_serialized(child_results)
-        return self._repartition_masked(child_results)
+        return self._repartition_device(child_results)
+
+    def _repartition_device(self, child_results):
+        """In-memory device partitioning (the MULTITHREADED mode body and
+        the SERIALIZED mode's device half)."""
+        if self.n_out == 1:
+            return self._repartition_passthrough(child_results)
+        if _partitioning_mode(self.conf) == "masked":
+            return self._repartition_masked(child_results)
+        return self._repartition_compact(child_results)
+
+    def _repartition_compact(self, child_results):
+        """Counting-sort exchange: one fused XLA computation per input
+        batch hashes the keys, pmods to partition ids, stable-sorts rows
+        by pid and emits the permuted planes plus the n_out+1 offsets
+        vector (ops/repartition.py). ONE host fetch of the offsets then
+        yields contiguous sub-batches sized by actual row counts — the
+        cudf hashPartitionAndClose contract, not an n_out-mask fanout."""
+        part_t = self.metrics.metric(M.PARTITION_TIME)
+        keys, n_out = self.keys, self.n_out
+
+        def build():
+            def fn(batch):
+                live = batch.live_mask()
+                ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                               batch.capacity, False, live=live)
+                key_cols = [e.eval_tpu(ectx) for e in keys]
+                h = K.partition_hash_batch(key_cols, batch.num_rows,
+                                           live=live)
+                pid = _pmod(h, n_out)
+                return RP.counting_sort_by_pid(batch, pid, n_out)
+            return fn
+
+        fn = fuse.fused(("hash_exchange_compact",
+                         tuple(e.fingerprint() for e in keys), n_out), build)
+        out: List[List[ColumnarBatch]] = [[] for _ in range(n_out)]
+        for part in child_results:
+            for batch in part:
+                with part_t.ns():
+                    self._emit_compact(batch, fn(batch), out)
+        return out
 
     def _repartition_serialized(self, child_results):
         """Masked device partition, then parallel serialization through the
@@ -2534,14 +2649,15 @@ class ShuffleExchangeExec(ExchangeExec):
         serde.codec_id(codec)  # validate up front
         store = ShuffleStore(self.n_out,
                              self.conf.get(C.SHUFFLE_HOST_BUDGET))
-        masked = self._repartition_masked(child_results)
+        parted = self._repartition_device(child_results)
         nthreads = max(1, self.conf.get(C.SHUFFLE_WRITER_THREADS))
-        work = [(p, b) for p, part in enumerate(masked) for b in part]
+        work = [(p, b) for p, part in enumerate(parted) for b in part]
 
         def ser(item):
+            # the compact partitioning path hands over already-contiguous
+            # right-sized slices; serialize_batch compacts the masked
+            # path's sub-batches itself
             p, b = item
-            if b.row_mask is not None:
-                b = K.compact_batch(b)
             if rows_int(b.num_rows) == 0:
                 return p, None  # empty sub-batches never ship
             return p, serde.serialize_batch(b, codec)
@@ -2700,11 +2816,10 @@ class ShuffleExchangeExec(ExchangeExec):
             key_cols = [e.eval_tpu(ectx) for e in self.keys]
             h = K.partition_hash_batch(key_cols, b.num_rows, live=b.live_mask())
             pid = _pmod(h, n)
-            lv = b.live_mask()
-            count_parts.append(jax.ops.segment_sum(
-                lv.astype(jnp.int32),
-                jnp.where(lv, pid, n).astype(jnp.int32),
-                num_segments=n + 1)[:n])
+            # per-(src,dst) counts via the counting-sort kernel's bucket
+            # pass (ops/repartition.py) — one code path sizes both the
+            # compact slices and the ICI send lanes
+            count_parts.append(RP.partition_counts(pid, b.live_mask(), n))
             tgt_parts.append(pad_plane(pid, 0, jnp.int32))
         target = jnp.concatenate(tgt_parts)
         # ONE host fetch sizes the send lanes: C = max rows any source
@@ -2785,10 +2900,7 @@ class ShuffleExchangeExec(ExchangeExec):
                     # mask-sliced sub-batches: the planes are SHARED across
                     # all n_out outputs (zero-copy partitioning); only the
                     # selection masks differ.
-                    for p, sub in enumerate(fn(batch)):
-                        for ic, oc in zip(batch.columns, sub.columns):
-                            oc.bounds = ic.bounds
-                        out[p].append(sub)
+                    self._emit_masked(batch, fn(batch), out)
         return out
 
 
@@ -2831,12 +2943,18 @@ class RoundRobinExchangeExec(ExchangeExec):
         return self.n_out
 
     def _repartition(self, child_results):
+        if self.n_out == 1:
+            return self._repartition_passthrough(child_results)
+        part_t = self.metrics.metric(M.PARTITION_TIME)
         n_out = self.n_out
+        compact = _partitioning_mode(self.conf) == "compact"
 
         def build():
             def fn(batch):
                 live = batch.live_mask()
                 pid = jnp.cumsum(live.astype(jnp.int32)) % n_out
+                if compact:
+                    return RP.counting_sort_by_pid(batch, pid, n_out)
                 subs = []
                 for p in range(n_out):
                     m = live & (pid == p)
@@ -2845,12 +2963,16 @@ class RoundRobinExchangeExec(ExchangeExec):
                 return subs
             return fn
 
-        fn = fuse.fused(("rr_exchange", n_out), build)
+        fn = fuse.fused(("rr_exchange_compact" if compact
+                         else "rr_exchange", n_out), build)
         out: List[List[ColumnarBatch]] = [[] for _ in range(self.n_out)]
         for part in child_results:
             for batch in part:
-                for p, sub in enumerate(fn(batch)):
-                    out[p].append(sub)
+                with part_t.ns():
+                    if compact:
+                        self._emit_compact(batch, fn(batch), out)
+                    else:
+                        self._emit_masked(batch, fn(batch), out)
         return out
 
 
@@ -2898,6 +3020,8 @@ class RangeExchangeExec(ExchangeExec):
                                  for o in self.orders)), build)
 
     def _repartition(self, child_results):
+        if self.n_out == 1:
+            return self._repartition_passthrough(child_results)
         part_t = self.metrics.metric(M.PARTITION_TIME)
         n_out = self.n_out
         keyfn = self._key_fn()
@@ -2928,6 +3052,7 @@ class RangeExchangeExec(ExchangeExec):
             # values into the fuse key would permanently cache one compiled
             # executable per dataset
             bound_planes = None
+            compact = _partitioning_mode(self.conf) == "compact"
 
             def build():
                 def fn(batch, planes, bplanes):
@@ -2942,6 +3067,8 @@ class RangeExchangeExec(ExchangeExec):
                             lt = lt | (eq & (plane > bv))
                             eq = eq & (plane == bv)
                         pid = pid + lt.astype(jnp.int32)
+                    if compact:
+                        return RP.counting_sort_by_pid(batch, pid, n_out)
                     subs = []
                     for p in range(n_out):
                         m = live & (pid == p)
@@ -2951,7 +3078,8 @@ class RangeExchangeExec(ExchangeExec):
                     return subs
                 return fn
 
-            fn = fuse.fused(("range_exchange", n_out,
+            fn = fuse.fused(("range_exchange_compact" if compact
+                             else "range_exchange", n_out,
                              tuple((o.expr.fingerprint(), o.ascending)
                                    for o in self.orders)), build)
             out: List[List[ColumnarBatch]] = [[] for _ in range(n_out)]
@@ -2961,8 +3089,12 @@ class RangeExchangeExec(ExchangeExec):
                         jnp.asarray(np.array([b[j] for b in bounds],
                                              dtype=planes[j].dtype))
                         for j in range(len(planes)))
-                for p, sub in enumerate(fn(batch, planes, bound_planes)):
-                    out[p].append(sub)
+                if compact:
+                    self._emit_compact(
+                        batch, fn(batch, planes, bound_planes), out)
+                else:
+                    self._emit_masked(
+                        batch, fn(batch, planes, bound_planes), out)
         return out
 
 

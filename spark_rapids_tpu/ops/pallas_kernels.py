@@ -56,6 +56,16 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _x64_off():
+    """Context manager tracing in 32-bit mode. `jax.enable_x64` is only
+    public API on newer jax; older builds (this container's 0.4.x) spell
+    it jax.experimental.enable_x64."""
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx
+    return ctx(False)
+
+
 def pallas_supported(n: int) -> bool:
     """Pallas path eligibility: block-aligned plane sizes only (the
     capacity bucketing makes every plane >= 1024 a multiple of 1024)."""
@@ -100,7 +110,7 @@ def murmur3_int32_pallas(values: jax.Array, seed: jax.Array) -> jax.Array:
     # the engine runs with global x64 enabled, under which pallas grid
     # index types lower to i64 and Mosaic fails to legalize; this kernel
     # is all-32-bit, so trace it in 32-bit mode
-    with jax.enable_x64(False):
+    with _x64_off():
         out = pl.pallas_call(
             _mm3_kernel,
             out_shape=jax.ShapeDtypeStruct(x.shape, jnp.uint32),
@@ -145,7 +155,7 @@ def ascii_case_map_pallas(raw: jax.Array, upper: bool) -> jax.Array:
     from jax.experimental import pallas as pl
     n = raw.shape[0]
     assert n % 4096 == 0, n
-    with jax.enable_x64(False):  # see murmur3_int32_pallas
+    with _x64_off():  # see murmur3_int32_pallas
         words = lax.bitcast_convert_type(raw.reshape(n // 4, 4), jnp.uint32)
         x = words.reshape(n // 4 // 128, 128)
         rows = x.shape[0]

@@ -1,0 +1,105 @@
+"""Counting-sort repartition: the single-dispatch compacted exchange tail.
+
+Reference parity: GpuShuffleExchangeExecBase partitions a batch with one
+cudf hash-partition kernel that returns a contiguous table plus partition
+offsets (GpuHashPartitioningBase.hashPartitionAndClose). The masked
+analog this module replaces emitted `n_out` full-capacity mask-sliced
+sub-batches per input batch, so every downstream operator paid
+`n_out * capacity` work on mostly-dead rows and one deferred count sync
+per sub-batch.
+
+The TPU-shaped equivalent is the counting-sort trick `ops/join.py`'s
+`_dense_table` already uses for the dense build table, applied to target
+partition ids:
+
+1. the caller computes `pid` (hash pmod / round-robin / range bounds)
+   inside the SAME trace,
+2. a stable counting sort permutes rows so partition p's rows are
+   contiguous at [offsets[p], offsets[p+1]) in input order,
+3. the `n_out+1` offsets vector is the ONLY thing the host fetches —
+   one round trip sizes every output slice,
+4. per-partition sub-batches are contiguous gathers sized by
+   `round_capacity(actual rows)` instead of the input capacity.
+
+Steps 1-3 fuse into ONE XLA computation per input batch (the exchange
+execs wrap them in `fuse.fused`); step 4 is host-driven assembly with no
+further synchronization.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, round_capacity
+from spark_rapids_tpu.ops import kernels as K
+
+
+def partition_counts(pid: jax.Array, live: jax.Array, n_out: int
+                     ) -> jax.Array:
+    """Traced: int32[n_out] live-row count per target partition. Dead rows
+    fall into an overflow bucket that is sliced away. Shared by the
+    counting sort below and the ICI exchange's per-(src,dst) lane sizing."""
+    slot = jnp.where(live, pid, n_out).astype(jnp.int32)
+    return jax.ops.segment_sum(jnp.ones(slot.shape[0], jnp.int32), slot,
+                               num_segments=n_out + 1)[:n_out]
+
+
+def counting_sort_by_pid(batch: ColumnarBatch, pid: jax.Array, n_out: int):
+    """Traced tail shared by the hash / round-robin / range exchanges.
+
+    Stable counting sort of the batch's rows by target partition id:
+    returns (sorted_batch, offsets[n_out+1]) where partition p's rows
+    occupy [offsets[p], offsets[p+1]) of the sorted planes in input order.
+    Dead rows sort past offsets[n_out] and gather as invalid padding.
+
+    Everything here stays on device; the caller's ONE host fetch of the
+    offsets vector is the entire synchronization cost of partitioning a
+    batch (vs one deferred count sync per masked sub-batch).
+    """
+    live = batch.live_mask()
+    cap = batch.capacity
+    cnt = partition_counts(pid, live, n_out)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(cnt).astype(jnp.int32)])
+    # stable: rows ordered by (pid, original index); dead rows rank last
+    slot = jnp.where(live, pid, n_out).astype(jnp.int32)
+    order = jnp.argsort(slot, stable=True).astype(jnp.int32)
+    total = offsets[n_out]
+    idx = jnp.where(jnp.arange(cap, dtype=jnp.int32) < total, order, -1)
+    out = K.gather_batch(batch, idx, total)
+    return out, offsets
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _slice_kernel(batch, start, length, out_cap: int):
+    """One jitted gather per output slice. start/length ride as TRACED
+    scalars so the executable caches per (input layout, out_cap) bucket
+    instead of per offset value."""
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    idx = jnp.where(pos < length, pos + start, -1)
+    return K.gather_batch(batch, idx, length)
+
+
+def compact_slices(sorted_batch: ColumnarBatch, offsets: np.ndarray,
+                   n_out: int) -> List[Optional[ColumnarBatch]]:
+    """Host-side assembly after the single offsets fetch: contiguous
+    per-partition sub-batches from the sorted planes, each with capacity
+    `round_capacity(rows)` instead of the input capacity and a plain host
+    int row count (downstream operators never sync a lazy count for them).
+    Empty partitions yield None."""
+    out: List[Optional[ColumnarBatch]] = []
+    for p in range(n_out):
+        start = int(offsets[p])
+        cnt = int(offsets[p + 1]) - start
+        if cnt <= 0:
+            out.append(None)
+            continue
+        sub = _slice_kernel(sorted_batch, jnp.int32(start), jnp.int32(cnt),
+                            round_capacity(cnt))
+        out.append(ColumnarBatch(sub.columns, cnt))
+    return out

@@ -233,6 +233,17 @@ def _agg_fn(node: _TN):
     from spark_rapids_tpu.sql import functions as F
     c = node.cls
     if c == "AggregateExpression":
+        # DISTINCT and FILTER (WHERE ...) change the aggregate's input
+        # row set; silently dropping them is a wrong-results class of bug
+        # (reference GpuOverrides tags these unsupported, falling back)
+        if node.field("isDistinct"):
+            raise SparkException(
+                "catalyst plan: DISTINCT aggregates are not supported "
+                "(AggregateExpression.isDistinct)")
+        if node.field("filter") is not None:
+            raise SparkException(
+                "catalyst plan: FILTER (WHERE ...) aggregate clauses are "
+                "not supported (AggregateExpression.filter)")
         return _agg_fn(node.children[0])
     if c not in _AGG_FNS:
         raise SparkException(
@@ -265,7 +276,7 @@ def _sort_orders(v) -> List[P.SortOrder]:
 
 _WRAPPERS = {
     "WholeStageCodegenExec", "InputAdapter", "AdaptiveSparkPlanExec",
-    "ShuffleExchangeExec", "BroadcastExchangeExec", "ReusedExchangeExec",
+    "ShuffleExchangeExec", "BroadcastExchangeExec",
     "ColumnarToRowExec", "RowToColumnarExec", "ShuffleQueryStageExec",
     "BroadcastQueryStageExec", "SortExec__removed",
 }
@@ -302,6 +313,14 @@ def _output_names(node: _TN) -> Optional[List[str]]:
 
 def plan(node: _TN) -> P.PlanNode:
     c = node.cls
+    if c == "ReusedExchangeExec":
+        # NOT an unwrappable wrapper: it references another exchange by id
+        # and carries NO child in the TreeNode JSON (unwrapping via
+        # children[0] dies with IndexError)
+        raise SparkException(
+            "catalyst plan: ReusedExchangeExec references a subtree by id "
+            "and cannot be reconstructed from the serialized plan; re-run "
+            "with spark.sql.exchange.reuse=false")
     if c in _WRAPPERS:
         return plan(node.children[0])
     if c == "ProjectExec":

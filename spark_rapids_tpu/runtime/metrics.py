@@ -39,6 +39,17 @@ SPILL_TO_DISK_BYTES = "spillToDiskBytes"
 RETRY_COUNT = "retryCount"
 SPLIT_RETRY_COUNT = "splitAndRetryCount"
 PARTITION_TIME = "partitionTime"
+#: PARTITIONING-KERNEL dispatches per input batch (the pid + sort +
+#: offsets computation, NOT output assembly): 'compact' launches ONE
+#: fused counting-sort program, 'masked' emits n_out full-capacity
+#: mask-sliced sub-batches (each a separate downstream computation).
+#: The compact path's per-slice assembly gathers are sized by output
+#: rows and are not partitioning kernels — they are not counted here.
+PARTITION_DISPATCHES = "partitionDispatches"
+#: host round trips needed to size an input batch's partitions: 'compact'
+#: fetches the n_out+1 offsets vector ONCE, 'masked' defers one lazy row
+#: count per sub-batch (n_out syncs when they materialize)
+PARTITION_HOST_FETCHES = "partitionHostFetches"
 
 
 class GpuMetric:

@@ -470,6 +470,7 @@ class _Parser:
                 break
         if not self.kw("from"):
             raise SparkException("SQL: subquery needs FROM")
+        saved = getattr(self, "_scope", {})
         df = self._from()
         scope = self._scope
         conjs = []
@@ -481,6 +482,9 @@ class _Parser:
             while self.op(","):
                 group_keys.append(self.expr())
         having = self.expr() if self.kw("having") else None
+        # pop the subquery's scope: the ENCLOSING query's scope must not
+        # end up holding the subquery's aliases after this parse returns
+        self._scope = saved
         return _SubSpec(items, star, df, conjs, group_keys, having, scope)
 
     def _scalar_subquery(self):
@@ -488,7 +492,9 @@ class _Parser:
         engine analog of Spark's uncorrelated ScalarSubquery, which also
         executes before the main query; correlated scalar subqueries
         raise at build when the outer column fails to resolve)."""
+        saved = getattr(self, "_scope", {})
         df = self.select()
+        self._scope = saved
         self.expect_op(")")
         tbl = df.limit(2).collect()
         if tbl.num_columns != 1:
@@ -618,7 +624,17 @@ class _Parser:
                 # every row UNKNOWN (dropped), and NULL probes only
                 # qualify against an EMPTY subquery (no comparisons
                 # happen) — the shape the reference handles as a
-                # null-aware anti join
+                # null-aware anti join. The emptiness/has-null shortcuts
+                # below evaluate the subquery AS A WHOLE, which is only
+                # sound when no correlation restricts it per outer row;
+                # a correlated NOT IN would over-drop unrelated outer
+                # rows, so reject it instead of guessing.
+                if pairs:
+                    raise SparkException(
+                        "SQL: correlated NOT IN subqueries are not "
+                        "supported (null-aware anti join with "
+                        "correlation); rewrite as NOT EXISTS with an "
+                        "explicit null check")
                 if sub_df.limit(1).count() == 0:
                     return df
                 has_null = sub_df.filter(
@@ -669,8 +685,14 @@ class _Parser:
     def _table(self):
         alias = None
         if self.op("("):
-            # derived table: FROM (SELECT ...) [AS] alias
+            # derived table: FROM (SELECT ...) [AS] alias. The nested
+            # select()'s own _from rebinds self._scope; save/restore so
+            # aliases registered earlier in THIS FROM clause survive and
+            # the derived table's inner aliases don't leak into the outer
+            # correlation scope.
+            saved = getattr(self, "_scope", {})
             df = self.select()
+            self._scope = saved
             self.expect_op(")")
         else:
             name = self.ident()

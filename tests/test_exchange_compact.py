@@ -1,0 +1,340 @@
+"""Counting-sort compacted exchange (spark.rapids.shuffle.partitioning).
+
+Differential coverage: the 'compact' path must produce byte-identical
+per-partition contents to the legacy 'masked' path across hash /
+round-robin / range exchanges, dict strings, nulls, masked inputs, and
+n_out in {1, 3, 4, 8} — while the partitionDispatches /
+partitionHostFetches metrics assert the O(1)-dispatch contract (ONE fused
+counting-sort dispatch + ONE offsets fetch per input batch vs n_out each
+on masked). Plus regression tests for the satellite fixes riding this PR
+(catalyst DISTINCT/FILTER aggregates, ReusedExchangeExec, parser scope
+push/pop, correlated NOT IN).
+"""
+import json
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import to_arrow
+from spark_rapids_tpu.expr.core import SparkException, col, lit
+from spark_rapids_tpu.plan.nodes import bind_expr
+from spark_rapids_tpu.plan.overrides import convert_plan
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.task import TaskContext
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSession
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    DoubleGen, IntegerGen, LongGen, RepeatSeqGen, StringGen, gen_df,
+    gen_table,
+)
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+_SPEC = [("k", RepeatSeqGen(IntegerGen(min_val=0, max_val=40), length=30)),
+         ("v", LongGen(min_val=-(1 << 40), max_val=1 << 40)),
+         ("d", DoubleGen()),
+         ("s", StringGen())]  # LongGen/DoubleGen/StringGen emit nulls
+
+
+def _drain(ex, names):
+    """Materialize an exchange: per-partition row lists (arrow pylist)."""
+    parts = []
+    for p in range(ex.num_partitions):
+        rows = []
+        with TaskContext(partition_id=p) as ctx:
+            for b in ex.execute_partition(ctx, p):
+                rows.extend(to_arrow(b, names).to_pylist())
+        parts.append(rows)
+    return parts
+
+
+def _eq(a, b):
+    """Order-SENSITIVE equality with NaN == NaN (floats gen NaNs)."""
+    if isinstance(a, float) and isinstance(b, float):
+        return (math.isnan(a) and math.isnan(b)) or a == b
+    if isinstance(a, dict):
+        return set(a) == set(b) and all(_eq(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)):
+        return len(a) == len(b) and all(_eq(x, y) for x, y in zip(a, b))
+    return a == b
+
+
+def _build_exchange(partitioning, n_out, kind="hash", masked_input=False,
+                    extra_conf=None):
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    conf = {"spark.rapids.shuffle.partitioning": partitioning}
+    conf.update(extra_conf or {})
+    s = TpuSession(conf)
+    df = gen_df(s, _SPEC, length=1500, seed=91, num_partitions=3)
+    if masked_input:
+        # FilterExec emits selection-mask batches: live rows at arbitrary
+        # positions exercise the dead-row handling of the counting sort
+        df = df.filter(col("v").is_not_null() | (col("k") < lit(20)))
+    child, _ = convert_plan(df.plan, s.conf)
+    if kind == "hash":
+        ex = X.ShuffleExchangeExec(
+            df.plan, [child], s.conf,
+            [bind_expr(col("k"), df.plan.schema)], n_out=n_out)
+    else:
+        ex = X.RoundRobinExchangeExec(df.plan, [child], s.conf, n_out=n_out)
+    return ex, list(df.plan.schema.names)
+
+
+@pytest.mark.parametrize("n_out", [1, 3, 4, 8])
+@pytest.mark.parametrize("masked_input", [False, True])
+def test_hash_exchange_compact_matches_masked(n_out, masked_input):
+    exc, names = _build_exchange("compact", n_out, masked_input=masked_input)
+    exm, _ = _build_exchange("masked", n_out, masked_input=masked_input)
+    got_c = _drain(exc, names)
+    got_m = _drain(exm, names)
+    # contents AND row order per partition match: the counting sort is
+    # stable, so each partition sees its rows in input order, exactly as
+    # the mask slices do
+    assert _eq(got_c, got_m)
+    total = sum(len(p) for p in got_c)
+    assert total == sum(len(p) for p in got_m)
+    # row conservation via the new metrics counters
+    assert exc.metrics.metric(M.NUM_OUTPUT_ROWS).value == total
+    assert exm.metrics.metric(M.NUM_OUTPUT_ROWS).value == total
+
+
+@pytest.mark.parametrize("n_out", [3, 8])
+def test_round_robin_exchange_compact_matches_masked(n_out):
+    exc, names = _build_exchange("compact", n_out, kind="rr")
+    exm, _ = _build_exchange("masked", n_out, kind="rr")
+    got_c = _drain(exc, names)
+    got_m = _drain(exm, names)
+    assert _eq(got_c, got_m)
+    assert exc.metrics.metric(M.NUM_OUTPUT_ROWS).value == \
+        sum(len(p) for p in got_c)
+
+
+def test_dict_string_keys_compact_matches_masked():
+    """Hash exchange keyed ON a dict-encoded string column."""
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    spec = [("s", RepeatSeqGen(StringGen(nullable=False), length=13)),
+            ("v", LongGen())]
+    out = {}
+    for partitioning in ("compact", "masked"):
+        s = TpuSession({"spark.rapids.shuffle.partitioning": partitioning})
+        df = gen_df(s, spec, length=900, seed=97, num_partitions=3)
+        child, _ = convert_plan(df.plan, s.conf)
+        ex = X.ShuffleExchangeExec(
+            df.plan, [child], s.conf,
+            [bind_expr(col("s"), df.plan.schema)], n_out=4)
+        out[partitioning] = _drain(ex, list(df.plan.schema.names))
+    assert _eq(out["compact"], out["masked"])
+
+
+def test_nested_columns_compact_matches_masked():
+    """Array/struct payload columns ride the permuting gather (masked
+    shares planes; compact must rebuild offsets + children correctly)."""
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    t = pa.table({
+        "k": pa.array([i % 9 for i in range(300)], pa.int64()),
+        "a": pa.array([[i, i + 1] if i % 4 else None for i in range(300)],
+                      pa.list_(pa.int32())),
+        "st": pa.array([{"x": i} if i % 5 else None for i in range(300)],
+                       pa.struct([("x", pa.int64())])),
+    })
+    out = {}
+    for mode in ("compact", "masked"):
+        s = TpuSession({"spark.rapids.shuffle.partitioning": mode})
+        df = s.create_dataframe(t, num_partitions=3)
+        child, _ = convert_plan(df.plan, s.conf)
+        ex = X.ShuffleExchangeExec(
+            df.plan, [child], s.conf,
+            [bind_expr(col("k"), df.plan.schema)], n_out=4)
+        out[mode] = _drain(ex, ["k", "a", "st"])
+    assert _eq(out["compact"], out["masked"])
+
+
+def test_compact_metrics_single_dispatch_single_fetch():
+    """THE acceptance assertion: per input batch, the compact path issues
+    exactly ONE partition-kernel dispatch and ONE host offsets fetch; the
+    masked path pays n_out of each."""
+    n_out = 4
+    for partitioning, per_batch in (("compact", 1), ("masked", n_out)):
+        ex, _ = _build_exchange(partitioning, n_out)
+        ex._materialize()
+        n_in = 3  # one batch per source partition
+        assert ex.metrics.metric(M.PARTITION_DISPATCHES).value \
+            == n_in * per_batch
+        assert ex.metrics.metric(M.PARTITION_HOST_FETCHES).value \
+            == n_in * per_batch
+
+
+def test_compact_outputs_are_right_sized():
+    """Compact sub-batches carry no selection mask, have host-int row
+    counts (no deferred count syncs), and capacity sized by actual rows
+    instead of the input capacity."""
+    from spark_rapids_tpu.columnar.batch import LazyRowCount, round_capacity
+    ex, _ = _build_exchange("compact", 4, masked_input=True)
+    with TaskContext(partition_id=0) as ctx:
+        for b in ex.execute_partition(ctx, 0):
+            assert b.row_mask is None
+            assert not isinstance(b.num_rows, LazyRowCount)
+            assert b.capacity == round_capacity(int(b.num_rows))
+
+
+@pytest.mark.parametrize("partitioning", ["compact", "masked"])
+def test_group_by_differential_under_partitioning(partitioning):
+    s = TpuSession({"spark.rapids.shuffle.partitioning": partitioning})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: gen_df(ss, _SPEC, length=2000, seed=67, num_partitions=4)
+        .group_by(col("k")).agg(F.sum("v").alias("sv"),
+                                F.count().alias("n"),
+                                F.min("d").alias("md")),
+        s, ignore_order=True)
+
+
+@pytest.mark.parametrize("partitioning", ["compact", "masked"])
+def test_range_exchange_global_sort_differential(partitioning):
+    s = TpuSession({"spark.rapids.shuffle.partitioning": partitioning})
+    spec = [("a", IntegerGen(min_val=-500, max_val=500)),
+            ("b", LongGen(min_val=0, max_val=1 << 30))]
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: gen_df(ss, spec, length=3000, seed=79, num_partitions=4)
+        .order_by(col("a").asc_nulls_first(), col("b").desc()),
+        s)
+
+
+def test_range_exchange_compact_metrics(session):
+    """The range exchange rides the same counting-sort tail."""
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    df = session.create_dataframe(
+        pa.table({"a": pa.array(np.arange(200)[::-1])}),
+        num_partitions=4).order_by(col("a"))
+    root, _ = convert_plan(df.plan, session.conf)
+    assert isinstance(root, X.SortExec)
+    ex = root.children[0]
+    assert isinstance(ex, X.RangeExchangeExec)
+    ex._materialize()
+    assert ex.metrics.metric(M.PARTITION_DISPATCHES).value == 4  # 1/batch
+    assert ex.metrics.metric(M.PARTITION_HOST_FETCHES).value == 4
+
+
+def test_serialized_mode_uses_compact_partitioning():
+    """SERIALIZED shuffle serializes straight from the sorted planes —
+    no per-sub-batch compaction pass."""
+    s = TpuSession({"spark.rapids.shuffle.mode": "SERIALIZED",
+                    "spark.rapids.shuffle.compression.codec": "zlib",
+                    "spark.rapids.shuffle.partitioning": "compact"})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: gen_df(ss, _SPEC, length=1200, seed=71, num_partitions=3)
+        .group_by(col("k")).agg(F.sum("v").alias("sv"),
+                                F.count().alias("n")),
+        s, ignore_order=True)
+
+
+def test_partitioning_conf_rejects_unknown_value():
+    ex, _ = _build_exchange("compact", 2)
+    ex.conf.set(C.SHUFFLE_PARTITIONING, "bogus")
+    with pytest.raises(ValueError, match="compact.*masked|masked.*compact"):
+        ex._materialize()
+
+
+# ---------------------------------------------------------------------------
+# Satellite regressions
+# ---------------------------------------------------------------------------
+
+def _agg_plan_json(is_distinct=False, filter_idx=None):
+    """Minimal Catalyst TreeNode JSON: LocalTableScan-less aggregate shape
+    is overkill; reuse the golden count_star plan and mutate the
+    AggregateExpression node."""
+    import os
+    golden = os.path.join(os.path.dirname(__file__), "golden_plans",
+                          "count_star.json")
+    with open(golden) as f:
+        arr = json.load(f)
+    for node in arr:
+        s = json.dumps(node)
+        if "AggregateExpression" not in s:
+            continue
+        for row in node.get("aggregateExpressions", []):
+            for sub in row:
+                if sub.get("class", "").endswith("AggregateExpression"):
+                    sub["isDistinct"] = is_distinct
+                    if filter_idx is not None:
+                        sub["filter"] = filter_idx
+    return json.dumps(arr)
+
+
+def test_catalyst_rejects_distinct_aggregate(session, tmp_path):
+    from spark_rapids_tpu.plan.catalyst import ingest_catalyst
+    raw = _agg_plan_json(is_distinct=True).replace("$DATA", str(tmp_path))
+    with pytest.raises(SparkException, match="isDistinct"):
+        ingest_catalyst(raw, session)
+
+
+def test_catalyst_rejects_filtered_aggregate(session, tmp_path):
+    from spark_rapids_tpu.plan.catalyst import ingest_catalyst
+    raw = _agg_plan_json(filter_idx=1).replace("$DATA", str(tmp_path))
+    with pytest.raises(SparkException, match="filter|FILTER"):
+        ingest_catalyst(raw, session)
+
+
+def test_catalyst_rejects_reused_exchange(session):
+    from spark_rapids_tpu.plan.catalyst import ingest_catalyst
+    bad = [{"class": "org.apache.spark.sql.execution.exchange."
+            "ReusedExchangeExec", "num-children": 0}]
+    # previously died with IndexError unwrapping a nonexistent child
+    with pytest.raises(SparkException, match="ReusedExchangeExec"):
+        ingest_catalyst(json.dumps(bad), session)
+
+
+@pytest.fixture
+def scoped_session():
+    s = TpuSession()
+    s.create_or_replace_temp_view("x", s.create_dataframe(
+        {"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]}))
+    s.create_or_replace_temp_view("y", s.create_dataframe(
+        {"k": [1, 2, 3], "w": [7, 8, 9]}))
+    s.create_or_replace_temp_view("z", s.create_dataframe(
+        {"k": [10, 30], "g": [1, 3]}))
+    return s
+
+
+def test_derived_table_keeps_outer_aliases(scoped_session):
+    """FROM x JOIN (SELECT ...) d: parsing the derived table must not drop
+    the alias `x` from the correlation scope (it previously rebound
+    self._scope, so the EXISTS below failed to resolve x.v)."""
+    s = scoped_session
+    got = s.sql(
+        "SELECT x.k FROM x JOIN (SELECT k FROM y) d ON x.k = d.k "
+        "WHERE EXISTS (SELECT 1 FROM z WHERE z.k = x.v)").to_pydict()
+    assert sorted(got["k"]) == [1, 3]
+
+
+def test_derived_table_inner_alias_does_not_leak(scoped_session):
+    """The derived table's inner alias `y` must NOT be visible to the
+    outer correlation scope after the nested parse pops it."""
+    s = scoped_session
+    with pytest.raises(SparkException, match="cannot resolve"):
+        s.sql("SELECT x.k FROM x JOIN (SELECT k FROM y) d ON x.k = d.k "
+              "WHERE EXISTS (SELECT 1 FROM z WHERE z.k = y.w)").collect()
+
+
+def test_correlated_not_in_rejected(scoped_session):
+    """The whole-subquery has-null shortcut is unsound under correlation;
+    correlated NOT IN now rejects instead of over-dropping rows."""
+    s = scoped_session
+    with pytest.raises(SparkException, match="correlated NOT IN"):
+        s.sql("SELECT k FROM x WHERE k NOT IN "
+              "(SELECT k FROM z WHERE z.g = x.k)").collect()
+
+
+def test_uncorrelated_not_in_still_works(scoped_session):
+    s = scoped_session
+    got = s.sql("SELECT k FROM x WHERE k NOT IN (SELECT g FROM z)"
+                ).to_pydict()
+    assert sorted(got["k"]) == [2, 4]
