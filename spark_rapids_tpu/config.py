@@ -321,6 +321,16 @@ INCOMPAT_ENABLED = conf_bool(
     "Enable operators whose results can differ from CPU Spark in documented "
     "corner cases (reference incompatOps).")
 
+STAGE_FUSION_ENABLED = conf_bool(
+    "spark.rapids.sql.stageFusion.enabled", True,
+    "Collapse maximal linear chains of narrow operators (project, filter, "
+    "expand, limit, and the partial phase of hash aggregation) into ONE "
+    "traced device computation per pipeline stage, so the host issues "
+    "exactly one XLA dispatch per input batch per stage — the TPU-idiomatic "
+    "analog of Spark's whole-stage codegen (which the reference GPU plugin "
+    "deliberately lacks). A stage whose composed trace fails falls back to "
+    "the unfused operator chain.", commonly_used=True)
+
 
 class RapidsConf:
     """A snapshot of config values: defaults, then environment overrides

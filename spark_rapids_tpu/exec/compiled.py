@@ -88,6 +88,8 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
         _STAGE_CACHE[key] = fn
 
     from spark_rapids_tpu.columnar.batch import traced_rows
+    from spark_rapids_tpu.exec import fuse
+    fuse.notify_dispatch(("run_stage", fp))  # dispatch-budget hook
     col_planes = [_planes_of(c) for c in batch.columns]
     out_planes, err = fn(col_planes, jnp.asarray(traced_rows(batch.num_rows), jnp.int32),
                          batch.live_mask())

@@ -8,17 +8,40 @@ milliseconds, so this framework fuses an ENTIRE operator (expression eval
 a single jit'd function over ColumnarBatch pytrees. XLA then fuses across
 the whole stage; the host issues exactly one call per operator per batch.
 
+Whole-STAGE vertical fusion (exec/stage_fusion.py) goes one level up:
+linear chains of narrow operators expose their traced bodies as StageBody
+records here and compose into one entry, so the host issues one call per
+PIPELINE STAGE per batch.
+
 The cache is keyed by a semantic fingerprint (expression fingerprints +
 operator shape); jax.jit's own signature cache handles layout/capacity
 variation beneath each entry.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 
 _FUSE_CACHE: Dict[Tuple, Callable] = {}
+
+#: test/diagnostic hook called with the fuse key once per device dispatch
+#: issued through fused() (the dispatch-budget regression harness; see
+#: tests/test_stage_fusion.py). None in production — the wrapper costs one
+#: attribute read per call.
+_DISPATCH_HOOK: Optional[Callable[[Tuple], None]] = None
+
+
+def set_dispatch_hook(hook: Optional[Callable[[Tuple], None]]) -> None:
+    global _DISPATCH_HOOK
+    _DISPATCH_HOOK = hook
+
+
+def notify_dispatch(key: Tuple) -> None:
+    """Report a device dispatch issued outside fused() (compiled.run_stage)
+    to the budget hook."""
+    if _DISPATCH_HOOK is not None:
+        _DISPATCH_HOOK(key)
 
 
 def fused(key: Tuple, builder: Callable[[], Callable]) -> Callable:
@@ -26,8 +49,58 @@ def fused(key: Tuple, builder: Callable[[], Callable]) -> Callable:
     if fn is None:
         fn = jax.jit(builder())
         _FUSE_CACHE[key] = fn
-    return fn
+    if _DISPATCH_HOOK is None:
+        return fn
+
+    def counted(*args, **kwargs):
+        notify_dispatch(key)
+        return fn(*args, **kwargs)
+
+    return counted
 
 
 def clear_cache() -> None:
     _FUSE_CACHE.clear()
+
+
+class StageBody:
+    """One fusable operator's traced body, separated from its driver loop
+    so exec/stage_fusion.py can compose several into ONE jitted entry.
+
+    builder() returns the uniform traced function
+        fn(batch, pid, carry) -> (batch, errors_dict, carry)
+    where `pid` is the traced partition id and `carry` is the operator's
+    per-partition loop state (ProjectExec's row_base, LimitExec's
+    remaining budget; a constant zero scalar for carry-free operators).
+    Builders MUST capture only expression-level state — never the exec
+    node, whose child tree can pin HBM-resident batches in the process-
+    global fuse cache.
+
+    bounds_map maps host-side column-stat bounds (ColumnVector.bounds,
+    NOT pytree leaves) across the operator: in_bounds per input column ->
+    bounds per output column.
+    """
+
+    __slots__ = ("key", "builder", "carry_init", "bounds_map", "has_carry",
+                 "exhausts", "name")
+
+    def __init__(self, key: Tuple, builder: Callable[[], Callable],
+                 carry_init: Optional[Callable] = None,
+                 bounds_map: Optional[Callable] = None,
+                 has_carry: bool = False, exhausts: bool = False,
+                 name: str = ""):
+        self.key = key
+        self.builder = builder
+        self.carry_init = carry_init
+        self.bounds_map = bounds_map
+        self.has_carry = has_carry
+        #: carry == 0 means every later batch is all-dead (LimitExec's
+        #: remaining budget): the fused driver may stop consuming input
+        self.exhausts = exhausts
+        self.name = name
+
+    def init_carry(self):
+        import jax.numpy as jnp
+        if self.carry_init is None:
+            return jnp.int64(0)
+        return self.carry_init()

@@ -17,7 +17,6 @@ stages (exec/compiled.py).
 from __future__ import annotations
 
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, List, Optional
 
 import numpy as np
@@ -194,7 +193,7 @@ class ParquetScanExec(TpuExec):
 
         # host decode of row group g+1.. overlaps device upload of g
         batch_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
-        tables = _prefetched(groups, load, threads)
+        tables = _prefetched(groups, load, threads, conf=self.conf)
         if mode in ("COALESCING", "AUTO"):
             tables = _host_coalesced(tables, batch_rows)
         for tbl in tables:
@@ -226,32 +225,19 @@ def _host_coalesced(tables, target_rows: int):
         yield pa.concat_tables(pending) if len(pending) > 1 else pending[0]
 
 
-def _prefetched(items, load_fn, n_threads: int):
-    """Iterator over load_fn(item) with BOUNDED background lookahead
-    (reference GpuMultiFileReader's host thread pool: host parse overlaps
-    device upload/compute; lookahead is capped so a large input cannot
-    buffer itself entirely into host memory)."""
+def _prefetched(items, load_fn, n_threads: int, conf=None):
+    """Iterator over load_fn(item) with BOUNDED background lookahead on the
+    process-wide host pool (reference MultiFileReaderThreadPool: host parse
+    overlaps device upload/compute; lookahead is capped so a large input
+    cannot buffer itself entirely into host memory, and the pool is shared
+    by every scan instead of constructed per call)."""
     if n_threads <= 1 or len(items) <= 1:
         for it in items:
             yield load_fn(it)
         return
-    from collections import deque
-    from concurrent.futures import ThreadPoolExecutor
-    with ThreadPoolExecutor(max_workers=n_threads) as pool:
-        pending = deque()
-        it = iter(items)
-        for _ in range(n_threads):
-            try:
-                pending.append(pool.submit(load_fn, next(it)))
-            except StopIteration:
-                break
-        while pending:
-            f = pending.popleft()
-            try:
-                pending.append(pool.submit(load_fn, next(it)))
-            except StopIteration:
-                pass
-            yield f.result()
+    from spark_rapids_tpu.runtime.host_pool import get_host_pool
+    yield from get_host_pool(conf).map_ordered(load_fn, items,
+                                               max_concurrency=n_threads)
 
 
 class TextScanExec(TpuExec):
@@ -379,6 +365,163 @@ class RangeExec(TpuExec):
                 break
 
 
+# ---------------------------------------------------------------------------
+# Stage bodies (whole-stage vertical fusion, exec/stage_fusion.py)
+#
+# Each fusable exec separates its traced per-batch body from its driver
+# loop as a fuse.StageBody with the uniform signature
+#     fn(batch, pid, carry) -> (batch, errors, carry)
+# so a planner pass can compose a Scan→Filter→Project→partial-agg chain
+# into ONE dispatch per batch. Builders are module-level and capture only
+# expressions/static config — never the exec (the fuse-cache pinning
+# hazard documented on _AggKernels).
+# ---------------------------------------------------------------------------
+
+def _project_bounds_map(exprs):
+    """Column-stat bounds across a projection: passthrough refs carry
+    their input column's bounds (the host half of compiled.carry_bounds)."""
+    def bmap(in_bounds):
+        out = []
+        for e in exprs:
+            inner = e.children[0] if isinstance(e, Alias) else e
+            if isinstance(inner, BoundRef) and inner.index < len(in_bounds):
+                out.append(in_bounds[inner.index])
+            else:
+                out.append(None)
+        return out
+    return bmap
+
+
+def project_stage_body(exprs, ansi: bool, trivial=None) -> fuse.StageBody:
+    if trivial is not None:
+        idx = tuple(trivial)
+
+        def build_trivial():
+            def fn(batch, pid, carry):
+                return (ColumnarBatch([batch.columns[i] for i in idx],
+                                      batch.num_rows, batch.row_mask),
+                        {}, carry)
+            return fn
+
+        return fuse.StageBody(
+            ("project_trivial", idx), build_trivial,
+            bounds_map=lambda bs: [bs[i] if i < len(bs) else None
+                                   for i in idx],
+            name="Project")
+
+    from spark_rapids_tpu.plan.overrides import _contains_project_only
+    needs_part_ctx = any(_contains_project_only(e) for e in exprs)
+
+    def build():
+        def fn(batch, pid, row_base):
+            ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                           batch.capacity, ansi, live=batch.live_mask(),
+                           partition_id=pid, row_base=row_base)
+            cols = [e.eval_tpu(ectx) for e in exprs]
+            if needs_part_ctx:  # only pay the count when ids need it
+                row_base = row_base + jnp.sum(
+                    batch.live_mask().astype(jnp.int64))
+            return (ColumnarBatch(cols, batch.num_rows, batch.row_mask),
+                    dict(ectx.errors), row_base)
+        return fn
+
+    key = ("project", tuple(e.fingerprint() for e in exprs), ansi,
+           needs_part_ctx)
+    return fuse.StageBody(key, build, bounds_map=_project_bounds_map(exprs),
+                          has_carry=needs_part_ctx, name="Project")
+
+
+def filter_stage_body(cond, ansi: bool) -> fuse.StageBody:
+    def build():
+        def fn(batch, pid, carry):
+            ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
+                           batch.capacity, ansi, live=batch.live_mask())
+            pred = cond.eval_tpu(ectx)
+            # validity=None means "valid on every live row"; the live
+            # rows of a masked batch (chained filter, exchange output)
+            # sit at positions >= live_count, so arange<num_rows would
+            # silently drop them — use the live mask instead.
+            valid = (pred.validity if pred.validity is not None
+                     else ectx.row_mask)
+            mask = pred.data.astype(jnp.bool_) & valid
+            return K.mask_filter_batch(batch, mask), dict(ectx.errors), carry
+        return fn
+
+    # a filter's output columns are 1:1 row subsets of its input: bounds
+    # (host metadata, valid under any row subset) pass straight through
+    return fuse.StageBody(("filter", cond.fingerprint(), ansi), build,
+                          bounds_map=lambda bs: list(bs), name="Filter")
+
+
+def expand_stage_body(proj_exprs, n_cols: int) -> fuse.StageBody:
+    """All projections of an Expand evaluated and stacked in ONE traced
+    computation (the unfused exec dispatches once per projection). Output
+    capacity is n_proj * input capacity with a tiled selection mask; only
+    built for fixed-width output schemas (stage_fusion gates strings —
+    cross-projection vocab unification cannot run inside a trace)."""
+    nproj = len(proj_exprs)
+
+    def build():
+        def fn(batch, pid, carry):
+            live = batch.live_mask()
+            nr = traced_rows(batch.num_rows)
+            errs = {}
+            per_proj = []
+            for exprs in proj_exprs:
+                ectx = EvalCtx(batch.columns, nr, batch.capacity, False,
+                               live=live)
+                per_proj.append([e.eval_tpu(ectx) for e in exprs])
+                errs.update(ectx.errors)
+            out_cols = []
+            for ci in range(n_cols):
+                cols = [p[ci] for p in per_proj]
+                data = jnp.concatenate([c.data for c in cols])
+                # validity=None means "valid on every LIVE row"; a masked
+                # input (chained filter) keeps live rows at positions >=
+                # live_count, so arange<num_rows would null them — use the
+                # live mask as the default plane
+                valid = jnp.concatenate(
+                    [c.validity if c.validity is not None else live
+                     for c in cols])
+                out_cols.append(ColumnVector(cols[0].dtype, data, valid))
+            mask = jnp.concatenate([live] * nproj)
+            count = jnp.sum(mask.astype(jnp.int32))
+            return (ColumnarBatch(out_cols, LazyRowCount(count), mask),
+                    errs, carry)
+        return fn
+
+    key = ("expand_stage",
+           tuple(tuple(e.fingerprint() for e in p) for p in proj_exprs))
+    return fuse.StageBody(key, build,
+                          bounds_map=lambda bs: [None] * n_cols,
+                          name="Expand")
+
+
+def limit_stage_body(n: int) -> fuse.StageBody:
+    """Device-side LIMIT: rows past the remaining budget are masked dead;
+    the budget rides as a device carry. The fused driver fetches the
+    carry per batch to stop consuming input once it hits zero (exhausts=
+    True) — the same one-scalar-per-batch sync the unfused LimitExec
+    already pays materializing each batch's row count."""
+    def build():
+        def fn(batch, pid, remaining):
+            live = batch.live_mask()
+            pos = jnp.cumsum(live.astype(jnp.int64))
+            keep = live & (pos <= remaining)
+            taken = jnp.sum(keep.astype(jnp.int64))
+            count = jnp.sum(keep.astype(jnp.int32))
+            return (ColumnarBatch(batch.columns, LazyRowCount(count), keep),
+                    {}, jnp.maximum(remaining - taken, 0))
+        return fn
+
+    # n reaches the trace only as the carried device scalar, so one cache
+    # entry serves every LIMIT value (no per-n recompiles)
+    return fuse.StageBody(("limit_stage",), build,
+                          carry_init=lambda: jnp.int64(n),
+                          bounds_map=lambda bs: list(bs),
+                          has_carry=True, exhausts=True, name="Limit")
+
+
 class ProjectExec(TpuExec):
     def _trivial_indices(self):
         """Pure column selection (only BoundRef / Alias(BoundRef)) costs no
@@ -392,9 +535,13 @@ class ProjectExec(TpuExec):
                 return None
         return idx
 
+    def stage_body(self) -> fuse.StageBody:
+        return project_stage_body(self.plan.exprs,
+                                  self.conf.get(C.ANSI_ENABLED),
+                                  trivial=self._trivial_indices())
+
     def execute_partition(self, ctx, pidx):
         op_t = self.metrics.metric(M.OP_TIME)
-        ansi = self.conf.get(C.ANSI_ENABLED)
         exprs = self.plan.exprs
         trivial = self._trivial_indices()
         if trivial is not None:
@@ -403,30 +550,14 @@ class ProjectExec(TpuExec):
                                     batch.num_rows, batch.row_mask)
             return
 
-        from spark_rapids_tpu.plan.overrides import _contains_project_only
-        needs_part_ctx = any(_contains_project_only(e) for e in exprs)
-
-        def build():
-            def fn(batch, pid, row_base):
-                ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
-                               batch.capacity, ansi, live=batch.live_mask(),
-                               partition_id=pid, row_base=row_base)
-                cols = [e.eval_tpu(ectx) for e in exprs]
-                if needs_part_ctx:  # only pay the count when ids need it
-                    row_base = row_base + jnp.sum(
-                        batch.live_mask().astype(jnp.int64))
-                return (ColumnarBatch(cols, batch.num_rows, batch.row_mask),
-                        dict(ectx.errors), row_base)
-            return fn
-
-        key = ("project", tuple(e.fingerprint() for e in exprs), ansi,
-               needs_part_ctx)
-        fn = fuse.fused(key, build)
-        row_base = jnp.int64(0)
+        body = self.stage_body()
+        fn = fuse.fused(body.key, body.builder)
+        row_base = body.init_carry()
+        pid = jnp.int32(pidx)
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
             with op_t.ns():
-                out, errs, row_base = fn(batch, jnp.int32(pidx), row_base)
+                out, errs, row_base = fn(batch, pid, row_base)
             compiled.raise_errors(errs)
             compiled.carry_bounds(exprs, batch.columns, out.columns)
             yield out
@@ -436,32 +567,21 @@ class FilterExec(TpuExec):
     """Predicate eval + compaction fused into ONE jitted computation per
     batch; the surviving-row count stays on device (LazyRowCount)."""
 
+    def stage_body(self) -> fuse.StageBody:
+        return filter_stage_body(self.plan.condition,
+                                 self.conf.get(C.ANSI_ENABLED))
+
     def execute_partition(self, ctx, pidx):
         op_t = self.metrics.metric(M.FILTER_TIME)
         out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
-        ansi = self.conf.get(C.ANSI_ENABLED)
-        cond = self.plan.condition
-
-        def build():
-            def fn(batch):
-                ectx = EvalCtx(batch.columns, traced_rows(batch.num_rows),
-                               batch.capacity, ansi, live=batch.live_mask())
-                pred = cond.eval_tpu(ectx)
-                # validity=None means "valid on every live row"; the live
-                # rows of a masked batch (chained filter, exchange output)
-                # sit at positions >= live_count, so arange<num_rows would
-                # silently drop them — use the live mask instead.
-                valid = (pred.validity if pred.validity is not None
-                         else ectx.row_mask)
-                mask = pred.data.astype(jnp.bool_) & valid
-                return K.mask_filter_batch(batch, mask), dict(ectx.errors)
-            return fn
-
-        fn = fuse.fused(("filter", cond.fingerprint(), ansi), build)
+        body = self.stage_body()
+        fn = fuse.fused(body.key, body.builder)
+        carry = body.init_carry()
+        pid = jnp.int32(pidx)
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
             with op_t.ns():
-                out, errs = fn(batch)
+                out, errs, carry = fn(batch, pid, carry)
             compiled.raise_errors(errs)
             # column-stat bounds are host metadata (not pytree leaves):
             # a filter's output columns are 1:1 row subsets of its input
@@ -472,6 +592,9 @@ class FilterExec(TpuExec):
 
 
 class LimitExec(TpuExec):
+    def stage_body(self) -> fuse.StageBody:
+        return limit_stage_body(self.plan.n)
+
     def execute_partition(self, ctx, pidx):
         remaining = self.plan.n
         for batch in self.children[0].execute_partition(ctx, pidx):
@@ -520,13 +643,20 @@ class UnionExec(TpuExec):
 
 
 class ExpandExec(TpuExec):
-    def execute_partition(self, ctx, pidx):
+    def _proj_exprs(self):
         out_types = self.plan.schema.types
+        return [[e if e.data_type() == dt else Cast(e, dt)
+                 for e, dt in zip(proj, out_types)]
+                for proj in self.plan.projections]
+
+    def stage_body(self) -> fuse.StageBody:
+        return expand_stage_body(self._proj_exprs(),
+                                 len(self.plan.schema.types))
+
+    def execute_partition(self, ctx, pidx):
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
-            for proj in self.plan.projections:
-                exprs = [e if e.data_type() == dt else Cast(e, dt)
-                         for e, dt in zip(proj, out_types)]
+            for exprs in self._proj_exprs():
                 yield compiled.run_projection(exprs, batch)
 
 
@@ -662,12 +792,16 @@ class CoalesceBatchesExec(TpuExec):
 
     def execute_partition(self, ctx, pidx):
         concat_t = self.metrics.metric(M.CONCAT_TIME)
-        n_coalesced = self.metrics.metric(M.NUM_INPUT_BATCHES)
+        n_in = self.metrics.metric(M.NUM_INPUT_BATCHES)
+        n_out = self.metrics.metric(M.NUM_OUTPUT_BATCHES)
         pending: List[ColumnarBatch] = []
         pending_bytes = 0
 
         def flush():
+            n_out.add(1)
             if len(pending) == 1:
+                # single-batch passthrough: no concat kernel runs, so no
+                # semaphore acquire either
                 return pending[0]
             self._acquire(ctx)
             with concat_t.ns():
@@ -675,7 +809,7 @@ class CoalesceBatchesExec(TpuExec):
 
         for batch in self.children[0].execute_partition(ctx, pidx):
             pending.append(batch)
-            n_coalesced.add(1)
+            n_in.add(1)
             pending_bytes += batch.device_memory_size()
             if not self.require_single and pending_bytes >= self.target_bytes:
                 yield flush()
@@ -2236,6 +2370,16 @@ class HashAggregateExec(TpuExec):
         # A filter condition absorbed into the update kernel (predicate
         # fusion): scan -> filter -> partial agg runs as ONE dispatch.
         self.pre_filter = pre_filter
+        #: whole-stage vertical fusion (exec/stage_fusion.py): traced
+        #: bodies of a narrow-operator chain composed BEFORE the update
+        #: phase inside one jit — scan -> filter -> project -> partial agg
+        #: is then exactly one dispatch per input batch. Set by the
+        #: planner pass; only carry-free bodies are absorbed (retry may
+        #: re-run the composed trace on a split batch).
+        self.pre_chain: Optional[List[fuse.StageBody]] = None
+        self.pre_chain_members: List[TpuExec] = []
+        self.fused_stage_id = 0
+        self._chain_failed = False
 
     # ---- schema of the partial (state) batches ----
     def state_fields(self):
@@ -2259,6 +2403,52 @@ class HashAggregateExec(TpuExec):
         pf = self.pre_filter.fingerprint() if self.pre_filter is not None else None
         return ("hashagg", phase, gfp, afp, ansi, pf)
 
+    # -- whole-stage fusion (absorbed narrow-operator chain) ---------------
+
+    def _chain_key(self, ansi: bool):
+        return ("hashagg_chain_update",
+                tuple(b.key for b in self.pre_chain),
+                self._sig("update", ansi))
+
+    def _build_chain_update(self, ansi: bool):
+        bodies = list(self.pre_chain)
+        kern = self.kern
+
+        def build():
+            fns = [b.builder() for b in bodies]
+            upd = kern._build_update(ansi)
+            zero = jnp.int64(0)
+
+            def fn(batch, pid):
+                errs_all, rows = [], []
+                for f in fns:
+                    batch, errs, _ = f(batch, pid, zero)
+                    errs_all.append(errs)
+                    rows.append(jnp.sum(
+                        batch.live_mask().astype(jnp.int64)))
+                out, uerrs = upd(batch)
+                errs_all.append(uerrs)
+                return out, tuple(errs_all), tuple(rows)
+            return fn
+        return build
+
+    def _unfused_pre_chain(self, source):
+        from spark_rapids_tpu.exec.stage_fusion import rebuild_chain
+        return rebuild_chain(self.pre_chain_members, source)
+
+    def tree_string(self, indent: int = 0) -> str:
+        if not self.pre_chain_members:
+            return super().tree_string(indent)
+        pad = "  " * indent
+        sid = self.fused_stage_id
+        lines = [f"{pad}*({sid}) {self.name()} <- {self.plan.describe()}"]
+        for m in reversed(self.pre_chain_members):
+            lines.append(f"{pad}  *({sid}) {type(m).__name__} "
+                         f"<- {m.plan.describe()} [fused]")
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
     def execute_partition(self, ctx, pidx):
         agg_t = self.metrics.metric(M.AGG_TIME)
         child_batches = self.children[0].execute_partition(ctx, pidx)
@@ -2268,16 +2458,51 @@ class HashAggregateExec(TpuExec):
             ansi = self.conf.get(C.ANSI_ENABLED)
             from spark_rapids_tpu.runtime.retry import with_retry
 
-            def attempt(b):
-                # raise_errors inside the attempt so ANSI-mode syncs (and
-                # any device OOM they surface) are seen by the retry loop.
-                # Note: under async dispatch a physical RESOURCE_EXHAUSTED
-                # can still surface at a LATER sync point; the cooperative
-                # budget (SpillFramework.reserve) is the primary defense,
-                # this translation is best-effort.
+            def plain_attempt(b):
+                # raise_errors inside the attempt so ANSI-mode syncs
+                # (and any device OOM they surface) are seen by the
+                # retry loop. Note: under async dispatch a physical
+                # RESOURCE_EXHAUSTED can still surface at a LATER sync
+                # point; the cooperative budget (SpillFramework.
+                # reserve) is the primary defense, this translation is
+                # best-effort.
                 out, errs = self.kern.update(b, ansi)
                 compiled.raise_errors(errs)
                 return out
+
+            attempt = plain_attempt
+            chain_live = False
+            chain_in_rows = [None]  # update-phase input rows (device)
+            if self.pre_chain and self._chain_failed:
+                # an earlier partition's composed trace failed: run the
+                # unfused member chain in front of the plain update
+                child_batches = self._unfused_pre_chain(
+                    self.children[0]).execute_partition(ctx, pidx)
+            elif self.pre_chain:
+                chain_fn = fuse.fused(self._chain_key(ansi),
+                                      self._build_chain_update(ansi))
+                pid = jnp.int32(pidx)
+                disp = self.metrics.metric(M.STAGE_DISPATCHES)
+                member_rows = [m.metrics.metric(M.NUM_OUTPUT_ROWS)
+                               for m in self.pre_chain_members]
+
+                def chain_attempt(b):
+                    # the absorbed chain + update phase is ONE composed
+                    # trace, idempotent over its input (chain bodies are
+                    # carry-free by the absorb gate), so retry/split-retry
+                    # treat it exactly like a plain update
+                    disp.add(1)
+                    out, errs_list, rows = chain_fn(b, pid)
+                    for e in errs_list:
+                        compiled.raise_errors(e)
+                    for mr, r in zip(member_rows, rows):
+                        mr.add(LazyRowCount(r))
+                    if rows:  # what the update phase actually saw
+                        chain_in_rows[0] = rows[-1]
+                    return out
+
+                attempt = chain_attempt
+                chain_live = True
 
             if (self.conf.get(C.AGG_FORCE_SINGLE_PASS) and nkeys > 0) \
                     or self.kern.has_custom:
@@ -2293,15 +2518,55 @@ class HashAggregateExec(TpuExec):
             skip_ratio = self.conf.get(C.SKIP_AGG_PASS_RATIO)
             skip_merge = False
             partials = []
-            for bi, batch in enumerate(child_batches):
+            it = iter(child_batches)
+            bi = -1
+            while True:
+                batch = next(it, None)
+                if batch is None:
+                    break
+                bi += 1
                 self._acquire(ctx)
-                with agg_t.ns():
-                    # update is idempotent over its input batch: retried
-                    # after a spill drain, or split in half, on OOM
-                    for out in with_retry(attempt, batch):
-                        if nkeys == 0:
-                            out = ColumnarBatch(out.columns, 1)
-                        partials.append(out)
+                n_before = len(partials)
+                try:
+                    with agg_t.ns():
+                        # update is idempotent over its input batch:
+                        # retried after a spill drain, or split in half,
+                        # on OOM
+                        for out in with_retry(attempt, batch):
+                            if nkeys == 0:
+                                out = ColumnarBatch(out.columns, 1)
+                            partials.append(out)
+                except Exception as ex:
+                    from spark_rapids_tpu.expr.core import SparkException
+                    if not chain_live or isinstance(ex, SparkException):
+                        # ANSI/analysis errors are deterministic runtime
+                        # errors, never trace failures — replaying them
+                        # through the unfused chain would double the work
+                        # just to raise the same error
+                        raise
+                    # per-stage fallback (the stageFusion contract): the
+                    # composed chain+update trace failed — drop this
+                    # batch's partials (update is idempotent), route the
+                    # batch and the rest of the input through the unfused
+                    # member chain, and continue with the plain update
+                    import logging
+                    logging.getLogger("spark_rapids_tpu").warning(
+                        "absorbed-chain trace failed for %s; falling back"
+                        " to the unfused chain", self.name(),
+                        exc_info=True)
+                    del partials[n_before:]
+                    self._chain_failed = True
+                    chain_live = False
+                    attempt = plain_attempt
+                    from spark_rapids_tpu.exec.stage_fusion import (
+                        _ReplaySourceExec,
+                    )
+                    src = _ReplaySourceExec(self.children[0].schema,
+                                            [batch], it)
+                    it = self._unfused_pre_chain(src).execute_partition(
+                        ctx, pidx)
+                    bi -= 1
+                    continue
                 if bi == 0 and skip_ratio < 1.0 and nkeys > 0 \
                         and self.mode == "partial":
                     # Reference skipAggPassReductionRatio: when the first
@@ -2309,8 +2574,14 @@ class HashAggregateExec(TpuExec):
                     # the ratio), skip the within-partition merge pass and
                     # defer cross-batch merging to the post-exchange final
                     # agg. Sampled on the first batch only — row counts
-                    # live on device and each fetch is a host sync.
-                    in_rows = max(int(batch.num_rows), 1)
+                    # live on device and each fetch is a host sync. With an
+                    # absorbed chain, the ratio is against the CHAIN's
+                    # output (the rows the update phase actually saw), not
+                    # the raw scan batch.
+                    src_rows = (chain_in_rows[0]
+                                if chain_live and chain_in_rows[0] is not None
+                                else batch.num_rows)
+                    in_rows = max(int(src_rows), 1)
                     skip_merge = int(partials[0].num_rows) > skip_ratio * in_rows
             if not partials:
                 if nkeys == 0:
@@ -2471,8 +2742,6 @@ class ExchangeExec(TpuExec):
         with self._lock:
             if self._out is None:
                 child = self.children[0]
-                nthreads = min(self.conf.get(C.SHUFFLE_WRITER_THREADS),
-                               max(child.num_partitions, 1))
                 results: List[List[ColumnarBatch]] = [None] * child.num_partitions
 
                 def run(p):
@@ -2482,9 +2751,22 @@ class ExchangeExec(TpuExec):
                 if child.num_partitions == 1:
                     results[0] = run(0)
                 else:
-                    with ThreadPoolExecutor(max_workers=nthreads) as pool:
-                        for p, res in enumerate(pool.map(run, range(child.num_partitions))):
-                            results[p] = res
+                    # child partitions run as tasks on the process-wide
+                    # host pool (one bounded pool instead of a throwaway
+                    # executor per exchange; nested exchanges degrade to
+                    # inline execution rather than deadlocking); the
+                    # writer-threads conf still caps THIS exchange's
+                    # concurrent materializations (HBM admission)
+                    from spark_rapids_tpu.runtime.host_pool import (
+                        get_host_pool,
+                    )
+                    pool = get_host_pool(self.conf)
+                    nthreads = self.conf.get(C.SHUFFLE_WRITER_THREADS)
+                    for p, res in enumerate(
+                            pool.map_ordered(run,
+                                             range(child.num_partitions),
+                                             max_concurrency=nthreads)):
+                        results[p] = res
                 self._out = self._repartition(results)
         return self._out
 
@@ -2664,10 +2946,11 @@ class ShuffleExchangeExec(ExchangeExec):
 
         with ser_t.ns():
             if len(work) > 1 and nthreads > 1:
-                with ThreadPoolExecutor(max_workers=nthreads) as pool:
-                    for p, blob in pool.map(ser, work):
-                        if blob is not None:
-                            store.add(p, blob)
+                from spark_rapids_tpu.runtime.host_pool import get_host_pool
+                for p, blob in get_host_pool(self.conf).map_ordered(
+                        ser, work, max_concurrency=nthreads):
+                    if blob is not None:
+                        store.add(p, blob)
             else:
                 for item in work:
                     p, blob = ser(item)
@@ -2675,7 +2958,7 @@ class ShuffleExchangeExec(ExchangeExec):
                         store.add(p, blob)
         self._store = store
         rthreads = self.conf.get(C.SHUFFLE_READER_THREADS)
-        return [[_LazyShuffleBlobs(store, p, rthreads)]
+        return [[_LazyShuffleBlobs(store, p, rthreads, self.conf)]
                 if store.partition_bytes(p)
                 else [] for p in range(self.n_out)]
 
@@ -2915,17 +3198,21 @@ class _LazyShuffleBlobs:
     reader pool (spark.rapids.shuffle.multiThreaded.reader.threads);
     device upload stays ordered."""
 
-    def __init__(self, store, partition: int, reader_threads: int = 1):
+    def __init__(self, store, partition: int, reader_threads: int = 1,
+                 conf=None):
         self.store = store
         self.partition = partition
         self.reader_threads = max(1, reader_threads)
+        self.conf = conf
 
     def batches(self):
         from spark_rapids_tpu.shuffle import serde
         blobs = list(self.store.iter_partition(self.partition))
         if self.reader_threads > 1 and len(blobs) > 1:
-            with ThreadPoolExecutor(max_workers=self.reader_threads) as pool:
-                yield from pool.map(serde.deserialize_batch, blobs)
+            from spark_rapids_tpu.runtime.host_pool import get_host_pool
+            yield from get_host_pool(self.conf).map_ordered(
+                serde.deserialize_batch, blobs,
+                max_concurrency=self.reader_threads)
             return
         for blob in blobs:
             yield serde.deserialize_batch(blob)

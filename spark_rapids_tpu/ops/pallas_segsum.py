@@ -98,7 +98,8 @@ def segsum_window(gid: jax.Array, payload: jax.Array, outcap: int
     assert n % TILE == 0 and outcap % (2 * TILE) == 0, (n, outcap)
     T = n // TILE
     bases = jnp.clip(gid[::TILE] // TILE, 0, outcap // TILE - 2)
-    with jax.enable_x64(False):
+    from spark_rapids_tpu.ops.pallas_kernels import _x64_off
+    with _x64_off():
         lo, hi = pl.pallas_call(
             _kernel_factory(P),
             grid_spec=pltpu.PrefetchScalarGridSpec(

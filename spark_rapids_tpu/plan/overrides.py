@@ -1267,6 +1267,10 @@ def convert_plan(plan: P.PlanNode, conf):
     from spark_rapids_tpu.plan.cost import apply_cost_optimizer
     apply_cost_optimizer(meta, conf)
     exec_root = meta.convert()
+    # whole-stage vertical fusion: collapse linear chains of narrow execs
+    # into one dispatch per batch (spark.rapids.sql.stageFusion.enabled)
+    from spark_rapids_tpu.exec.stage_fusion import fuse_stages
+    exec_root = fuse_stages(exec_root, conf)
     lore_dir = conf.get(C.LORE_DUMP_DIR)
     if lore_dir:
         from spark_rapids_tpu.runtime.lore import LoreDumper

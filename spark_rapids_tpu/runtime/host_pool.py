@@ -1,0 +1,123 @@
+"""Process-wide bounded host task pool.
+
+Reference parity: MultiFileReaderThreadPool (GpuMultiFileReader.scala) —
+ONE executor-wide pool shared by every multi-file reader, sized once,
+instead of a pool per scan. This engine previously built a throwaway
+ThreadPoolExecutor per prefetch call and per exchange materialization;
+every one paid thread start-up latency and, worse, the aggregate thread
+count was unbounded (an exchange over an exchange over N parquet scans
+could spawn writer*reader*scan threads). All host-side task parallelism
+(scan prefetch, exchange child materialization, serialized-shuffle codec
+work, shuffle-blob decode) now shares this bounded pool.
+
+Deadlock discipline: pool workers may themselves reach code that submits
+to the pool (an exchange task runs a scan whose prefetcher submits row-
+group loads — the engine's dominant query shape). A single bounded pool
+whose workers block on queued work deadlocks, so the pool is TWO tiers
+of equal size: top-level submissions run on tier 0, submissions from a
+tier-0 worker run on tier 1 (scan prefetch under an exchange keeps its
+decode/upload overlap), and submissions from a tier-1 worker run inline.
+Tier-1 workers never wait on tier-1 work, so no cycle can starve — the
+same layering the reference gets from keeping file reads off the shuffle
+threads, with both tiers' sizes still bounded.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, List, Optional
+
+_PREFIX0 = "rapids-host-pool-t0"
+_PREFIX1 = "rapids-host-pool-t1"
+_LOCK = threading.Lock()
+_POOL: "Optional[HostTaskPool]" = None
+
+
+class HostTaskPool:
+    """Bounded shared two-tier pool with inline fallback at depth 2."""
+
+    def __init__(self, n_threads: int):
+        self.n_threads = max(1, int(n_threads))
+        self._tier0 = ThreadPoolExecutor(max_workers=self.n_threads,
+                                         thread_name_prefix=_PREFIX0)
+        self._tier1 = ThreadPoolExecutor(max_workers=self.n_threads,
+                                         thread_name_prefix=_PREFIX1)
+
+    @staticmethod
+    def _depth() -> int:
+        name = threading.current_thread().name
+        if name.startswith(_PREFIX1):
+            return 2
+        if name.startswith(_PREFIX0):
+            return 1
+        return 0
+
+    def submit(self, fn: Callable, *args) -> Future:
+        depth = self._depth()
+        if depth == 0:
+            return self._tier0.submit(fn, *args)
+        if depth == 1:
+            return self._tier1.submit(fn, *args)
+        f: Future = Future()
+        try:
+            f.set_result(fn(*args))
+        except BaseException as e:  # noqa: BLE001 - future carries it
+            f.set_exception(e)
+        return f
+
+    def map_ordered(self, fn: Callable, items: Iterable,
+                    max_concurrency: Optional[int] = None) -> Iterator:
+        """Results of fn(item) in input order (pool.map analog that keeps
+        the tiered-submission discipline). `max_concurrency` caps this
+        CALLER's in-flight tasks below the tier size — the per-site knobs
+        (shuffle writer/reader threads) still bound how much work one
+        exchange admits, even though the threads are shared."""
+        from collections import deque
+        limit = self.n_threads if max_concurrency is None \
+            else max(1, min(int(max_concurrency), self.n_threads))
+        pending: "deque[Future]" = deque()
+        it = iter(items)
+        for item in it:
+            pending.append(self.submit(fn, item))
+            if len(pending) >= limit:
+                break
+        while pending:
+            f = pending.popleft()
+            try:
+                pending.append(self.submit(fn, next(it)))
+            except StopIteration:
+                pass
+            yield f.result()
+
+    def shutdown(self) -> None:
+        self._tier0.shutdown(wait=True)
+        self._tier1.shutdown(wait=True)
+
+
+def _pool_size(conf) -> int:
+    """The tier size honors every conf that used to size its own pool:
+    multiThreadedRead (scans) and the shuffle writer/reader threads."""
+    from spark_rapids_tpu import config as C
+    c = conf if conf is not None else C.conf()
+    return max(c.get(C.MULTIFILE_READER_THREADS),
+               c.get(C.SHUFFLE_WRITER_THREADS),
+               c.get(C.SHUFFLE_READER_THREADS))
+
+
+def get_host_pool(conf=None) -> HostTaskPool:
+    """The process-wide pool, created on first use (the first caller's
+    conf wins, exactly like the reference's getOrCreateThreadPool)."""
+    global _POOL
+    with _LOCK:
+        if _POOL is None:
+            _POOL = HostTaskPool(_pool_size(conf))
+        return _POOL
+
+
+def reset_host_pool() -> None:
+    """Test hook: drop the shared pool so the next user re-sizes it."""
+    global _POOL
+    with _LOCK:
+        pool, _POOL = _POOL, None
+    if pool is not None:
+        pool.shutdown()

@@ -50,6 +50,11 @@ PARTITION_DISPATCHES = "partitionDispatches"
 #: fetches the n_out+1 offsets vector ONCE, 'masked' defers one lazy row
 #: count per sub-batch (n_out syncs when they materialize)
 PARTITION_HOST_FETCHES = "partitionHostFetches"
+#: fused-stage entries issued per input batch: a vertically fused pipeline
+#: stage (exec/stage_fusion.py) dispatches exactly ONE composed XLA
+#: computation per batch; the unfused chain pays one per member operator.
+#: Dispatch-budget tests assert stageDispatches == input batch count.
+STAGE_DISPATCHES = "stageDispatches"
 
 
 class GpuMetric:

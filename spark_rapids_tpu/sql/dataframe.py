@@ -664,8 +664,25 @@ class DataFrame:
         return int(out.column(0)[0].as_py())
 
     def explain(self, mode: str = "placement") -> str:
-        from spark_rapids_tpu.plan.overrides import explain_plan
-        s = explain_plan(self.plan, self.session.conf, all_ops=True)
+        """'placement' (default): the tagging report — every operator with
+        its TPU/CPU placement and fallback reasons. 'stages': the physical
+        exec tree after whole-stage vertical fusion, with fusion groups
+        annotated `*(N)` the way Spark prints whole-stage-codegen ids."""
+        if mode == "stages":
+            # build the exec tree WITHOUT convert_plan's action-time side
+            # effects (LORE dumper install would overwrite recordings;
+            # test-mode fallback assertions would raise instead of print)
+            from spark_rapids_tpu.exec.stage_fusion import fuse_stages
+            from spark_rapids_tpu.plan.cost import apply_cost_optimizer
+            from spark_rapids_tpu.plan.overrides import wrap_and_tag
+            from spark_rapids_tpu.plan.prune import prune_plan
+            conf = self.session.conf
+            meta = wrap_and_tag(prune_plan(self.plan), conf)
+            apply_cost_optimizer(meta, conf)
+            s = fuse_stages(meta.convert(), conf).tree_string()
+        else:
+            from spark_rapids_tpu.plan.overrides import explain_plan
+            s = explain_plan(self.plan, self.session.conf, all_ops=True)
         print(s)
         return s
 

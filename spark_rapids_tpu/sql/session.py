@@ -178,12 +178,24 @@ class TpuSession:
         {exec_name#i: {metric: value}} in plan order."""
         out = {}
 
-        def walk(node, idx=[0]):
+        def snap_one(node, idx):
             snap = node.metrics.snapshot()
             key = f"{type(node).__name__}#{idx[0]}"
             idx[0] += 1
             if snap:
                 out[key] = snap
+
+        def walk(node, idx=[0]):
+            snap_one(node, idx)
+            # vertically fused members (FusedStageExec.members / an
+            # aggregate's absorbed pre_chain_members) are not children but
+            # still carry attributed metrics. Their ORIGINAL child links
+            # still point into the collapsed chain, so snapshot the member
+            # alone — recursing would re-walk shared subtrees.
+            for m in (getattr(node, "members", None) or []):
+                snap_one(m, idx)
+            for m in (getattr(node, "pre_chain_members", None) or []):
+                snap_one(m, idx)
             for c in node.children:
                 walk(c, idx)
 

@@ -1,0 +1,575 @@
+"""Whole-stage vertical fusion (spark.rapids.sql.stageFusion.enabled).
+
+Dispatch-budget regression tests (extending PR 1's partitionDispatches
+counters with the fuse-layer dispatch hook): a fused Filter→Project→
+partial-HashAggregate chain must issue exactly ONE device dispatch per
+input batch, and fused results must be identical to the unfused chain
+across ANSI on/off, masked batches, and empty batches. Plus the satellite
+regressions riding this PR (process-wide host pool, CoalesceBatchesExec
+metrics).
+"""
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import to_arrow
+from spark_rapids_tpu.expr.core import SparkException, col, lit
+from spark_rapids_tpu.plan import nodes as P
+from spark_rapids_tpu.plan.overrides import convert_plan
+from spark_rapids_tpu.exec import fuse
+from spark_rapids_tpu.exec import tpu_nodes as X
+from spark_rapids_tpu.exec.stage_fusion import fuse_stages, fused_stage_cls
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime.task import TaskContext
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSession
+
+from asserts import assert_tpu_and_cpu_are_equal_collect
+from data_gen import (
+    DoubleGen, IntegerGen, LongGen, RepeatSeqGen, StringGen, gen_df,
+)
+
+
+FusedStageExec = fused_stage_cls()
+
+_SPEC = [("k", RepeatSeqGen(IntegerGen(min_val=0, max_val=40), length=30)),
+         ("v", LongGen(min_val=-(1 << 40), max_val=1 << 40)),
+         ("d", DoubleGen()),
+         ("s", StringGen())]
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def _drain(ex, names):
+    parts = []
+    for p in range(ex.num_partitions):
+        with TaskContext(partition_id=p) as ctx:
+            for b in ex.execute_partition(ctx, p):
+                parts.extend(to_arrow(b, names).to_pylist())
+    return parts
+
+
+def _eq(a, b):
+    if isinstance(a, float) and isinstance(b, float):
+        return (math.isnan(a) and math.isnan(b)) or a == b
+    if isinstance(a, (list, tuple)):
+        return len(a) == len(b) and all(_eq(x, y) for x, y in zip(a, b))
+    if isinstance(a, dict):
+        return set(a) == set(b) and all(_eq(a[k], b[k]) for k in a)
+    return a == b
+
+
+class _DispatchCounter:
+    """Counts device dispatches through the fuse layer (fused() entries +
+    compiled.run_stage), the budget the fusion pass minimizes."""
+
+    def __init__(self):
+        self.keys = []
+
+    def __enter__(self):
+        fuse.set_dispatch_hook(self.keys.append)
+        return self
+
+    def __exit__(self, *exc):
+        fuse.set_dispatch_hook(None)
+        return False
+
+    @property
+    def count(self):
+        return len(self.keys)
+
+
+def _chain_df(s, length=1800, parts=3, masked=False):
+    df = gen_df(s, _SPEC, length=length, seed=11, num_partitions=parts)
+    if masked:
+        # a leading filter makes every chain input a selection-mask batch
+        df = df.filter(col("s").is_not_null())
+    return (df.filter(col("v").is_not_null() & (col("v") > lit(0)))
+            .select(col("k"), (col("v") % lit(1000)).alias("m"),
+                    (col("d") * lit(2.0)).alias("dd")))
+
+
+# ---------------------------------------------------------------------------
+# Plan shape
+# ---------------------------------------------------------------------------
+
+def test_chain_collapses_to_fused_stage(session):
+    df = _chain_df(session)
+    root, _ = convert_plan(df.plan, session.conf)
+    assert isinstance(root, FusedStageExec)
+    kinds = [type(m).__name__ for m in root.members]
+    assert kinds == ["FilterExec", "ProjectExec"]  # child-most first
+
+
+def test_fusion_disabled_keeps_chain():
+    s = TpuSession({"spark.rapids.sql.stageFusion.enabled": "false"})
+    df = _chain_df(s)
+    root, _ = convert_plan(df.plan, s.conf)
+    assert isinstance(root, X.ProjectExec)
+    assert isinstance(root.children[0], X.FilterExec)
+
+
+def test_single_dispatching_op_not_fused(session):
+    df = gen_df(session, _SPEC, length=300, seed=3).filter(col("v") > lit(0))
+    root, _ = convert_plan(df.plan, session.conf)
+    assert isinstance(root, X.FilterExec)  # one op = already one dispatch
+
+
+def test_explain_stages_prints_fusion_groups(session, capsys):
+    df = _chain_df(session)
+    s = df.explain(mode="stages")
+    capsys.readouterr()
+    assert "*(1)" in s and "FusedStageExec" in s and "[fused]" in s
+
+
+# ---------------------------------------------------------------------------
+# Dispatch budget
+# ---------------------------------------------------------------------------
+
+def _partial_agg_chain(s, n_rows=3000, parts=3):
+    """Filter→Project→partial-HashAggregate over a NON-packable (float)
+    group key, built the way the multi-device planner shapes it."""
+    rng = np.random.default_rng(5)
+    t = pa.table({
+        "g": rng.uniform(0, 6, n_rows).round(0),
+        "v": rng.integers(-1000, 1000, n_rows),
+        "d": rng.uniform(-10, 10, n_rows),
+    })
+    df = (s.create_dataframe(t, num_partitions=parts)
+          .filter(col("v") > lit(-500))
+          .select(col("g"), (col("v") * lit(3)).alias("v3"), col("d"))
+          .group_by(col("g")).agg(F.sum("v3").alias("sv"),
+                                  F.count().alias("n"),
+                                  F.min("d").alias("md")))
+    node = df.plan
+    while not isinstance(node, P.Aggregate):
+        node = node.children[0]
+    child, _ = convert_plan(node.children[0], s.conf)
+    agg = X.HashAggregateExec(node, [child], s.conf, mode="partial")
+    return fuse_stages(agg, s.conf), df
+
+
+def test_partial_agg_chain_absorbed_one_dispatch_per_batch(session):
+    root, _ = _partial_agg_chain(session)
+    assert root.pre_chain is not None
+    assert [type(m).__name__ for m in root.pre_chain_members] == \
+        ["FilterExec", "ProjectExec"]
+    with _DispatchCounter() as dc:
+        rows = _drain(root, [f.name for f in root.state_fields()])
+    assert rows
+    # THE acceptance assertion: one input batch per source partition, ONE
+    # composed dispatch each — nothing else touches the device
+    assert dc.count == root.num_partitions
+    assert all(k[0] == "hashagg_chain_update" for k in dc.keys)
+    assert root.metrics.metric(M.STAGE_DISPATCHES).value == \
+        root.num_partitions
+
+
+def test_fused_stage_one_dispatch_per_batch(session):
+    df = _chain_df(session)
+    root, _ = convert_plan(df.plan, session.conf)
+    assert isinstance(root, FusedStageExec)
+    with _DispatchCounter() as dc:
+        rows = _drain(root, ["k", "m", "dd"])
+    assert rows
+    assert dc.count == root.num_partitions  # one batch per partition
+    assert all(k[0] == "fused_stage" for k in dc.keys)
+    assert root.metrics.metric(M.STAGE_DISPATCHES).value == \
+        root.num_partitions
+    # per-member attribution: filter rows >= project rows == stage output
+    fil, prj = root.members
+    assert prj.metrics.metric(M.NUM_OUTPUT_ROWS).value == len(rows)
+    assert fil.metrics.metric(M.NUM_OUTPUT_ROWS).value == len(rows)
+
+
+def test_unfused_chain_pays_one_dispatch_per_op():
+    s = TpuSession({"spark.rapids.sql.stageFusion.enabled": "false"})
+    df = _chain_df(s)
+    root, _ = convert_plan(df.plan, s.conf)
+    with _DispatchCounter() as dc:
+        _drain(root, ["k", "m", "dd"])
+    assert dc.count == 2 * root.num_partitions  # filter + project per batch
+
+
+# ---------------------------------------------------------------------------
+# Result parity fused vs unfused
+# ---------------------------------------------------------------------------
+
+def _run_query(build, conf):
+    s = TpuSession(conf)
+    return build(s).collect().to_pylist()
+
+
+@pytest.mark.parametrize("masked", [False, True])
+@pytest.mark.parametrize("ansi", ["false", "true"])
+def test_chain_parity_fused_vs_unfused(ansi, masked):
+    res = {}
+    for flag in ("true", "false"):
+        res[flag] = _run_query(
+            lambda s: _chain_df(s, masked=masked),
+            {"spark.rapids.sql.stageFusion.enabled": flag,
+             "spark.sql.ansi.enabled": ansi})
+    assert _eq(res["true"], res["false"])
+
+
+@pytest.mark.parametrize("masked", [False, True])
+@pytest.mark.parametrize("ansi", ["false", "true"])
+def test_agg_chain_parity_fused_vs_unfused(ansi, masked):
+    def build(s):
+        df = gen_df(s, _SPEC, length=2200, seed=23, num_partitions=3)
+        if masked:
+            df = df.filter(col("s").is_not_null())
+        return (df.filter(col("v").is_not_null())
+                .select((col("d") * lit(1.5)).alias("g"),
+                        (col("v") % lit(97)).alias("m"))
+                .group_by(col("g")).agg(F.sum("m").alias("sm"),
+                                        F.count().alias("n")))
+
+    res = {}
+    for flag in ("true", "false"):
+        got = _run_query(build, {
+            "spark.rapids.sql.stageFusion.enabled": flag,
+            "spark.sql.ansi.enabled": ansi})
+        res[flag] = sorted(
+            got, key=lambda r: (r["g"] is None,
+                                r["g"] if r["g"] is not None
+                                and not math.isnan(r["g"]) else 1e308))
+    assert _eq(res["true"], res["false"])
+
+
+def test_empty_batches_parity():
+    res = {}
+    for flag in ("true", "false"):
+        res[flag] = _run_query(
+            lambda s: _chain_df(s).filter(col("m") > lit(10 ** 9)),
+            {"spark.rapids.sql.stageFusion.enabled": flag})
+    assert res["true"] == res["false"] == []
+
+
+def test_empty_source_parity():
+    t = pa.table({"k": pa.array([], pa.int64()),
+                  "v": pa.array([], pa.int64())})
+    res = {}
+    for flag in ("true", "false"):
+        s = TpuSession({"spark.rapids.sql.stageFusion.enabled": flag})
+        res[flag] = (s.create_dataframe(t)
+                     .filter(col("v") > lit(0))
+                     .select((col("k") + lit(1)).alias("k1"),
+                             (col("v") * lit(2)).alias("v2"))
+                     .collect().to_pylist())
+    assert res["true"] == res["false"] == []
+
+
+def test_ansi_error_still_raises_through_fused_stage():
+    s = TpuSession({"spark.sql.ansi.enabled": "true"})
+    df = (s.create_dataframe({"a": [1, 2, 3], "b": [1, 0, 2]})
+          .filter(col("a") > lit(0))
+          .select((col("a") / col("b")).alias("q"),
+                  (col("a") + lit(1)).alias("a1"))
+          .filter(col("a1") > lit(0)))
+    root, _ = convert_plan(df.plan, s.conf)
+    assert isinstance(root, FusedStageExec)
+    with pytest.raises(SparkException):
+        df.collect()
+
+
+def test_row_base_carry_threads_through_fused_stage():
+    """monotonically_increasing_id needs the row_base carry: fused and
+    unfused chains must assign the same ids across batches."""
+    res = {}
+    for flag in ("true", "false"):
+        s = TpuSession({"spark.rapids.sql.stageFusion.enabled": flag,
+                        "spark.rapids.sql.reader.batchSizeRows": "256"})
+        df = (s.create_dataframe(
+            {"v": list(range(2000))}, num_partitions=2)
+            .filter(col("v") % lit(3) > lit(0))
+            .select(col("v"), F.monotonically_increasing_id().alias("id"))
+            .filter(col("v") > lit(10)))
+        res[flag] = sorted(df.collect().to_pylist(),
+                           key=lambda r: r["v"])
+    assert res["true"] == res["false"]
+
+
+def test_limit_in_fused_chain_parity():
+    for flag in ("true", "false"):
+        s = TpuSession({"spark.rapids.sql.stageFusion.enabled": flag})
+        df = (s.create_dataframe({"v": list(range(100))})
+              .filter(col("v") > lit(4))
+              .limit(20)
+              .select((col("v") * lit(2)).alias("w"))
+              .filter(col("w") < lit(40)))
+        got = df.collect().to_pydict()["w"]
+        assert got == [v * 2 for v in range(5, 20)]
+
+
+def test_limit_fused_stage_stops_consuming_input():
+    """A small LIMIT in a fused chain must still early-exit: once the
+    device budget carry hits zero the driver stops pulling batches."""
+    s = TpuSession({"spark.rapids.sql.reader.batchSizeRows": "128"})
+    df = (s.create_dataframe({"v": list(range(4000))})
+          .filter(col("v") >= lit(0))
+          .limit(50)
+          .select((col("v") + lit(1)).alias("w"))
+          .filter(col("w") > lit(0)))
+    root, _ = convert_plan(df.plan, s.conf)
+    assert isinstance(root, FusedStageExec)
+    with _DispatchCounter() as dc:
+        rows = _drain(root, ["w"])
+    assert [r["w"] for r in rows] == list(range(1, 51))
+    # 4000 rows / 128 per batch = 32 batches; the stage must stop after
+    # the first (limit-filling) batch, not drain the input
+    assert dc.count <= 2
+
+
+def test_expand_grouping_sets_parity():
+    """ROLLUP lowers to Expand under an aggregate — the expand body path."""
+    res = {}
+    for flag in ("true", "false"):
+        s = TpuSession({"spark.rapids.sql.stageFusion.enabled": flag})
+        df = gen_df(s, [("a", RepeatSeqGen(IntegerGen(min_val=0, max_val=4),
+                                           length=7)),
+                        ("b", RepeatSeqGen(IntegerGen(min_val=0, max_val=3),
+                                           length=5)),
+                        ("v", LongGen(min_val=0, max_val=1000))],
+                    length=600, seed=41, num_partitions=2)
+        got = (df.rollup(col("a"), col("b"))
+               .agg(F.sum("v").alias("sv"), F.count().alias("n"))
+               .collect().to_pylist())
+        res[flag] = sorted(
+            got, key=lambda r: (r["a"] is None, r["a"] or 0,
+                                r["b"] is None, r["b"] or 0))
+    assert _eq(res["true"], res["false"])
+
+
+def test_expand_absorbed_into_agg_one_dispatch_per_batch(session):
+    """ROLLUP over a float key: the Expand body fuses into the (general-
+    path) aggregate update — one dispatch per input batch."""
+    rng = np.random.default_rng(13)
+    n = 2000
+    t = pa.table({"g": rng.uniform(0, 5, n).round(0),
+                  "v": rng.integers(0, 100, n)})
+    df = (session.create_dataframe(t, num_partitions=2)
+          .rollup(col("g")).agg(F.sum("v").alias("sv"),
+                                F.count().alias("n")))
+    node = df.plan
+    while not isinstance(node, P.Aggregate):
+        node = node.children[0]
+    child, _ = convert_plan(node.children[0], session.conf)
+    assert isinstance(child, X.ExpandExec)
+    agg = X.HashAggregateExec(node, [child], session.conf, mode="partial")
+    root = fuse_stages(agg, session.conf)
+    assert "ExpandExec" in [type(m).__name__
+                            for m in root.pre_chain_members]
+    with _DispatchCounter() as dc:
+        rows = _drain(root, [f.name for f in root.state_fields()])
+    assert dc.count == root.num_partitions
+    # every live input row appears once per rollup projection
+    total = sum(1 for _ in rows)
+    assert total >= 2  # grouped states, not raw rows
+    # parity against the full unfused query
+    res = {}
+    for flag in ("true", "false"):
+        s = TpuSession({"spark.rapids.sql.stageFusion.enabled": flag})
+        got = (s.create_dataframe(t, num_partitions=2)
+               .rollup(col("g")).agg(F.sum("v").alias("sv"),
+                                     F.count().alias("n"))
+               .collect().to_pylist())
+        res[flag] = sorted(got, key=lambda r: (r["g"] is None, r["g"] or 0))
+    assert _eq(res["true"], res["false"])
+
+
+def test_expand_over_masked_input_parity():
+    """Filter→Expand absorbed into the aggregate: live rows of the masked
+    filter output sit past the live count, and the expand body must not
+    null them (regression: validity defaulted to arange<num_rows)."""
+    rng = np.random.default_rng(29)
+    n = 1500
+    t = pa.table({"g": rng.uniform(0, 5, n).round(0),
+                  "b": rng.integers(0, 3, n),
+                  "v": rng.integers(0, 100, n)})
+    res = {}
+    for flag in ("true", "false"):
+        s = TpuSession({"spark.rapids.sql.stageFusion.enabled": flag})
+        got = (s.create_dataframe(t, num_partitions=2)
+               .filter(col("v") % lit(7) > lit(1))  # masked batches
+               .rollup(col("g"), col("b"))
+               .agg(F.sum("v").alias("sv"), F.count().alias("n"))
+               .collect().to_pylist())
+        res[flag] = sorted(
+            got, key=lambda r: (r["g"] is None, r["g"] or 0,
+                                r["b"] is None, r["b"] or 0))
+    assert _eq(res["true"], res["false"])
+
+
+def test_last_metrics_no_duplicate_subtrees(session):
+    """Fused members are snapshotted once, without re-walking the shared
+    chain/input subtrees through their stale children links."""
+    df = _chain_df(session)
+    df.session = session
+    session.collect(df.plan)
+    keys = list(session.last_metrics().keys())
+    scans = [k for k in keys if k.startswith("InMemoryScanExec")]
+    assert len(scans) <= 1
+    fused = [k for k in keys if k.startswith("FusedStageExec")]
+    assert len(fused) == 1
+
+
+def test_absorbed_chain_trace_failure_falls_back(session, monkeypatch):
+    root, df = _partial_agg_chain(session)
+    assert root.pre_chain is not None
+    root._chain_key = lambda ansi: ("hashagg_chain_broken_test", ansi)
+
+    def broken(ansi):
+        def build():
+            def fn(batch, pid):
+                raise RuntimeError("synthetic trace failure")
+            return fn
+        return build
+
+    monkeypatch.setattr(root, "_build_chain_update", broken)
+    rows = _drain(root, [f.name for f in root.state_fields()])
+    assert root._chain_failed
+    assert rows  # unfused chain + plain update produced the partials
+    # a fresh, unbroken exec over the same plan agrees
+    ref_root, _ = _partial_agg_chain(TpuSession(
+        {"spark.rapids.sql.stageFusion.enabled": "false"}))
+    want = _drain(ref_root, [f.name for f in ref_root.state_fields()])
+    key = ref_root.plan.group_names[0]
+    srt = lambda rs: sorted(  # noqa: E731
+        rs, key=lambda r: (r[key] is None, r[key] or 0))
+    assert _eq(srt(rows), srt(want))
+
+
+def test_trace_failure_falls_back_to_unfused(session, monkeypatch):
+    df = _chain_df(session)
+    root, _ = convert_plan(df.plan, session.conf)
+    assert isinstance(root, FusedStageExec)
+    root._key = ("fused_stage_broken_test", root._key)
+
+    def broken_build():
+        def fn(batch, pid, carries):
+            raise RuntimeError("synthetic trace failure")
+        return fn
+
+    monkeypatch.setattr(root, "_build", lambda: broken_build)
+    got = _drain(root, ["k", "m", "dd"])
+    assert root._failed
+    s2 = TpuSession({"spark.rapids.sql.stageFusion.enabled": "false"})
+    want = _run_query(lambda s: _chain_df(s2),
+                      {"spark.rapids.sql.stageFusion.enabled": "false"})
+    assert _eq(got, want)
+
+
+def test_differential_group_by_under_fusion():
+    for flag in ("true", "false"):
+        s = TpuSession({"spark.rapids.sql.stageFusion.enabled": flag})
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda ss: gen_df(ss, _SPEC, length=1500, seed=67,
+                              num_partitions=3)
+            .filter(col("v").is_not_null())
+            .select(col("k"), (col("v") % lit(50)).alias("m"))
+            .group_by(col("k")).agg(F.sum("m").alias("sm"),
+                                    F.count().alias("n")),
+            s, ignore_order=True)
+
+
+# ---------------------------------------------------------------------------
+# Satellites: host pool + coalesce metrics
+# ---------------------------------------------------------------------------
+
+def test_host_pool_is_process_wide_and_bounded():
+    from spark_rapids_tpu.runtime.host_pool import (
+        get_host_pool, reset_host_pool,
+    )
+    reset_host_pool()
+    try:
+        s = TpuSession()
+        pool = get_host_pool(s.conf)
+        assert pool is get_host_pool()  # one shared instance
+        assert pool.n_threads == s.conf.get(C.MULTIFILE_READER_THREADS)
+        assert list(pool.map_ordered(lambda x: x * x, range(8))) == \
+            [x * x for x in range(8)]
+
+        def nested(x):
+            # a worker submitting to its own pool must not deadlock
+            return sum(pool.map_ordered(lambda y: y + x, range(4)))
+
+        assert list(pool.map_ordered(nested, range(32))) == \
+            [sum(y + x for y in range(4)) for x in range(32)]
+    finally:
+        reset_host_pool()
+
+
+def test_prefetched_uses_host_pool(tmp_path):
+    """Parquet scans prefetch on the shared pool — no throwaway executors
+    (thread names carry the pool prefix)."""
+    import threading
+    from spark_rapids_tpu.runtime.host_pool import reset_host_pool
+    reset_host_pool()
+    try:
+        import pyarrow.parquet as pq
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(pa.table({"a": list(range(5000))}), path,
+                       row_group_size=500)
+        s = TpuSession()
+        got = s.read_parquet(path).collect()
+        assert got.num_rows == 5000
+        names = {t.name for t in threading.enumerate()}
+        assert any(n.startswith("rapids-host-pool") for n in names)
+    finally:
+        reset_host_pool()
+
+
+def test_exchange_uses_host_pool_and_matches():
+    from spark_rapids_tpu.plan.nodes import bind_expr
+    s = TpuSession()
+    df = gen_df(s, _SPEC, length=1200, seed=31, num_partitions=4)
+    child, _ = convert_plan(df.plan, s.conf)
+    ex = X.ShuffleExchangeExec(df.plan, [child], s.conf,
+                               [bind_expr(col("k"), df.plan.schema)],
+                               n_out=4)
+    parts = [_drain_one(ex, p, list(df.plan.schema.names))
+             for p in range(4)]
+    assert sum(len(p) for p in parts) == 1200
+
+
+def _drain_one(ex, p, names):
+    rows = []
+    with TaskContext(partition_id=p) as ctx:
+        for b in ex.execute_partition(ctx, p):
+            rows.extend(to_arrow(b, names).to_pylist())
+    return rows
+
+
+def test_coalesce_batches_counts_outputs(session):
+    df = gen_df(session, _SPEC, length=900, seed=7, num_partitions=1)
+    child, _ = convert_plan(df.plan, session.conf)
+    co = X.CoalesceBatchesExec(df.plan, [child], session.conf)
+    n_out = 0
+    with TaskContext(partition_id=0) as ctx:
+        for _ in co.execute_partition(ctx, 0):
+            n_out += 1
+    assert co.metrics.metric(M.NUM_OUTPUT_BATCHES).value == n_out
+    assert co.metrics.metric(M.NUM_INPUT_BATCHES).value >= n_out
+
+
+def test_coalesce_single_batch_skips_semaphore(session):
+    """len(pending) == 1 short-circuits: no concat kernel, no semaphore
+    acquire, and the metrics still record the passthrough output."""
+    df = gen_df(session, _SPEC, length=100, seed=9, num_partitions=1)
+    child, _ = convert_plan(df.plan, session.conf)
+    co = X.CoalesceBatchesExec(df.plan, [child], session.conf)
+    acquired = []
+    co._acquire = lambda ctx: acquired.append(1)
+    with TaskContext(partition_id=0) as ctx:
+        out = list(co.execute_partition(ctx, 0))
+    assert len(out) == 1
+    assert not acquired
+    assert co.metrics.metric(M.NUM_OUTPUT_BATCHES).value == 1
+    assert co.metrics.metric(M.CONCAT_TIME).value == 0

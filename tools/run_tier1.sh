@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Tier-1 verify wrapper (the ROADMAP.md command, verbatim semantics):
+# CPU-backend pytest over the non-slow suite, with a DOTS_PASSED count so
+# CI and sessions can diff pass counts against the seed.
+#
+# Usage: tools/run_tier1.sh [extra pytest args...]
+set -o pipefail
+cd "$(dirname "$0")/.."
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly "$@" 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+# character class is the ROADMAP one plus 'X' (xpassed) — an xpass in a
+# progress line must not drop the whole line's dots from the count
+echo "DOTS_PASSED=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"
