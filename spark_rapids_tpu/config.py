@@ -124,6 +124,37 @@ PROFILE_DIR = conf_str(
     "this directory (XProf/TensorBoard-viewable; the reference's "
     "CUPTI-based Profiler + NVTX analog).")
 
+TRACE_ENABLED = conf_bool(
+    "spark.rapids.sql.trace.enabled", False,
+    "Record a structured trace per query: spans for every exec's device "
+    "work (tied to the same GpuMetric timers the SQL metrics use — one "
+    "instrumentation point), instant events for semaphore/spill/retry/"
+    "host-pool/fused-dispatch activity, and a per-task accumulator event "
+    "log, written as Chrome-trace-event JSON plus JSONL under "
+    "spark.rapids.sql.trace.path and aggregated offline by "
+    "tools/profiler_report.py (reference NvtxWithMetrics + "
+    "ProfilerOnExecutor). Off by default; the disabled path costs one "
+    "branch per span.", commonly_used=True)
+
+TRACE_PATH = conf_str(
+    "spark.rapids.sql.trace.path", "/tmp/rapids_tpu_trace",
+    "Directory receiving per-query trace artifacts "
+    "(query_<n>_trace.json / _events.jsonl / _metrics.json) when "
+    "spark.rapids.sql.trace.enabled is set (reference "
+    "spark.rapids.profile pathPrefix).")
+
+TRACE_LEVEL = conf_str(
+    "spark.rapids.sql.trace.level", "MODERATE",
+    "Trace verbosity, reusing the metric levels: ESSENTIAL (exec spans + "
+    "task rollups), MODERATE (+ semaphore/spill/retry/dispatch instants), "
+    "DEBUG (+ host-pool queueing, shuffle serde, per-stage internals).")
+
+TRACE_TASK_METRICS = conf_bool(
+    "spark.rapids.sql.trace.taskMetrics", True,
+    "Roll per-task accumulators (retry count/time, spill bytes/time, "
+    "semaphore wait, max device bytes held — the GpuTaskMetrics analog) "
+    "into the per-query event log at task completion.")
+
 LORE_DUMP_DIR = conf_str(
     "spark.rapids.sql.lore.dumpPath", "",
     "When set, every exec's input batches dump as parquet under "
@@ -198,10 +229,13 @@ SHUFFLE_READER_THREADS = conf_int(
     "Threads in the executor-wide shuffle reader pool.")
 
 SHUFFLE_COMPRESSION = conf_str(
-    "spark.rapids.shuffle.compression.codec", "zstd",
-    "Codec for serialized shuffle tables: none, zstd, zlib "
+    "spark.rapids.shuffle.compression.codec", "auto",
+    "Codec for serialized shuffle tables: auto, none, zstd, zlib "
     "(reference TableCompressionCodec; nvcomp lz4 has no TPU-side analog "
-    "in this environment, zstd plays that role).")
+    "in this environment, zstd plays that role). 'auto' resolves to zstd "
+    "when the zstandard package is importable and zlib (stdlib, always "
+    "present) otherwise; naming zstd explicitly without the package "
+    "fails fast.")
 
 SHUFFLE_HOST_BUDGET = conf_int(
     "spark.rapids.shuffle.hostSpillBudget", 256 << 20,

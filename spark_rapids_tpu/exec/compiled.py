@@ -89,10 +89,15 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
 
     from spark_rapids_tpu.columnar.batch import traced_rows
     from spark_rapids_tpu.exec import fuse
+    from spark_rapids_tpu.runtime import trace as TR
     fuse.notify_dispatch(("run_stage", fp))  # dispatch-budget hook
     col_planes = [_planes_of(c) for c in batch.columns]
-    out_planes, err = fn(col_planes, jnp.asarray(traced_rows(batch.num_rows), jnp.int32),
-                         batch.live_mask())
+    with TR.span("compiled.run_stage", cat="dispatch", level=TR.DEBUG,
+                 args={"exprs": len(exprs)}):
+        out_planes, err = fn(col_planes,
+                             jnp.asarray(traced_rows(batch.num_rows),
+                                         jnp.int32),
+                             batch.live_mask())
     raise_errors(err)
     outs = [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
     carry_bounds(exprs, batch.columns, outs)

@@ -202,9 +202,10 @@ def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
     if isinstance(plan, P.InMemorySource):
         return table_to_cols(plan.table)
     if isinstance(plan, P.ParquetScan):
-        import pyarrow.parquet as pq
+        from spark_rapids_tpu.io import read_parquet_file
         tables = [plan.with_partition_cols(
-            pq.read_table(p, columns=getattr(plan, "file_columns", plan.columns)), i)
+            read_parquet_file(p, getattr(plan, "file_columns",
+                                         plan.columns)), i)
             for i, p in enumerate(plan.paths)]
         table = pa.concat_tables(tables, promote_options="permissive") \
             if len(tables) > 1 else tables[0]

@@ -48,6 +48,7 @@ from spark_rapids_tpu import config as C
 from spark_rapids_tpu.columnar.batch import LazyRowCount
 from spark_rapids_tpu.exec import compiled, fuse
 from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import trace as TR
 
 log = logging.getLogger("spark_rapids_tpu")
 
@@ -169,6 +170,7 @@ def make_fused_stage_exec():
                     self.children[0]).execute_partition(ctx, pidx)
                 return
             out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+            in_batches = self.metrics.metric(M.NUM_INPUT_BATCHES)
             disp = self.metrics.metric(M.STAGE_DISPATCHES)
             # opTime attribution: the dispatch time splits EVENLY across
             # members (the stage records only dispatch/row metrics itself,
@@ -185,6 +187,7 @@ def make_fused_stage_exec():
             first = True
             for batch in it:
                 self._acquire(ctx)
+                in_batches.add(1)
                 t0 = time.perf_counter_ns()
                 try:
                     out, errs_all, carries, rows = fn(batch, pid, carries)
@@ -214,6 +217,16 @@ def make_fused_stage_exec():
                     return
                 first = False
                 dt = time.perf_counter_ns() - t0
+                if TR.active() is not None:
+                    # the stage owns the timing (dt also splits across
+                    # member opTime below), so the trace event is emitted
+                    # from the already-measured interval instead of a
+                    # metric_span; gated so name() never builds when off
+                    TR.emit_span(self.name(), t0, dt, cat="exec", args={
+                        "stage_id": self.stage_id,
+                        "members": len(self.members)})
+                    TR.instant("stageDispatch", cat="dispatch",
+                               args={"stage_id": self.stage_id})
                 for errs in errs_all:
                     compiled.raise_errors(errs)
                 disp.add(1)

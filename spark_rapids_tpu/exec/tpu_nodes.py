@@ -43,6 +43,7 @@ from spark_rapids_tpu.ops import radix as R
 from spark_rapids_tpu.ops import repartition as RP
 from spark_rapids_tpu.plan import nodes as P
 from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import trace as TR
 from spark_rapids_tpu.runtime.semaphore import get_semaphore
 from spark_rapids_tpu.runtime.task import TaskContext
 
@@ -75,6 +76,14 @@ class TpuExec:
         for c in self.children:
             lines.append(c.tree_string(indent + 1))
         return "\n".join(lines)
+
+    def span(self, metric):
+        """Trace span + the paired GpuMetric timer as ONE instrumentation
+        point (the NvtxWithMetrics contract): tracing off returns the
+        metric's own timer; tracing on additionally emits a
+        `ExecName.metricName` complete event on this task's track and
+        forwards the range to jax.profiler.TraceAnnotation."""
+        return TR.exec_span(self, metric)
 
     def _acquire(self, ctx: TaskContext) -> None:
         get_semaphore(self.conf).acquire_if_necessary(ctx)
@@ -112,8 +121,9 @@ class InMemoryScanExec(TpuExec):
             take = min(max_rows, n - off)
             chunk = table.slice(start + off, take)
             self._acquire(ctx)
-            with copy_t.ns():
-                yield from_arrow(chunk)
+            with self.span(copy_t):
+                b = from_arrow(chunk)
+            yield b
             out_rows.add(take)
             off += max(take, 1)
             if n == 0:
@@ -185,7 +195,7 @@ class ParquetScanExec(TpuExec):
         def load(g):
             # one ParquetFile per call: parquet-cpp FileReader is NOT
             # thread-safe and loads run on prefetch workers
-            with decode_t.ns():
+            with self.span(decode_t):
                 f = pq.ParquetFile(path)
                 if g < 0:
                     return f.read(columns=cols)
@@ -202,8 +212,9 @@ class ParquetScanExec(TpuExec):
             while off < tbl.num_rows or (tbl.num_rows == 0 and off == 0):
                 chunk = tbl.slice(off, batch_rows)
                 self._acquire(ctx)
-                with copy_t.ns():
-                    yield from_arrow(chunk)
+                with self.span(copy_t):
+                    b = from_arrow(chunk)
+                yield b
                 out_rows.add(chunk.num_rows)
                 off += max(chunk.num_rows, 1)
                 if tbl.num_rows == 0:
@@ -252,7 +263,7 @@ class TextScanExec(TpuExec):
         decode_t = self.metrics.metric(M.DECODE_TIME)
         copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
         out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
-        with decode_t.ns():
+        with self.span(decode_t):
             table = self.plan.read_host(self.plan.paths[pidx])
         batch_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
         n = table.num_rows
@@ -261,8 +272,9 @@ class TextScanExec(TpuExec):
             take = min(batch_rows, n - off)
             chunk = table.slice(off, take)
             self._acquire(ctx)
-            with copy_t.ns():
-                yield from_arrow(chunk)
+            with self.span(copy_t):
+                b = from_arrow(chunk)
+            yield b
             out_rows.add(take)
             off += max(take, 1)
             if n == 0:
@@ -556,7 +568,7 @@ class ProjectExec(TpuExec):
         pid = jnp.int32(pidx)
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
-            with op_t.ns():
+            with self.span(op_t):
                 out, errs, row_base = fn(batch, pid, row_base)
             compiled.raise_errors(errs)
             compiled.carry_bounds(exprs, batch.columns, out.columns)
@@ -580,7 +592,7 @@ class FilterExec(TpuExec):
         pid = jnp.int32(pidx)
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
-            with op_t.ns():
+            with self.span(op_t):
                 out, errs, carry = fn(batch, pid, carry)
             compiled.raise_errors(errs)
             # column-stat bounds are host metadata (not pytree leaves):
@@ -678,7 +690,7 @@ class ShuffleFileScanExec(TpuExec):
         self._acquire(ctx)
         it = read_partition_batches(self.plan.root, pidx)
         while True:
-            with copy_t.ns():
+            with self.span(copy_t):
                 batch = next(it, None)
             if batch is None:
                 return
@@ -774,7 +786,7 @@ class GenerateExec(TpuExec):
         fn = fuse.fused(key, build)
         for batch in self.children[0].execute_partition(ctx, pidx):
             self._acquire(ctx)
-            with op_t.ns():
+            with self.span(op_t):
                 out = fn(batch)
             out_rows.add(rows_int(out.num_rows))
             yield out
@@ -804,7 +816,7 @@ class CoalesceBatchesExec(TpuExec):
                 # semaphore acquire either
                 return pending[0]
             self._acquire(ctx)
-            with concat_t.ns():
+            with self.span(concat_t):
                 return K.concat_batches(pending)
 
         for batch in self.children[0].execute_partition(ctx, pidx):
@@ -908,7 +920,7 @@ class TopNExec(TpuExec):
         batch = K.concat_batches(batches) if len(batches) > 1 else batches[0]
         n = self.n
         bound = max(4 * n, 4096)
-        with sort_t.ns():
+        with self.span(sort_t):
             if self._fusable and batch.capacity > bound:
                 orders = self.orders
 
@@ -979,15 +991,20 @@ class SortExec(TpuExec):
         self._acquire(ctx)
         total = sum(b.device_memory_size() for b in batches)
         if total > self.conf.get(C.SORT_OOC_BYTES):
-            with sort_t.ns():
-                yield from self._out_of_core(batches)
-            return
+            it = self._out_of_core(batches)
+            while True:
+                with self.span(sort_t):
+                    b = next(it, None)
+                if b is None:
+                    return
+                yield b
         batch = K.concat_batches(batches) if len(batches) > 1 else batches[0]
         if batch.row_mask is not None:
             batch = K.compact_batch(batch)
-        with sort_t.ns():
+        with self.span(sort_t):
             perm = self._sort_perm(batch)
-            yield K.gather_batch(batch, perm, batch.num_rows)
+            out = K.gather_batch(batch, perm, batch.num_rows)
+        yield out
 
     def _sort_perm(self, batch):
         return _sort_perm_for(self.plan.orders, batch)
@@ -2129,16 +2146,18 @@ class WindowExec(TpuExec):
                   pspec.key)
             fnA = fuse.fused(kA, lambda: build_sort_layout(pspec))
             fnB = fuse.fused(kB, lambda: build_apply_fns(pspec))
-            with win_t.ns():
+            with self.span(win_t):
                 lay = fnA(batch, ranges)
-                yield fnB(batch, *lay)
+                out = fnB(batch, *lay)
+            yield out
             return
         if pspec is not None:
             key = ("window_packed", tuple(w.fingerprint() for w in exprs),
                    pspec.key)
             fn = fuse.fused(key, lambda: build_packed(pspec))
-            with win_t.ns():
-                yield fn(batch, ranges)
+            with self.span(win_t):
+                out = fn(batch, ranges)
+            yield out
             return
 
         def build():
@@ -2190,8 +2209,9 @@ class WindowExec(TpuExec):
 
         key = ("window", tuple(w.fingerprint() for w in exprs))
         fn = fuse.fused(key, build)
-        with win_t.ns():
-            yield fn(batch)
+        with self.span(win_t):
+            out = fn(batch)
+        yield out
 
 
 # Module-level (state-free) window kernels: the fused builder closure is
@@ -2473,6 +2493,7 @@ class HashAggregateExec(TpuExec):
             attempt = plain_attempt
             chain_live = False
             chain_in_rows = [None]  # update-phase input rows (device)
+            in_batches = self.metrics.metric(M.NUM_INPUT_BATCHES)
             if self.pre_chain and self._chain_failed:
                 # an earlier partition's composed trace failed: run the
                 # unfused member chain in front of the plain update
@@ -2492,6 +2513,14 @@ class HashAggregateExec(TpuExec):
                     # carry-free by the absorb gate), so retry/split-retry
                     # treat it exactly like a plain update
                     disp.add(1)
+                    if TR.active() is not None:  # args gated when off
+                        TR.instant("stageDispatch", cat="dispatch", args={
+                            "stage_id": self.fused_stage_id,
+                            "absorbed": True,
+                            # chain members + the update phase, composed
+                            # into this ONE dispatch (the report's
+                            # fusion-wins denominator)
+                            "members": len(self.pre_chain_members) + 1})
                     out, errs_list, rows = chain_fn(b, pid)
                     for e in errs_list:
                         compiled.raise_errors(e)
@@ -2526,9 +2555,10 @@ class HashAggregateExec(TpuExec):
                     break
                 bi += 1
                 self._acquire(ctx)
+                in_batches.add(1)
                 n_before = len(partials)
                 try:
-                    with agg_t.ns():
+                    with self.span(agg_t):
                         # update is idempotent over its input batch:
                         # retried after a spill drain, or split in half,
                         # on OOM
@@ -2604,17 +2634,16 @@ class HashAggregateExec(TpuExec):
                     yield K.compact_batch(p)
                 return
             self._acquire(ctx)
-            with agg_t.ns():
+            with self.span(agg_t):
                 merged = self._merge(partials)
                 # no compact at yield: exchanges, downstream aggs, and the
                 # collect boundary consume masked batches natively
                 # (zero-copy mask slices; session compacts on device right
                 # before download), and every compact costs a ~90ms count
                 # sync on the tunneled device
-                if self.mode == "partial":
-                    yield merged
-                else:
-                    yield self._evaluate(merged)
+                if self.mode != "partial":
+                    merged = self._evaluate(merged)
+            yield merged
 
     # -- phase helpers -----------------------------------------------------
 
@@ -2869,7 +2898,7 @@ class ShuffleExchangeExec(ExchangeExec):
     def _repartition(self, child_results):
         mode = self.conf.get(C.SHUFFLE_MODE).upper()
         if mode == "ICI":
-            with self.metrics.metric(M.PARTITION_TIME).ns():
+            with self.span(self.metrics.metric(M.PARTITION_TIME)):
                 out = self._repartition_ici(child_results)
             if out is not None:
                 return out
@@ -2913,7 +2942,7 @@ class ShuffleExchangeExec(ExchangeExec):
         out: List[List[ColumnarBatch]] = [[] for _ in range(n_out)]
         for part in child_results:
             for batch in part:
-                with part_t.ns():
+                with self.span(part_t):
                     self._emit_compact(batch, fn(batch), out)
         return out
 
@@ -2944,7 +2973,7 @@ class ShuffleExchangeExec(ExchangeExec):
                 return p, None  # empty sub-batches never ship
             return p, serde.serialize_batch(b, codec)
 
-        with ser_t.ns():
+        with self.span(ser_t):
             if len(work) > 1 and nthreads > 1:
                 from spark_rapids_tpu.runtime.host_pool import get_host_pool
                 for p, blob in get_host_pool(self.conf).map_ordered(
@@ -3179,7 +3208,7 @@ class ShuffleExchangeExec(ExchangeExec):
         out: List[List[ColumnarBatch]] = [[] for _ in range(self.n_out)]
         for part in child_results:
             for batch in part:
-                with part_t.ns():
+                with self.span(part_t):
                     # mask-sliced sub-batches: the planes are SHARED across
                     # all n_out outputs (zero-copy partitioning); only the
                     # selection masks differ.
@@ -3255,7 +3284,7 @@ class RoundRobinExchangeExec(ExchangeExec):
         out: List[List[ColumnarBatch]] = [[] for _ in range(self.n_out)]
         for part in child_results:
             for batch in part:
-                with part_t.ns():
+                with self.span(part_t):
                     if compact:
                         self._emit_compact(batch, fn(batch), out)
                     else:
@@ -3315,7 +3344,7 @@ class RangeExchangeExec(ExchangeExec):
         per_batch = []   # (batch, planes)
         samples = []     # host tuples
         budget = self.conf.get(C.CPU_RANGE_PARTITION_SAMPLE) * n_out
-        with part_t.ns():
+        with self.span(part_t):
             for part in child_results:
                 for batch in part:
                     planes, live = keyfn(batch)
@@ -3511,8 +3540,9 @@ class _HashJoinBase(TpuExec):
             if table is not None and table.max_dup <= 1:
                 for probe in probe_iter:
                     self._acquire(ctx)
-                    with join_t.ns():
-                        yield self._probe_masked(probe, build, table)
+                    with self.span(join_t):
+                        out = self._probe_masked(probe, build, table)
+                    yield out
                 return
         # sub-partitioning applies to inner/left/semi/anti; right/full track
         # a build-global matched mask that bucket-local indices would
@@ -3522,7 +3552,7 @@ class _HashJoinBase(TpuExec):
         build_parts = self._split_build(build, k) if k > 1 else None
         for probe in probe_iter:
             self._acquire(ctx)
-            with join_t.ns():
+            with self.span(join_t):
                 if build_parts is not None:
                     probe_parts = self._bucket_split(probe, self._hash_keys(0), k)
                     for pp, (bpc, bkeys) in zip(probe_parts, build_parts):
@@ -3766,7 +3796,7 @@ class BroadcastHashJoinExec(_HashJoinBase):
                 build_t = self.metrics.metric(M.BUILD_TIME)
                 right = self.children[1]
                 batches = []
-                with build_t.ns():
+                with self.span(build_t):
                     for p in range(right.num_partitions):
                         with TaskContext(partition_id=p) as tctx:
                             batches.extend(right.execute_partition(tctx, p))
@@ -3904,7 +3934,7 @@ class BroadcastNestedLoopJoinExec(TpuExec):
                 build_t = self.metrics.metric(M.BUILD_TIME)
                 right = self.children[1]
                 batches = []
-                with build_t.ns():
+                with self.span(build_t):
                     for p in range(right.num_partitions):
                         with TaskContext(partition_id=p) as tctx:
                             batches.extend(right.execute_partition(tctx, p))
@@ -3974,7 +4004,7 @@ class BroadcastNestedLoopJoinExec(TpuExec):
             tile_rows = max(1, min(bcap, self.MAX_PAIRS // lcap))
             fn = self._tile_fn(tile_rows, how, ansi)
             lmatched = jnp.zeros(lcap, jnp.bool_)
-            with join_t.ns():
+            with self.span(join_t):
                 for t0 in range(0, max(n_build, 1), tile_rows):
                     if n_build == 0:
                         break
@@ -4046,7 +4076,7 @@ class ShuffledHashJoinExec(_HashJoinBase):
     def execute_partition(self, ctx, pidx):
         join_t = self.metrics.metric(M.JOIN_TIME)
         build_t = self.metrics.metric(M.BUILD_TIME)
-        with build_t.ns():
+        with self.span(build_t):
             batches = list(self.children[1].execute_partition(ctx, pidx))
             if batches:
                 build = K.compact_batch(K.concat_batches(batches))

@@ -4,3 +4,20 @@ Reference parity: SURVEY.md §2.6 — GpuParquetScan/GpuOrcScan/GpuCSVScan
 multi-file reading, ColumnarOutputWriter, io/async/{AsyncOutputStream,
 ThrottlingExecutor,TrafficController}.
 """
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def read_parquet_file(path: str, columns: Optional[Sequence[str]] = None):
+    """Read ONE parquet file with no dataset-level magic. pyarrow >= 13's
+    `pq.read_table(path)` routes through the dataset API, which infers
+    hive partition columns from `k=v` segments anywhere in the path —
+    so a lore dump under `loreId=0/...` grows a phantom `loreId` column
+    and a partition-file read duplicates the partition key the scan
+    appends itself. `ParquetFile.read` is the file-scoped reader."""
+    import pyarrow.parquet as pq
+    # [] is a real projection (zero data columns, e.g. a partition-key-
+    # only select): only None means "all columns"
+    return pq.ParquetFile(path).read(
+        columns=None if columns is None else list(columns))

@@ -22,10 +22,20 @@ class TrafficController:
         self._cv = threading.Condition()
 
     def acquire(self, nbytes: int) -> None:
+        import time
+
+        from spark_rapids_tpu.runtime import trace
+        t0 = time.perf_counter_ns()
+        blocked = False
         with self._cv:
             while self._inflight > 0 and self._inflight + nbytes > self.limit:
+                blocked = True
                 self._cv.wait()
             self._inflight += nbytes
+        if blocked:
+            trace.instant("asyncWriteThrottled", cat="io", args={
+                "blocked_ns": time.perf_counter_ns() - t0,
+                "bytes": nbytes})
 
     def release(self, nbytes: int) -> None:
         with self._cv:
@@ -50,8 +60,11 @@ class ThrottlingExecutor:
         self.controller.acquire(nbytes)
 
         def run():
+            from spark_rapids_tpu.runtime import trace
             try:
-                return fn(*args)
+                with trace.span("asyncWrite", cat="io", level=trace.DEBUG,
+                                args={"bytes": nbytes}):
+                    return fn(*args)
             finally:
                 self.controller.release(nbytes)
 

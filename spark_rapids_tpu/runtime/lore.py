@@ -30,9 +30,12 @@ class _DumpedChild:
         self.num_partitions = nparts
 
     def execute_partition(self, ctx, pidx) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.io import read_parquet_file
         for f in sorted(glob.glob(os.path.join(self.path, f"part{pidx}",
                                                "*.parquet"))):
-            yield from_arrow(pq.read_table(f))
+            # file-scoped read: the dataset API would grow a phantom
+            # loreId partition column from the dump path's k=v segment
+            yield from_arrow(read_parquet_file(f))
 
 
 class LoreDumper:
@@ -54,6 +57,10 @@ class LoreDumper:
         d = os.path.join(self.root_dir, f"loreId={lore_id}")
         os.makedirs(d, exist_ok=True)
         with open(os.path.join(d, "plan.txt"), "w") as f:
+            # the id rides in the dump itself (not just the dir name) so a
+            # hot span found in a trace — exec spans carry lore_id in their
+            # args — maps straight to `lore.replay(dir, <loreId>, plan)`
+            f.write(f"loreId={lore_id} exec={type(node).__name__}\n")
             f.write(node.tree_string())
         for i, child in enumerate(node.children):
             self._wrap_child(node, i, child, d)

@@ -38,6 +38,21 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch
 DEVICE, HOST, DISK = "device", "host", "disk"
 
 
+def _record_spill(kind: str, nbytes: int, dur_ns: int,
+                  handle_id: str) -> None:
+    """Spill observability: the spilling TASK's accumulators (GpuTaskMetrics
+    spillToHostTimeNs analog — the spill runs on the thread whose
+    reservation forced it) plus a trace instant event."""
+    from spark_rapids_tpu.runtime import trace
+    from spark_rapids_tpu.runtime.task import TaskContext
+    ctx = TaskContext.peek()
+    if ctx is not None:
+        ctx.metric(kind + "Bytes").add(nbytes)
+        ctx.metric(kind + "Time").add(dur_ns)
+    trace.instant(kind, cat="memory", args={
+        "bytes": nbytes, "dur_ns": dur_ns, "handle": handle_id[:8]})
+
+
 class SpillableHandle:
     """One registered batch. State machine: device -> host -> disk,
     rematerialized back to device on demand (`get`). Priority: larger
@@ -68,6 +83,8 @@ class SpillableHandle:
 
     def spill_to_host(self) -> int:
         """device -> host. Returns bytes freed from the device tier."""
+        import time as _time
+        t0 = _time.perf_counter_ns()
         with self._lock:
             if self._tier != DEVICE or self._closed or self._pinned:
                 return 0
@@ -76,10 +93,14 @@ class SpillableHandle:
             self._treedef = treedef
             self._device = None
             self._tier = HOST
-            return self.size
+        _record_spill("spillToHost", self.size,
+                      _time.perf_counter_ns() - t0, self.handle_id)
+        return self.size
 
     def spill_to_disk(self) -> int:
         """host -> disk. Returns bytes freed from the host tier."""
+        import time as _time
+        t0 = _time.perf_counter_ns()
         with self._lock:
             if self._tier != HOST or self._closed or self._pinned:
                 return 0
@@ -92,7 +113,9 @@ class SpillableHandle:
             self._disk_paths = paths
             self._host = None
             self._tier = DISK
-            return self.size
+        _record_spill("spillToDisk", self.size,
+                      _time.perf_counter_ns() - t0, self.handle_id)
+        return self.size
 
     def get(self) -> ColumnarBatch:
         """Rematerialize on device. NEVER calls into the framework while
@@ -187,6 +210,17 @@ class SpillFramework:
                 import traceback
                 self._origins[h.handle_id] = "".join(
                     traceback.format_stack(limit=8)[:-1])
+        from spark_rapids_tpu.runtime import trace
+        if trace.active() is not None:
+            from spark_rapids_tpu.runtime.task import TaskContext
+            ctx = TaskContext.peek()
+            if ctx is not None:
+                # high-water mark of device bytes registered while this
+                # task ran (GpuTaskMetrics maxDeviceMemoryBytes analog).
+                # Gated: device_bytes_held() sums live handles under the
+                # framework lock — only worth paying when a trace is live
+                ctx.metric("maxDeviceBytesHeld").set_max(
+                    self.device_bytes_held())
         return h
 
     def unregister(self, h: SpillableHandle) -> None:

@@ -84,6 +84,13 @@ class GpuMetric:
             self._value = int(v)
             self._deferred = []
 
+    def set_max(self, v: int) -> None:
+        """High-water-mark semantics (maxDeviceBytesHeld in the task
+        accumulators; reference GpuTaskMetrics maxDeviceMemoryBytes)."""
+        with self._lock:
+            if int(v) > self._value:
+                self._value = int(v)
+
     @property
     def value(self) -> int:
         with self._lock:

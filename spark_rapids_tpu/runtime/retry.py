@@ -116,7 +116,11 @@ def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
                         splittable: bool) -> object:
     """Shared retry loop: injection check, OOM translation, spill drain.
     Raises _Split when the caller should split the input instead."""
+    import time as _time
+
+    from spark_rapids_tpu.runtime import trace
     from spark_rapids_tpu.runtime.memory import get_spill_framework
+    from spark_rapids_tpu.runtime.task import TaskContext
 
     retries = 0
     while True:
@@ -131,9 +135,20 @@ def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
             if not isinstance(e, TpuRetryOOM) and not is_device_oom(e):
                 raise
             retries += 1
+            ctx = TaskContext.peek()
+            if ctx is not None:
+                ctx.metric("retryCount").add(1)
+            trace.instant("retryOOM", cat="retry", args={
+                "attempt": retries, "error": type(e).__name__})
             if retries > max_retries:
                 raise
+            t0 = _time.perf_counter_ns()
             get_spill_framework().drain_all()
+            if ctx is not None:
+                # time spent freeing memory before the re-attempt
+                # (GpuTaskMetrics retryBlockTime analog)
+                ctx.metric("retryBlockTime").add(
+                    _time.perf_counter_ns() - t0)
 
 
 def with_retry(attempt: Callable[[ColumnarBatch], object],
@@ -145,6 +160,9 @@ def with_retry(attempt: Callable[[ColumnarBatch], object],
     (sub-)batch — a split produces several results, which the caller
     treats exactly like extra input batches (the reference's withRetry
     returns an iterator for the same reason)."""
+    from spark_rapids_tpu.runtime import trace
+    from spark_rapids_tpu.runtime.task import TaskContext
+
     stack = [batch]
     while stack:
         b = stack.pop(0)
@@ -152,6 +170,13 @@ def with_retry(attempt: Callable[[ColumnarBatch], object],
             yield _attempt_with_drain(lambda: attempt(b), max_retries,
                                       splittable=True)
         except _Split:
+            ctx = TaskContext.peek()
+            if ctx is not None:
+                ctx.metric("splitAndRetryCount").add(1)
+            if trace.active() is not None:
+                # args gated: int(num_rows) can sync a lazy device count
+                trace.instant("splitAndRetryOOM", cat="retry",
+                              args={"rows": int(b.num_rows)})
             stack = split_policy(b) + stack
 
 

@@ -5,12 +5,22 @@ Limits the number of tasks concurrently touching the device to
 `spark.rapids.sql.concurrentTpuTasks`. Priority follows the reference's
 design: tasks already holding device data (re-acquisition) outrank fresh
 tasks, reducing memory pressure; ties break by task id (older first).
+
+Wakeups are DIRECT HANDOFF, not polling: a release (or an enqueue while
+permits are free) grants permits to eligible head waiters under the lock
+and signals exactly those waiters' events — a waiter blocks on its event
+with no timeout, so the measured semaphoreWaitTime is real contention,
+never a 50 ms poll quantum (the reference PrioritySemaphore's
+condition-signal discipline).
 """
 from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import Dict, Optional
+
+from spark_rapids_tpu.runtime import trace
 
 
 class PrioritySemaphore:
@@ -18,12 +28,20 @@ class PrioritySemaphore:
         self._permits = permits
         self._available = permits
         self._lock = threading.Lock()
-        self._waiters = []  # heap of (-priority, seq, event)
+        self._waiters = []  # heap of [-priority, seq, n, event]
         self._seq = 0
+
+    def _grant_head_locked(self) -> None:
+        """Direct handoff (caller holds the lock): pop head waiters while
+        their permits fit, reserving the permits FOR them before setting
+        their event — the woken thread never re-contends."""
+        while self._waiters and self._available >= self._waiters[0][2]:
+            _, _, n, ev = heapq.heappop(self._waiters)
+            self._available -= n
+            ev.set()
 
     def acquire(self, n: int = 1, priority: int = 0,
                 wait_metric=None) -> None:
-        import time
         t0 = time.perf_counter_ns()
         with self._lock:
             if self._available >= n and not self._waiters:
@@ -31,25 +49,19 @@ class PrioritySemaphore:
                 return
             ev = threading.Event()
             self._seq += 1
-            heapq.heappush(self._waiters, (-priority, self._seq, n, ev))
-        while True:
-            ev.wait(timeout=0.05)
-            with self._lock:
-                if self._waiters and self._waiters[0][3] is ev \
-                        and self._available >= n:
-                    heapq.heappop(self._waiters)
-                    self._available -= n
-                    if wait_metric is not None:
-                        wait_metric.add(time.perf_counter_ns() - t0)
-                    return
-                if ev.is_set():
-                    ev.clear()
+            heapq.heappush(self._waiters, [-priority, self._seq, n, ev])
+            # a higher-priority arrival may jump an ineligible queue, and
+            # permits freed while nobody dispatched must not strand: try
+            # the handoff immediately (possibly granting ourselves)
+            self._grant_head_locked()
+        ev.wait()  # event-driven: set only once our permits are reserved
+        if wait_metric is not None:
+            wait_metric.add(time.perf_counter_ns() - t0)
 
     def release(self, n: int = 1) -> None:
         with self._lock:
             self._available += n
-            if self._waiters:
-                self._waiters[0][3].set()
+            self._grant_head_locked()
 
     @property
     def available(self) -> int:
@@ -71,8 +83,14 @@ class TpuSemaphore:
             if self._held.get(tid):
                 return
         prio = 1 if task_ctx.holds_device_data else 0
+        traced = trace.active() is not None
+        t0 = time.perf_counter_ns() if traced else 0
         self._sem.acquire(1, priority=prio,
                           wait_metric=task_ctx.metric("semaphoreWaitTime"))
+        if traced:  # args gated: no dict/clock work when tracing is off
+            trace.instant("semaphoreAcquire", cat="semaphore", args={
+                "task_id": tid, "priority": prio,
+                "wait_ns": time.perf_counter_ns() - t0})
         with self._lock:
             self._held[tid] = 1
         task_ctx.on_completion(lambda: self.release(task_ctx))
@@ -83,6 +101,9 @@ class TpuSemaphore:
             if not self._held.pop(tid, 0):
                 return
         self._sem.release(1)
+        if trace.active() is not None:
+            trace.instant("semaphoreRelease", cat="semaphore",
+                          args={"task_id": tid})
 
     @property
     def available(self) -> int:

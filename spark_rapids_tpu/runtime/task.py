@@ -7,7 +7,7 @@ RmmSpark keeps for the retry framework.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 from spark_rapids_tpu.runtime.metrics import GpuMetric
 
@@ -18,12 +18,14 @@ class TaskContext:
     _local = threading.local()
 
     def __init__(self, partition_id: int = 0, stage_id: int = 0):
+        import time
         with TaskContext._counter_lock:
             TaskContext._counter += 1
             self.task_id = TaskContext._counter
         self.partition_id = partition_id
         self.stage_id = stage_id
         self.holds_device_data = False
+        self.start_ns = time.perf_counter_ns()
         self._metrics: Dict[str, GpuMetric] = {}
         self._completion: List[Callable[[], None]] = []
         self._failed = False
@@ -44,8 +46,19 @@ class TaskContext:
             except Exception:
                 pass
         self._completion.clear()
+        # roll the task accumulators into the active query trace's event
+        # log AFTER the completion callbacks (the semaphore release hook
+        # runs first, so its final wait total is included)
+        from spark_rapids_tpu.runtime import trace
+        trace.on_task_complete(self)
 
     # -- thread association ------------------------------------------------
+    @staticmethod
+    def peek() -> "Optional[TaskContext]":
+        """The thread's bound context WITHOUT creating one (trace track
+        resolution must not mint phantom tasks on driver/pool threads)."""
+        return getattr(TaskContext._local, "ctx", None)
+
     @staticmethod
     def get() -> "TaskContext":
         ctx = getattr(TaskContext._local, "ctx", None)

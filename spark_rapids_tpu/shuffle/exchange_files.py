@@ -25,7 +25,7 @@ MANIFEST = "manifest.json"
 
 
 def write_exchange(df, root: str, keys: List[str], n_out: int,
-                   codec: str = "zstd") -> None:
+                   codec: str = "auto") -> None:
     """Hash-partition `df` by `keys` (murmur3 pmod, bit-parity with the
     in-process exchange) and write shuffle files + manifest under root."""
     from spark_rapids_tpu.expr.core import col

@@ -39,8 +39,29 @@ CODEC_ZLIB = 2
 _CODEC_NAMES = {"none": CODEC_NONE, "zstd": CODEC_ZSTD, "zlib": CODEC_ZLIB}
 
 
+_AUTO_CODEC: Optional[str] = None
+
+
+def _resolve_auto() -> str:
+    """'auto' -> zstd when its package exists, else stdlib zlib. Probed
+    ONCE: a failed import is not negatively cached by Python and costs
+    ~0.8 ms, which the per-batch serialize hot path must not repay."""
+    global _AUTO_CODEC
+    if _AUTO_CODEC is None:
+        try:
+            import zstandard  # noqa: F401
+            _AUTO_CODEC = "zstd"
+        except ImportError:
+            _AUTO_CODEC = "zlib"
+    return _AUTO_CODEC
+
+
 def codec_id(name: str) -> int:
     key = (name or "none").lower()
+    if key == "auto":
+        # best available (an explicit 'zstd' below still fails fast when
+        # the package is absent)
+        key = _resolve_auto()
     if key == "lz4":
         # lz4 is not in this environment; zstd covers the same role
         raise ValueError(
@@ -348,48 +369,58 @@ def _unpack_frame(data: bytes, verify: bool = True
 # public API
 # ---------------------------------------------------------------------------
 
-def serialize_batch(batch: ColumnarBatch, codec: str = "zstd") -> bytes:
+def serialize_batch(batch: ColumnarBatch, codec: str = "auto") -> bytes:
     """Device batch -> wire bytes. Masked batches are compacted first (dead
     rows never ship)."""
     from spark_rapids_tpu.ops import kernels as K
     from spark_rapids_tpu.columnar.batch import fetch_batch_host
-    if batch.row_mask is not None:
-        batch = K.compact_batch(batch)
-    host = fetch_batch_host(batch)
-    n = int(host.num_rows)
-    planes: List[np.ndarray] = []
-    cols = [_describe_column(c, n, planes) for c in host.columns]
-    meta = json.dumps({"n": n, "cols": cols}).encode()
-    frame = _pack_frame(meta, planes)
-    cid = codec_id(codec)
-    if cid == CODEC_ZSTD:
-        import zstandard
-        payload = zstandard.ZstdCompressor(level=1).compress(frame)
-    elif cid == CODEC_ZLIB:
-        import zlib
-        payload = zlib.compress(frame, 1)
-    else:
-        payload = frame
-    return bytes([cid]) + payload
+    from spark_rapids_tpu.runtime import trace as TR
+    with TR.span("shuffle.serialize", cat="shuffle",
+                 level=TR.DEBUG) as sp:
+        if batch.row_mask is not None:
+            batch = K.compact_batch(batch)
+        host = fetch_batch_host(batch)
+        n = int(host.num_rows)
+        planes: List[np.ndarray] = []
+        cols = [_describe_column(c, n, planes) for c in host.columns]
+        meta = json.dumps({"n": n, "cols": cols}).encode()
+        frame = _pack_frame(meta, planes)
+        cid = codec_id(codec)
+        if cid == CODEC_ZSTD:
+            import zstandard
+            payload = zstandard.ZstdCompressor(level=1).compress(frame)
+        elif cid == CODEC_ZLIB:
+            import zlib
+            payload = zlib.compress(frame, 1)
+        else:
+            payload = frame
+        out = bytes([cid]) + payload
+        if sp is not None:
+            sp.args.update(rows=n, frame_bytes=len(frame),
+                           wire_bytes=len(out))
+        return out
 
 
 def deserialize_batch(data: bytes, verify: bool = True) -> ColumnarBatch:
     """Wire bytes -> device batch (planes re-padded to capacity buckets)."""
-    cid = data[0]
-    payload = data[1:]
-    if cid == CODEC_ZSTD:
-        import zstandard
-        frame = zstandard.ZstdDecompressor().decompress(payload)
-    elif cid == CODEC_ZLIB:
-        import zlib
-        frame = zlib.decompress(payload)
-    elif cid == CODEC_NONE:
-        frame = payload
-    else:
-        raise ValueError(f"unknown codec id {cid}")
-    meta, bufs = _unpack_frame(frame, verify=verify)
-    desc = json.loads(meta.decode())
-    n = desc["n"]
-    cap = round_capacity(max(n, 1))
-    cols = [_rebuild_column(d, bufs, n, cap) for d in desc["cols"]]
-    return ColumnarBatch(cols, n)
+    from spark_rapids_tpu.runtime import trace as TR
+    with TR.span("shuffle.deserialize", cat="shuffle", level=TR.DEBUG,
+                 args={"wire_bytes": len(data)}):
+        cid = data[0]
+        payload = data[1:]
+        if cid == CODEC_ZSTD:
+            import zstandard
+            frame = zstandard.ZstdDecompressor().decompress(payload)
+        elif cid == CODEC_ZLIB:
+            import zlib
+            frame = zlib.decompress(payload)
+        elif cid == CODEC_NONE:
+            frame = payload
+        else:
+            raise ValueError(f"unknown codec id {cid}")
+        meta, bufs = _unpack_frame(frame, verify=verify)
+        desc = json.loads(meta.decode())
+        n = desc["n"]
+        cap = round_capacity(max(n, 1))
+        cols = [_rebuild_column(d, bufs, n, cap) for d in desc["cols"]]
+        return ColumnarBatch(cols, n)

@@ -41,6 +41,7 @@ import pyarrow.parquet as pq
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.expr import core as E
 from spark_rapids_tpu.expr.core import SparkException, col
+from spark_rapids_tpu.io import read_parquet_file as _read_pq
 
 
 class ConcurrentModification(SparkException):
@@ -142,7 +143,7 @@ class DeltaLog:
             return -1, []
         with open(lc) as f:
             v = int(json.load(f)["version"])
-        t = pq.read_table(os.path.join(self.log_path, _checkpoint_name(v)))
+        t = _read_pq(os.path.join(self.log_path, _checkpoint_name(v)))
         if "kind" in t.schema.names and "payload" in t.schema.names:
             # pre-round-5 checkpoint layout (kind + JSON payload columns)
             return v, [{row["kind"]: json.loads(row["payload"])}
@@ -318,7 +319,7 @@ class DeltaTable:
         if not paths:
             schema = _schema_from_string(snap.metadata["schemaString"])
             return self.session.create_dataframe(schema.empty_table())
-        table = pa.concat_tables([pq.read_table(p) for p in paths])
+        table = pa.concat_tables([_read_pq(p) for p in paths])
         return self.session.create_dataframe(table)
 
     def history(self) -> List[dict]:
@@ -354,7 +355,7 @@ class DeltaTable:
         actions: List[dict] = []
         for rel, add in snap.files.items():
             fp = os.path.join(self.path, rel)
-            table = pq.read_table(fp)
+            table = _read_pq(fp)
             df = self.session.create_dataframe(table)
             # DELETE removes only rows where the condition is TRUE; rows
             # where it evaluates to NULL are kept (Spark DeleteCommand).
@@ -379,7 +380,7 @@ class DeltaTable:
         actions: List[dict] = []
         for rel, add in snap.files.items():
             fp = os.path.join(self.path, rel)
-            table = pq.read_table(fp)
+            table = _read_pq(fp)
             df = self.session.create_dataframe(table)
             pred = _as_pred(condition) if condition is not None else None
             if pred is not None:

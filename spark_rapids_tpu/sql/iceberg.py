@@ -34,6 +34,7 @@ import pyarrow.parquet as pq
 
 from spark_rapids_tpu.expr.core import SparkException
 from spark_rapids_tpu.io.avro import read_avro, write_avro
+from spark_rapids_tpu.io import read_parquet_file as _read_pq
 
 
 class IcebergConcurrentCommit(SparkException):
@@ -256,7 +257,7 @@ class IcebergTable:
             schema = _arrow_schema(self._metadata()["schema"])
             return self.session.create_dataframe(schema.empty_table())
         table = pa.concat_tables([
-            pq.read_table(os.path.join(self.path, f["file_path"]))
+            _read_pq(os.path.join(self.path, f["file_path"]))
             for f in files])
         return self.session.create_dataframe(table)
 

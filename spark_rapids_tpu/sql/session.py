@@ -59,6 +59,9 @@ class TpuSession:
         self.conf = C.RapidsConf(conf_overrides)
         self._views: Dict = {}
         self._last_meta = None
+        #: artifact paths of the most recent traced action
+        #: ({"trace","events","metrics"}; None until a traced collect runs)
+        self.last_trace_paths = None
         from spark_rapids_tpu.ops import pallas_kernels as PK
         PK.set_enabled(self.conf.get(C.PALLAS_ENABLED))
 
@@ -204,13 +207,43 @@ class TpuSession:
         return out
 
     def collect(self, plan: P.PlanNode) -> pa.Table:
-        prof_dir = self.conf.get(C.PROFILE_DIR)
-        if prof_dir:
-            # XProf trace per action (reference ProfilerOnExecutor / NVTX)
-            import jax
-            with jax.profiler.trace(prof_dir):
-                return self._collect_inner(plan)
-        return self._collect_inner(plan)
+        from spark_rapids_tpu.runtime import trace as TR
+        # structured trace per action (spark.rapids.sql.trace.*): spans +
+        # instants + the task event log, finalized with this action's
+        # metrics snapshot so the offline report can reconcile the two.
+        # A nested collect (broadcast materialization) returns qt=None and
+        # joins the enclosing query's trace.
+        qt = TR.start_query(self.conf)
+        if qt is None and self.conf.get(C.TRACE_ENABLED):
+            # tracing was requested but another query owns the tracer
+            # (nested collect, or a concurrent session): this action gets
+            # no artifacts of its own — never leave a PREVIOUS query's
+            # paths looking like this one's. A same-session outer collect
+            # restores its own paths when it finalizes.
+            self.last_trace_paths = None
+        try:
+            prof_dir = self.conf.get(C.PROFILE_DIR)
+            if prof_dir:
+                # XProf trace per action (reference ProfilerOnExecutor /
+                # NVTX); structured spans forward TraceAnnotations into
+                # this capture so both timelines share operator names
+                import jax
+                with jax.profiler.trace(prof_dir):
+                    return self._collect_inner(plan)
+            return self._collect_inner(plan)
+        finally:
+            if qt is not None:
+                # cleared first so a finalize failure can never leave a
+                # PREVIOUS query's artifacts looking like this one's
+                self.last_trace_paths = None
+                try:
+                    self.last_trace_paths = TR.end_query(
+                        qt, last_metrics=self.last_metrics())
+                except Exception:  # noqa: BLE001 - observability must
+                    # never fail a query that already succeeded
+                    import logging
+                    logging.getLogger("spark_rapids_tpu").warning(
+                        "failed to finalize query trace", exc_info=True)
 
     def run_partitions(self, exec_root, per_batch):
         """Execute every partition of an exec tree (parallel tasks, up to

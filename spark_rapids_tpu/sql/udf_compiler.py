@@ -110,6 +110,22 @@ def _compare(argrepr: str, a: E.Expression, b: E.Expression) -> E.Expression:
     return _CMP[m.group(1)](a, b)
 
 
+# Python 3.10 emits one opcode per operator (BINARY_ADD, ...); 3.11+
+# folds them into BINARY_OP whose argrepr carries the symbol. Support
+# both so the compiler works across the interpreter versions this
+# engine runs under (the reference compiler has the same bytecode-
+# version matrix problem, OpcodeSuite).
+_BIN_OPNAMES = {
+    "BINARY_ADD": "+", "BINARY_SUBTRACT": "-", "BINARY_MULTIPLY": "*",
+    "BINARY_TRUE_DIVIDE": "/", "BINARY_FLOOR_DIVIDE": "//",
+    "BINARY_MODULO": "%", "BINARY_POWER": "**", "BINARY_AND": "&",
+    "BINARY_OR": "|", "BINARY_XOR": "^", "BINARY_LSHIFT": "<<",
+    "BINARY_RSHIFT": ">>",
+}
+_BIN_OPNAMES.update({k.replace("BINARY_", "INPLACE_"): v
+                     for k, v in _BIN_OPNAMES.items()})
+
+
 class _Frame:
     __slots__ = ("stack", "locals")
 
@@ -207,6 +223,11 @@ def compile_udf(fn, arg_exprs: List[E.Expression]
                     sym = ins.argrepr.rstrip("=")
                     st.append(_binary(sym, a, b))
                     idx += 1
+                elif op in _BIN_OPNAMES:  # 3.10 per-operator opcodes
+                    b = _as_expr(st.pop())
+                    a = _as_expr(st.pop())
+                    st.append(_binary(_BIN_OPNAMES[op], a, b))
+                    idx += 1
                 elif op == "COMPARE_OP":
                     b = _as_expr(st.pop())
                     a = _as_expr(st.pop())
@@ -230,6 +251,30 @@ def compile_udf(fn, arg_exprs: List[E.Expression]
                         raise _Unsupported("call of computed value")
                     st.append(_call(target[1], args))
                     idx += 1
+                elif op in ("CALL_FUNCTION", "CALL_METHOD"):
+                    # 3.10 call forms: n args above the callable; no NULL
+                    # sentinel (LOAD_METHOD's self slot is folded into the
+                    # single ("callable", fn) entry LOAD_METHOD pushed)
+                    n = ins.arg
+                    args = [_as_expr(st.pop()) for _ in range(n)][::-1]
+                    target = st.pop()
+                    if not (isinstance(target, tuple)
+                            and target[0] == "callable"):
+                        raise _Unsupported("call of computed value")
+                    st.append(_call(target[1], args))
+                    idx += 1
+                elif op == "DUP_TOP":  # 3.10's COPY(1)
+                    st.append(st[-1])
+                    idx += 1
+                elif op == "ROT_TWO":  # 3.10's SWAP(2)
+                    st[-1], st[-2] = st[-2], st[-1]
+                    idx += 1
+                elif op == "JUMP_ABSOLUTE":
+                    # forward only: a backward absolute jump is a loop
+                    jump_idx = by_offset[ins.argval]
+                    if jump_idx <= idx:
+                        raise _Unsupported("loop")
+                    idx = jump_idx
                 elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
                     cond = _as_expr(st.pop())
                     if op.endswith("TRUE"):

@@ -162,3 +162,116 @@ def test_leak_audit_reports_unreleased_handles():
     h2 = fw.register(b)
     fw.assert_no_leaks(expected_live=1)
     fw.unregister(h2)
+
+
+# ---------------------------------------------------------------------------
+# per-task accumulators (GpuTaskMetrics analog) + trace event log
+# ---------------------------------------------------------------------------
+
+def _traced_conf(tmp, **extra):
+    from spark_rapids_tpu import config as C
+    d = {"spark.rapids.sql.trace.enabled": "true",
+         "spark.rapids.sql.trace.path": str(tmp)}
+    d.update(extra)
+    return C.RapidsConf(d)
+
+
+def _task_rollups(paths):
+    import json
+    out = []
+    with open(paths["events"]) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "task":
+                out.append(rec)
+    return out
+
+
+def _instants(paths):
+    import json
+    with open(paths["trace"]) as f:
+        return [e for e in json.load(f)["traceEvents"] if e["ph"] == "i"]
+
+
+def test_retry_accumulators_roll_up_under_injection(tmp_path):
+    # injected retry-OOMs must land in the task rollup AND as instant
+    # events in the trace (reference GpuTaskMetrics retryCount +
+    # ProfilerOnExecutor artifacts)
+    from spark_rapids_tpu.runtime import trace
+    from spark_rapids_tpu.runtime.task import TaskContext
+    tr = trace.start_query(_traced_conf(tmp_path))
+    try:
+        OomInjector.configure(num_ooms=2)
+        with TaskContext(partition_id=0) as ctx:
+            out = list(with_retry(lambda b: int(b.num_rows), _batch(10)))
+            assert out == [10]
+            assert ctx.metric("retryCount").value == 2
+    finally:
+        paths = trace.end_query(tr)
+        OomInjector.configure(0)
+    recs = _task_rollups(paths)
+    assert any(r["metrics"].get("retryCount") == 2 for r in recs)
+    assert sum(1 for e in _instants(paths) if e["name"] == "retryOOM") == 2
+
+
+def test_split_retry_accumulators_and_instants(tmp_path):
+    from spark_rapids_tpu.runtime import trace
+    from spark_rapids_tpu.runtime.task import TaskContext
+    tr = trace.start_query(_traced_conf(tmp_path))
+    try:
+        OomInjector.configure(num_ooms=1, split=True)
+        with TaskContext(partition_id=0) as ctx:
+            out = list(with_retry(lambda b: int(b.num_rows), _batch(10)))
+            assert sum(out) == 10 and len(out) == 2
+            assert ctx.metric("splitAndRetryCount").value == 1
+    finally:
+        paths = trace.end_query(tr)
+        OomInjector.configure(0)
+    recs = _task_rollups(paths)
+    assert any(r["metrics"].get("splitAndRetryCount") == 1 for r in recs)
+    assert any(e["name"] == "splitAndRetryOOM" for e in _instants(paths))
+
+
+def test_spill_accumulators_and_instants(tmp_path):
+    # a reservation-forced spill charges the spilling TASK's accumulators
+    # (bytes + time) and emits spillToHost instants with byte counts
+    from spark_rapids_tpu.runtime import trace
+    from spark_rapids_tpu.runtime.task import TaskContext
+    tr = trace.start_query(_traced_conf(tmp_path))
+    try:
+        big = _batch(4096, 1)
+        small = _batch(64, 2)
+        fw = SpillFramework(big.device_memory_size()
+                            + small.device_memory_size() + 1024, 1 << 30)
+        with TaskContext(partition_id=3) as ctx:
+            hb, hs = fw.register(big), fw.register(small)
+            fw.reserve(2048)
+            assert hb.tier == "host"
+            assert ctx.metric("spillToHostBytes").value == hb.size
+            assert ctx.metric("spillToHostTime").value > 0
+            assert ctx.metric("maxDeviceBytesHeld").value >= hb.size
+            hb.close(); hs.close()
+    finally:
+        paths = trace.end_query(tr)
+    recs = _task_rollups(paths)
+    rec = next(r for r in recs if r["partition_id"] == 3)
+    assert rec["metrics"]["spillToHostBytes"] > 0
+    assert rec["metrics"]["maxDeviceBytesHeld"] > 0
+    ev = [e for e in _instants(paths) if e["name"] == "spillToHost"]
+    assert ev and ev[0]["args"]["bytes"] > 0
+
+
+def test_end_to_end_injection_query_traces_retries(tmp_path):
+    # extend the existing end-to-end injection test with the trace layer:
+    # same results AND the retry shows up in the query's event log
+    t = pa.table({"k": ["a", "b"] * 32, "v": list(range(64))})
+    s = TpuSession({"spark.rapids.sql.test.injectRetryOOM": "1",
+                    "spark.rapids.sql.trace.enabled": "true",
+                    "spark.rapids.sql.trace.path": str(tmp_path)})
+    got = s.create_dataframe(t).group_by("k") \
+        .agg(F.sum(col("v"))).collect().to_pylist()
+    assert sorted(r["k"] for r in got) == ["a", "b"]
+    recs = _task_rollups(s.last_trace_paths)
+    assert any(r["metrics"].get("retryCount", 0) >= 1 for r in recs)
+    assert any(e["name"] == "retryOOM"
+               for e in _instants(s.last_trace_paths))

@@ -56,8 +56,12 @@ def _eq(a, b):
     return a == b
 
 
-@pytest.mark.parametrize("codec", ["zstd", "zlib", "none"])
+@pytest.mark.parametrize("codec", ["auto", "zstd", "zlib", "none"])
 def test_serde_roundtrip(codec):
+    if codec == "zstd":
+        # explicit zstd requires the optional zstandard package; the
+        # engine's default is 'auto' (zstd when available, else zlib)
+        pytest.importorskip("zstandard")
     t = _rt_table()
     b = from_arrow(t)
     data = serde.serialize_batch(b, codec)

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# CI gate: docs drift, trace-overhead smoke, tier-1 tests.
+#
+#   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
+#   tools/ci_check.sh --fast     # skip tier-1 (docs drift + trace smoke)
+#
+# Mirrors the reference's build checks: generated docs must match the
+# committed ones (SupportedOpsDocs/RapidsConf.help regeneration), the
+# observability layer must stay free when disabled, and the tier-1 suite
+# (the exact ROADMAP.md command) must pass.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+step() { echo; echo "=== $1 ==="; }
+
+step "docs drift (tools/gen_docs.py output == committed docs)"
+if ! python tools/gen_docs.py >/dev/null; then
+    echo "FAIL: gen_docs.py errored"; fail=1
+elif ! git diff --exit-code -- docs/configs.md docs/supported_ops.md \
+        tools/generated_files; then
+    echo "FAIL: regenerate docs with 'python tools/gen_docs.py' and commit"
+    fail=1
+else
+    echo "OK: docs match the registries"
+fi
+
+step "trace-overhead smoke (disabled <2% of no-trace baseline; enabled run emits Perfetto-loadable JSON)"
+if ! python tools/trace_overhead.py; then
+    fail=1
+fi
+
+if [[ "${1:-}" != "--fast" ]]; then
+    step "tier-1 tests (ROADMAP.md command)"
+    set -o pipefail; rm -f /tmp/_t1.log
+    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+        -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+    rc=${PIPESTATUS[0]}
+    echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+        | tr -cd . | wc -c)
+    if [[ $rc -ne 0 ]]; then
+        echo "FAIL: tier-1 exited $rc"
+        fail=1
+    fi
+fi
+
+echo
+if [[ $fail -ne 0 ]]; then
+    echo "ci_check: FAIL"
+    exit 1
+fi
+echo "ci_check: PASS"

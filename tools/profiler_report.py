@@ -1,0 +1,423 @@
+"""Offline profiler report: aggregate a query's trace + event log + metrics.
+
+The spark-rapids-tools profiling-report analog: given the artifacts a
+traced action writes under spark.rapids.sql.trace.path
+(query_<n>_trace.json — Chrome trace-event JSON, query_<n>_events.jsonl —
+per-task GpuTaskMetrics rollups, query_<n>_metrics.json — the
+last_metrics() per-exec snapshot), render a markdown report:
+
+- top operators by EXCLUSIVE span time (nested spans subtracted, so an
+  aggregate's time excludes the serde spans inside it);
+- dispatch counts vs batch counts per exec (is the one-dispatch-per-batch
+  contract holding?);
+- per-stage fusion wins (dispatches saved by whole-stage fusion);
+- spill / retry hot spots (bytes, events, which tasks);
+- semaphore contention (wait distribution across tasks);
+- a reconciliation table proving span totals match the GpuMetric timers
+  (they share one instrumentation point, so deltas beyond rounding flag
+  an instrumentation bug).
+
+Run:  python tools/profiler_report.py <trace-dir> [--query N] [--json]
+      python tools/profiler_report.py <query_N_trace.json>
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_CHROME_PHASES = {"X", "B", "E", "i", "I", "M", "C", "b", "e", "n", "s",
+                  "t", "f", "P", "N", "O", "D"}
+
+
+# ---------------------------------------------------------------------------
+# loading & validation
+# ---------------------------------------------------------------------------
+
+def validate_chrome_trace(path: str) -> List[dict]:
+    """Assert the file is Chrome trace-event JSON (object form). Returns
+    the event list; raises ValueError on malformation."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace (no traceEvents)")
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            raise ValueError(f"{path}: event {i} is not an object")
+        if "ph" not in ev or "name" not in ev:
+            raise ValueError(f"{path}: event {i} missing ph/name")
+        if ev["ph"] not in _CHROME_PHASES:
+            raise ValueError(f"{path}: event {i} unknown phase {ev['ph']!r}")
+        if ev["ph"] in ("X", "i", "I", "C"):
+            if "ts" not in ev or "pid" not in ev or "tid" not in ev:
+                raise ValueError(f"{path}: event {i} missing ts/pid/tid")
+        if ev["ph"] == "X" and "dur" not in ev:
+            raise ValueError(f"{path}: complete event {i} missing dur")
+    return events
+
+
+def find_query(trace_dir: str, query_id: Optional[int] = None
+               ) -> Tuple[int, str]:
+    """Locate query_<n>_trace.json in a directory (latest id wins unless
+    one is requested)."""
+    found = {}
+    for p in glob.glob(os.path.join(trace_dir, "query_*_trace.json")):
+        m = re.match(r"query_(\d+)_trace\.json$", os.path.basename(p))
+        if m:
+            found[int(m.group(1))] = p
+    if not found:
+        raise FileNotFoundError(f"no query_*_trace.json under {trace_dir!r}")
+    qid = query_id if query_id is not None else max(found)
+    if qid not in found:
+        raise FileNotFoundError(f"query {qid} not found in {trace_dir!r} "
+                                f"(have {sorted(found)})")
+    return qid, found[qid]
+
+
+def load_artifacts(trace_path: str) -> Dict:
+    """Load trace + sibling events.jsonl / metrics.json (both optional)."""
+    events = validate_chrome_trace(trace_path)
+    base = trace_path[: -len("_trace.json")]
+    tasks, query_rec = [], None
+    ev_path = base + "_events.jsonl"
+    if os.path.exists(ev_path):
+        with open(ev_path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                if rec.get("type") == "task":
+                    tasks.append(rec)
+                elif rec.get("type") == "query":
+                    query_rec = rec
+    metrics = None
+    m_path = base + "_metrics.json"
+    if os.path.exists(m_path):
+        with open(m_path) as f:
+            metrics = json.load(f)
+    return {"events": events, "tasks": tasks, "query": query_rec,
+            "metrics": metrics, "trace_path": trace_path}
+
+
+# ---------------------------------------------------------------------------
+# span analysis
+# ---------------------------------------------------------------------------
+
+def exclusive_times(events: List[dict]) -> Dict[str, dict]:
+    """Per span name: count, total (inclusive) and EXCLUSIVE µs. Spans
+    nest per (pid, tid) track; a span's exclusive time subtracts every
+    child span directly contained in it."""
+    by_track: Dict[Tuple, List[dict]] = {}
+    for ev in events:
+        if ev["ph"] == "X":
+            by_track.setdefault((ev.get("pid"), ev.get("tid")), []).append(ev)
+    out: Dict[str, dict] = {}
+
+    def acct(name, total, excl):
+        rec = out.setdefault(name, {"count": 0, "total_us": 0.0,
+                                    "exclusive_us": 0.0})
+        rec["count"] += 1
+        rec["total_us"] += total
+        rec["exclusive_us"] += excl
+
+    for track in by_track.values():
+        # sort by start asc, then duration desc so a parent precedes the
+        # children that share its start timestamp
+        track.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: List[Tuple[dict, float]] = []  # (event, child_time)
+        for ev in track:
+            while stack and ev["ts"] >= stack[-1][0]["ts"] + stack[-1][0]["dur"]:
+                done, child_t = stack.pop()
+                acct(done["name"], done["dur"],
+                     max(done["dur"] - child_t, 0.0))
+                if stack:
+                    stack[-1] = (stack[-1][0], stack[-1][1] + done["dur"])
+            stack.append((ev, 0.0))
+        while stack:
+            done, child_t = stack.pop()
+            acct(done["name"], done["dur"], max(done["dur"] - child_t, 0.0))
+            if stack:
+                stack[-1] = (stack[-1][0], stack[-1][1] + done["dur"])
+    return out
+
+
+def operator_rollup(span_stats: Dict[str, dict]) -> Dict[str, dict]:
+    """Fold `ExecName.metricName` spans into per-operator totals."""
+    ops: Dict[str, dict] = {}
+    for name, rec in span_stats.items():
+        op = name.split(".", 1)[0]
+        dst = ops.setdefault(op, {"count": 0, "total_us": 0.0,
+                                  "exclusive_us": 0.0})
+        for k in ("count", "total_us", "exclusive_us"):
+            dst[k] += rec[k]
+    return ops
+
+
+def reconcile(span_stats: Dict[str, dict], metrics: Optional[dict]
+              ) -> List[dict]:
+    """Span totals vs the GpuMetric timers they feed. One instrumentation
+    point means the numbers must agree up to µs-rounding; a bigger delta
+    is an instrumentation bug. Returns one row per (exec, time-metric)
+    that appears in both."""
+    if not metrics:
+        return []
+    metric_totals: Dict[str, int] = {}
+    for exec_key, snap in metrics.items():
+        op = exec_key.split("#", 1)[0]
+        for mname, v in snap.items():
+            if mname.lower().endswith("time"):
+                metric_totals[f"{op}.{mname}"] = \
+                    metric_totals.get(f"{op}.{mname}", 0) + int(v)
+    rows = []
+    for name, rec in sorted(span_stats.items()):
+        if name not in metric_totals:
+            continue
+        metric_us = metric_totals[name] / 1000.0
+        delta = abs(rec["total_us"] - metric_us)
+        denom = max(rec["total_us"], metric_us, 1.0)
+        rows.append({"name": name, "span_us": rec["total_us"],
+                     "metric_us": metric_us,
+                     "delta_pct": 100.0 * delta / denom})
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# metric-side analyses
+# ---------------------------------------------------------------------------
+
+_BATCH_KEYS = ("numInputBatches", "numOutputBatches")
+
+
+def dispatch_vs_batches(metrics: Optional[dict]) -> List[dict]:
+    """Per exec with a stageDispatches metric: dispatches vs batch count
+    (the one-dispatch-per-batch contract)."""
+    if not metrics:
+        return []
+    rows = []
+    for exec_key, snap in metrics.items():
+        if "stageDispatches" not in snap:
+            continue
+        batches = max((snap.get(k, 0) for k in _BATCH_KEYS), default=0)
+        rows.append({"exec": exec_key,
+                     "dispatches": snap["stageDispatches"],
+                     "batches": batches})
+    return rows
+
+
+def fusion_wins(metrics: Optional[dict], events: List[dict]) -> List[dict]:
+    """Dispatches saved by whole-stage fusion, per stage. Trace-driven:
+    every FusedStageExec dispatch span carries stage_id + member count in
+    its args, so each stage's savings are exact — (members−1) composed
+    calls avoided per dispatch. Falls back to the metrics snapshot (count
+    only, members unknown) when the trace has no fused spans (e.g. an
+    ESSENTIAL-level trace)."""
+    per_stage: Dict[int, dict] = {}
+    for ev in events:
+        if ev["ph"] == "X" and ev["name"].startswith("FusedStageExec("):
+            args = ev.get("args") or {}
+            sid = args.get("stage_id")
+            if sid is None:
+                continue
+            rec = per_stage.setdefault(sid, {
+                "exec": f"{ev['name']} [stage {sid}]",
+                "members": args.get("members", 0), "dispatches": 0})
+            rec["dispatches"] += 1
+        elif ev["ph"] == "i" and ev["name"] == "stageDispatch" \
+                and (ev.get("args") or {}).get("absorbed"):
+            # absorbed-aggregate stages dispatch inside the agg's update
+            # (no FusedStageExec span exists); their instants carry the
+            # stage id and composed member count
+            args = ev["args"]
+            sid = args.get("stage_id")
+            if sid is None:
+                continue
+            rec = per_stage.setdefault(sid, {
+                "exec": f"absorbed agg chain [stage {sid}]",
+                "members": args.get("members", 0), "dispatches": 0})
+            rec["dispatches"] += 1
+    rows = list(per_stage.values())
+    if not rows and metrics:
+        rows = [{"exec": exec_key, "dispatches": snap["stageDispatches"],
+                 "members": None}
+                for exec_key, snap in metrics.items()
+                if exec_key.startswith("FusedStageExec")
+                and "stageDispatches" in snap]
+    for r in rows:
+        r["saved_dispatches"] = ((r["members"] - 1) * r["dispatches"]
+                                 if r.get("members") else None)
+    return rows
+
+
+def spill_retry_hotspots(events: List[dict], tasks: List[dict]) -> dict:
+    inst = {"spillToHost": [], "spillToDisk": [], "retryOOM": [],
+            "splitAndRetryOOM": []}
+    for ev in events:
+        if ev["ph"] == "i" and ev["name"] in inst:
+            inst[ev["name"]].append(ev.get("args") or {})
+    per_task = []
+    for t in tasks:
+        m = t.get("metrics", {})
+        keys = ("retryCount", "splitAndRetryCount", "retryBlockTime",
+                "spillToHostBytes", "spillToDiskBytes",
+                "spillToHostTime", "spillToDiskTime", "maxDeviceBytesHeld")
+        if any(m.get(k) for k in keys):
+            per_task.append({"task_id": t["task_id"],
+                             "partition_id": t.get("partition_id"),
+                             **{k: m[k] for k in keys if m.get(k)}})
+    return {
+        "spill_to_host_bytes": sum(a.get("bytes", 0)
+                                   for a in inst["spillToHost"]),
+        "spill_to_disk_bytes": sum(a.get("bytes", 0)
+                                   for a in inst["spillToDisk"]),
+        "spill_events": len(inst["spillToHost"]) + len(inst["spillToDisk"]),
+        "retry_events": len(inst["retryOOM"]),
+        "split_retry_events": len(inst["splitAndRetryOOM"]),
+        "tasks": per_task,
+    }
+
+
+def semaphore_contention(tasks: List[dict], events: List[dict]) -> dict:
+    waits = [t.get("metrics", {}).get("semaphoreWaitTime", 0)
+             for t in tasks]
+    acquires = [ev for ev in events
+                if ev["ph"] == "i" and ev["name"] == "semaphoreAcquire"]
+    waits_ns = sorted(waits)
+    return {
+        "tasks": len(waits),
+        "acquires": len(acquires),
+        "total_wait_ms": sum(waits) / 1e6,
+        "max_wait_ms": (max(waits) / 1e6) if waits else 0.0,
+        "p50_wait_ms": (waits_ns[len(waits_ns) // 2] / 1e6) if waits_ns
+        else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def _fmt_us(us: float) -> str:
+    return f"{us / 1000.0:.3f}"
+
+
+def generate_report(art: Dict, top_n: int = 20) -> str:
+    events, tasks, metrics = art["events"], art["tasks"], art["metrics"]
+    spans = exclusive_times(events)
+    ops = operator_rollup(spans)
+    rec = reconcile(spans, metrics)
+    disp = dispatch_vs_batches(metrics)
+    wins = fusion_wins(metrics, events)
+    hot = spill_retry_hotspots(events, tasks)
+    sem = semaphore_contention(tasks, events)
+
+    L = ["# Profiler report", ""]
+    if art.get("query"):
+        q = art["query"]
+        L.append(f"query {q.get('query_id')} · "
+                 f"{q.get('duration_ns', 0) / 1e6:.1f} ms wall · "
+                 f"{q.get('n_tasks')} tasks · source "
+                 f"`{os.path.basename(art['trace_path'])}`")
+        L.append("")
+
+    L += ["## Top operators by exclusive time", "",
+          "| operator | spans | exclusive ms | inclusive ms |",
+          "|---|---:|---:|---:|"]
+    for op, r in sorted(ops.items(), key=lambda kv: -kv[1]["exclusive_us"]
+                        )[:top_n]:
+        L.append(f"| {op} | {r['count']} | {_fmt_us(r['exclusive_us'])} "
+                 f"| {_fmt_us(r['total_us'])} |")
+
+    if disp:
+        L += ["", "## Dispatches vs batches (one-dispatch-per-batch "
+              "contract)", "",
+              "| exec | stageDispatches | batches |", "|---|---:|---:|"]
+        for r in disp:
+            L.append(f"| {r['exec']} | {r['dispatches']} "
+                     f"| {r['batches']} |")
+
+    if wins:
+        L += ["", "## Whole-stage fusion wins", "",
+              "| fused stage | composed dispatches | members "
+              "| dispatches saved |", "|---|---:|---:|---:|"]
+        for r in wins:
+            L.append(f"| {r['exec']} | {r['dispatches']} "
+                     f"| {r['members'] or '?'} "
+                     f"| {'?' if r['saved_dispatches'] is None else r['saved_dispatches']} |")
+
+    L += ["", "## Spill / retry hot spots", "",
+          f"- spill to host: {hot['spill_to_host_bytes']} B over "
+          f"{hot['spill_events']} spill event(s); to disk: "
+          f"{hot['spill_to_disk_bytes']} B",
+          f"- retry OOMs: {hot['retry_events']}; split-and-retry: "
+          f"{hot['split_retry_events']}"]
+    if hot["tasks"]:
+        L += ["", "| task | partition | accumulators |", "|---|---|---|"]
+        for t in hot["tasks"][:top_n]:
+            acc = ", ".join(f"{k}={v}" for k, v in t.items()
+                            if k not in ("task_id", "partition_id"))
+            L.append(f"| {t['task_id']} | {t['partition_id']} | {acc} |")
+
+    L += ["", "## Semaphore contention", "",
+          f"- {sem['tasks']} task(s), {sem['acquires']} traced acquire(s)",
+          f"- total wait {sem['total_wait_ms']:.3f} ms · "
+          f"max {sem['max_wait_ms']:.3f} ms · "
+          f"p50 {sem['p50_wait_ms']:.3f} ms"]
+
+    if rec:
+        L += ["", "## Trace ↔ metric reconciliation", "",
+              "spans and GpuMetric timers share one instrumentation "
+              "point; deltas beyond rounding indicate a bug.", "",
+              "| span | span total ms | metric total ms | delta % |",
+              "|---|---:|---:|---:|"]
+        for r in rec:
+            L.append(f"| {r['name']} | {_fmt_us(r['span_us'])} "
+                     f"| {_fmt_us(r['metric_us'])} "
+                     f"| {r['delta_pct']:.2f} |")
+
+    L.append("")
+    return "\n".join(L)
+
+
+def analyze(art: Dict) -> Dict:
+    """Machine-readable version of the report (for --json and tests)."""
+    spans = exclusive_times(art["events"])
+    return {
+        "spans": spans,
+        "operators": operator_rollup(spans),
+        "reconciliation": reconcile(spans, art["metrics"]),
+        "dispatch_vs_batches": dispatch_vs_batches(art["metrics"]),
+        "fusion_wins": fusion_wins(art["metrics"], art["events"]),
+        "hotspots": spill_retry_hotspots(art["events"], art["tasks"]),
+        "semaphore": semaphore_contention(art["tasks"], art["events"]),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="trace directory or query_N_trace.json")
+    ap.add_argument("--query", type=int, default=None,
+                    help="query id (directory mode; default: latest)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable analysis instead")
+    ap.add_argument("--top", type=int, default=20)
+    args = ap.parse_args()
+    path = args.path
+    if os.path.isdir(path):
+        _, path = find_query(path, args.query)
+    art = load_artifacts(path)
+    if args.json:
+        print(json.dumps(analyze(art), indent=1, sort_keys=True))
+    else:
+        print(generate_report(art, top_n=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
