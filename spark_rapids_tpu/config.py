@@ -155,6 +155,39 @@ TRACE_TASK_METRICS = conf_bool(
     "semaphore wait, max device bytes held — the GpuTaskMetrics analog) "
     "into the per-query event log at task completion.")
 
+OBS_ENABLED = conf_bool(
+    "spark.rapids.obs.enabled", True,
+    "Publish live metrics into the process-wide observability registry "
+    "(runtime/obs): task accumulators fold in once per task completion, "
+    "per-exec rollups once per query — never per batch. Disabled, every "
+    "hook costs one global read (same budget as trace.py). The registry "
+    "feeds the /metrics endpoint and the query history store.")
+
+OBS_PORT = conf_int(
+    "spark.rapids.obs.port", 0,
+    "When > 0, serve a background HTTP endpoint on this port: /metrics "
+    "(Prometheus text format from the live registry) and /healthz (JSON: "
+    "device liveness via a trivial dispatch probe, semaphore saturation, "
+    "spill pressure, last-query status; HTTP 200 ok / 503 degraded). "
+    "0 disables the endpoint (the reference surfaces GpuMetrics through "
+    "the Spark UI; a standalone engine scrapes).", commonly_used=True)
+
+OBS_HISTORY_DIR = conf_str(
+    "spark.rapids.obs.historyDir", "",
+    "When set, append one JSON record per query to "
+    "<dir>/query_history.jsonl: plan digest, per-exec metric rollups, "
+    "fusion groups, fallback reasons, config delta, wall time, status "
+    "(ok/failed + exception class), trace artifact paths. Rendered by "
+    "tools/history_server.py (query list -> annotated plan -> "
+    "run-over-run diff by plan digest); tools/nds_probe.py appends its "
+    "scorecards here too.", commonly_used=True)
+
+OBS_PROBE_TIMEOUT_MS = conf_int(
+    "spark.rapids.obs.probeTimeoutMs", 2000,
+    "Timeout for the /healthz device dispatch probe; a probe that "
+    "exceeds it reports the device as blocked and flips the endpoint "
+    "to degraded (503).")
+
 LORE_DUMP_DIR = conf_str(
     "spark.rapids.sql.lore.dumpPath", "",
     "When set, every exec's input batches dump as parquet under "

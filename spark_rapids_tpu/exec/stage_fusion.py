@@ -334,6 +334,35 @@ def fuse_stages(exec_root, conf):
     return _rewrite(exec_root, conf, counter)
 
 
+def fusion_groups(exec_root) -> list:
+    """Export the fused stages of a converted exec tree as data (what the
+    query-history record stores and the history server renders): one
+    entry per stage — id, kind (fused chain vs aggregate-absorbed), and
+    the member operator names child-most first (an absorbed chain ends
+    with the aggregate it dispatches through). Derived from the ONE
+    canonical walk (metrics.walk_exec_tree), so the member/pre-chain/
+    no-recurse discipline can never drift from what last_metrics and
+    explain_analyze report."""
+    from spark_rapids_tpu.runtime.metrics import walk_exec_tree
+    groups, cur = [], None
+    for _k, node, _d, role, sid in walk_exec_tree(exec_root):
+        if role is None:
+            cur = None
+            if sid is not None:
+                cur = {"stage_id": sid,
+                       "kind": ("fused" if getattr(node, "members", None)
+                                else "absorbed"),
+                       "members": [], "_self": type(node).__name__}
+                groups.append(cur)
+        elif cur is not None:
+            cur["members"].append(type(node).__name__)
+    for g in groups:
+        self_name = g.pop("_self")
+        if g["kind"] == "absorbed":
+            g["members"].append(self_name)
+    return groups
+
+
 def _rewrite(node, conf, counter):
     X = _exec_base()
 

@@ -115,6 +115,7 @@ class InMemoryScanExec(TpuExec):
         start, n = _split_rows(table.num_rows, self.num_partitions)[pidx]
         max_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
         out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        out_batches = self.metrics.metric(M.NUM_OUTPUT_BATCHES)
         copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
         off = 0
         while off < n or (n == 0 and off == 0):
@@ -125,6 +126,7 @@ class InMemoryScanExec(TpuExec):
                 b = from_arrow(chunk)
             yield b
             out_rows.add(take)
+            out_batches.add(1)
             off += max(take, 1)
             if n == 0:
                 break
@@ -2629,9 +2631,16 @@ class HashAggregateExec(TpuExec):
                 else:
                     return
         if partials:
+            # rollup export (EXPLAIN ANALYZE / history / live registry):
+            # lazy row counts — no sync unless something reads them
+            out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+            out_batches = self.metrics.metric(M.NUM_OUTPUT_BATCHES)
             if skip_merge and len(partials) > 1:
                 for p in partials:
-                    yield K.compact_batch(p)
+                    p = K.compact_batch(p)
+                    out_rows.add(p.num_rows)
+                    out_batches.add(1)
+                    yield p
                 return
             self._acquire(ctx)
             with self.span(agg_t):
@@ -2643,6 +2652,8 @@ class HashAggregateExec(TpuExec):
                 # sync on the tunneled device
                 if self.mode != "partial":
                     merged = self._evaluate(merged)
+            out_rows.add(merged.num_rows)
+            out_batches.add(1)
             yield merged
 
     # -- phase helpers -----------------------------------------------------
@@ -2986,6 +2997,11 @@ class ShuffleExchangeExec(ExchangeExec):
                     if blob is not None:
                         store.add(p, blob)
         self._store = store
+        tot = store.totals()
+        self.metrics.metric(M.SHUFFLE_BYTES_WRITTEN).add(
+            tot["bytes_written"])
+        self.metrics.metric(M.SHUFFLE_BYTES_SPILLED).add(
+            tot["bytes_spilled"])
         rthreads = self.conf.get(C.SHUFFLE_READER_THREADS)
         return [[_LazyShuffleBlobs(store, p, rthreads, self.conf)]
                 if store.partition_bytes(p)

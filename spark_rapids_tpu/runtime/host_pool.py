@@ -105,6 +105,12 @@ class HostTaskPool:
                 pass
             yield f.result()
 
+    def queue_depths(self) -> dict:
+        """Tasks queued (submitted, not yet picked up) per tier — the
+        live backlog gauge /metrics exposes. Racy reads by design."""
+        return {"tier0": self._tier0._work_queue.qsize(),
+                "tier1": self._tier1._work_queue.qsize()}
+
     def shutdown(self) -> None:
         self._tier0.shutdown(wait=True)
         self._tier1.shutdown(wait=True)
@@ -128,6 +134,12 @@ def get_host_pool(conf=None) -> HostTaskPool:
         if _POOL is None:
             _POOL = HostTaskPool(_pool_size(conf))
         return _POOL
+
+
+def current_pool() -> "Optional[HostTaskPool]":
+    """The pool if one exists, WITHOUT creating it (the live queue-depth
+    gauges must not size a pool from a scrape thread's conf)."""
+    return _POOL
 
 
 def reset_host_pool() -> None:

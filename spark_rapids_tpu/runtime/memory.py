@@ -409,6 +409,13 @@ def _device_budget_from(conf) -> int:
     return budget
 
 
+def peek_spill_framework() -> Optional[SpillFramework]:
+    """The process framework WITHOUT creating (or re-syncing) one — the
+    /healthz spill-pressure read and the live gauges must observe, never
+    instantiate with a scrape thread's conf."""
+    return _GLOBAL
+
+
 def reset_spill_framework() -> None:
     global _GLOBAL
     with _GLOBAL_LOCK:

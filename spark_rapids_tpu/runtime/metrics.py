@@ -55,6 +55,11 @@ PARTITION_HOST_FETCHES = "partitionHostFetches"
 #: computation per batch; the unfused chain pays one per member operator.
 #: Dispatch-budget tests assert stageDispatches == input batch count.
 STAGE_DISPATCHES = "stageDispatches"
+#: serialized-shuffle bytes an exchange wrote into its host store
+#: (post-compression wire bytes; reference shuffle write metrics)
+SHUFFLE_BYTES_WRITTEN = "shuffleBytesWritten"
+#: serialized-shuffle bytes the host store overflowed to disk files
+SHUFFLE_BYTES_SPILLED = "shuffleBytesSpilled"
 
 
 class GpuMetric:
@@ -141,6 +146,66 @@ class MetricsRegistry:
     def snapshot(self) -> Dict[str, int]:
         return {k: m.value for k, m in self.metrics.items()
                 if m.level <= self.level}
+
+
+def walk_exec_tree(root):
+    """THE canonical exec-tree metric walk: each node, then its
+    vertically fused members, then its absorbed pre-chain members, then
+    its children — yielding `(key, node, depth, role, stage_id)` with
+    keys `ClsName#i` in visit order. `TpuSession.last_metrics()` /
+    `explain_analyze()` and `stage_fusion.fusion_groups()` (and through
+    them the history records and the history server's plan annotation)
+    all derive from this ONE generator, so the walk-order invariant
+    cannot drift between hand-written copies. Fused members' original
+    child links point into the collapsed chain — they are yielded
+    alone, never recursed. Duck-typed: no exec imports."""
+    counter = [0]
+
+    def key_of(n):
+        k = f"{type(n).__name__}#{counter[0]}"
+        counter[0] += 1
+        return k
+
+    def walk(n, depth):
+        members = getattr(n, "members", None) or []
+        pre = getattr(n, "pre_chain_members", None) or []
+        sid = (getattr(n, "stage_id", None) if members
+               else getattr(n, "fused_stage_id", None) if pre else None)
+        yield key_of(n), n, depth, None, sid
+        for m in members:
+            yield key_of(m), m, depth, "member", sid
+        for m in pre:
+            yield key_of(m), m, depth, "absorbed", sid
+        for c in n.children:
+            yield from walk(c, depth + 1)
+
+    yield from walk(root, 0)
+
+
+def exec_rollup(snapshot: Dict[str, int]) -> Dict[str, int]:
+    """Fold one exec's metric snapshot into the standard rollup the
+    observability surfaces share (EXPLAIN ANALYZE annotations, history
+    records, /metrics per-operator series): output rows, batches,
+    device dispatches, and total operator time.
+
+    time_ns sums every *Time metric EXCEPT semaphoreWaitTime — wait is
+    scheduling, not operator work, and folding it in would make every
+    hot-path comparison lie under contention."""
+    rows = int(snapshot.get(NUM_OUTPUT_ROWS, 0))
+    # presence-based fallback, NOT falsy-or: an exec that RECORDED zero
+    # output batches (every input row filtered away) must report 0, not
+    # its input batch count — the zero-output case is exactly what a
+    # reader of these numbers is usually debugging
+    batches = int(snapshot[NUM_OUTPUT_BATCHES]
+                  if NUM_OUTPUT_BATCHES in snapshot
+                  else snapshot.get(NUM_INPUT_BATCHES, 0))
+    dispatches = int(snapshot[STAGE_DISPATCHES]
+                     if STAGE_DISPATCHES in snapshot
+                     else snapshot.get(PARTITION_DISPATCHES, 0))
+    time_ns = sum(int(v) for k, v in snapshot.items()
+                  if k.endswith("Time") and k != SEMAPHORE_WAIT_TIME)
+    return {"rows": rows, "batches": batches, "dispatches": dispatches,
+            "time_ns": time_ns}
 
 
 def metrics_level_from_conf(conf) -> int:

@@ -1,0 +1,442 @@
+"""Live observability: process-wide registry, HTTP endpoint, query history.
+
+This package is the LIVE half of the observability story — the offline
+half (structured traces + event logs + profiler report) is
+runtime/trace.py. Data flow:
+
+    GpuMetric / TaskContext accumulators   (per batch, unchanged hot path)
+        -> on_task_complete(ctx)           (ONE registry fold per task)
+    last_metrics() exec rollups, history   (once per query, at the end)
+        -> on_query_end(...)
+    registry  ->  /metrics (Prometheus text), tools/history_server.py
+    healthz() ->  /healthz (device probe, semaphore, spill, last query)
+
+Overhead discipline (same budget as trace.py): with
+`spark.rapids.obs.enabled=false` every hook is one module-global read +
+branch; enabled, the hooks run per task/query completion, never per
+batch. The HTTP endpoint starts only when `spark.rapids.obs.port` is
+set; the history store only when `spark.rapids.obs.historyDir` is set.
+
+Process-wide singleton (like the tracer and the semaphore): the first
+session that installs wins the endpoint port and history dir; later
+sessions publish into the same registry. Nested collects (broadcast
+materialization, subqueries) join the enclosing query — only top-level
+actions produce history records.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from spark_rapids_tpu.runtime.obs.history import (  # noqa: F401 (re-export)
+    QueryHistoryStore, build_query_record, conf_delta, plan_digest,
+)
+from spark_rapids_tpu.runtime.obs.registry import MetricsRegistry
+
+_STATE: "Optional[ObsState]" = None
+_STATE_LOCK = threading.Lock()
+
+#: TaskContext accumulator -> process counter (folded once per task)
+_TASK_COUNTERS = {
+    "semaphoreWaitTime": ("rapids_semaphore_wait_ns_total",
+                          "Total ns tasks waited on the device semaphore"),
+    "semaphoreHoldTime": ("rapids_semaphore_hold_ns_total",
+                          "Total ns tasks held a device semaphore permit"),
+    "retryCount": ("rapids_retries_total",
+                   "Retry-OOM attempts replayed"),
+    "splitAndRetryCount": ("rapids_split_retries_total",
+                           "Split-and-retry OOM splits"),
+    "retryBlockTime": ("rapids_retry_block_ns_total",
+                       "Total ns spent draining spill stores before "
+                       "re-attempts"),
+    "retryWastedTime": ("rapids_retry_wasted_ns_total",
+                        "Total ns spent in attempts that later OOMed and "
+                        "were replayed"),
+    "spillToHostBytes": ("rapids_spill_to_host_bytes_total",
+                         "Bytes spilled device->host"),
+    "spillToDiskBytes": ("rapids_spill_to_disk_bytes_total",
+                         "Bytes spilled host->disk"),
+    "spillToHostTime": ("rapids_spill_to_host_ns_total",
+                        "Total ns spent spilling device->host"),
+    "spillToDiskTime": ("rapids_spill_to_disk_ns_total",
+                        "Total ns spent spilling host->disk"),
+}
+
+
+class ObsState:
+    """Everything the live layer owns. One per process."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.history: Optional[QueryHistoryStore] = None
+        self.server = None  # ObsHttpServer
+        self.probe = None   # DeviceProbe
+        self._lock = threading.Lock()
+        self._query_seq = 0
+        self._active = 0  # top-level queries currently running
+        self.last_query: Optional[dict] = None
+
+
+#: per-thread collect depth: a re-entrant collect on the SAME thread is
+#: a nested action (broadcast materialization, subqueries) and joins the
+#: enclosing query; a collect on ANOTHER thread is a concurrent
+#: top-level query and gets its own token — queries that merely overlap
+#: must not vanish from the counters/history of a serving process
+_TLS = threading.local()
+
+#: sentinel token for a nested collect (must still flow to on_query_end
+#: so the thread's depth unwinds; publishes nothing)
+NESTED = "nested"
+
+
+def _preregister(reg: MetricsRegistry) -> None:
+    """Create the roster instruments up front so a scrape before the
+    first task/query still renders them (at zero) — an empty /metrics
+    reads as a broken exporter, not an idle engine."""
+    for _, (name, help_) in _TASK_COUNTERS.items():
+        reg.counter(name, help_)
+    reg.counter("rapids_tasks_completed_total", "Tasks completed")
+    reg.counter("rapids_tasks_failed_total", "Tasks failed")
+    reg.counter("rapids_queries_total", "Queries completed",
+                labels={"status": "ok"})
+    reg.counter("rapids_queries_total", "Queries completed",
+                labels={"status": "failed"})
+    reg.counter("rapids_shuffle_bytes_written_total",
+                "Serialized shuffle bytes written to the host store")
+    reg.counter("rapids_shuffle_bytes_spilled_total",
+                "Serialized shuffle bytes spilled to disk")
+    reg.histogram("rapids_query_wall_time_ms",
+                  "Per-query wall time (ms)")
+    reg.histogram("rapids_task_duration_ms", "Per-task duration (ms)")
+    reg.gauge("rapids_max_device_bytes_held",
+              "High-water mark of registered device bytes (any task)")
+    # live gauges (evaluated at scrape time)
+    from spark_rapids_tpu.runtime import host_pool as HP
+    from spark_rapids_tpu.runtime import memory as MEM
+    from spark_rapids_tpu.runtime import semaphore as SEM
+
+    def _sem(attr):
+        def read():
+            sem = SEM.peek_semaphore()
+            return getattr(sem, attr) if sem is not None else 0
+        return read
+
+    reg.gauge_fn("rapids_semaphore_available", _sem("available"),
+                 "Device semaphore permits currently free")
+    reg.gauge_fn("rapids_semaphore_waiting", _sem("waiting"),
+                 "Tasks parked on the device semaphore")
+
+    def _pool_depth(tier):
+        def read():
+            pool = HP.current_pool()
+            return pool.queue_depths().get(tier, 0) if pool else 0
+        return read
+
+    for tier in ("tier0", "tier1"):
+        reg.gauge_fn("rapids_host_pool_queue_depth", _pool_depth(tier),
+                     "Host task-pool queued (not yet running) tasks",
+                     labels={"tier": tier})
+
+    def _spill(attr):
+        def read():
+            fw = MEM.peek_spill_framework()
+            return getattr(fw, attr)() if fw is not None else 0
+        return read
+
+    reg.gauge_fn("rapids_device_bytes_held", _spill("device_bytes_held"),
+                 "Registered (spillable) device bytes currently held")
+    reg.gauge_fn("rapids_host_spill_bytes_held", _spill("host_bytes_held"),
+                 "Spilled bytes currently resident in the host store")
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def install(conf) -> "Optional[ObsState]":
+    """Install (or extend) the process-wide observability state from a
+    session's conf. Idempotent; called from TpuSession.__init__."""
+    global _STATE
+    from spark_rapids_tpu import config as Cf
+    if not conf.get(Cf.OBS_ENABLED):
+        return _STATE
+    with _STATE_LOCK:
+        st = _STATE
+        if st is None:
+            st = ObsState(MetricsRegistry())
+            _preregister(st.registry)
+            _STATE = st
+        hist_dir = conf.get(Cf.OBS_HISTORY_DIR)
+        if hist_dir and st.history is None:
+            st.history = QueryHistoryStore(hist_dir)
+        port = int(conf.get(Cf.OBS_PORT))
+        if port > 0 and st.server is None:
+            from spark_rapids_tpu.runtime.obs.endpoint import (
+                DeviceProbe, ObsHttpServer,
+            )
+            if st.probe is None:
+                st.probe = DeviceProbe(
+                    timeout_s=conf.get(Cf.OBS_PROBE_TIMEOUT_MS) / 1000.0)
+            try:
+                server = ObsHttpServer(port, st.registry.render_prometheus,
+                                       healthz)
+                server.start()
+                st.server = server
+            except Exception:  # noqa: BLE001 - a bind failure (port in
+                # use by another engine process) must not kill session
+                # construction for an observability feature; queries run,
+                # the endpoint just isn't served from this process
+                import logging
+                logging.getLogger("spark_rapids_tpu").warning(
+                    "failed to start obs endpoint on port %d", port,
+                    exc_info=True)
+        return st
+
+
+def state() -> "Optional[ObsState]":
+    return _STATE
+
+
+def enabled() -> bool:
+    return _STATE is not None
+
+
+def shutdown_for_tests() -> None:
+    """Tear the singleton down (tests only: frees the port, drops the
+    registry so the next install starts clean)."""
+    global _STATE
+    with _STATE_LOCK:
+        st, _STATE = _STATE, None
+    if st is not None and st.server is not None:
+        try:
+            st.server.stop()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def set_device_probe(fn: Callable[[], bool]) -> None:
+    """Swap the /healthz device probe (tests: a blocking fn proves the
+    degraded flip without wedging a real device)."""
+    st = _STATE
+    if st is not None:
+        from spark_rapids_tpu.runtime.obs.endpoint import DeviceProbe
+        timeout = st.probe.timeout_s if st.probe is not None else 2.0
+        st.probe = DeviceProbe(fn, timeout_s=timeout)
+
+
+# ---------------------------------------------------------------------------
+# publish hooks (the only calls on engine paths)
+# ---------------------------------------------------------------------------
+
+def on_task_complete(ctx) -> None:
+    """Fold one finished task's accumulators into the process registry —
+    ONE write batch per task, nothing per batch. Called by
+    TaskContext.complete after the trace rollup."""
+    st = _STATE
+    if st is None:
+        return
+    reg = st.registry
+    try:
+        reg.counter("rapids_tasks_failed_total" if ctx._failed
+                    else "rapids_tasks_completed_total").inc()
+        dur_ns = time.perf_counter_ns() - ctx.start_ns
+        reg.histogram("rapids_task_duration_ms").observe(dur_ns / 1e6)
+        for acc_name, (cname, chelp) in _TASK_COUNTERS.items():
+            m = ctx._metrics.get(acc_name)
+            if m is None:
+                continue
+            try:
+                v = int(m.value)
+            except Exception:  # noqa: BLE001 - unresolvable lazy count
+                continue
+            if v:
+                reg.counter(cname, chelp).inc(v)
+        mdb = ctx._metrics.get("maxDeviceBytesHeld")
+        if mdb is not None:
+            reg.gauge("rapids_max_device_bytes_held").set_max(int(mdb.value))
+    except Exception:  # noqa: BLE001 - observability never fails a task
+        pass
+
+
+def on_query_start():
+    """Returns a query token: None when obs is off, the NESTED sentinel
+    for a re-entrant collect on this thread (it joins the enclosing
+    query but must still reach on_query_end to unwind the depth), or a
+    fresh query id. Concurrent top-level queries from other threads/
+    sessions each get their own token — they all count. (Known limit
+    shared with the tracer: concurrent queries in ONE session share
+    `_last_exec`, so their per-exec rollups can interleave.)"""
+    st = _STATE
+    if st is None:
+        return None
+    depth = getattr(_TLS, "depth", 0)
+    _TLS.depth = depth + 1
+    if depth:
+        return NESTED
+    with st._lock:
+        st._query_seq += 1
+        st._active += 1
+        return st._query_seq
+
+
+def wants_rollups() -> bool:
+    """Does a consumer (endpoint or history store) exist for per-exec
+    rollups? The epilogue uses this to decide whether the metric
+    snapshot — which resolves lazy device row counts, real syncs — is
+    worth taking at all."""
+    st = _STATE
+    return st is not None and (st.server is not None
+                               or st.history is not None)
+
+
+def on_query_end(token, *, session, plan, status: str,
+                 error: Optional[BaseException], duration_ns: int,
+                 wall_start_unix: float,
+                 trace_paths: Optional[dict],
+                 last_metrics: Optional[Dict[str, dict]] = None
+                 ) -> Optional[dict]:
+    """Publish one finished top-level action: registry rollups + the
+    history record. Returns the record (None when history is off).
+    MUST be called for every non-None token (including NESTED) — it
+    unwinds the thread's collect depth."""
+    _TLS.depth = max(0, getattr(_TLS, "depth", 1) - 1)
+    st = _STATE
+    if st is None or token is NESTED:
+        return None
+    reg = st.registry
+    try:
+        reg.counter("rapids_queries_total",
+                    labels={"status": status}).inc()
+        reg.histogram("rapids_query_wall_time_ms").observe(duration_ns / 1e6)
+        # per-exec rollups resolve lazy device row counts (real syncs):
+        # pay them only when something consumes the result — a scrape
+        # endpoint or the history store. A bare registry (obs enabled,
+        # nothing configured) keeps the query epilogue sync-free, and
+        # the caller's snapshot (if it took one for the trace) is
+        # reused so the epilogue snapshots the tree exactly ONCE.
+        snaps = last_metrics
+        if st.server is not None or st.history is not None:
+            if snaps is None:
+                snaps = {}
+                try:
+                    snaps = session.last_metrics()
+                except Exception:  # noqa: BLE001 - a poisoned lazy count
+                    pass  # must not drop the whole publish
+            _publish_exec_rollups(reg, snaps)
+        rec = None
+        if st.history is not None:
+            rec = build_query_record(
+                query_id=token, wall_start_unix=wall_start_unix,
+                duration_ns=duration_ns, status=status, error=error,
+                plan=plan, session=session, trace_paths=trace_paths,
+                snaps=snaps)
+            st.history.append(rec)
+        st.last_query = {
+            "query_id": token, "status": status,
+            "wall_ms": round(duration_ns / 1e6, 3),
+            "error_class": type(error).__name__ if error else None,
+            "finished_unix": time.time(),
+        }
+        return rec
+    except Exception:  # noqa: BLE001 - observability never fails a query
+        return None
+    finally:
+        with st._lock:
+            st._active -= 1
+
+
+def _publish_exec_rollups(reg: MetricsRegistry, snaps: Dict[str, dict]
+                          ) -> None:
+    """Per-exec-CLASS rollups (bounded cardinality: one series per
+    operator type, not per instance)."""
+    from spark_rapids_tpu.runtime.metrics import exec_rollup
+    per_cls: Dict[str, dict] = {}
+    shuffle_written = shuffle_spilled = 0
+    for exec_key, snap in snaps.items():
+        cls = exec_key.split("#", 1)[0]
+        r = exec_rollup(snap)
+        dst = per_cls.setdefault(cls, {"rows": 0, "batches": 0,
+                                       "dispatches": 0, "time_ns": 0})
+        for k in dst:
+            v = r.get(k)
+            if v:
+                dst[k] += int(v)
+        shuffle_written += int(snap.get("shuffleBytesWritten", 0))
+        shuffle_spilled += int(snap.get("shuffleBytesSpilled", 0))
+    for cls, r in per_cls.items():
+        lbl = {"exec": cls}
+        if r["time_ns"]:
+            reg.counter("rapids_exec_time_ns_total",
+                        "Per-operator-class device/op time (ns)",
+                        labels=lbl).inc(r["time_ns"])
+        if r["rows"]:
+            reg.counter("rapids_exec_rows_total",
+                        "Per-operator-class output rows", labels=lbl
+                        ).inc(r["rows"])
+        if r["dispatches"]:
+            reg.counter("rapids_exec_dispatches_total",
+                        "Per-operator-class device dispatches", labels=lbl
+                        ).inc(r["dispatches"])
+    if shuffle_written:
+        reg.counter("rapids_shuffle_bytes_written_total"
+                    ).inc(shuffle_written)
+    if shuffle_spilled:
+        reg.counter("rapids_shuffle_bytes_spilled_total"
+                    ).inc(shuffle_spilled)
+
+
+# ---------------------------------------------------------------------------
+# health
+# ---------------------------------------------------------------------------
+
+def healthz() -> dict:
+    """The /healthz document. Degraded when the device probe is blocked
+    or failing; everything else is informational pressure data."""
+    st = _STATE
+    if st is None:
+        return {"status": "degraded", "reason": "obs not installed"}
+    from spark_rapids_tpu.runtime import memory as MEM
+    from spark_rapids_tpu.runtime import semaphore as SEM
+    if st.probe is None:
+        from spark_rapids_tpu.runtime.obs.endpoint import DeviceProbe
+        st.probe = DeviceProbe()
+    device = st.probe.check()
+    sem = SEM.peek_semaphore()
+    sem_doc = {"permits": sem.permits, "available": sem.available,
+               "waiting": sem.waiting,
+               "saturated": sem.available == 0} if sem is not None else None
+    fw = MEM.peek_spill_framework()
+    if fw is not None:
+        host_held = fw.host_bytes_held()
+        spill_doc = {
+            "device_bytes_held": fw.device_bytes_held(),
+            "device_budget": fw.device_budget,
+            "host_bytes_held": host_held,
+            "host_budget": fw.host_budget,
+            "disk_spill_bytes": fw.metrics.get("spill_to_disk_bytes", 0),
+            "pressure": round(host_held / fw.host_budget, 4)
+            if fw.host_budget else 0.0,
+        }
+    else:
+        spill_doc = None
+    with st._lock:
+        active = st._active
+    # direct counter reads: a full registry snapshot would walk every
+    # histogram's quantiles per poll, and load balancers poll often
+    reg = st.registry
+    return {
+        "status": "ok" if device.get("alive") else "degraded",
+        "device": device,
+        "semaphore": sem_doc,
+        "spill": spill_doc,
+        "queries": {
+            "active": active,
+            "completed_ok": reg.counter(
+                "rapids_queries_total", labels={"status": "ok"}).value,
+            "failed": reg.counter(
+                "rapids_queries_total",
+                labels={"status": "failed"}).value,
+            "last": st.last_query,
+        },
+    }

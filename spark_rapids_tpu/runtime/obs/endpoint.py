@@ -1,0 +1,155 @@
+"""Opt-in background HTTP endpoint: Prometheus /metrics + /healthz JSON.
+
+Enabled by setting `spark.rapids.obs.port` (> 0). The server is a
+threading HTTP server on a daemon thread — scrapes are served while
+queries run; nothing about serving touches a query hot path (the
+registry reads take per-instrument locks only, and gauge callbacks are
+explicit live reads).
+
+/healthz reports:
+- device liveness via a trivial dispatch probe (a one-scalar device
+  round trip run on its own daemon thread with a timeout: a wedged
+  device/runtime — the reference's executor-heartbeat failure mode —
+  flips the status to "degraded" instead of hanging the scrape);
+- semaphore saturation (permits/available/waiting);
+- spill pressure (device/host bytes held vs budget, disk spill bytes);
+- last-query status (id, status, wall ms) and query counters.
+
+HTTP codes follow load-balancer conventions: 200 when ok, 503 when
+degraded, so the endpoint doubles as a liveness probe without a JSON
+parser in the prober.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+def default_device_probe() -> bool:
+    """One trivial dispatch + fetch: the cheapest end-to-end proof the
+    accelerator runtime still answers."""
+    import jax
+    import jax.numpy as jnp
+    return int(jax.device_get(jnp.asarray(1, jnp.int32) + 1)) == 2
+
+
+class DeviceProbe:
+    """Runs the probe on a daemon thread with a timeout. A probe that
+    never returns leaves its thread parked and reports degraded on this
+    and every later check until it completes — threads are never stacked
+    behind a wedged probe."""
+
+    def __init__(self, probe_fn: Callable[[], bool] = default_device_probe,
+                 timeout_s: float = 2.0):
+        self.probe_fn = probe_fn
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        #: the live probe generation: (done_event, result_holder, t0).
+        #: Results live on the generation's own holder, so a wedged
+        #: probe completing late can never overwrite a newer answer.
+        self._current = None
+
+    def check(self) -> dict:
+        blocked = {"alive": False, "blocked": True, "probe_ms": None}
+        with self._lock:
+            cur = self._current
+            if cur is not None and not cur[0].is_set():
+                if time.perf_counter() - cur[2] >= self.timeout_s:
+                    # a probe already past its deadline is still parked:
+                    # degraded, and no thread stacking behind it
+                    return blocked
+                # a HEALTHY probe is merely in flight (concurrent
+                # scrapes): share it and wait out its remaining budget
+                # instead of reporting a false 'blocked'
+            else:
+                done = threading.Event()
+                holder: dict = {}
+                t0 = time.perf_counter()
+
+                def run():
+                    ok = False
+                    try:
+                        ok = bool(self.probe_fn())
+                    except Exception:  # noqa: BLE001 - a raising probe
+                        ok = False  # is a dead device
+                    holder["alive"] = ok
+                    holder["ms"] = (time.perf_counter() - t0) * 1000.0
+                    done.set()
+
+                cur = (done, holder, t0)
+                self._current = cur
+                threading.Thread(target=run, name="rapids-obs-probe",
+                                 daemon=True).start()
+        done, holder, t0 = cur
+        remaining = self.timeout_s - (time.perf_counter() - t0)
+        if remaining <= 0 or not done.wait(remaining):
+            return blocked
+        return {"alive": bool(holder.get("alive")), "blocked": False,
+                "probe_ms": round(holder.get("ms", 0.0), 3)}
+
+
+class ObsHttpServer:
+    """Daemon-thread HTTP server serving the registry + health callback."""
+
+    def __init__(self, port: int,
+                 render_metrics: Callable[[], str],
+                 healthz: Callable[[], dict],
+                 host: str = "127.0.0.1"):
+        self._render_metrics = render_metrics
+        self._healthz = healthz
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # silence per-request stderr
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        body = outer._render_metrics().encode()
+                        self._send(200, body,
+                                   "text/plain; version=0.0.4; "
+                                   "charset=utf-8")
+                    elif path == "/healthz":
+                        doc = outer._healthz()
+                        code = 200 if doc.get("status") == "ok" else 503
+                        self._send(code, json.dumps(doc, indent=1).encode(),
+                                   "application/json")
+                    elif path == "/":
+                        self._send(200, b"spark-rapids-tpu obs endpoint: "
+                                   b"/metrics /healthz\n", "text/plain")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except Exception as e:  # noqa: BLE001 - scrape must answer
+                    self._send(500, f"error: {e}\n".encode(), "text/plain")
+
+        self._server = ThreadingHTTPServer((host, int(port)), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="rapids-obs-http", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

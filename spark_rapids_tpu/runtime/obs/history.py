@@ -1,0 +1,191 @@
+"""Persistent query history: one JSON record per query, digest-matched.
+
+The Spark SQL tab / history-server analog for a standalone engine whose
+metrics otherwise die with the process: every top-level action appends
+one JSONL record under `spark.rapids.obs.historyDir` — plan digest,
+physical plan text, per-exec metric rollups, fusion groups, fallback
+reasons, config delta, wall time, status (ok/failed + exception class),
+and the trace artifact paths when tracing was on. `tools/history_server.py`
+renders the store as static HTML (query list -> annotated plan with
+hot-path highlighting -> run-over-run diff of the same plan digest), and
+`tools/profiler_report.py --history` cross-links a trace file to its
+history record through the shared plan digest.
+
+The digest is a canonical hash of the LOGICAL plan tree (node type +
+describe + children), so two runs of the same query — today or next
+week, traced or not — land on the same digest and become a diffable
+pair. State-dependent describes (CachedRelation's hot/cold) are
+normalized out.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+HISTORY_FILE = "query_history.jsonl"
+
+
+def _digest_describe(node) -> str:
+    """describe() with run-state normalized out so the digest is stable
+    across runs of the same query."""
+    from spark_rapids_tpu.plan import nodes as P
+    if isinstance(node, P.CachedRelation):
+        return "CachedRelation"  # hot/cold flips between runs
+    return node.describe()
+
+
+def plan_digest(plan) -> str:
+    """Stable 16-hex digest of a logical plan tree."""
+
+    def walk(n) -> dict:
+        return {"t": type(n).__name__, "d": _digest_describe(n),
+                "c": [walk(c) for c in n.children]}
+
+    blob = json.dumps(walk(plan), sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def conf_delta(conf) -> Dict[str, object]:
+    """Config values differing from their registered defaults (the knobs
+    that shaped THIS run — what a run-over-run diff must surface when
+    the plan digest matches but the numbers moved)."""
+    from spark_rapids_tpu import config as C
+    out: Dict[str, object] = {}
+    for key, entry in C.registry().items():
+        if entry.internal:
+            continue
+        v = conf.get(key)
+        if v != entry.default:
+            out[key] = v
+    return out
+
+
+class QueryHistoryStore:
+    """Append-only JSONL store (one line per query record). Appends are
+    single write() calls under a process lock — concurrent sessions in
+    one process interleave whole lines, never partial ones."""
+
+    def __init__(self, history_dir: str):
+        self.dir = history_dir
+        os.makedirs(history_dir, exist_ok=True)
+        self.path = os.path.join(history_dir, HISTORY_FILE)
+        self._lock = threading.Lock()
+
+    def append(self, record: dict) -> None:
+        line = json.dumps(record, default=str) + "\n"
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line)
+
+    def read_all(self) -> List[dict]:
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # a torn tail line must not kill the reader
+        return out
+
+    def by_digest(self, digest: str) -> List[dict]:
+        return [r for r in self.read_all()
+                if r.get("plan_digest") == digest]
+
+    def latest(self, n: int = 50) -> List[dict]:
+        return self.read_all()[-n:]
+
+
+def build_query_record(*, query_id: int, wall_start_unix: float,
+                       duration_ns: int, status: str,
+                       error: Optional[BaseException],
+                       plan, session,
+                       trace_paths: Optional[dict],
+                       snaps: Optional[dict] = None) -> dict:
+    """Assemble one history record from a finished action's state. Every
+    sub-extraction is best-effort: history must never fail a query.
+    `snaps` is the caller's last_metrics() snapshot when it already took
+    one — re-snapshotting would redo the lazy-count device syncs."""
+    rec: Dict[str, object] = {
+        "type": "query",
+        "query_id": query_id,
+        "wall_start_unix": wall_start_unix,
+        "duration_ns": int(duration_ns),
+        "status": status,
+    }
+    if error is not None:
+        rec["error_class"] = type(error).__name__
+        rec["error"] = str(error)[:500]
+    try:
+        rec["plan_digest"] = plan_digest(plan)
+    except Exception:  # noqa: BLE001
+        rec["plan_digest"] = None
+    try:
+        exec_root = getattr(session, "_last_exec", None)
+        if exec_root is not None:
+            rec["physical_plan"] = exec_root.tree_string()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from spark_rapids_tpu.runtime.metrics import exec_rollup
+        if snaps is None:
+            snaps = session.last_metrics()
+        rec["execs"] = {k: dict(v, **{"_rollup": exec_rollup(v)})
+                        for k, v in snaps.items()}
+    except Exception:  # noqa: BLE001
+        rec["execs"] = {}
+    try:
+        # the engine's own canonical walk annotates the plan (the
+        # history server renders this directly: tree_string prints
+        # fused members parent-most first while metric keys assign
+        # child-most first, so a renderer-side class-occurrence match
+        # would attach members' numbers to each other's lines)
+        rec["annotated_plan"] = session.explain_analyze()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from spark_rapids_tpu.exec.stage_fusion import fusion_groups
+        exec_root = getattr(session, "_last_exec", None)
+        rec["fusion_groups"] = (fusion_groups(exec_root)
+                                if exec_root is not None else [])
+    except Exception:  # noqa: BLE001
+        rec["fusion_groups"] = []
+    try:
+        rec["fallback_reasons"] = _meta_reasons(
+            getattr(session, "_last_meta", None))
+    except Exception:  # noqa: BLE001
+        rec["fallback_reasons"] = []
+    try:
+        rec["conf_delta"] = conf_delta(session.conf)
+    except Exception:  # noqa: BLE001
+        rec["conf_delta"] = {}
+    if trace_paths:
+        rec["trace_paths"] = dict(trace_paths)
+    return rec
+
+
+def _meta_reasons(meta) -> List[str]:
+    """Flatten the tagging tree's fallback reasons (why anything ran on
+    CPU), deduplicated in tree order."""
+    if meta is None:
+        return []
+    out: List[str] = []
+    seen = set()
+
+    def walk(m):
+        for r in getattr(m, "reasons", ()):  # SparkPlanMeta
+            if r not in seen:
+                seen.add(r)
+                out.append(r)
+        for c in getattr(m, "children", ()):
+            walk(c)
+
+    walk(meta)
+    return out

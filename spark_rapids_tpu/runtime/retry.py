@@ -115,7 +115,19 @@ class _Split(Exception):
 def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
                         splittable: bool) -> object:
     """Shared retry loop: injection check, OOM translation, spill drain.
-    Raises _Split when the caller should split the input instead."""
+    Raises _Split when the caller should split the input instead.
+
+    Retry accounting: the enclosing exec timer (agg/sort/join span) wraps
+    the WHOLE loop, so a replayed attempt's time lands in the same
+    GpuMetric as the attempt it replaces — the total is real wall time,
+    but "how much of it was replay" used to be invisible (and the
+    offline report double-counted the work as if the operator were that
+    slow). Each failed attempt is therefore timed and (a) accumulated
+    into the task's retryWastedTime, (b) emitted as its own tagged
+    `retryAttempt` span nested inside the exec span — the report's
+    exclusive-time pass then attributes replay to retry, not the
+    operator, and rollups report attempt count and first-attempt vs
+    total time separately."""
     import time as _time
 
     from spark_rapids_tpu.runtime import trace
@@ -124,20 +136,48 @@ def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
 
     retries = 0
     while True:
+        t0a = _time.perf_counter_ns()
         try:
             OomInjector.maybe_throw()
-            return attempt()
-        except TpuSplitAndRetryOOM:
+            result = attempt()
+            if retries and trace.active() is not None:
+                # the attempt that finally landed, tagged with how many
+                # tries the work took in total
+                trace.instant("retrySucceeded", cat="retry", args={
+                    "attempts": retries + 1})
+            return result
+        except TpuSplitAndRetryOOM as e:
             if splittable:
+                # the split flavor replays too: the halves re-run work
+                # this attempt already did, so its time is wasted-attempt
+                # time exactly like a plain retry (same tagging, same
+                # first-attempt arithmetic in the report)
+                wasted_ns = _time.perf_counter_ns() - t0a
+                ctx = TaskContext.peek()
+                if ctx is not None:
+                    ctx.metric("retryWastedTime").add(wasted_ns)
+                trace.emit_span("retryAttempt", t0a, wasted_ns,
+                                cat="retry",
+                                args={"attempt": retries + 1,
+                                      "retried": True, "split": True,
+                                      "error": type(e).__name__})
                 raise _Split()
             raise
         except Exception as e:  # noqa: BLE001 - translate device OOM too
             if not isinstance(e, TpuRetryOOM) and not is_device_oom(e):
                 raise
+            wasted_ns = _time.perf_counter_ns() - t0a
             retries += 1
             ctx = TaskContext.peek()
             if ctx is not None:
                 ctx.metric("retryCount").add(1)
+                # the portion of the enclosing exec timer that was a
+                # replayed attempt (first-attempt time = metric total
+                # minus this accumulator)
+                ctx.metric("retryWastedTime").add(wasted_ns)
+            trace.emit_span("retryAttempt", t0a, wasted_ns, cat="retry",
+                            args={"attempt": retries, "retried": True,
+                                  "error": type(e).__name__})
             trace.instant("retryOOM", cat="retry", args={
                 "attempt": retries, "error": type(e).__name__})
             if retries > max_retries:

@@ -67,13 +67,21 @@ class PrioritySemaphore:
     def available(self) -> int:
         return self._available
 
+    @property
+    def waiting(self) -> int:
+        """Parked waiters (healthz saturation signal; racy read is fine)."""
+        return len(self._waiters)
+
 
 class TpuSemaphore:
     """Task-aware wrapper: re-entrant per task, auto-released on task end
     (reference GpuSemaphore.acquireIfNecessary / completion hook)."""
 
     def __init__(self, permits: int):
+        self.permits = permits
         self._sem = PrioritySemaphore(permits)
+        #: task_id -> perf_counter_ns at acquisition (truthy while held;
+        #: the timestamp feeds the semaphoreHoldTime task accumulator)
         self._held: Dict[int, int] = {}
         self._lock = threading.Lock()
 
@@ -92,14 +100,20 @@ class TpuSemaphore:
                 "task_id": tid, "priority": prio,
                 "wait_ns": time.perf_counter_ns() - t0})
         with self._lock:
-            self._held[tid] = 1
+            self._held[tid] = time.perf_counter_ns()
         task_ctx.on_completion(lambda: self.release(task_ctx))
 
     def release(self, task_ctx) -> None:
         tid = task_ctx.task_id
         with self._lock:
-            if not self._held.pop(tid, 0):
+            t_acq = self._held.pop(tid, 0)
+            if not t_acq:
                 return
+        # hold-time accumulator (permit occupancy — the saturation-side
+        # complement of semaphoreWaitTime; folded into the live registry
+        # at task completion)
+        task_ctx.metric("semaphoreHoldTime").add(
+            time.perf_counter_ns() - t_acq)
         self._sem.release(1)
         if trace.active() is not None:
             trace.instant("semaphoreRelease", cat="semaphore",
@@ -108,6 +122,10 @@ class TpuSemaphore:
     @property
     def available(self) -> int:
         return self._sem.available
+
+    @property
+    def waiting(self) -> int:
+        return self._sem.waiting
 
 
 _global: Optional[TpuSemaphore] = None
@@ -125,6 +143,13 @@ def get_semaphore(conf=None) -> TpuSemaphore:
                 c = get_conf()
             _global = TpuSemaphore(c.get(C.CONCURRENT_TPU_TASKS))
         return _global
+
+
+def peek_semaphore() -> Optional[TpuSemaphore]:
+    """The process semaphore WITHOUT creating one (healthz / the live
+    gauges must not mint a semaphore sized by whatever conf happens to
+    be active on the scrape thread)."""
+    return _global
 
 
 def reset_semaphore() -> None:

@@ -48,9 +48,12 @@ class TaskContext:
         self._completion.clear()
         # roll the task accumulators into the active query trace's event
         # log AFTER the completion callbacks (the semaphore release hook
-        # runs first, so its final wait total is included)
-        from spark_rapids_tpu.runtime import trace
+        # runs first, so its final wait total is included), then fold
+        # them into the live observability registry — ONE registry write
+        # batch per task, the only obs cost on the execution path
+        from spark_rapids_tpu.runtime import obs, trace
         trace.on_task_complete(self)
+        obs.on_task_complete(self)
 
     # -- thread association ------------------------------------------------
     @staticmethod

@@ -43,8 +43,9 @@ from spark_rapids_tpu.runtime.metrics import DEBUG, ESSENTIAL, MODERATE
 #: (the GpuTaskMetrics analog). semaphoreWaitTime is fed by the
 #: semaphore itself; the rest by runtime/retry.py and runtime/memory.py.
 TASK_METRIC_NAMES = (
-    "semaphoreWaitTime",
+    "semaphoreWaitTime", "semaphoreHoldTime",
     "retryCount", "splitAndRetryCount", "retryBlockTime",
+    "retryWastedTime",
     "spillToHostBytes", "spillToDiskBytes",
     "spillToHostTime", "spillToDiskTime",
     "maxDeviceBytesHeld",
@@ -156,8 +157,16 @@ class Tracer:
                 "events": base + "_events.jsonl",
                 "metrics": base + "_metrics.json"}
 
-    def finalize(self, last_metrics: Optional[dict] = None) -> Dict[str, str]:
-        """Write the three artifacts; returns their paths."""
+    def finalize(self, last_metrics: Optional[dict] = None,
+                 status: str = "ok",
+                 error: Optional[BaseException] = None,
+                 plan_digest: Optional[str] = None) -> Dict[str, str]:
+        """Write the three artifacts; returns their paths. A failed query
+        finalizes with status="failed" + the exception class so the
+        buffered events flush instead of dying with the query (and the
+        offline report can say WHY the trace ends early); plan_digest
+        cross-links these artifacts to the query-history record that
+        shares it."""
         os.makedirs(self.out_dir, exist_ok=True)
         p = self.paths()
         with self._lock:
@@ -170,17 +179,24 @@ class Tracer:
                 "query_id": self.query_id,
                 "trace_level": self.level,
                 "wall_start_unix": self._wall0,
+                "status": status,
+                "plan_digest": plan_digest,
                 "producer": "spark_rapids_tpu.runtime.trace",
             },
         }
         with open(p["trace"], "w") as f:
             json.dump(doc, f)
         with open(p["events"], "w") as f:
-            f.write(json.dumps({
+            qrec = {
                 "type": "query", "query_id": self.query_id,
                 "wall_start_unix": self._wall0,
                 "duration_ns": time.perf_counter_ns() - self._t0,
-                "n_tasks": len(tasks)}) + "\n")
+                "n_tasks": len(tasks),
+                "status": status,
+                "plan_digest": plan_digest}
+            if error is not None:
+                qrec["error_class"] = type(error).__name__
+            f.write(json.dumps(qrec) + "\n")
             for rec in tasks:
                 f.write(json.dumps(rec) + "\n")
         if last_metrics is not None:
@@ -369,10 +385,16 @@ def start_query(conf) -> Optional[Tracer]:
 
 
 def end_query(tracer: Tracer,
-              last_metrics: Optional[dict] = None) -> Dict[str, str]:
-    """Uninstall + finalize; returns the artifact paths."""
+              last_metrics: Optional[dict] = None,
+              status: str = "ok",
+              error: Optional[BaseException] = None,
+              plan_digest: Optional[str] = None) -> Dict[str, str]:
+    """Uninstall + finalize; returns the artifact paths. The tracer is
+    uninstalled FIRST so a finalize failure can never leave a dead
+    tracer swallowing the next query's events."""
     global _TRACER
     with _STATE_LOCK:
         if _TRACER is tracer:
             _TRACER = None
-    return tracer.finalize(last_metrics=last_metrics)
+    return tracer.finalize(last_metrics=last_metrics, status=status,
+                           error=error, plan_digest=plan_digest)

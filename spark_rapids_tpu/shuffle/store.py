@@ -89,6 +89,16 @@ class ShuffleStore:
                         self._resident -= len(b)
                         self.bytes_spilled += len(b)
 
+    def totals(self) -> dict:
+        """Byte totals for the exchange's metric export (folded into the
+        exchange exec's shuffleBytesWritten/Spilled GpuMetrics once per
+        materialization — the live registry then rolls them up at query
+        end; never read on the per-blob path)."""
+        with self._lock:
+            return {"bytes_written": self.bytes_written,
+                    "bytes_spilled": self.bytes_spilled,
+                    "bytes_resident": self._resident}
+
     def iter_partition(self, partition: int) -> Iterator[bytes]:
         for b in list(self._parts[partition]):
             yield b if isinstance(b, bytes) else b.read()

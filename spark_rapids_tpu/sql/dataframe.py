@@ -667,8 +667,16 @@ class DataFrame:
         """'placement' (default): the tagging report — every operator with
         its TPU/CPU placement and fallback reasons. 'stages': the physical
         exec tree after whole-stage vertical fusion, with fusion groups
-        annotated `*(N)` the way Spark prints whole-stage-codegen ids."""
-        if mode == "stages":
+        annotated `*(N)` the way Spark prints whole-stage-codegen ids.
+        'analyze': EXECUTE the query, then print the physical tree
+        annotated with the actual rows/batches/dispatches/time each exec
+        recorded (Spark's EXPLAIN ANALYZE / the SQL tab's live metric
+        annotations) — a slow query is diagnosable from its own run,
+        without re-running it under the tracer."""
+        if mode == "analyze":
+            self.collect()
+            s = self.session.explain_analyze()
+        elif mode == "stages":
             # build the exec tree WITHOUT convert_plan's action-time side
             # effects (LORE dumper install would overwrite recordings;
             # test-mode fallback assertions would raise instead of print)

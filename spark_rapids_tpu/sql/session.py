@@ -13,6 +13,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import to_arrow
 from spark_rapids_tpu.expr.core import SparkException
 from spark_rapids_tpu.plan import nodes as P
+from spark_rapids_tpu.runtime.metrics import walk_exec_tree
 from spark_rapids_tpu.runtime.task import TaskContext
 from spark_rapids_tpu.sql.dataframe import DataFrame
 
@@ -64,6 +65,10 @@ class TpuSession:
         self.last_trace_paths = None
         from spark_rapids_tpu.ops import pallas_kernels as PK
         PK.set_enabled(self.conf.get(C.PALLAS_ENABLED))
+        # live observability (spark.rapids.obs.*): process-wide registry,
+        # optional /metrics+/healthz endpoint, optional history store
+        from spark_rapids_tpu.runtime import obs
+        obs.install(self.conf)
 
     def _activate(self):
         # name binding (case sensitivity) consults the active session conf
@@ -178,35 +183,22 @@ class TpuSession:
     def last_metrics(self):
         """Per-exec metrics of the most recent action (the SQL-UI metrics
         surface; reference GpuMetric / GpuTaskMetrics §5.5). Returns
-        {exec_name#i: {metric: value}} in plan order."""
+        {exec_name#i: {metric: value}} in walk_exec_tree order (fused
+        members and absorbed pre-chains snapshot alone — recursing their
+        original child links would re-walk shared subtrees)."""
         out = {}
-
-        def snap_one(node, idx):
-            snap = node.metrics.snapshot()
-            key = f"{type(node).__name__}#{idx[0]}"
-            idx[0] += 1
-            if snap:
-                out[key] = snap
-
-        def walk(node, idx=[0]):
-            snap_one(node, idx)
-            # vertically fused members (FusedStageExec.members / an
-            # aggregate's absorbed pre_chain_members) are not children but
-            # still carry attributed metrics. Their ORIGINAL child links
-            # still point into the collapsed chain, so snapshot the member
-            # alone — recursing would re-walk shared subtrees.
-            for m in (getattr(node, "members", None) or []):
-                snap_one(m, idx)
-            for m in (getattr(node, "pre_chain_members", None) or []):
-                snap_one(m, idx)
-            for c in node.children:
-                walk(c, idx)
-
         if getattr(self, "_last_exec", None) is not None:
-            walk(self._last_exec)
+            for key, node, _d, _role, _sid in walk_exec_tree(
+                    self._last_exec):
+                snap = node.metrics.snapshot()
+                if snap:
+                    out[key] = snap
         return out
 
     def collect(self, plan: P.PlanNode) -> pa.Table:
+        import time as _time
+
+        from spark_rapids_tpu.runtime import obs as OBS
         from spark_rapids_tpu.runtime import trace as TR
         # structured trace per action (spark.rapids.sql.trace.*): spans +
         # instants + the task event log, finalized with this action's
@@ -221,6 +213,20 @@ class TpuSession:
             # paths looking like this one's. A same-session outer collect
             # restores its own paths when it finalizes.
             self.last_trace_paths = None
+        # live-observability token: None when obs is off or this is a
+        # nested collect (only top-level actions publish + make history)
+        ot = OBS.on_query_start()
+        if qt is not None or (ot is not None and ot is not OBS.NESTED):
+            # drop the PREVIOUS action's exec tree before this one runs:
+            # a failure before convert_plan rebuilds it must publish
+            # nothing — republishing the old tree's (unchanged) metrics
+            # would double the registry counters and attach the previous
+            # query's plan to this query's history record
+            self._last_exec = None
+            self._last_meta = None
+        t0 = _time.perf_counter_ns()
+        wall0 = _time.time()
+        error: Optional[BaseException] = None
         try:
             prof_dir = self.conf.get(C.PROFILE_DIR)
             if prof_dir:
@@ -231,19 +237,82 @@ class TpuSession:
                 with jax.profiler.trace(prof_dir):
                     return self._collect_inner(plan)
             return self._collect_inner(plan)
+        except BaseException as e:
+            error = e
+            raise
         finally:
-            if qt is not None:
-                # cleared first so a finalize failure can never leave a
-                # PREVIOUS query's artifacts looking like this one's
-                self.last_trace_paths = None
-                try:
-                    self.last_trace_paths = TR.end_query(
-                        qt, last_metrics=self.last_metrics())
-                except Exception:  # noqa: BLE001 - observability must
-                    # never fail a query that already succeeded
-                    import logging
-                    logging.getLogger("spark_rapids_tpu").warning(
-                        "failed to finalize query trace", exc_info=True)
+            self._finish_action(plan, qt, ot, error,
+                                _time.perf_counter_ns() - t0, wall0)
+
+    def _finish_action(self, plan, qt, ot, error, duration_ns,
+                       wall0) -> None:
+        """Query epilogue: finalize the trace (success OR failure) and
+        publish the action to the live observability layer. Every step is
+        fenced — a failed query must still flush its buffered trace
+        events (with an `error` instant and status=failed), and a
+        last_metrics() snapshot that itself raises (a lazy device count
+        on a poisoned buffer) must not swallow the artifacts, which it
+        previously did by raising between the two finalize halves."""
+        import logging
+
+        from spark_rapids_tpu.runtime import obs as OBS
+        from spark_rapids_tpu.runtime import trace as TR
+        log = logging.getLogger("spark_rapids_tpu")
+        status = "ok" if error is None else "failed"
+        # ONE metric snapshot serves the trace finalize, the registry
+        # rollups, and the history record (resolving lazy device row
+        # counts costs real syncs) — and it is taken at all only when
+        # something consumes it: a tracer, the endpoint, or the store
+        top_level = ot is not None and ot is not OBS.NESTED
+        digest = None
+        lm = None
+        if qt is not None or (top_level and OBS.wants_rollups()):
+            try:
+                lm = self.last_metrics()
+            except Exception:  # noqa: BLE001 - snapshot must not block
+                log.warning("failed to snapshot last_metrics",
+                            exc_info=True)
+        if qt is not None:
+            try:
+                digest = OBS.plan_digest(plan)
+            except Exception:  # noqa: BLE001
+                pass
+        if qt is not None:
+            # cleared first so a finalize failure can never leave a
+            # PREVIOUS query's artifacts looking like this one's
+            self.last_trace_paths = None
+            try:
+                if error is not None:
+                    # flush-time marker: the trace ends HERE because the
+                    # query raised, not because instrumentation stopped
+                    TR.instant("queryError", cat="query", args={
+                        "error": type(error).__name__,
+                        "message": str(error)[:200]},
+                        level=TR.ESSENTIAL)
+                self.last_trace_paths = TR.end_query(
+                    qt, last_metrics=lm, status=status, error=error,
+                    plan_digest=digest)
+            except Exception:  # noqa: BLE001 - observability must
+                # never fail (or mask the real error of) a query
+                log.warning("failed to finalize query trace",
+                            exc_info=True)
+        if ot is not None:
+            try:
+                OBS.on_query_end(
+                    ot, session=self, plan=plan, status=status,
+                    error=error, duration_ns=duration_ns,
+                    wall_start_unix=wall0,
+                    # only a trace finalized by THIS action may attach:
+                    # an untraced query must not inherit a previous
+                    # traced query's artifact paths into its history
+                    # record (cross_link would then resolve that trace
+                    # to the wrong query)
+                    trace_paths=(self.last_trace_paths
+                                 if qt is not None else None),
+                    last_metrics=lm)
+            except Exception:  # noqa: BLE001
+                log.warning("failed to publish query to obs",
+                            exc_info=True)
 
     def run_partitions(self, exec_root, per_batch):
         """Execute every partition of an exec tree (parallel tasks, up to
@@ -307,3 +376,34 @@ class TpuSession:
 
     def last_plan_explain(self) -> str:
         return self._last_meta.explain(all_ops=True) if self._last_meta else ""
+
+    def explain_analyze(self) -> str:
+        """The physical exec tree of the MOST RECENT action annotated
+        with its actual runtime metrics — rows, batches, dispatches, and
+        operator time per exec, straight from last_metrics() (the
+        EXPLAIN ANALYZE surface; reference: the Spark SQL tab's metric
+        annotations on the live plan). Fused-stage members render
+        indented under their stage with the *(N) fusion-group marker,
+        each with its own attributed numbers."""
+        from spark_rapids_tpu.runtime.metrics import exec_rollup
+        root = getattr(self, "_last_exec", None)
+        if root is None:
+            return "<no executed plan: run an action first>"
+        snaps = self.last_metrics()
+        lines: List[str] = []
+        for key, node, depth, role, sid in walk_exec_tree(root):
+            r = exec_rollup(snaps.get(key, {}))
+            parts = [f"rows={r['rows']}", f"batches={r['batches']}"]
+            if r["dispatches"]:
+                parts.append(f"dispatches={r['dispatches']}")
+            parts.append(f"time={r['time_ns'] / 1e6:.3f}ms")
+            annot = ", ".join(parts)
+            pad = "  " * depth
+            if role is None:
+                mark = f"*({sid}) " if sid is not None else ""
+                lines.append(f"{pad}{mark}{node.name()}  [{annot}]")
+            else:
+                tag = "fused" if role == "member" else role
+                lines.append(f"{pad}  *({sid}) {type(node).__name__} "
+                             f"[{tag}]  [{annot}]")
+        return "\n".join(lines)

@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
-# CI gate: docs drift, trace-overhead smoke, tier-1 tests.
+# CI gate: docs drift, trace-overhead smoke, obs smoke, tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
-#   tools/ci_check.sh --fast     # skip tier-1 (docs drift + trace smoke)
+#   tools/ci_check.sh --fast     # skip tier-1 (docs drift + smokes)
 #
 # Mirrors the reference's build checks: generated docs must match the
 # committed ones (SupportedOpsDocs/RapidsConf.help regeneration), the
@@ -27,6 +27,11 @@ fi
 
 step "trace-overhead smoke (disabled <2% of no-trace baseline; enabled run emits Perfetto-loadable JSON)"
 if ! python tools/trace_overhead.py; then
+    fail=1
+fi
+
+step "obs smoke (/metrics scrape while a query runs, /healthz degraded flip, history round-trip)"
+if ! python tools/obs_smoke.py; then
     fail=1
 fi
 

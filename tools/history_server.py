@@ -1,0 +1,307 @@
+"""Static HTML renderer for the query history store (the Spark SQL tab
+analog for a standalone engine).
+
+Given a `spark.rapids.obs.historyDir` containing query_history.jsonl
+(written by the engine's query epilogue and by tools/nds_probe.py),
+renders:
+
+- index.html — the query list (id, start time, status, wall ms, digest,
+  fallback count) newest first, plus the NDS scorecard records;
+- query_<n>.html — one page per query: the physical plan annotated with
+  per-exec rollups, HOT-PATH HIGHLIGHTING (execs above 15% of total
+  operator time render highlighted), fusion groups, fallback reasons,
+  config delta, trace artifact paths;
+- diff_<digest>.html — for every plan digest with >= 2 runs, a
+  run-over-run diff of the latest two runs: per-exec metric deltas side
+  by side (the regression-hunting view: same plan, what moved?).
+
+Everything is self-contained static HTML (inline CSS, no JS deps) so the
+output can be dropped behind any file server.
+
+Run:  python tools/history_server.py <historyDir> [--out DIR]
+      python tools/history_server.py <historyDir> --serve PORT
+"""
+from __future__ import annotations
+
+import argparse
+import html
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from spark_rapids_tpu.runtime.obs.history import (  # noqa: E402
+    QueryHistoryStore,
+)
+
+_CSS = """
+body { font-family: -apple-system, 'Segoe UI', Roboto, sans-serif;
+       margin: 2em auto; max-width: 1100px; color: #1a1a2e; }
+table { border-collapse: collapse; width: 100%; margin: 1em 0; }
+th, td { border: 1px solid #d0d0e0; padding: 4px 10px; text-align: left;
+         font-size: 14px; }
+th { background: #f0f0f8; }
+tr.failed td { background: #fde8e8; }
+pre { background: #f6f6fb; padding: 1em; overflow-x: auto;
+      font-size: 13px; line-height: 1.45; }
+.hot { background: #ffe2b8; font-weight: bold; }
+.num { text-align: right; font-variant-numeric: tabular-nums; }
+.delta-up { color: #b00020; font-weight: bold; }
+.delta-down { color: #0a7a2f; font-weight: bold; }
+.badge-ok { color: #0a7a2f; } .badge-failed { color: #b00020; }
+h1, h2 { font-weight: 600; }
+a { color: #3949ab; }
+small.digest { font-family: monospace; color: #666; }
+"""
+
+
+def _page(title: str, body: str) -> str:
+    return (f"<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title><style>{_CSS}</style>"
+            f"</head><body><h1>{html.escape(title)}</h1>{body}"
+            f"</body></html>")
+
+
+def _esc(x) -> str:
+    return html.escape(str(x))
+
+
+def _fmt_time(unix: Optional[float]) -> str:
+    if not unix:
+        return "?"
+    return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(unix))
+
+
+def _rollups(rec: dict) -> Dict[str, dict]:
+    """exec_key -> rollup dict ({rows,batches,dispatches,time_ns})."""
+    out = {}
+    for k, snap in (rec.get("execs") or {}).items():
+        out[k] = snap.get("_rollup") or {}
+    return out
+
+
+def _page_names(records: List[dict]) -> Dict[int, str]:
+    """record index -> unique page name. query_id restarts at 1 per
+    PROCESS, so (id, second) collides across processes appending to one
+    store — the store position disambiguates."""
+    return {i: f"query_{i}_{rec.get('query_id')}.html"
+            for i, rec in enumerate(records)
+            if rec.get("type") != "nds_scorecard"}
+
+
+# ---------------------------------------------------------------------------
+# per-query page
+# ---------------------------------------------------------------------------
+
+_TIME_RE = re.compile(r"time=([0-9.]+)ms")
+
+
+def render_query_page(rec: dict) -> str:
+    # the record carries the plan ALREADY annotated by the engine's own
+    # canonical walk (session.explain_analyze) — renderer-side matching
+    # of plan lines to metric keys is impossible to get right because
+    # tree_string prints fused members parent-most first while the
+    # metric keys assign child-most first. Here we only highlight: a
+    # line whose annotated time is >= 15% of the plan total is hot.
+    plan = rec.get("annotated_plan") or rec.get("physical_plan") or ""
+    line_times = [float(m.group(1)) if (m := _TIME_RE.search(ln))
+                  else None for ln in plan.splitlines()]
+    total_ms = sum(t for t in line_times if t) or 1.0
+    hot_cut = 0.15 * total_ms
+    out_lines = []
+    for line, t in zip(plan.splitlines(), line_times):
+        hot = t is not None and t > 0 and t >= hot_cut
+        out_lines.append(f"<span class='hot'>{_esc(line)}</span>"
+                         if hot else _esc(line))
+
+    body = [f"<p>status <b class='badge-{rec.get('status', 'ok')}'>"
+            f"{_esc(rec.get('status'))}</b>"
+            + (f" ({_esc(rec.get('error_class'))}: "
+               f"{_esc(rec.get('error', ''))})"
+               if rec.get("error_class") else "")
+            + f" · started {_fmt_time(rec.get('wall_start_unix'))}"
+            f" · wall {rec.get('duration_ns', 0) / 1e6:.1f} ms"
+            f" · digest <small class='digest'>"
+            f"{_esc(rec.get('plan_digest'))}</small></p>"]
+    body.append("<h2>Annotated plan</h2><pre>"
+                + "\n".join(out_lines) + "</pre>")
+
+    if rec.get("fusion_groups"):
+        body.append("<h2>Fusion groups</h2><table><tr><th>stage</th>"
+                    "<th>kind</th><th>members</th></tr>")
+        for g in rec["fusion_groups"]:
+            body.append(f"<tr><td>*({_esc(g.get('stage_id'))})</td>"
+                        f"<td>{_esc(g.get('kind'))}</td>"
+                        f"<td>{_esc(' → '.join(g.get('members', [])))}"
+                        f"</td></tr>")
+        body.append("</table>")
+
+    if rec.get("fallback_reasons"):
+        body.append("<h2>Fallback reasons</h2><ul>")
+        for r in rec["fallback_reasons"]:
+            body.append(f"<li>{_esc(r)}</li>")
+        body.append("</ul>")
+
+    if rec.get("conf_delta"):
+        body.append("<h2>Config delta (vs defaults)</h2><table>"
+                    "<tr><th>key</th><th>value</th></tr>")
+        for k in sorted(rec["conf_delta"]):
+            body.append(f"<tr><td><code>{_esc(k)}</code></td>"
+                        f"<td>{_esc(rec['conf_delta'][k])}</td></tr>")
+        body.append("</table>")
+
+    if rec.get("trace_paths"):
+        body.append("<h2>Trace artifacts</h2><ul>")
+        for k, p in rec["trace_paths"].items():
+            body.append(f"<li>{_esc(k)}: <code>{_esc(p)}</code></li>")
+        body.append("</ul>")
+
+    body.append("<p><a href='index.html'>&larr; query list</a></p>")
+    return _page(f"Query {rec.get('query_id')}", "\n".join(body))
+
+
+# ---------------------------------------------------------------------------
+# run-over-run diff
+# ---------------------------------------------------------------------------
+
+def render_diff_page(digest: str, older: dict, newer: dict) -> str:
+    ra, rb = _rollups(older), _rollups(newer)
+    keys = sorted(set(ra) | set(rb),
+                  key=lambda k: (k.split("#")[0], int(k.split("#")[1])))
+    rows = ["<table><tr><th>exec</th>"
+            "<th class='num'>rows (old → new)</th>"
+            "<th class='num'>time ms (old → new)</th>"
+            "<th class='num'>Δ time</th></tr>"]
+    for k in keys:
+        a, b = ra.get(k, {}), rb.get(k, {})
+        ta, tb = a.get("time_ns", 0) / 1e6, b.get("time_ns", 0) / 1e6
+        delta = tb - ta
+        cls = ("delta-up" if delta > ta * 0.1 + 0.01
+               else "delta-down" if delta < -ta * 0.1 - 0.01 else "")
+        rows.append(
+            f"<tr><td>{_esc(k)}</td>"
+            f"<td class='num'>{a.get('rows', 0)} → {b.get('rows', 0)}</td>"
+            f"<td class='num'>{ta:.3f} → {tb:.3f}</td>"
+            f"<td class='num {cls}'>{delta:+.3f}</td></tr>")
+    rows.append("</table>")
+    wall = (f"<p>wall: {older.get('duration_ns', 0) / 1e6:.1f} ms → "
+            f"{newer.get('duration_ns', 0) / 1e6:.1f} ms · runs "
+            f"{_fmt_time(older.get('wall_start_unix'))} vs "
+            f"{_fmt_time(newer.get('wall_start_unix'))}</p>")
+    conf_note = ("<p><b>config changed between runs</b></p>"
+                 if older.get("conf_delta") != newer.get("conf_delta")
+                 else "")
+    return _page(f"Diff {digest}",
+                 wall + conf_note + "\n".join(rows)
+                 + "<p><a href='index.html'>&larr; query list</a></p>")
+
+
+# ---------------------------------------------------------------------------
+# index
+# ---------------------------------------------------------------------------
+
+def render_index(records: List[dict], diff_digests: List[str],
+                 page_names: Dict[int, str]) -> str:
+    body = ["<h2>Queries</h2><table><tr><th>id</th><th>started</th>"
+            "<th>status</th><th class='num'>wall ms</th><th>digest</th>"
+            "<th class='num'>fallbacks</th><th></th></tr>"]
+    for i in reversed(range(len(records))):
+        rec = records[i]
+        if rec.get("type") == "nds_scorecard":
+            continue
+        st = rec.get("status", "?")
+        body.append(
+            f"<tr class='{st}'><td>{_esc(rec.get('query_id'))}</td>"
+            f"<td>{_fmt_time(rec.get('wall_start_unix'))}</td>"
+            f"<td class='badge-{st}'>{_esc(st)}</td>"
+            f"<td class='num'>{rec.get('duration_ns', 0) / 1e6:.1f}</td>"
+            f"<td><small class='digest'>{_esc(rec.get('plan_digest'))}"
+            f"</small></td>"
+            f"<td class='num'>{len(rec.get('fallback_reasons', []))}</td>"
+            f"<td><a href='{page_names[i]}'>plan</a></td></tr>")
+    body.append("</table>")
+    if diff_digests:
+        body.append("<h2>Run-over-run diffs (same plan digest)</h2><ul>")
+        for d in diff_digests:
+            body.append(f"<li><a href='diff_{d}.html'>"
+                        f"<small class='digest'>{d}</small></a></li>")
+        body.append("</ul>")
+    nds = [r for r in records if r.get("type") == "nds_scorecard"]
+    if nds:
+        body.append("<h2>NDS probe scorecards</h2><table><tr><th>query"
+                    "</th><th>status</th><th>device</th>"
+                    "<th class='num'>seconds</th><th>recorded</th></tr>")
+        for r in reversed(nds):
+            body.append(
+                f"<tr><td>{_esc(r.get('query'))}</td>"
+                f"<td>{_esc(r.get('status'))}</td>"
+                f"<td>{_esc(r.get('device', ''))}</td>"
+                f"<td class='num'>{r.get('seconds', '')}</td>"
+                f"<td>{_fmt_time(r.get('wall_start_unix'))}</td></tr>")
+        body.append("</table>")
+    return _page("spark-rapids-tpu query history", "\n".join(body))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def render_site(history_dir: str, out_dir: str) -> Dict[str, str]:
+    """Render everything; returns {page_name: path}."""
+    store = QueryHistoryStore(history_dir)
+    records = store.read_all()
+    os.makedirs(out_dir, exist_ok=True)
+    written: Dict[str, str] = {}
+
+    def write(name: str, content: str) -> None:
+        p = os.path.join(out_dir, name)
+        with open(p, "w") as f:
+            f.write(content)
+        written[name] = p
+
+    page_names = _page_names(records)
+    by_digest: Dict[str, List[dict]] = {}
+    for i, rec in enumerate(records):
+        if rec.get("type") == "nds_scorecard":
+            continue
+        write(page_names[i], render_query_page(rec))
+        d = rec.get("plan_digest")
+        if d:
+            by_digest.setdefault(d, []).append(rec)
+    diff_digests = []
+    for d, recs in by_digest.items():
+        if len(recs) >= 2:
+            write(f"diff_{d}.html", render_diff_page(d, recs[-2], recs[-1]))
+            diff_digests.append(d)
+    write("index.html", render_index(records, diff_digests, page_names))
+    return written
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("history_dir")
+    ap.add_argument("--out", default=None,
+                    help="output dir (default: <historyDir>/html)")
+    ap.add_argument("--serve", type=int, default=0,
+                    help="after rendering, serve the output dir on this "
+                    "port (blocking)")
+    args = ap.parse_args()
+    out_dir = args.out or os.path.join(args.history_dir, "html")
+    written = render_site(args.history_dir, out_dir)
+    print(f"wrote {len(written)} page(s) under {out_dir}")
+    if args.serve:
+        import functools
+        from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+        handler = functools.partial(SimpleHTTPRequestHandler,
+                                    directory=out_dir)
+        srv = ThreadingHTTPServer(("127.0.0.1", args.serve), handler)
+        print(f"serving http://127.0.0.1:{srv.server_address[1]}/")
+        srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,8 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import numpy as np
 import pyarrow as pa
 
@@ -2584,10 +2586,12 @@ def _canon_rows(table):
     return sorted(rows, key=repr)
 
 
-def run_one(sess, dfs, qn: int) -> dict:
+def run_one(sess, dfs, qn: int, history_dir: str = "",
+            sf: float = None) -> dict:
     df = QUERIES[qn](sess, dfs)
     explain = df.explain()
     device = "fallback" if "cannot run on TPU" in explain else "clean"
+    wall0 = time.time()
     t0 = time.perf_counter()
     tpu_table = df.collect()
     first = time.perf_counter() - t0
@@ -2597,9 +2601,70 @@ def run_one(sess, dfs, qn: int) -> dict:
     cpu_table = df.collect_cpu()  # full differential vs CPU interpreter
     status = "ok" if _canon_rows(tpu_table) == _canon_rows(cpu_table) \
         else "wrong"
-    return {"status": status, "device": device,
-            "rows": int(tpu_table.num_rows),
-            "seconds": round(dt, 4), "first_run_seconds": round(first, 4)}
+    rec = {"status": status, "device": device,
+           "rows": int(tpu_table.num_rows),
+           "seconds": round(dt, 4), "first_run_seconds": round(first, 4)}
+    if history_dir:
+        append_scorecard(history_dir, qn, rec, df.plan, wall0, sf=sf)
+    return rec
+
+
+def append_scorecard(history_dir: str, qn: int, rec: dict, plan,
+                     wall0: float, sf: float = None) -> None:
+    """Persist one probe result as a history record: BENCH_*.json
+    trajectories then regenerate from the store (--from-history) instead
+    of by hand, and tools/history_server.py lists the scorecards next to
+    the queries they measured (shared plan digest)."""
+    from spark_rapids_tpu.runtime.obs.history import (QueryHistoryStore,
+                                                      plan_digest)
+    try:
+        try:
+            digest = plan_digest(plan)
+        except Exception:  # noqa: BLE001
+            digest = None
+        QueryHistoryStore(history_dir).append({
+            "type": "nds_scorecard", "query": f"q{qn}", "sf": sf,
+            "wall_start_unix": wall0, "plan_digest": digest, **rec})
+    except Exception as e:  # noqa: BLE001 - an unwritable store must not
+        # flip an ALREADY-VALIDATED query result to "error"
+        print(f"warning: could not append q{qn} scorecard to "
+              f"{history_dir!r}: {e}", file=sys.stderr)
+
+
+def summarize_card(card: dict, sf: float) -> dict:
+    """The scorecard summary shape (shared by a live run and
+    --from-history regeneration, so the two can never drift)."""
+    translated = [q for q in card.values()
+                  if q["status"] != "not_translated"]
+    return {
+        "sf": sf,
+        "translated": len(translated),
+        "ok": sum(1 for q in translated if q["status"] == "ok"),
+        "clean_device": sum(1 for q in translated
+                            if q.get("device") == "clean"),
+        "queries": card,
+    }
+
+
+def scorecard_from_history(history_dir: str, sf: float) -> dict:
+    """Rebuild the scorecard summary from history records (latest run per
+    query wins) — the exact shape main() writes, so BENCH trajectories
+    regenerate from persistent state instead of a rerun. Only records of
+    the REQUESTED scale factor count (records carry their sf; mixing
+    sf=0.01 leftovers into an sf=1 trajectory would mask regressions),
+    and error/timeout runs are records too, so a query that regressed
+    from ok to error cannot hide behind its older success."""
+    from spark_rapids_tpu.runtime.obs.history import QueryHistoryStore
+    latest = {}
+    for rec in QueryHistoryStore(history_dir).read_all():
+        if rec.get("type") == "nds_scorecard" and rec.get("sf") == sf:
+            latest[rec["query"]] = {
+                k: v for k, v in rec.items()
+                if k not in ("type", "query", "sf", "plan_digest",
+                             "wall_start_unix")}
+    card = {f"q{qn}": latest.get(f"q{qn}", {"status": "not_translated"})
+            for qn in range(1, 100)}
+    return summarize_card(card, sf)
 
 
 def main():
@@ -2610,7 +2675,24 @@ def main():
                     help="child mode: run ONE query, print its JSON")
     ap.add_argument("--inline", action="store_true",
                     help="run queries in-process (no isolation)")
+    ap.add_argument("--history-dir",
+                    default=os.environ.get("RAPIDS_TPU_HISTORY_DIR", ""),
+                    help="append each per-query scorecard to this query "
+                    "history store (spark.rapids.obs.historyDir)")
+    ap.add_argument("--from-history", action="store_true",
+                    help="skip running: rebuild the scorecard summary "
+                    "from --history-dir records (latest run per query)")
     args = ap.parse_args()
+
+    if args.from_history:
+        if not args.history_dir:
+            ap.error("--from-history requires --history-dir")
+        summary = scorecard_from_history(args.history_dir, args.sf)
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(json.dumps({k: summary[k] for k in
+                          ("sf", "translated", "ok", "clean_device")}))
+        return
 
     if args.query:
         t0 = time.perf_counter()
@@ -2621,13 +2703,19 @@ def main():
             _df.count()
         setup_s = round(time.perf_counter() - t0, 2)
         try:
-            rec = run_one(sess, dfs, args.query)
+            rec = run_one(sess, dfs, args.query,
+                          history_dir=args.history_dir, sf=args.sf)
             rec["setup_seconds"] = setup_s
             print("RESULT " + json.dumps(rec))
         except Exception as e:  # noqa: BLE001
-            print("RESULT " + json.dumps(
-                {"status": "error", "setup_seconds": setup_s,
-                 "error": f"{type(e).__name__}: {e}"}))
+            err = {"status": "error", "setup_seconds": setup_s,
+                   "error": f"{type(e).__name__}: {e}"}
+            if args.history_dir:
+                # failures are history too: --from-history must see a
+                # regression from ok to error, not the stale success
+                append_scorecard(args.history_dir, args.query, err,
+                                 None, time.time(), sf=args.sf)
+            print("RESULT " + json.dumps(err))
         return
 
     per_query_s = int(os.environ.get("NDS_QUERY_TIMEOUT_S", "420"))
@@ -2642,10 +2730,15 @@ def main():
             continue
         if args.inline:
             try:
-                card[f"q{qn}"] = run_one(sess, dfs, qn)
+                card[f"q{qn}"] = run_one(sess, dfs, qn,
+                                         history_dir=args.history_dir,
+                                         sf=args.sf)
             except Exception as e:  # noqa: BLE001
                 card[f"q{qn}"] = {"status": "error",
                                   "error": f"{type(e).__name__}: {e}"}
+                if args.history_dir:
+                    append_scorecard(args.history_dir, qn, card[f"q{qn}"],
+                                     None, time.time(), sf=args.sf)
         else:
             # SUBPROCESS isolation: a wedged remote compile cannot be
             # interrupted by SIGALRM (it blocks in C), so each query gets
@@ -2654,6 +2747,10 @@ def main():
             import subprocess
             cmd = [sys.executable, os.path.abspath(__file__),
                    "--sf", str(args.sf), "--query", str(qn)]
+            if args.history_dir:
+                # children append their scorecards to the SAME store
+                # (whole-line appends interleave safely across processes)
+                cmd += ["--history-dir", os.path.abspath(args.history_dir)]
             # setup (data gen + cache upload) happens inside the child:
             # give it an sf-scaled allowance on top of the query budget so
             # a slow upload never reads as a query timeout
@@ -2669,17 +2766,16 @@ def main():
             except subprocess.TimeoutExpired:
                 card[f"q{qn}"] = {"status": "timeout",
                                   "seconds_limit": per_query_s}
+            if args.history_dir and \
+                    card[f"q{qn}"].get("status") in ("error", "timeout"):
+                # the child appends its own ok/wrong records; a crashed
+                # or killed child never got the chance — the parent
+                # records the failure so history mirrors the scorecard
+                append_scorecard(args.history_dir, qn, card[f"q{qn}"],
+                                 None, time.time(), sf=args.sf)
         print(f"q{qn}: {card[f'q{qn}']}", file=sys.stderr, flush=True)
 
-    translated = [q for q in card.values() if q["status"] != "not_translated"]
-    summary = {
-        "sf": args.sf,
-        "translated": len(translated),
-        "ok": sum(1 for q in translated if q["status"] == "ok"),
-        "clean_device": sum(1 for q in translated
-                            if q.get("device") == "clean"),
-        "queries": card,
-    }
+    summary = summarize_card(card, args.sf)
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=1)
     print(json.dumps({k: summary[k] for k in

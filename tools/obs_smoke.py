@@ -1,0 +1,189 @@
+"""Observability smoke: /metrics scrape, /healthz flip, history round-trip.
+
+The live-layer CI gate (tools/ci_check.sh):
+
+1. start a session with `spark.rapids.obs.port` (a free ephemeral port)
+   and `spark.rapids.obs.historyDir`; drive queries from a background
+   thread and SCRAPE WHILE THEY RUN;
+2. /metrics must be Prometheus-parseable (every line a comment or
+   `name{labels} value`) and include the acceptance roster: semaphore
+   wait, spill bytes, retry count, the per-query wall-time histogram;
+3. /healthz must report ok (HTTP 200) with a live device probe, then
+   flip to degraded (HTTP 503) when the probe is blocked;
+4. the history store must round-trip: two runs of the same query produce
+   two records with the SAME plan digest and per-exec rollups;
+5. the disabled path must stay free: obs.on_task_complete with obs off
+   is one global read — measured per-call and gated.
+
+Run:  python tools/obs_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"[-+]?(\d+\.?\d*([eE][-+]?\d+)?|NaN|nan|[Ii]nf)$")
+
+ROSTER = (
+    "rapids_semaphore_wait_ns_total",
+    "rapids_spill_to_host_bytes_total",
+    "rapids_retries_total",
+    "rapids_query_wall_time_ms",
+    "rapids_tasks_completed_total",
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def check_prometheus(text: str) -> int:
+    """Validate exposition-format lines; returns sample-line count."""
+    n = 0
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        if not _METRIC_LINE.match(line):
+            raise AssertionError(f"unparseable metrics line: {line!r}")
+        n += 1
+    return n
+
+
+def main() -> int:
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.expr.core import col, lit
+    from spark_rapids_tpu.runtime import obs
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.session import TpuSession
+
+    obs.shutdown_for_tests()  # fresh singleton (port, registry)
+    hist_dir = tempfile.mkdtemp(prefix="obs_smoke_hist_")
+    port = _free_port()
+    sess = TpuSession({
+        "spark.rapids.obs.port": str(port),
+        "spark.rapids.obs.historyDir": hist_dir,
+        "spark.rapids.sql.reader.batchSizeRows": "4096",
+    })
+    rng = np.random.default_rng(7)
+    t = pa.table({"k": rng.integers(0, 50, 200_000),
+                  "v": rng.integers(0, 1000, 200_000)})
+
+    def query():
+        return (sess.create_dataframe(t, num_partitions=4)
+                .filter(col("v") > lit(10))
+                .select(col("k"), (col("v") * lit(2)).alias("v2"))
+                .group_by("k").agg(F.sum(col("v2"))).collect())
+
+    # -- scrape while a query runs ----------------------------------------
+    errors: list = []
+
+    def driver():
+        try:
+            for _ in range(3):
+                query()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=driver)
+    th.start()
+    mid_scrapes = 0
+    while th.is_alive():
+        code, body = _get(f"http://127.0.0.1:{port}/metrics")
+        assert code == 200, f"/metrics -> {code}"
+        check_prometheus(body)
+        mid_scrapes += 1
+        time.sleep(0.05)
+    th.join()
+    assert not errors, f"query failed under scrape: {errors}"
+    assert mid_scrapes >= 1, "no scrape landed while queries ran"
+
+    code, body = _get(f"http://127.0.0.1:{port}/metrics")
+    assert code == 200
+    samples = check_prometheus(body)
+    for name in ROSTER:
+        assert name in body, f"roster metric {name} missing from /metrics"
+    wall_count = [line for line in body.splitlines()
+                  if line.startswith("rapids_query_wall_time_ms_count")]
+    assert wall_count and int(wall_count[0].split()[-1]) >= 3, wall_count
+
+    # -- healthz: ok, then degraded under a blocked probe ------------------
+    code, hz = _get(f"http://127.0.0.1:{port}/healthz")
+    doc = json.loads(hz)
+    assert code == 200 and doc["status"] == "ok", (code, doc)
+    assert doc["semaphore"] is not None and doc["device"]["alive"]
+    obs.set_device_probe(lambda: time.sleep(60) or True)
+    t0 = time.time()
+    code, hz = _get(f"http://127.0.0.1:{port}/healthz")
+    doc = json.loads(hz)
+    assert code == 503 and doc["status"] == "degraded", (code, doc)
+    assert doc["device"]["blocked"], doc["device"]
+    probe_wait = time.time() - t0
+    from spark_rapids_tpu.runtime.obs.endpoint import default_device_probe
+    obs.set_device_probe(default_device_probe)
+
+    # -- history round-trip ------------------------------------------------
+    recs = [r for r in obs.state().history.read_all()
+            if r.get("type") == "query"]
+    assert len(recs) >= 3, f"expected >=3 history records, got {len(recs)}"
+    digests = {r["plan_digest"] for r in recs}
+    assert len(digests) == 1 and None not in digests, \
+        f"same query must share one digest, got {digests}"
+    assert all(r["status"] == "ok" and r.get("execs") for r in recs)
+
+    # -- disabled path stays free ------------------------------------------
+    obs.shutdown_for_tests()
+
+    class _Ctx:  # the shape on_task_complete reads
+        _failed = False
+        _metrics: dict = {}
+        start_ns = 0
+
+    ctx = _Ctx()
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs.on_task_complete(ctx)
+    per_call_ns = (time.perf_counter() - t0) / n * 1e9
+    assert per_call_ns < 1000, \
+        f"disabled obs hook costs {per_call_ns:.0f}ns/call"
+
+    print(json.dumps({
+        "metrics_samples": samples,
+        "mid_query_scrapes": mid_scrapes,
+        "healthz_degraded_after_s": round(probe_wait, 2),
+        "history_records": len(recs),
+        "plan_digest": next(iter(digests)),
+        "disabled_hook_ns_per_call": round(per_call_ns, 1),
+    }))
+    print("PASS: /metrics parseable + roster present, /healthz flips to "
+          "degraded on a blocked probe, history round-trips with a "
+          "stable digest")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,8 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 _CHROME_PHASES = {"X", "B", "E", "i", "I", "M", "C", "b", "e", "n", "s",
                   "t", "f", "P", "N", "O", "D"}
 
@@ -104,6 +106,30 @@ def load_artifacts(trace_path: str) -> Dict:
             metrics = json.load(f)
     return {"events": events, "tasks": tasks, "query": query_rec,
             "metrics": metrics, "trace_path": trace_path}
+
+
+def cross_link_history(art: Dict, history_dir: str) -> Optional[dict]:
+    """Resolve a trace to ITS query-history record through the shared
+    plan digest (both the trace's query record and the history record
+    carry it — no more filename-convention matching). Among runs of the
+    same digest, prefer the record whose trace_paths point at this very
+    trace file; otherwise take the run closest in wall-clock start."""
+    q = art.get("query") or {}
+    digest = q.get("plan_digest")
+    if not digest:
+        return None
+    from spark_rapids_tpu.runtime.obs.history import QueryHistoryStore
+    cands = QueryHistoryStore(history_dir).by_digest(digest)
+    if not cands:
+        return None
+    tp = os.path.abspath(art["trace_path"])
+    for rec in cands:
+        rp = (rec.get("trace_paths") or {}).get("trace")
+        if rp and os.path.abspath(rp) == tp:
+            return rec
+    t0 = q.get("wall_start_unix") or 0
+    return min(cands, key=lambda r: abs((r.get("wall_start_unix") or 0)
+                                        - t0))
 
 
 # ---------------------------------------------------------------------------
@@ -265,12 +291,19 @@ def spill_retry_hotspots(events: List[dict], tasks: List[dict]) -> dict:
     for t in tasks:
         m = t.get("metrics", {})
         keys = ("retryCount", "splitAndRetryCount", "retryBlockTime",
+                "retryWastedTime",
                 "spillToHostBytes", "spillToDiskBytes",
                 "spillToHostTime", "spillToDiskTime", "maxDeviceBytesHeld")
         if any(m.get(k) for k in keys):
             per_task.append({"task_id": t["task_id"],
                              "partition_id": t.get("partition_id"),
                              **{k: m[k] for k in keys if m.get(k)}})
+    # retry accounting (satellite): the replayed-attempt split. First-
+    # attempt time = the enclosing exec timers MINUS this wasted total —
+    # reported separately so a retry storm reads as retry, not as a slow
+    # operator.
+    wasted_ns = sum(t.get("metrics", {}).get("retryWastedTime", 0)
+                    for t in tasks)
     return {
         "spill_to_host_bytes": sum(a.get("bytes", 0)
                                    for a in inst["spillToHost"]),
@@ -279,6 +312,7 @@ def spill_retry_hotspots(events: List[dict], tasks: List[dict]) -> dict:
         "spill_events": len(inst["spillToHost"]) + len(inst["spillToDisk"]),
         "retry_events": len(inst["retryOOM"]),
         "split_retry_events": len(inst["splitAndRetryOOM"]),
+        "retry_wasted_ns": wasted_ns,
         "tasks": per_task,
     }
 
@@ -307,7 +341,8 @@ def _fmt_us(us: float) -> str:
     return f"{us / 1000.0:.3f}"
 
 
-def generate_report(art: Dict, top_n: int = 20) -> str:
+def generate_report(art: Dict, top_n: int = 20,
+                    history_rec: Optional[dict] = None) -> str:
     events, tasks, metrics = art["events"], art["tasks"], art["metrics"]
     spans = exclusive_times(events)
     ops = operator_rollup(spans)
@@ -356,7 +391,9 @@ def generate_report(art: Dict, top_n: int = 20) -> str:
           f"{hot['spill_events']} spill event(s); to disk: "
           f"{hot['spill_to_disk_bytes']} B",
           f"- retry OOMs: {hot['retry_events']}; split-and-retry: "
-          f"{hot['split_retry_events']}"]
+          f"{hot['split_retry_events']}; replayed-attempt time "
+          f"{hot['retry_wasted_ns'] / 1e6:.3f} ms (subtract from exec "
+          f"timers for first-attempt time)"]
     if hot["tasks"]:
         L += ["", "| task | partition | accumulators |", "|---|---|---|"]
         for t in hot["tasks"][:top_n]:
@@ -369,6 +406,16 @@ def generate_report(art: Dict, top_n: int = 20) -> str:
           f"- total wait {sem['total_wait_ms']:.3f} ms · "
           f"max {sem['max_wait_ms']:.3f} ms · "
           f"p50 {sem['p50_wait_ms']:.3f} ms"]
+
+    if history_rec is not None:
+        L += ["", "## History cross-link (by plan digest)", "",
+              f"- history query {history_rec.get('query_id')} · status "
+              f"{history_rec.get('status')} · wall "
+              f"{history_rec.get('duration_ns', 0) / 1e6:.1f} ms · "
+              f"digest `{history_rec.get('plan_digest')}`"]
+        if history_rec.get("fallback_reasons"):
+            L.append(f"- fallbacks: "
+                     f"{len(history_rec['fallback_reasons'])}")
 
     if rec:
         L += ["", "## Trace ↔ metric reconciliation", "",
@@ -407,15 +454,23 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable analysis instead")
     ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--history", default=None, metavar="DIR",
+                    help="query-history dir: cross-link this trace to its "
+                    "history record via the shared plan digest")
     args = ap.parse_args()
     path = args.path
     if os.path.isdir(path):
         _, path = find_query(path, args.query)
     art = load_artifacts(path)
+    hist = (cross_link_history(art, args.history)
+            if args.history else None)
     if args.json:
-        print(json.dumps(analyze(art), indent=1, sort_keys=True))
+        doc = analyze(art)
+        if hist is not None:
+            doc["history"] = hist
+        print(json.dumps(doc, indent=1, sort_keys=True))
     else:
-        print(generate_report(art, top_n=args.top))
+        print(generate_report(art, top_n=args.top, history_rec=hist))
     return 0
 
 
