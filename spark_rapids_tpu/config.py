@@ -304,6 +304,14 @@ ASYNC_WRITE_MAX_INFLIGHT = conf_int(
     "Throttle for async output writes "
     "(reference io/async/TrafficController.scala).")
 
+ASYNC_WRITE_STALL_WARN_S = conf_int(
+    "spark.rapids.sql.asyncWrite.stallWarnSeconds", 60,
+    "Seconds a producer may block in TrafficController.acquire before a "
+    "stall diagnostic fires (one log warning + asyncWriteStalled trace "
+    "instant + rapids_async_write_stalls_total obs counter). Admission "
+    "semantics are unchanged — the producer keeps waiting. 0 disables "
+    "the diagnostic.")
+
 IMPROVED_FLOAT_OPS = conf_bool(
     "spark.rapids.sql.improvedFloatOps.enabled", True,
     "Allow float aggregation orderings that may differ from CPU Spark in "
@@ -387,6 +395,25 @@ INCOMPAT_ENABLED = conf_bool(
     "spark.rapids.sql.incompatibleOps.enabled", True,
     "Enable operators whose results can differ from CPU Spark in documented "
     "corner cases (reference incompatOps).")
+
+PIPELINE_ENABLED = conf_bool(
+    "spark.rapids.sql.pipeline.enabled", True,
+    "Overlap host-side batch production (pyarrow decode, pad/H2D upload, "
+    "shuffle deserialization) with device compute: a planner pass inserts "
+    "bounded producer/consumer pipeline boundaries at scan->compute edges, "
+    "running the upstream generator on the shared host pool so batch i+1 "
+    "is decoded/uploaded while the device computes batch i (reference "
+    "MultiFileReaderThreadPool / ThrottlingExecutor overlap). Also gates "
+    "the deferred per-batch scalar fetches (shuffle offsets, LIMIT carry) "
+    "and the async throttled serialized-shuffle writer. A stage whose "
+    "pipeline setup fails falls back to the synchronous path.",
+    commonly_used=True)
+
+PIPELINE_DEPTH = conf_int(
+    "spark.rapids.sql.pipeline.depth", 2,
+    "Bounded lookahead of each pipeline boundary: how many produced "
+    "batches may sit decoded/uploaded ahead of the consumer. 0 disables "
+    "pipelining (identical to pipeline.enabled=false).")
 
 STAGE_FUSION_ENABLED = conf_bool(
     "spark.rapids.sql.stageFusion.enabled", True,

@@ -249,6 +249,10 @@ def apply_node(plan: P.PlanNode, children: List[List[CpuCol]],
         return _gather_cols(child, np.arange(min(plan.n, n)))
     if isinstance(plan, P.Union):
         return _exec_union(plan, children)
+    if isinstance(plan, P.Repartition):
+        # partitioning is a physical-layout concern: row-wise the result
+        # is the child unchanged (comparisons downstream ignore order)
+        return children[0]
     if isinstance(plan, P.WindowNode):
         return _exec_window(plan, children[0], ansi)
     if isinstance(plan, P.Join):

@@ -236,6 +236,16 @@ def make_fused_stage_exec():
                     mr.add(LazyRowCount(r))
                 out_rows.add(out.num_rows)
                 self._carry_bounds(batch, out)
+                if exhaust_idx:
+                    # issue the carry D2H NOW and consume it only after
+                    # the yield: the scalar transfer overlaps downstream
+                    # consumption of this batch instead of serializing
+                    # between dispatches (runtime/pipeline.py deferred-
+                    # fetch discipline; semantics unchanged — the value
+                    # is still read before the next batch is pulled)
+                    from spark_rapids_tpu.runtime.pipeline import start_d2h
+                    for i in exhaust_idx:
+                        start_d2h(carries[i])
                 yield out
                 # LIMIT early exit: a zero remaining-budget carry means
                 # every later batch is all-dead — stop consuming input

@@ -941,6 +941,11 @@ class TopNExec(TpuExec):
 
                 sel = fuse.fused(("topn_select", self._fp()), build_select)
                 cand, cnt_d = sel(batch)
+                # start the count D2H before blocking on it: the transfer
+                # overlaps the tail of the select computation instead of
+                # waiting for an idle device to begin
+                from spark_rapids_tpu.runtime.pipeline import start_d2h
+                start_d2h(cnt_d)
                 cnt = int(cnt_d)
                 if cnt <= bound:
                     out_cap = round_capacity(bound)
@@ -2773,15 +2778,35 @@ class ExchangeExec(TpuExec):
         super().__init__(plan, children, conf)
         self._lock = threading.Lock()
         self._out: Optional[List[List[ColumnarBatch]]] = None
+        #: streaming tap: when set, every emitted (partition, sub_batch)
+        #: is ALSO handed to this callable as it is produced — the
+        #: serialized writer hooks it so serde/spill of batch i overlaps
+        #: the device partitioning of batch i+1
+        self._emit_sink = None
 
     @property
     def schema(self):
         return self.children[0].schema
 
+    #: a streaming-capable _repartition consumes each child partition in
+    #: ONE forward pass, so _materialize may hand it live iterators
+    #: instead of materialized lists (ShuffleExchangeExec narrows this
+    #: for the ICI mode, whose eligibility probe iterates twice)
+    _streaming_ok = True
+
     def _materialize(self) -> List[List[ColumnarBatch]]:
         with self._lock:
             if self._out is None:
                 child = self.children[0]
+                streams = self._streamed_children(child)
+                if streams is not None:
+                    try:
+                        self._out = self._repartition(streams)
+                    finally:
+                        for s in streams:
+                            s.close()
+                        self._finish_stream_tasks()
+                    return self._out
                 results: List[List[ColumnarBatch]] = [None] * child.num_partitions
 
                 def run(p):
@@ -2810,6 +2835,94 @@ class ExchangeExec(TpuExec):
                 self._out = self._repartition(results)
         return self._out
 
+    def _streamed_children(self, child):
+        """The compute->exchange-write pipeline boundary: each child
+        partition becomes a bounded PipelinedIterator whose producer runs
+        the partition's generator (decode, compute, upload) on the host
+        pool WHILE this thread's repartition loop consumes earlier
+        batches — the partitioning kernel, its offsets fetch, and the
+        serialized writer's throttled serde all overlap upstream compute
+        instead of waiting for full materialization. Child partitions
+        still produce concurrently (every producer is armed up front,
+        each with `depth` lookahead — a tighter memory bound than the
+        historical materialize-everything). Returns None when streaming
+        must not engage: pipelining off, a two-pass _repartition (ICI),
+        a nested (pool-worker) caller, or more child partitions than
+        device-semaphore permits. The permit gate is a deadlock fence: a
+        producer past the permit count would park its pool worker in the
+        semaphore wait queue, and enough parked producers would starve
+        the pool of the workers the permit HOLDERS need to finish and
+        release — the materialize-worker path (below) gives each
+        partition a dedicated worker for its whole life, so it has no
+        such cycle and keeps the wide-partition case."""
+        from spark_rapids_tpu.runtime.host_pool import (
+            HostTaskPool, get_host_pool,
+        )
+        from spark_rapids_tpu.runtime.pipeline import (
+            PipelinedIterator, pipeline_conf,
+        )
+        depth = pipeline_conf(self.conf)
+        nparts = self.children[0].num_partitions
+        if depth <= 0 or not self._streaming_ok \
+                or HostTaskPool._depth() != 0 \
+                or nparts > self.conf.get(C.CONCURRENT_TPU_TASKS) \
+                or nparts >= get_host_pool(self.conf).n_threads:
+            return None
+
+        def gen(p, tctx, fin):
+            # the producer thread owns the task end-of-life exactly like
+            # the materialize worker did (semaphore release, accumulator
+            # rollup); `fin` makes completion exactly-once across this
+            # finally and the close-path sweep in _finish_stream_tasks
+            status = "failed"
+            try:
+                for b in self.children[0].execute_partition(tctx, p):
+                    yield b
+                status = "ok"
+            except GeneratorExit:
+                # early close (sibling partition failed, consumer bailed)
+                # cancels this task — it did not itself fail
+                status = "cancelled"
+                raise
+            finally:
+                if not fin[0]:
+                    fin[0] = True
+                    tctx.complete(failed=(status == "failed"))
+
+        streams = []
+        finals = []
+        try:
+            for p in range(self.children[0].num_partitions):
+                tctx = TaskContext(partition_id=p)
+                fin = [False]
+                finals.append((tctx, fin))
+                streams.append(PipelinedIterator(
+                    gen(p, tctx, fin), depth, ctx=tctx, conf=self.conf,
+                    label=f"{self.name()}@p{p}",
+                    stall_metric=self.metrics.metric(M.PIPELINE_STALL_TIME),
+                    producer_metric=self.metrics.metric(
+                        M.PIPELINE_PRODUCER_TIME)))
+        except Exception:  # noqa: BLE001 - setup fallback: synchronous
+            for s in streams:
+                s.close()
+            self._stream_finals = finals
+            self._finish_stream_tasks()
+            return None
+        self._stream_finals = finals
+        self.metrics.metric(M.PIPELINE_DEPTH).set(depth)
+        return streams
+
+    def _finish_stream_tasks(self) -> None:
+        """Complete any streamed-child task whose generator never ran
+        (close() on a not-yet-started generator skips its finally): the
+        task did no work and did not fail, but its (empty) rollup and
+        completion callbacks must still fire exactly once."""
+        for tctx, fin in getattr(self, "_stream_finals", ()):
+            if not fin[0]:
+                fin[0] = True
+                tctx.complete(failed=False)
+        self._stream_finals = []
+
     def _repartition(self, child_results) -> List[List[ColumnarBatch]]:
         raise NotImplementedError
 
@@ -2829,6 +2942,8 @@ class ExchangeExec(TpuExec):
             for b in part:
                 rows_m.add(b.num_rows)
                 flat.append(b)
+                if self._emit_sink is not None:
+                    self._emit_sink(0, b)
         return [flat]
 
     def _emit_compact(self, batch, fused_out, out) -> None:
@@ -2850,6 +2965,8 @@ class ExchangeExec(TpuExec):
                 oc.bounds = ic.bounds
             rows_m.add(int(sub.num_rows))
             out[p].append(sub)
+            if self._emit_sink is not None:
+                self._emit_sink(p, sub)
 
     def _emit_masked(self, batch, subs, out) -> None:
         """Masked-path emission with the bookkeeping the compact path gets
@@ -2864,6 +2981,35 @@ class ExchangeExec(TpuExec):
                 oc.bounds = ic.bounds
             rows_m.add(sub.num_rows)
             out[p].append(sub)
+            if self._emit_sink is not None:
+                self._emit_sink(p, sub)
+
+    def _compact_stream(self, batches, dispatch, out, part_t) -> None:
+        """Drive a compact partitioning loop with a one-deep deferred
+        offsets fetch (pipeline-gated): dispatch batch i+1's counting
+        sort and START its offsets D2H before consuming batch i's
+        offsets, so the transfer rides under device compute instead of
+        serializing against it. Emission order (and therefore every
+        downstream result) is unchanged; with pipelining disabled this
+        is exactly the historical dispatch-then-fetch loop."""
+        from spark_rapids_tpu.runtime.pipeline import pipeline_conf, start_d2h
+        if pipeline_conf(self.conf) <= 0:
+            for batch in batches:
+                with self.span(part_t):
+                    self._emit_compact(batch, dispatch(batch), out)
+            return
+        pending = None
+        for batch in batches:
+            with self.span(part_t):
+                fo = dispatch(batch)
+            start_d2h(fo[1])
+            if pending is not None:
+                with self.span(part_t):
+                    self._emit_compact(pending[0], pending[1], out)
+            pending = (batch, fo)
+        if pending is not None:
+            with self.span(part_t):
+                self._emit_compact(pending[0], pending[1], out)
 
     def execute_partition(self, ctx, pidx):
         out = self._materialize()
@@ -2905,6 +3051,12 @@ class ShuffleExchangeExec(ExchangeExec):
     @property
     def num_partitions(self):
         return self.n_out
+
+    @property
+    def _streaming_ok(self):
+        # the ICI eligibility probe and vocab alignment iterate the child
+        # results twice — a live stream cannot be replayed
+        return self.conf.get(C.SHUFFLE_MODE).upper() != "ICI"
 
     def _repartition(self, child_results):
         mode = self.conf.get(C.SHUFFLE_MODE).upper()
@@ -2951,10 +3103,8 @@ class ShuffleExchangeExec(ExchangeExec):
         fn = fuse.fused(("hash_exchange_compact",
                          tuple(e.fingerprint() for e in keys), n_out), build)
         out: List[List[ColumnarBatch]] = [[] for _ in range(n_out)]
-        for part in child_results:
-            for batch in part:
-                with self.span(part_t):
-                    self._emit_compact(batch, fn(batch), out)
+        self._compact_stream((b for part in child_results for b in part),
+                             fn, out, part_t)
         return out
 
     def _repartition_serialized(self, child_results):
@@ -2966,14 +3116,13 @@ class ShuffleExchangeExec(ExchangeExec):
         lists deserialize lazily at read time."""
         from spark_rapids_tpu.shuffle import serde
         from spark_rapids_tpu.shuffle.store import ShuffleStore
+        from spark_rapids_tpu.runtime.pipeline import pipeline_conf
         ser_t = self.metrics.metric(M.PARTITION_TIME)
         codec = self.conf.get(C.SHUFFLE_COMPRESSION)
         serde.codec_id(codec)  # validate up front
         store = ShuffleStore(self.n_out,
                              self.conf.get(C.SHUFFLE_HOST_BUDGET))
-        parted = self._repartition_device(child_results)
         nthreads = max(1, self.conf.get(C.SHUFFLE_WRITER_THREADS))
-        work = [(p, b) for p, part in enumerate(parted) for b in part]
 
         def ser(item):
             # the compact partitioning path hands over already-contiguous
@@ -2984,18 +3133,26 @@ class ShuffleExchangeExec(ExchangeExec):
                 return p, None  # empty sub-batches never ship
             return p, serde.serialize_batch(b, codec)
 
-        with self.span(ser_t):
-            if len(work) > 1 and nthreads > 1:
-                from spark_rapids_tpu.runtime.host_pool import get_host_pool
-                for p, blob in get_host_pool(self.conf).map_ordered(
-                        ser, work, max_concurrency=nthreads):
-                    if blob is not None:
-                        store.add(p, blob)
-            else:
-                for item in work:
-                    p, blob = ser(item)
-                    if blob is not None:
-                        store.add(p, blob)
+        if pipeline_conf(self.conf) > 0 and nthreads > 1:
+            self._serialize_streaming(child_results, store, ser, nthreads,
+                                      ser_t)
+        else:
+            parted = self._repartition_device(child_results)
+            work = [(p, b) for p, part in enumerate(parted) for b in part]
+            with self.span(ser_t):
+                if len(work) > 1 and nthreads > 1:
+                    from spark_rapids_tpu.runtime.host_pool import (
+                        get_host_pool,
+                    )
+                    for p, blob in get_host_pool(self.conf).map_ordered(
+                            ser, work, max_concurrency=nthreads):
+                        if blob is not None:
+                            store.add(p, blob)
+                else:
+                    for item in work:
+                        p, blob = ser(item)
+                        if blob is not None:
+                            store.add(p, blob)
         self._store = store
         tot = store.totals()
         self.metrics.metric(M.SHUFFLE_BYTES_WRITTEN).add(
@@ -3006,6 +3163,62 @@ class ShuffleExchangeExec(ExchangeExec):
         return [[_LazyShuffleBlobs(store, p, rthreads, self.conf)]
                 if store.partition_bytes(p)
                 else [] for p in range(self.n_out)]
+
+    def _serialize_streaming(self, child_results, store, ser,
+                             nthreads: int, ser_t) -> None:
+        """Async throttled serialized write (reference ThrottlingExecutor
+        / RapidsShuffleThreadedWriterBase): the emit sink submits each
+        sub-batch for serde the moment the device partitioning produces
+        it, so serde/spill of batch i overlaps the partitioning kernel of
+        batch i+1. TrafficController caps the host bytes in flight;
+        completed blobs drain into the store IN SUBMISSION ORDER (the
+        deque head gates on done()), so per-partition blob order — and
+        every downstream result — is identical to the synchronous path."""
+        from collections import deque
+
+        from spark_rapids_tpu.io.async_io import (
+            ThrottlingExecutor, TrafficController,
+        )
+        from spark_rapids_tpu.runtime.host_pool import get_host_pool
+        ctrl = TrafficController(
+            self.conf.get(C.ASYNC_WRITE_MAX_INFLIGHT),
+            stall_warn_s=self.conf.get(C.ASYNC_WRITE_STALL_WARN_S) or None)
+        # serde runs on the SHARED host pool (PR-2 boundedness invariant:
+        # no per-writer throwaway executors); the TrafficController's
+        # byte budget is the per-exchange admission bound
+        ex = ThrottlingExecutor(nthreads, ctrl,
+                                pool=get_host_pool(self.conf))
+        futures = deque()
+
+        def drain(block: bool) -> None:
+            while futures and (block or futures[0].done()):
+                p, blob = futures.popleft().result()
+                if blob is not None:
+                    store.add(p, blob)
+
+        def sink(p, b):
+            futures.append(ex.submit(b.device_memory_size(), ser, (p, b)))
+            drain(False)
+
+        self._emit_sink = sink
+        ok = False
+        try:
+            self._repartition_device(child_results)
+            ok = True
+        finally:
+            self._emit_sink = None
+            if ok:
+                with self.span(ser_t):
+                    drain(True)
+                ex.shutdown()
+            else:
+                # partitioning raised: settle the in-flight serde work
+                # without letting ITS errors mask the propagating one
+                try:
+                    drain(True)
+                except Exception:  # noqa: BLE001
+                    pass
+                ex.shutdown(wait=False)
 
     def execute_partition(self, ctx, pidx):
         out = self._materialize()
@@ -3298,13 +3511,15 @@ class RoundRobinExchangeExec(ExchangeExec):
         fn = fuse.fused(("rr_exchange_compact" if compact
                          else "rr_exchange", n_out), build)
         out: List[List[ColumnarBatch]] = [[] for _ in range(self.n_out)]
+        if compact:
+            self._compact_stream(
+                (b for part in child_results for b in part), fn, out,
+                part_t)
+            return out
         for part in child_results:
             for batch in part:
                 with self.span(part_t):
-                    if compact:
-                        self._emit_compact(batch, fn(batch), out)
-                    else:
-                        self._emit_masked(batch, fn(batch), out)
+                    self._emit_masked(batch, fn(batch), out)
         return out
 
 

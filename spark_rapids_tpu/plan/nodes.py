@@ -496,6 +496,26 @@ class Limit(PlanNode):
         return f"Limit[{self.n}]"
 
 
+class Repartition(PlanNode):
+    """Explicit exchange (DataFrame.repartition): hash-partition by `keys`
+    into n_out partitions, or round-robin when no keys are given (Spark's
+    repartition(n) / repartition(n, cols) — previously the engine only
+    planned exchanges implicitly under aggregates/sorts/windows)."""
+
+    def __init__(self, n_out: int, keys: List[Expression], child: PlanNode):
+        self.children = [child]
+        self.n_out = max(1, int(n_out))
+        self.keys = [bind_expr(e, child.schema) for e in keys]
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        how = f"hash{self.keys!r}" if self.keys else "roundrobin"
+        return f"Repartition[{how}, n={self.n_out}]"
+
+
 class Join(PlanNode):
     """Equi-join with optional extra condition (reference GpuShuffledHashJoin
     / GpuBroadcastHashJoin; the planner picks the physical strategy)."""

@@ -852,6 +852,9 @@ class SparkPlanMeta:
                         f"enabled=false")
             if p.condition is not None:
                 tag_expression(p.condition, self.conf, self.reasons, name)
+        elif isinstance(p, P.Repartition):
+            for e in p.keys:
+                tag_expression(e, self.conf, self.reasons, name)
         elif isinstance(p, P.Expand):
             for proj in p.projections:
                 for e in proj:
@@ -984,6 +987,12 @@ class SparkPlanMeta:
             return local
         if isinstance(p, P.Union):
             return X.UnionExec(p, child_execs, conf)
+        if isinstance(p, P.Repartition):
+            if p.keys:
+                return X.ShuffleExchangeExec(p, child_execs, conf, p.keys,
+                                             n_out=p.n_out)
+            return X.RoundRobinExchangeExec(p, child_execs, conf,
+                                            n_out=p.n_out)
         if isinstance(p, P.Expand):
             return X.ExpandExec(p, child_execs, conf)
         if isinstance(p, P.Generate):
@@ -1271,6 +1280,11 @@ def convert_plan(plan: P.PlanNode, conf):
     # into one dispatch per batch (spark.rapids.sql.stageFusion.enabled)
     from spark_rapids_tpu.exec.stage_fusion import fuse_stages
     exec_root = fuse_stages(exec_root, conf)
+    # pipelined execution: bounded producer/consumer boundaries at
+    # scan->compute edges so host decode/upload of batch i+1 overlaps
+    # device compute of batch i (spark.rapids.sql.pipeline.enabled)
+    from spark_rapids_tpu.runtime.pipeline import insert_pipelines
+    exec_root = insert_pipelines(exec_root, conf)
     lore_dir = conf.get(C.LORE_DUMP_DIR)
     if lore_dir:
         from spark_rapids_tpu.runtime.lore import LoreDumper

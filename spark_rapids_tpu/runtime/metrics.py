@@ -60,6 +60,24 @@ STAGE_DISPATCHES = "stageDispatches"
 SHUFFLE_BYTES_WRITTEN = "shuffleBytesWritten"
 #: serialized-shuffle bytes the host store overflowed to disk files
 SHUFFLE_BYTES_SPILLED = "shuffleBytesSpilled"
+#: lookahead of a pipeline boundary as executed (0 = ran synchronously:
+#: pipelining disabled, or the per-stage setup fallback fired)
+PIPELINE_DEPTH = "pipelineDepth"
+#: ns the CONSUMER side of a pipeline boundary spent blocked waiting for
+#: the producer (device starved by host decode — the number a deeper
+#: lookahead or more reader threads would shrink)
+PIPELINE_STALL_TIME = "pipelineStallTime"
+#: ns the producer side spent decoding/uploading upstream batches on the
+#: host pool — work that overlapped downstream compute instead of
+#: sitting serially in the critical path
+PIPELINE_PRODUCER_TIME = "pipelineProducerTime"
+
+#: *Time metrics that record WAITING or overlapped work, not exclusive
+#: operator work: folding them into an operator-time rollup would make
+#: hot-path comparisons lie (wait is scheduling; producer time is the
+#: upstream's own decode/upload time, already on the upstream's metrics)
+WAIT_TIME_METRICS = frozenset((
+    SEMAPHORE_WAIT_TIME, PIPELINE_STALL_TIME, PIPELINE_PRODUCER_TIME))
 
 
 class GpuMetric:
@@ -188,9 +206,11 @@ def exec_rollup(snapshot: Dict[str, int]) -> Dict[str, int]:
     records, /metrics per-operator series): output rows, batches,
     device dispatches, and total operator time.
 
-    time_ns sums every *Time metric EXCEPT semaphoreWaitTime — wait is
-    scheduling, not operator work, and folding it in would make every
-    hot-path comparison lie under contention."""
+    time_ns sums every *Time metric EXCEPT the WAIT_TIME_METRICS
+    (semaphore wait, pipeline stall, pipeline producer time) — wait is
+    scheduling and producer time is overlapped upstream work, not this
+    operator's own; folding either in would make every hot-path
+    comparison lie under contention."""
     rows = int(snapshot.get(NUM_OUTPUT_ROWS, 0))
     # presence-based fallback, NOT falsy-or: an exec that RECORDED zero
     # output batches (every input row filtered away) must report 0, not
@@ -203,7 +223,7 @@ def exec_rollup(snapshot: Dict[str, int]) -> Dict[str, int]:
                      if STAGE_DISPATCHES in snapshot
                      else snapshot.get(PARTITION_DISPATCHES, 0))
     time_ns = sum(int(v) for k, v in snapshot.items()
-                  if k.endswith("Time") and k != SEMAPHORE_WAIT_TIME)
+                  if k.endswith("Time") and k not in WAIT_TIME_METRICS)
     return {"rows": rows, "batches": batches, "dispatches": dispatches,
             "time_ns": time_ns}
 

@@ -221,6 +221,12 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(P.Limit(n, self.plan), self.session)
 
+    def repartition(self, n: int, *cols) -> "DataFrame":
+        """Explicit exchange: hash-partition by `cols` into n partitions,
+        round-robin when no columns are given (Spark's repartition)."""
+        keys = [_e(c) for c in cols]
+        return DataFrame(P.Repartition(n, keys, self.plan), self.session)
+
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(P.Union([self.plan, other.plan]), self.session)
 

@@ -1,0 +1,401 @@
+"""Pipelined batch execution (runtime/pipeline.py): overlap, cancellation,
+error propagation, retry interaction, TaskContext attribution, and the
+pipeline.enabled=false == synchronous-path contract."""
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.runtime.pipeline import PipelinedIterator
+from spark_rapids_tpu.runtime.task import TaskContext
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSession
+
+
+def _table(rows, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 40, rows),
+        "v": rng.integers(-1000, 1000, rows),
+        "d": rng.uniform(0, 1, rows),
+    })
+
+
+def _session(**conf):
+    base = {"spark.rapids.sql.reader.batchSizeRows": "1024"}
+    base.update(conf)
+    return TpuSession(base)
+
+
+def _non_pool_threads():
+    return {t for t in threading.enumerate()
+            if not t.name.startswith("rapids-host-pool")}
+
+
+# ---------------------------------------------------------------------------
+# PipelinedIterator unit behavior
+# ---------------------------------------------------------------------------
+
+def test_iterator_overlap_wall_clock():
+    """depth>=1 overlaps producer and consumer work: wall clock of a
+    5x(50ms produce + 50ms consume) loop must land well under the 500ms
+    serial sum (and the sync depth-0 control must not)."""
+    def src():
+        for i in range(5):
+            time.sleep(0.05)
+            yield i
+
+    t0 = time.monotonic()
+    pit = PipelinedIterator(src(), depth=2)
+    got = []
+    for item in pit:
+        time.sleep(0.05)
+        got.append(item)
+    pit.close()
+    overlapped = time.monotonic() - t0
+    assert got == list(range(5))
+    assert overlapped < 0.42, overlapped  # serial would be >= 0.5
+
+    t0 = time.monotonic()
+    got = []
+    for item in src():
+        time.sleep(0.05)
+        got.append(item)
+    serial = time.monotonic() - t0
+    assert serial >= 0.45
+    assert overlapped < serial
+
+
+def test_iterator_preserves_order_and_count():
+    pit = PipelinedIterator(iter(range(257)), depth=3)
+    assert list(pit) == list(range(257))
+    pit.close()
+
+
+def test_iterator_producer_exception_propagates():
+    def src():
+        yield 1
+        yield 2
+        raise ValueError("decode exploded")
+
+    pit = PipelinedIterator(src(), depth=2)
+    got = []
+    with pytest.raises(ValueError, match="decode exploded"):
+        for item in pit:
+            got.append(item)
+    pit.close()
+    assert got == [1, 2]
+
+
+def test_iterator_early_close_cancels_producer():
+    """Closing mid-stream must stop production promptly, run the source
+    generator's finally (GeneratorExit delivered), and leave no threads
+    beyond the shared pool's workers."""
+    state = {"produced": 0, "closed": False}
+
+    def src():
+        try:
+            for i in range(10_000):
+                state["produced"] += 1
+                yield i
+        finally:
+            state["closed"] = True
+
+    before = _non_pool_threads()
+    pit = PipelinedIterator(src(), depth=2)
+    it = iter(pit)
+    assert next(it) == 0
+    assert next(it) == 1
+    pit.close()
+    assert state["closed"], "source generator finally did not run"
+    # bounded lookahead: the producer cannot have raced far past the
+    # queue depth + one stashed item + the two we took
+    assert state["produced"] <= 2 + 2 + 2
+    assert _non_pool_threads() == before
+
+
+def test_iterator_taskcontext_binding():
+    """The producer runs on a pool worker but must see the CONSUMER
+    task's thread-local TaskContext (semaphore re-entrancy, retry and
+    metric attribution all key off it)."""
+    seen = {}
+
+    def src():
+        seen["ctx"] = TaskContext.peek()
+        seen["thread"] = threading.current_thread().name
+        yield 1
+
+    with TaskContext(partition_id=3) as ctx:
+        pit = PipelinedIterator(src(), depth=1, ctx=ctx)
+        assert list(pit) == [1]
+        pit.close()
+    assert seen["ctx"] is ctx
+    assert seen["thread"].startswith("rapids-host-pool")
+
+
+def test_iterator_pool_worker_context_restored():
+    """A refill must not leak the task binding into the pool worker it
+    borrowed: the next task the worker runs sees its own context."""
+    from spark_rapids_tpu.runtime.host_pool import get_host_pool
+    with TaskContext() as ctx:
+        pit = PipelinedIterator(iter([1, 2, 3]), depth=1, ctx=ctx)
+        assert list(pit) == [1, 2, 3]
+        pit.close()
+    # drain every worker: none may still carry the finished task
+    pool = get_host_pool()
+    futs = [pool.submit(TaskContext.peek) for _ in range(pool.n_threads * 2)]
+    assert all(f.result() is not ctx for f in futs)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: planner pass + queries
+# ---------------------------------------------------------------------------
+
+def _norm(tbl):
+    d = tbl.to_pydict()
+    keys = sorted(d)
+    return sorted(zip(*[
+        [round(v, 9) if isinstance(v, float) else v for v in d[k]]
+        for k in keys]))
+
+
+def test_pipelined_query_matches_sync():
+    t = _table(30_000)
+
+    def q(s):
+        return (s.create_dataframe(t, num_partitions=2)
+                .filter(col("v") > lit(-500))
+                .group_by("k").agg(F.sum(col("v")).alias("sv"),
+                                   F.count().alias("n")))
+
+    r_pipe = q(_session()).collect()
+    r_sync = q(_session(**{
+        "spark.rapids.sql.pipeline.enabled": "false"})).collect()
+    assert _norm(r_pipe) == _norm(r_sync)
+
+
+def test_depth_zero_equals_synchronous_plan_and_results():
+    """depth=0 must not only match results — it must BE the synchronous
+    plan: no PipelineExec node is inserted at all."""
+    t = _table(8_000)
+
+    def tree_classes(s, df):
+        from spark_rapids_tpu.plan.overrides import convert_plan
+        root, _ = convert_plan(df.plan, s.conf)
+        names = []
+
+        def walk(n):
+            names.append(type(n).__name__)
+            for c in n.children:
+                walk(c)
+        walk(root)
+        return names
+
+    s0 = _session(**{"spark.rapids.sql.pipeline.depth": "0"})
+    df0 = s0.create_dataframe(t).filter(col("v") > lit(0))
+    assert "PipelineExec" not in tree_classes(s0, df0)
+    s1 = _session()
+    df1 = s1.create_dataframe(t).filter(col("v") > lit(0))
+    assert "PipelineExec" in tree_classes(s1, df1)
+    assert _norm(df0.collect()) == _norm(df1.collect())
+
+
+def test_dispatch_budget_unchanged_by_pipelining():
+    """Pipelining moves host work off the critical path; it must not
+    change WHAT is dispatched (the fuse hook counts every device entry
+    issued through fused())."""
+    from spark_rapids_tpu.exec import fuse
+    t = _table(16_000)
+
+    def run(enabled):
+        counts = []
+        fuse.set_dispatch_hook(lambda key: counts.append(key))
+        try:
+            s = _session(**{
+                "spark.rapids.sql.pipeline.enabled": str(enabled).lower()})
+            out = (s.create_dataframe(t, num_partitions=1)
+                   .filter(col("d") < lit(0.9))
+                   .select(col("k"), (col("v") * lit(2)).alias("v2"))
+                   .group_by("k").agg(F.sum(col("v2")).alias("s")))
+            res = out.collect()
+        finally:
+            fuse.set_dispatch_hook(None)
+        return res, len(counts)
+
+    r1, n1 = run(True)
+    r2, n2 = run(False)
+    assert _norm(r1) == _norm(r2)
+    assert n1 == n2
+
+
+def test_trace_shows_producer_consumer_overlap(tmp_path):
+    """The DEBUG trace carries pipelineProduce spans from the producer
+    side; with a bounded queue their intervals must interleave with (not
+    strictly precede) consumer-side exec spans — the overlap the whole
+    layer exists to create."""
+    import json
+    t = _table(60_000)
+    s = _session(**{
+        "spark.rapids.sql.trace.enabled": "true",
+        "spark.rapids.sql.trace.path": str(tmp_path),
+        "spark.rapids.sql.trace.level": "DEBUG",
+    })
+    out = (s.create_dataframe(t, num_partitions=1)
+           .filter(col("v") > lit(-900))
+           .group_by("k").agg(F.sum(col("v")).alias("sv")))
+    out.collect()
+    assert s.last_trace_paths is not None
+    with open(s.last_trace_paths["trace"]) as f:
+        events = json.load(f)["traceEvents"]
+    produce = [(e["ts"], e["ts"] + e["dur"]) for e in events
+               if e.get("name") == "pipelineProduce"]
+    consume = [(e["ts"], e["ts"] + e["dur"]) for e in events
+               if e.get("ph") == "X" and "HashAggregate" in e.get("name", "")]
+    assert produce, "no pipelineProduce spans in DEBUG trace"
+    assert consume, "no consumer-side agg spans in trace"
+    # overlap: some batch was produced AFTER consumption began (the
+    # bounded queue forces the producer to wait for the consumer)
+    first_consume = min(ts for ts, _ in consume)
+    assert max(ts for ts, _ in produce) > first_consume
+
+
+def test_producer_error_fails_query(tmp_path):
+    """A decode failure on the producer thread must surface as the
+    query's exception, not hang or get swallowed."""
+    import pyarrow.parquet as pq
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(_table(4_000), path, row_group_size=256)
+    s = _session()
+    df = s.read_parquet(path).filter(col("v") > lit(0))
+    with open(path, "wb") as f:
+        f.write(b"not a parquet file at all")
+    before = _non_pool_threads()
+    with pytest.raises(Exception):
+        df.collect()
+    assert _non_pool_threads() == before
+
+
+def test_limit_early_exit_no_thread_leak():
+    t = _table(200_000)
+    s = _session()
+    before = _non_pool_threads()
+    r = (s.create_dataframe(t)
+         .filter(col("d") >= lit(0.0)).limit(7).collect())
+    assert r.num_rows == 7
+    assert _non_pool_threads() == before
+    # the pipeline actually engaged AND stopped early: far fewer batches
+    # crossed the boundary than the ~196 the input holds
+    lm = s.last_metrics()
+    pipe = next(v for k, v in lm.items() if k.startswith("PipelineExec"))
+    assert pipe["pipelineDepth"] >= 1
+    assert pipe["numOutputBatches"] < 50
+
+
+def test_retry_oom_through_pipelined_stage():
+    """injectRetryOOM firing under a pipelined scan->agg stage must
+    drain/replay exactly as in the synchronous path and converge to the
+    same result."""
+    from spark_rapids_tpu import config as C
+    t = _table(20_000)
+
+    def q(s):
+        return (s.create_dataframe(t, num_partitions=2)
+                .group_by("k").agg(F.sum(col("v")).alias("sv"),
+                                   F.count().alias("n")))
+
+    expected = _norm(q(_session(**{
+        "spark.rapids.sql.pipeline.enabled": "false"})).collect())
+    got = _norm(q(_session(**{
+        C.RETRY_OOM_INJECT.key: "3"})).collect())
+    assert got == expected
+
+
+def test_pipelined_serialized_shuffle_matches_sync():
+    """The streaming ThrottlingExecutor writer (pipeline on) must produce
+    byte-identical shuffle results to the synchronous serde path."""
+    t = _table(24_000)
+
+    def q(s):
+        # multi-partition group_by plans partial-agg -> ShuffleExchange
+        # (the test backend exposes 8 virtual devices, so the planner
+        # takes the exchange path, not the collected single-device one)
+        return (s.create_dataframe(t, num_partitions=4)
+                .group_by("k").agg(F.count().alias("n"),
+                                   F.sum(col("v")).alias("sv")))
+
+    conf = {"spark.rapids.shuffle.mode": "SERIALIZED",
+            "spark.rapids.shuffle.multiThreaded.writer.threads": "4"}
+    r_pipe = q(_session(**conf)).collect()
+    r_sync = q(_session(**dict(
+        conf, **{"spark.rapids.sql.pipeline.enabled": "false"}))).collect()
+    assert _norm(r_pipe) == _norm(r_sync)
+
+
+def test_deferred_offsets_fetch_matches_sync():
+    """Compact exchange with the one-deep deferred offsets window must
+    emit exactly the sub-batches (contents AND per-partition row order)
+    the eager dispatch-then-fetch loop emits."""
+    from spark_rapids_tpu.columnar.batch import to_arrow
+    from spark_rapids_tpu.exec import tpu_nodes as X
+    from spark_rapids_tpu.plan.nodes import bind_expr
+    from spark_rapids_tpu.plan.overrides import convert_plan
+    t = _table(6_000)
+
+    def drain(enabled):
+        s = _session(**{
+            "spark.rapids.sql.pipeline.enabled": str(enabled).lower()})
+        df = s.create_dataframe(t, num_partitions=3)
+        child, _ = convert_plan(df.plan, s.conf)
+        ex = X.ShuffleExchangeExec(
+            df.plan, [child], s.conf,
+            [bind_expr(col("k"), df.plan.schema)], n_out=4)
+        parts = []
+        for p in range(ex.num_partitions):
+            with TaskContext(partition_id=p) as ctx:
+                parts.append([to_arrow(b, df.plan.schema.names).to_pylist()
+                              for b in ex.execute_partition(ctx, p)])
+        return parts
+
+    assert drain(True) == drain(False)
+
+
+# ---------------------------------------------------------------------------
+# TrafficController stall diagnostic (io/async_io.py satellite)
+# ---------------------------------------------------------------------------
+
+def test_traffic_controller_stall_warning(caplog):
+    import logging
+
+    from spark_rapids_tpu.io.async_io import TrafficController
+    ctrl = TrafficController(100, stall_warn_s=0.05)
+    ctrl.acquire(80)
+    release = threading.Timer(0.25, ctrl.release, args=(80,))
+    release.start()
+    with caplog.at_level(logging.WARNING, logger="spark_rapids_tpu"):
+        t0 = time.monotonic()
+        ctrl.acquire(80)  # blocks past the 50ms warn threshold
+        waited = time.monotonic() - t0
+    release.join()
+    ctrl.release(80)
+    assert waited >= 0.2  # admission semantics unchanged: it WAITED
+    assert any("async write throttle stalled" in r.message
+               for r in caplog.records)
+    # exactly one warning per acquire, however long the wait
+    assert sum("async write throttle stalled" in r.message
+               for r in caplog.records) == 1
+
+
+def test_traffic_controller_no_warning_below_threshold(caplog):
+    import logging
+
+    from spark_rapids_tpu.io.async_io import TrafficController
+    ctrl = TrafficController(100, stall_warn_s=5.0)
+    ctrl.acquire(80)
+    threading.Timer(0.05, ctrl.release, args=(80,)).start()
+    with caplog.at_level(logging.WARNING, logger="spark_rapids_tpu"):
+        ctrl.acquire(80)
+    ctrl.release(80)
+    assert not any("stalled" in r.message for r in caplog.records)

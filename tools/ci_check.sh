@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# CI gate: docs drift, trace-overhead smoke, obs smoke, tier-1 tests.
+# CI gate: docs drift, trace-overhead smoke, obs smoke, pipeline smoke,
+# tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (docs drift + smokes)
@@ -32,6 +33,11 @@ fi
 
 step "obs smoke (/metrics scrape while a query runs, /healthz degraded flip, history round-trip)"
 if ! python tools/obs_smoke.py; then
+    fail=1
+fi
+
+step "pipeline smoke (overlap engaged on a multi-batch query, LIMIT cancel, no thread leak)"
+if ! python tools/pipeline_smoke.py; then
     fail=1
 fi
 

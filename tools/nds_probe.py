@@ -2603,7 +2603,12 @@ def run_one(sess, dfs, qn: int, history_dir: str = "",
         else "wrong"
     rec = {"status": status, "device": device,
            "rows": int(tpu_table.num_rows),
-           "seconds": round(dt, 4), "first_run_seconds": round(first, 4)}
+           "seconds": round(dt, 4), "first_run_seconds": round(first, 4),
+           # first-run times are 7-11s vs 0.6s steady-state: nearly all
+           # of the delta is XLA compilation, so the second-run delta IS
+           # the compile cost — splitting it out makes compile-cache
+           # regressions visible instead of smearing into "slow query"
+           "compile_seconds": round(max(first - dt, 0.0), 4)}
     if history_dir:
         append_scorecard(history_dir, qn, rec, df.plan, wall0, sf=sf)
     return rec
@@ -2631,17 +2636,34 @@ def append_scorecard(history_dir: str, qn: int, rec: dict, plan,
               f"{history_dir!r}: {e}", file=sys.stderr)
 
 
+def _compile_seconds(q: dict) -> float:
+    """Per-query compile cost: the recorded split when present, the
+    first-minus-steady delta for records written before the split."""
+    if "compile_seconds" in q:
+        return float(q["compile_seconds"])
+    return max(float(q.get("first_run_seconds", 0.0))
+               - float(q.get("seconds", 0.0)), 0.0)
+
+
 def summarize_card(card: dict, sf: float) -> dict:
     """The scorecard summary shape (shared by a live run and
-    --from-history regeneration, so the two can never drift)."""
+    --from-history regeneration, so the two can never drift). The
+    compile/steady totals aggregate the per-query split so the scorecard
+    trajectory shows compile-cache regressions separately from kernel
+    regressions."""
     translated = [q for q in card.values()
                   if q["status"] != "not_translated"]
+    measured = [q for q in translated if q["status"] in ("ok", "wrong")]
     return {
         "sf": sf,
         "translated": len(translated),
         "ok": sum(1 for q in translated if q["status"] == "ok"),
         "clean_device": sum(1 for q in translated
                             if q.get("device") == "clean"),
+        "steady_seconds_total": round(
+            sum(float(q.get("seconds", 0.0)) for q in measured), 4),
+        "compile_seconds_total": round(
+            sum(_compile_seconds(q) for q in measured), 4),
         "queries": card,
     }
 
