@@ -1,0 +1,23 @@
+"""Static analysis + runtime sanitizers for the engine's own invariants.
+
+Five rounds of PR review built up a set of hand-enforced concurrency and
+instrumentation rules (locks never held across I/O, all host parallelism
+on the shared pool, every exec timer through TpuExec.span, ...). This
+package checks them mechanically:
+
+- ``lint.py``    — AST-based lint suite (``tools/tpulint.py`` CLI). Pure
+  stdlib, no engine imports: the full-tree run must stay under seconds.
+- ``sanitizer.py`` — runtime concurrency sanitizer behind
+  ``spark.rapids.debug.sanitizer.enabled``: instrumented Lock/Condition
+  wrappers record the lock-acquisition-order graph, detect cycles
+  (potential deadlocks) and held-lock blocking calls, and dump a ranked
+  report through the trace machinery.
+- ``plan_verify.py`` — plan-invariant verifier run by ``convert_plan``
+  under ``spark.rapids.debug.planVerify.enabled`` (and always by the
+  golden dispatch-budget tests in CI).
+
+The reference ships the same class of tooling alongside its engine (the
+RMM leak-detector preload lib, refcount debug stacks, assertIsOnTheGpu);
+this is that idea applied to the invariants THIS engine's history says
+actually break.
+"""

@@ -1,0 +1,528 @@
+"""tpulint: AST checks for the engine's hand-enforced invariants.
+
+Each rule encodes a discipline this codebase already follows (and has
+been burned by breaking — TPU-L001 is exactly the PR 5 review bug where
+a stall diagnostic did logging/trace/obs I/O while holding the traffic
+controller's condition lock). The linter is pure stdlib ``ast`` over
+source files — importing the engine (and therefore jax) would blow the
+<10s full-tree budget and make the lint unusable as a pre-commit hook.
+
+Rules
+-----
+TPU-L001  no ``with <lock>:`` body containing logging, trace/obs emission,
+          file I/O, blocking waits, or callback invocation. A wedged log
+          handler or slow disk must never extend a critical section.
+TPU-L002  no bare ``ThreadPoolExecutor``/``threading.Thread`` outside
+          ``runtime/host_pool.py`` — all host parallelism goes through
+          the shared bounded pool (or its sanctioned task-wave/service-
+          thread factories).
+TPU-L003  no exec timer site bypassing ``TpuExec.span``: ``.ns()``
+          metric timers in the exec layer dodge the one-instrumentation-
+          point contract (trace + metric must stay a single block).
+TPU-L004  no device-array host sync (``.item()``, ``jax.device_get``,
+          ``np.asarray``) inside a span'd timer body without a
+          ``# tpulint: deferred-fetch <why>`` annotation — an
+          unannotated sync serializes the host against the device inside
+          a timed region (the dispatch-pipelining killer).
+TPU-L005  no mutable default arguments (list/dict/set literals or
+          constructors) anywhere in the package.
+TPU-L006  no silently swallowed exceptions: an ``except`` over
+          Exception/BaseException (or bare) whose body is just ``pass``
+          must carry a justification comment on the except line.
+TPU-L007  every string-literal metric name at a ``.metric("...")`` /
+          ``GpuMetric("...")`` site must be registered in
+          ``runtime/metrics.py`` (module constants) or the task-metric
+          roster in ``runtime/trace.py``, and present in the generated
+          ``docs/metrics.md`` — ad-hoc names silently vanish from the
+          rollups and the docs.
+
+Suppression
+-----------
+``# tpulint: disable=TPU-LNNN <reason>`` on the violating line (or alone
+on the line above it, when the reason outgrows the line) — or, for
+TPU-L001, on the ``with`` statement opening the locked region — records
+a counted, justified suppression. ``--strict`` fails on any unsuppressed
+violation and on any disable comment without a reason. Deferred fetches
+use ``# tpulint: deferred-fetch <why>`` (an annotation, not a
+suppression: it documents that the fetch rides under device compute).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "TPU-L001": "logging/trace/obs/file-I/O/blocking call inside a held "
+                "lock region",
+    "TPU-L002": "bare ThreadPoolExecutor/threading.Thread outside "
+                "runtime/host_pool.py",
+    "TPU-L003": "exec timer bypasses TpuExec.span (.ns() in the exec "
+                "layer)",
+    "TPU-L004": "device->host sync inside a span'd timer body without a "
+                "deferred-fetch annotation",
+    "TPU-L005": "mutable default argument",
+    "TPU-L006": "swallowed 'except Exception: pass' without a "
+                "justification comment",
+    "TPU-L007": "metric name not registered in runtime/metrics.py (or "
+                "absent from docs/metrics.md)",
+}
+
+_DISABLE_RE = re.compile(
+    r"#\s*tpulint:\s*disable=(TPU-L\d{3})\b[ \t]*(.*)")
+_DEFERRED_RE = re.compile(r"#\s*tpulint:\s*deferred-fetch\b[ \t]*(.*)")
+_LOCKISH_RE = re.compile(
+    r"(?:^|_)(lock|locks|glock|mutex|cv|cond|condition)$")
+
+#: attribute terminals that mean "this call emits a log record" when the
+#: receiver looks like a logger
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_LOGGER_NAMES = {"log", "logger", "logging"}
+#: module-level trace entry points (runtime/trace.py)
+_TRACE_FUNCS = {"instant", "span", "metric_span", "exec_span", "emit_span",
+                "complete", "task_rollup", "start_query", "end_query",
+                "on_task_complete", "finalize"}
+_TRACE_BASES = {"trace", "tr", "tracer"}
+#: (base, terminal) file-I/O pairs
+_IO_PAIRS = {
+    ("np", "save"), ("np", "load"), ("numpy", "save"), ("numpy", "load"),
+    ("os", "unlink"), ("os", "remove"), ("os", "makedirs"),
+    ("os", "rename"), ("os", "replace"), ("os", "rmdir"),
+    ("shutil", "rmtree"), ("shutil", "copy"), ("shutil", "move"),
+    ("json", "dump"), ("pickle", "dump"),
+    ("time", "sleep"), ("subprocess", "run"), ("subprocess", "check_call"),
+    ("subprocess", "check_output"),
+}
+#: terminals that block or do I/O on any receiver
+_BLOCKING_TERMINALS = {"write", "flush", "wait", "result"}
+#: bare names whose call under a lock is file I/O / console I/O
+_IO_NAMES = {"open", "print"}
+#: bare names that are conventionally caller-supplied callbacks
+_CALLBACK_NAMES = {"fn", "cb", "callback", "hook"}
+
+#: host-sync calls inside span bodies (TPU-L004)
+_SYNC_TERMINALS = {"item", "device_get", "asarray"}
+
+_OBS_FUNCS = {"on_query_start", "on_query_end", "on_task_complete",
+              "state", "install"}
+
+
+@dataclasses.dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def render(self, root: str) -> str:
+        rel = os.path.relpath(self.path, root)
+        tag = " [suppressed: %s]" % self.reason if self.suppressed else ""
+        return f"{rel}:{self.line}: {self.rule}: {self.message}{tag}"
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    """Final identifier of a Name/Attribute chain ('self._lock' -> '_lock')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _base_name(node: ast.AST) -> Optional[str]:
+    """Identifier the terminal hangs off ('trace.instant' -> 'trace',
+    'self.tracer.complete' -> 'tracer')."""
+    if isinstance(node, ast.Attribute):
+        return _terminal(node.value)
+    return None
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = _terminal(expr)
+    return bool(name and _LOCKISH_RE.search(name.lower()))
+
+
+def _expr_key(expr: ast.AST) -> str:
+    return ast.dump(expr)
+
+
+def _is_span_call(expr: ast.AST) -> bool:
+    """Is this with-item a span'd timer? self.span(m), trace.metric_span,
+    trace.exec_span, <metric>.ns() (the bare timer), node.span(...)."""
+    if not isinstance(expr, ast.Call):
+        return False
+    term = _terminal(expr.func)
+    if term in ("span", "metric_span", "exec_span", "ns"):
+        return True
+    return False
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str, known_metrics: Set[str],
+                 relpath: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.lines = source.splitlines()
+        self.known_metrics = known_metrics
+        self.violations: List[Violation] = []
+        # stack of (lock_keys, with_lineno) for held-lock regions
+        self._lock_stack: List[Tuple[Set[str], int]] = []
+        self._span_depth = 0
+        self._in_host_pool = self.relpath.endswith("runtime/host_pool.py")
+        self._in_exec_layer = "/exec/" in "/" + self.relpath
+        self._in_analysis = "/analysis/" in "/" + self.relpath
+
+    # -- helpers -----------------------------------------------------------
+
+    def _line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def _annotated_deferred(self, lineno: int) -> bool:
+        """deferred-fetch annotation on the line or either neighbor (the
+        call often wraps across lines)."""
+        for ln in (lineno - 1, lineno, lineno + 1):
+            if _DEFERRED_RE.search(self._line(ln)):
+                return True
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, message: str,
+              also_lines: Tuple[int, ...] = ()) -> None:
+        lineno = getattr(node, "lineno", 1)
+        candidates = []
+        for ln in (lineno,) + also_lines:
+            # the disable comment sits on the statement line or — when
+            # the reason is too long for the line — alone on the line
+            # above it (the eslint-disable-next-line convention)
+            candidates += [ln, ln - 1]
+        for ln in candidates:
+            m = _DISABLE_RE.search(self._line(ln))
+            if m and m.group(1) == rule:
+                self.violations.append(Violation(
+                    rule, self.path, lineno, message, suppressed=True,
+                    reason=m.group(2).strip()))
+                return
+        self.violations.append(Violation(rule, self.path, lineno, message))
+
+    # -- TPU-L001 ----------------------------------------------------------
+
+    def _check_locked_call(self, node: ast.Call) -> None:
+        if not self._lock_stack:
+            return
+        lock_keys = set().union(*(k for k, _ in self._lock_stack))
+        with_lines = tuple(ln for _, ln in self._lock_stack)
+        func = node.func
+        term = _terminal(func)
+        base = _base_name(func)
+
+        def hit(what: str) -> None:
+            self._emit("TPU-L001", node,
+                       f"{what} inside a held lock region "
+                       f"(lock taken at line {with_lines[-1]})",
+                       also_lines=with_lines)
+
+        if isinstance(func, ast.Name):
+            if func.id in _IO_NAMES:
+                hit(f"file/console I/O call {func.id}()")
+            elif func.id in _CALLBACK_NAMES:
+                hit(f"callback invocation {func.id}()")
+            return
+        if term is None:
+            return
+        # a condition waiting on ITSELF is the cv protocol, not a held-
+        # lock block (cv.wait releases the lock it guards)
+        if term in ("wait", "notify", "notify_all") and base is not None:
+            owner = func.value
+            if _expr_key(owner) in lock_keys:
+                return
+        if term in _LOG_METHODS and base is not None \
+                and (base.lower() in _LOGGER_NAMES
+                     or (isinstance(func.value, ast.Call)
+                         and _terminal(func.value.func) == "getLogger")):
+            hit(f"logging call .{term}()")
+            return
+        if term in _TRACE_FUNCS and base is not None \
+                and base.lower() in _TRACE_BASES:
+            hit(f"trace emission {base}.{term}()")
+            return
+        if term in _OBS_FUNCS and base == "obs":
+            hit(f"obs call obs.{term}()")
+            return
+        if base is not None and (base, term) in _IO_PAIRS:
+            hit(f"I/O call {base}.{term}()")
+            return
+        if term in _BLOCKING_TERMINALS:
+            hit(f"blocking call .{term}()")
+            return
+
+    # -- visitors ----------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        lock_keys: Set[str] = set()
+        span = False
+        for item in node.items:
+            # the context expressions evaluate before the region is
+            # entered — check them against the ENCLOSING state
+            self.visit(item.context_expr)
+        for item in node.items:
+            expr = item.context_expr
+            if _is_lockish(expr):
+                lock_keys.add(_expr_key(expr))
+            elif isinstance(expr, ast.Call) and _is_lockish(expr.func):
+                # factory-style: with lock(): — rare, treat as lock
+                lock_keys.add(_expr_key(expr))
+            if _is_span_call(expr):
+                span = True
+        if lock_keys:
+            self._lock_stack.append((lock_keys, node.lineno))
+        if span:
+            self._span_depth += 1
+        for child in node.body:
+            self.visit(child)
+        if span:
+            self._span_depth -= 1
+        if lock_keys:
+            self._lock_stack.pop()
+
+    # nested defs/lambdas inside a with-block do NOT run under the lock
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        saved, self._lock_stack = self._lock_stack, []
+        saved_span, self._span_depth = self._span_depth, 0
+        self.generic_visit(node)
+        self._lock_stack = saved
+        self._span_depth = saved_span
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self._lock_stack = self._lock_stack, []
+        saved_span, self._span_depth = self._span_depth, 0
+        self.generic_visit(node)
+        self._lock_stack = saved
+        self._span_depth = saved_span
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_locked_call(node)
+        self._check_threads(node)
+        self._check_timer_bypass(node)
+        self._check_host_sync(node)
+        self._check_metric_name(node)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        self._check_swallowed(node)
+        self.generic_visit(node)
+
+    # -- TPU-L002 ----------------------------------------------------------
+
+    def _check_threads(self, node: ast.Call) -> None:
+        if self._in_host_pool:
+            return
+        term = _terminal(node.func)
+        if term in ("ThreadPoolExecutor", "ProcessPoolExecutor"):
+            self._emit("TPU-L002", node,
+                       f"bare {term} — use runtime/host_pool.py "
+                       f"(get_host_pool / run_task_wave)")
+        elif term == "Thread":
+            base = _base_name(node.func)
+            if base in (None, "threading"):
+                self._emit("TPU-L002", node,
+                           "bare threading.Thread — use host_pool."
+                           "spawn_service_thread for service threads")
+
+    # -- TPU-L003 ----------------------------------------------------------
+
+    def _check_timer_bypass(self, node: ast.Call) -> None:
+        if not self._in_exec_layer:
+            return
+        if _terminal(node.func) == "ns" and not node.args \
+                and not node.keywords:
+            self._emit("TPU-L003", node,
+                       "raw GpuMetric.ns() timer in the exec layer — "
+                       "time device work with TpuExec.span(metric) so the "
+                       "trace and the metric stay one instrumentation "
+                       "point")
+
+    # -- TPU-L004 ----------------------------------------------------------
+
+    def _check_host_sync(self, node: ast.Call) -> None:
+        if self._span_depth == 0:
+            return
+        term = _terminal(node.func)
+        if term not in _SYNC_TERMINALS:
+            return
+        if term == "asarray":
+            base = _base_name(node.func)
+            if base not in ("np", "numpy"):
+                return  # jnp.asarray stays on device
+        if term == "item" and (node.args or node.keywords):
+            return
+        if self._annotated_deferred(node.lineno):
+            return
+        self._emit("TPU-L004", node,
+                   f"device->host sync .{term}() inside a span'd timer "
+                   f"body — defer it (start_d2h + consume after yield) or "
+                   f"annotate '# tpulint: deferred-fetch <why>'")
+
+    # -- TPU-L005 ----------------------------------------------------------
+
+    def _check_defaults(self, node: ast.FunctionDef) -> None:
+        for d in list(node.args.defaults) + [
+                x for x in node.args.kw_defaults if x is not None]:
+            bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set") and not d.args
+                and not d.keywords)
+            if bad:
+                self._emit("TPU-L005", d,
+                           f"mutable default argument in {node.name}() — "
+                           f"shared across calls; default to None")
+
+    # -- TPU-L006 ----------------------------------------------------------
+
+    def _check_swallowed(self, node: ast.ExceptHandler) -> None:
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        if not broad:
+            return
+        if len(node.body) != 1 or not isinstance(node.body[0], ast.Pass):
+            return
+        # a justification comment on the except line (or the pass line)
+        # documents the swallow as deliberate — the codebase convention is
+        # '# noqa: BLE001 - <why>'
+        for ln in range(node.lineno, node.body[0].lineno + 1):
+            text = self._line(ln)
+            if "#" in text and text.split("#", 1)[1].strip():
+                return
+        self._emit("TPU-L006", node,
+                   "except Exception: pass with no justification comment "
+                   "— handle it, narrow it, or document why swallowing "
+                   "is safe")
+
+    # -- TPU-L007 ----------------------------------------------------------
+
+    def _check_metric_name(self, node: ast.Call) -> None:
+        term = _terminal(node.func)
+        if term == "metric":
+            args = node.args
+        elif term == "GpuMetric":
+            args = node.args
+        else:
+            return
+        if not args or not isinstance(args[0], ast.Constant) \
+                or not isinstance(args[0].value, str):
+            return
+        name = args[0].value
+        if name not in self.known_metrics:
+            self._emit("TPU-L007", node,
+                       f"metric name {name!r} is not registered in "
+                       f"runtime/metrics.py (or the task-metric roster in "
+                       f"runtime/trace.py) — register it so rollups and "
+                       f"docs/metrics.md stay complete")
+
+
+# ---------------------------------------------------------------------------
+# Registry extraction (AST-only: no engine import)
+# ---------------------------------------------------------------------------
+
+def known_metric_names(pkg_root: str) -> Set[str]:
+    """Registered metric names: module-level string constants in
+    runtime/metrics.py plus the TASK_METRIC_NAMES roster in
+    runtime/trace.py."""
+    names: Set[str] = set()
+    mpath = os.path.join(pkg_root, "runtime", "metrics.py")
+    tree = ast.parse(open(mpath).read(), mpath)
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.isupper():
+                    names.add(stmt.value.value)
+    tpath = os.path.join(pkg_root, "runtime", "trace.py")
+    ttree = ast.parse(open(tpath).read(), tpath)
+    for stmt in ttree.body:
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name) \
+                        and tgt.id == "TASK_METRIC_NAMES" \
+                        and isinstance(stmt.value, (ast.Tuple, ast.List)):
+                    for el in stmt.value.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str):
+                            names.add(el.value)
+    return names
+
+
+def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
+    """Metric names documented in docs/metrics.md (None when the file is
+    missing — the doc-presence half of TPU-L007 then reports once)."""
+    path = os.path.join(repo_root, "docs", "metrics.md")
+    if not os.path.exists(path):
+        return None
+    found = set()
+    for m in re.finditer(r"`([A-Za-z][A-Za-z0-9_.]*)`", open(path).read()):
+        found.add(m.group(1))
+    return found
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def lint_source(source: str, path: str, known_metrics: Set[str],
+                relpath: Optional[str] = None) -> List[Violation]:
+    tree = ast.parse(source, path)
+    linter = _FileLinter(path, source, known_metrics,
+                         relpath if relpath is not None else path)
+    linter.visit(tree)
+    return linter.violations
+
+
+def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
+    """Lint every .py under spark_rapids_tpu/. Returns (violations,
+    stats). Also cross-checks registered metric names against
+    docs/metrics.md (the docs half of TPU-L007)."""
+    pkg_root = os.path.join(repo_root, "spark_rapids_tpu")
+    known = known_metric_names(pkg_root)
+    violations: List[Violation] = []
+    n_files = 0
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            n_files += 1
+            rel = os.path.relpath(path, pkg_root)
+            violations.extend(lint_source(
+                open(path).read(), path, known, relpath=rel))
+    documented = docs_metric_names(repo_root)
+    mpath = os.path.join(pkg_root, "runtime", "metrics.py")
+    if documented is None:
+        violations.append(Violation(
+            "TPU-L007", mpath, 1,
+            "docs/metrics.md is missing — regenerate with "
+            "'python tools/gen_docs.py'"))
+    else:
+        for name in sorted(known - documented):
+            violations.append(Violation(
+                "TPU-L007", mpath, 1,
+                f"registered metric {name!r} absent from docs/metrics.md "
+                f"— regenerate with 'python tools/gen_docs.py'"))
+    stats = {
+        "files": n_files,
+        "violations": sum(1 for v in violations if not v.suppressed),
+        "suppressed": sum(1 for v in violations if v.suppressed),
+        "suppressions_without_reason": sum(
+            1 for v in violations if v.suppressed and not v.reason),
+    }
+    return violations, stats

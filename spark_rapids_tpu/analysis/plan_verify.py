@@ -1,0 +1,310 @@
+"""Plan-invariant verifier: structural checks over a CONVERTED exec tree.
+
+``convert_plan`` ends with three tree rewrites (cost optimizer, stage
+fusion, pipeline insertion) whose legality rules live in reviewers'
+heads: a fused chain must be linear/narrow/carry-free, a pipeline
+boundary must wrap exactly a scan, wrappers must be schema-transparent.
+Each rule was hand-checked in the PR that introduced it and nothing
+re-checks it as the passes evolve. This module re-derives them from the
+tree itself:
+
+- **schema consistency** (PV-SCHEMA): every node exposes a well-formed
+  ``types.Schema``; pass-through nodes (Filter/Limit/Sort/TopN/Coalesce/
+  Pipeline and the exchanges) must preserve their child's column names
+  and types exactly — a wrapper that changes the schema is corrupting
+  data, not routing it.
+- **fusion-group legality** (PV-FUSE / PV-ABSORB): every
+  ``FusedStageExec`` member is statically fusable, >=2 members actually
+  dispatch (the pass's own profitability bar), the member chain is
+  linked child-most-first, and stage ids are unique; an absorbed
+  pre-chain hangs off a partial/complete ``HashAggregateExec`` with
+  carry-free bodies.
+- **pipeline legality** (PV-PIPE): a ``PipelineExec`` wraps exactly one
+  scan, never the root, with depth >= 1 — the exact placement rule of
+  ``insert_pipelines``.
+- **dispatch budget** (:func:`dispatch_budget`): the static count of
+  device dispatches per input batch the plan shape implies, exported as
+  data so ``tests/golden_plans/dispatch_budgets.json`` can pin it per
+  NDS probe query — a fusion or pipeline regression then fails a test
+  instead of showing up as silent perf loss.
+
+Run it two ways: ``spark.rapids.debug.planVerify.enabled`` makes
+``convert_plan`` verify every tree it returns (debug conf — the walk is
+linear but touches every node), and the golden-budget tests in CI verify
+the NDS probe plans unconditionally.
+
+Duck-typed by class NAME (like ``metrics.walk_exec_tree``): the exec
+classes for fusion/pipelining are created lazily against the live base,
+so isinstance against them would force imports this module doesn't need.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["PlanVerifyError", "check_plan", "verify_plan",
+           "dispatch_budget", "compare_budget"]
+
+
+class PlanVerifyError(AssertionError):
+    """A converted exec tree violates an engine invariant. Raised before
+    execution starts — a malformed plan must never reach the device."""
+
+    def __init__(self, violations: List[str]):
+        self.violations = violations
+        super().__init__(
+            "plan verification failed (%d violation%s):\n  " % (
+                len(violations), "s" if len(violations) != 1 else "")
+            + "\n  ".join(violations))
+
+
+#: wrappers that must hand their child's schema through unchanged
+_SCHEMA_PRESERVING = {
+    "FilterExec", "LimitExec", "SortExec", "TopNExec",
+    "CoalesceBatchesExec", "PipelineExec", "ShuffleExchangeExec",
+    "RoundRobinExchangeExec", "RangeExchangeExec", "CollectExchangeExec",
+}
+
+#: the only nodes insert_pipelines may wrap (its scan_types tuple)
+_PIPELINE_WRAPPABLE = {
+    "ParquetScanExec", "TextScanExec", "InMemoryScanExec",
+    "ShuffleFileScanExec",
+}
+
+
+def _cls(node) -> str:
+    # PipelineExec.name() renders as "PipelineExec(depth=N)"; the class
+    # name is the stable identity
+    return type(node).__name__
+
+
+def _schema_sig(schema) -> Optional[list]:
+    try:
+        return [(f.name, f.dtype) for f in schema.fields]
+    except Exception:  # noqa: BLE001 - malformed schema reported by caller
+        return None
+
+
+def _check_schema(node, path: str, out: List[str]) -> None:
+    sig = _schema_sig(node.schema)
+    if sig is None:
+        out.append(f"PV-SCHEMA {path}: schema is not a well-formed "
+                   f"types.Schema (fields of name+dtype)")
+        return
+    for name, dtype in sig:
+        if not isinstance(name, str) or dtype is None:
+            out.append(f"PV-SCHEMA {path}: malformed field "
+                       f"{name!r}:{dtype!r}")
+    if _cls(node) in _SCHEMA_PRESERVING and node.children:
+        child_sig = _schema_sig(node.children[0].schema)
+        if child_sig is not None and child_sig != sig:
+            out.append(
+                f"PV-SCHEMA {path}: {_cls(node)} must preserve its "
+                f"child's schema but maps {child_sig} -> {sig}")
+
+
+def _check_fused(node, path: str, seen_stage_ids: Dict[int, str],
+                 out: List[str]) -> None:
+    from spark_rapids_tpu.exec import stage_fusion as SF
+    members = node.members
+    if not members:
+        out.append(f"PV-FUSE {path}: FusedStageExec with no members")
+        return
+    if len(node.children) != 1:
+        out.append(f"PV-FUSE {path}: fused stage must have exactly one "
+                   f"child, has {len(node.children)}")
+    for m in members:
+        if not SF._fusable(m):
+            out.append(f"PV-FUSE {path}: member {_cls(m)} is not a "
+                       f"fusable narrow operator")
+    n_disp = sum(1 for m in members if SF._dispatching(m))
+    if n_disp < 2:
+        out.append(f"PV-FUSE {path}: only {n_disp} dispatching member(s) "
+                   f"— fusion is only legal when >=2 dispatches collapse "
+                   f"(lone narrow ops must stay unfused)")
+    for i in range(len(members) - 1):
+        nxt = members[i + 1]
+        if not nxt.children or nxt.children[0] is not members[i]:
+            out.append(f"PV-FUSE {path}: members are not a linked chain "
+                       f"child-most-first at position {i + 1} "
+                       f"({_cls(nxt)})")
+    if node.plan is not members[-1].plan:
+        out.append(f"PV-FUSE {path}: fused stage must carry the chain "
+                   f"head's plan node ({_cls(members[-1])})")
+    _check_stage_id(getattr(node, "stage_id", 0), path, "PV-FUSE",
+                    seen_stage_ids, out)
+
+
+def _check_absorbed(node, path: str, seen_stage_ids: Dict[int, str],
+                    out: List[str]) -> None:
+    from spark_rapids_tpu.exec import stage_fusion as SF
+    if _cls(node) != "HashAggregateExec":
+        out.append(f"PV-ABSORB {path}: pre-chain absorbed into "
+                   f"{_cls(node)} — only HashAggregateExec may absorb")
+        return
+    if node.mode not in ("partial", "complete"):
+        out.append(f"PV-ABSORB {path}: absorbing aggregate has mode "
+                   f"{node.mode!r}; only partial/complete update kernels "
+                   f"may run a pre-chain")
+    members = node.pre_chain_members
+    for m in members:
+        if not SF._fusable(m):
+            out.append(f"PV-ABSORB {path}: pre-chain member {_cls(m)} is "
+                       f"not a fusable narrow operator")
+    if not any(SF._dispatching(m) for m in members):
+        out.append(f"PV-ABSORB {path}: no pre-chain member dispatches — "
+                   f"absorbing saves nothing and costs a retrace")
+    for body in node.pre_chain:
+        if body.has_carry:
+            out.append(f"PV-ABSORB {path}: pre-chain body {body.key!r} "
+                       f"carries state — carries cannot thread through "
+                       f"the aggregate update kernel")
+    for i in range(len(members) - 1):
+        nxt = members[i + 1]
+        if not nxt.children or nxt.children[0] is not members[i]:
+            out.append(f"PV-ABSORB {path}: pre-chain members are not a "
+                       f"linked chain child-most-first at position "
+                       f"{i + 1} ({_cls(nxt)})")
+    _check_stage_id(getattr(node, "fused_stage_id", 0), path, "PV-ABSORB",
+                    seen_stage_ids, out)
+
+
+def _check_stage_id(sid, path: str, rule: str,
+                    seen_stage_ids: Dict[int, str], out: List[str]) -> None:
+    if not isinstance(sid, int) or sid <= 0:
+        out.append(f"{rule} {path}: stage id must be a positive int, "
+                   f"got {sid!r}")
+        return
+    prev = seen_stage_ids.get(sid)
+    if prev is not None:
+        out.append(f"{rule} {path}: stage id {sid} already used by "
+                   f"{prev}")
+    else:
+        seen_stage_ids[sid] = path
+
+
+def _check_pipeline(node, path: str, is_root: bool, out: List[str]) -> None:
+    if is_root:
+        out.append(f"PV-PIPE {path}: PipelineExec at the root — the "
+                   f"consumer side of the boundary would be the driver "
+                   f"loop itself (insert_pipelines only wraps non-root "
+                   f"scans)")
+    if len(node.children) != 1:
+        out.append(f"PV-PIPE {path}: pipeline boundary must wrap exactly "
+                   f"one child, has {len(node.children)}")
+        return
+    child = node.children[0]
+    if _cls(child) not in _PIPELINE_WRAPPABLE:
+        out.append(f"PV-PIPE {path}: pipeline wraps {_cls(child)} — only "
+                   f"host-producing scans are legal boundaries "
+                   f"({sorted(_PIPELINE_WRAPPABLE)})")
+    if not isinstance(node.depth, int) or node.depth < 1:
+        out.append(f"PV-PIPE {path}: lookahead depth must be >= 1, got "
+                   f"{node.depth!r} (depth<=0 plans must stay unwrapped)")
+
+
+def check_plan(exec_root) -> List[str]:
+    """All violations in a converted exec tree (empty list = clean).
+    Linear in tree size; no device work, no imports beyond the already-
+    loaded exec layer."""
+    out: List[str] = []
+    seen_stage_ids: Dict[int, str] = {}
+    on_stack: set = set()
+
+    def walk(node, path: str, is_root: bool) -> None:
+        if id(node) in on_stack:
+            out.append(f"PV-TREE {path}: cycle — node {_cls(node)} is "
+                       f"its own ancestor")
+            return
+        on_stack.add(id(node))
+        try:
+            _check_schema(node, path, out)
+            if getattr(node, "members", None):
+                _check_fused(node, path, seen_stage_ids, out)
+            if getattr(node, "pre_chain_members", None):
+                _check_absorbed(node, path, seen_stage_ids, out)
+            if _cls(node) == "PipelineExec":
+                _check_pipeline(node, path, is_root, out)
+            if not isinstance(node.children, list):
+                out.append(f"PV-TREE {path}: children must be a list")
+                return
+            for i, c in enumerate(node.children):
+                walk(c, f"{path}/{_cls(c)}[{i}]", False)
+        finally:
+            on_stack.discard(id(node))
+
+    walk(exec_root, _cls(exec_root), True)
+    return out
+
+
+def verify_plan(exec_root) -> None:
+    """Raise :class:`PlanVerifyError` listing every violation (or return
+    silently). Called by ``convert_plan`` under
+    ``spark.rapids.debug.planVerify.enabled`` and by the CI golden
+    tests."""
+    violations = check_plan(exec_root)
+    if violations:
+        raise PlanVerifyError(violations)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch budgets
+# ---------------------------------------------------------------------------
+
+def dispatch_budget(exec_root) -> dict:
+    """Static per-batch device-dispatch budget of a converted tree.
+
+    Counts the NARROW dispatching sites — the ones stage fusion exists to
+    collapse: one per fused stage, one per aggregate update (its absorbed
+    pre-chain rides for free), one per standalone Filter/Expand/
+    non-trivial Project that escaped fusion. Wide operators (joins,
+    sorts, exchanges) dispatch data-dependently and are out of scope —
+    the budget pins the plan SHAPE, not the workload. Also exports the
+    fusion groups, pipeline-boundary count and exec-class census so a
+    golden file diff says exactly what changed."""
+    from spark_rapids_tpu.exec import stage_fusion as SF
+    from spark_rapids_tpu.runtime.metrics import walk_exec_tree
+
+    narrow = 0
+    pipeline_boundaries = 0
+    exec_count = 0
+    census: Dict[str, int] = {}
+    for _key, node, _depth, role, _sid in walk_exec_tree(exec_root):
+        name = _cls(node)
+        if role is not None:
+            # fused members / absorbed pre-chains never dispatch alone
+            continue
+        exec_count += 1
+        census[name] = census.get(name, 0) + 1
+        if name == "PipelineExec":
+            pipeline_boundaries += 1
+        elif name == "FusedStageExec":
+            narrow += 1
+        elif name == "HashAggregateExec":
+            narrow += 1
+        elif name in ("FilterExec", "ExpandExec", "ProjectExec"):
+            if SF._dispatching(node):
+                narrow += 1
+    groups = SF.fusion_groups(exec_root)
+    return {
+        "narrow_dispatches_per_batch": narrow,
+        "fused_stages": sum(1 for g in groups if g["kind"] == "fused"),
+        "absorbed_stages": sum(1 for g in groups
+                               if g["kind"] == "absorbed"),
+        "fusion_groups": [
+            {"kind": g["kind"], "members": g["members"]} for g in groups],
+        "pipeline_boundaries": pipeline_boundaries,
+        "exec_count": exec_count,
+        "exec_census": dict(sorted(census.items())),
+    }
+
+
+def compare_budget(actual: dict, golden: dict) -> List[str]:
+    """Human-readable diffs between a plan's budget and its golden pin
+    (empty = match). Key-by-key so a failure names the regressed
+    dimension instead of dumping two dicts."""
+    diffs = []
+    for key in sorted(set(golden) | set(actual)):
+        if actual.get(key) != golden.get(key):
+            diffs.append(f"{key}: golden {golden.get(key)!r} != actual "
+                         f"{actual.get(key)!r}")
+    return diffs

@@ -155,6 +155,38 @@ TRACE_TASK_METRICS = conf_bool(
     "semaphore wait, max device bytes held — the GpuTaskMetrics analog) "
     "into the per-query event log at task completion.")
 
+SANITIZER_ENABLED = conf_bool(
+    "spark.rapids.debug.sanitizer.enabled", False,
+    "Enable the runtime concurrency sanitizer (analysis/sanitizer.py): "
+    "the engine's named lock sites record a process-wide lock-"
+    "acquisition-order graph, report cycles (potential ABBA deadlocks) "
+    "the first time both orders are merely observed, flag locks held "
+    "past the holdWarnMs threshold (blocking work inside a critical "
+    "section — the runtime twin of tpulint TPU-L001), and flag "
+    "Condition waits made while other locks are held. Findings rank in "
+    "sanitizer.report() and emit sanitizerFinding trace instants via "
+    "sanitizer.dump(). Debug-only: enabled runs capture a stack per "
+    "acquire; disabled, every lock operation costs one global read "
+    "(gated <2% by tools/sanitizer_smoke.py).")
+
+SANITIZER_HOLD_WARN_MS = conf_float(
+    "spark.rapids.debug.sanitizer.holdWarnMs", 50.0,
+    "Hold-duration threshold (milliseconds) above which the sanitizer "
+    "reports a held-lock-blocking finding with the acquire-site stack.")
+
+SANITIZER_STACK_DEPTH = conf_int(
+    "spark.rapids.debug.sanitizer.stackDepth", 8,
+    "Innermost stack frames captured per lock acquisition while the "
+    "sanitizer is enabled (deeper = better reports, slower acquires).")
+
+PLAN_VERIFY_ENABLED = conf_bool(
+    "spark.rapids.debug.planVerify.enabled", False,
+    "Run the plan-invariant verifier (analysis/plan_verify.py) on every "
+    "converted exec tree: schema consistency across exec boundaries, "
+    "fusion-group legality, and pipeline-boundary sanity. Violations "
+    "raise PlanVerifyError before execution starts. Always exercised in "
+    "CI against the golden dispatch budgets regardless of this conf.")
+
 OBS_ENABLED = conf_bool(
     "spark.rapids.obs.enabled", True,
     "Publish live metrics into the process-wide observability registry "

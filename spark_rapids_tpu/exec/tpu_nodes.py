@@ -804,6 +804,13 @@ class CoalesceBatchesExec(TpuExec):
         self.target_bytes = target_bytes or conf.get(C.TARGET_BATCH_SIZE)
         self.require_single = require_single
 
+    @property
+    def schema(self):
+        # concat never changes columns: like ExchangeExec, report the
+        # child's schema even when self.plan is a downstream node (the
+        # collected-complete-agg wrapper hands us the aggregate's plan)
+        return self.children[0].schema
+
     def execute_partition(self, ctx, pidx):
         concat_t = self.metrics.metric(M.CONCAT_TIME)
         n_in = self.metrics.metric(M.NUM_INPUT_BATCHES)
@@ -3580,6 +3587,7 @@ class RangeExchangeExec(ExchangeExec):
                 for batch in part:
                     planes, live = keyfn(batch)
                     per_batch.append((batch, planes))
+                    # tpulint: disable=TPU-L004 range bounds need the sample values on host before the slicing kernels can be BUILT — there is no later point to consume a deferred fetch
                     host = jax.device_get(list(planes) + [live])
                     lv = host[-1]
                     idx = np.flatnonzero(lv)

@@ -27,8 +27,9 @@ class TrafficController:
                  stall_warn_s: Optional[float] = None):
         self.limit = max_in_flight_bytes
         self.stall_warn_s = stall_warn_s
+        from spark_rapids_tpu.analysis import sanitizer as _san
         self._inflight = 0
-        self._cv = threading.Condition()
+        self._cv = _san.condition("asyncWrite.controller")
 
     def _warn_stalled(self, waited_s: float, nbytes: int,
                       inflight: int) -> None:
@@ -118,6 +119,7 @@ class ThrottlingExecutor:
     def __init__(self, max_threads: int, controller: TrafficController,
                  pool=None):
         self._owned = pool is None
+        # tpulint: disable=TPU-L002 standalone-writer fallback only: the engine always passes pool= (the shared host pool); an owned executor here serves direct ThrottlingExecutor users (tests, tools) with shutdown() semantics the shared pool must not have
         self.pool = ThreadPoolExecutor(max_workers=max_threads) \
             if pool is None else pool
         self.controller = controller

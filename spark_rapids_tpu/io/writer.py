@@ -203,9 +203,8 @@ class DataFrameWriter:
             if nparts == 1:
                 run_partition(0)
             else:
-                from concurrent.futures import ThreadPoolExecutor
-                with ThreadPoolExecutor(max_workers=min(nparts, 16)) as tp:
-                    list(tp.map(run_partition, range(nparts)))
+                from spark_rapids_tpu.runtime.host_pool import run_task_wave
+                run_task_wave(run_partition, range(nparts))
             for f in futures:
                 f.result()
             with open(os.path.join(path, "_SUCCESS"), "w"):

@@ -719,8 +719,8 @@ def tag_expression(e: E.Expression, conf, reasons: List[str], where: str) -> Non
             r = rule.input_sig.reason_not_supported(cdt)
             if r:
                 reasons.append(f"{where}: {rule.name} input {r}")
-        except Exception:
-            pass
+        except Exception:  # noqa: BLE001 - unresolvable child type: the
+            pass           # recursive tag below records its own reason
     if rule.extra is not None:
         r = rule.extra(e)
         if r:
@@ -1285,6 +1285,12 @@ def convert_plan(plan: P.PlanNode, conf):
     # device compute of batch i (spark.rapids.sql.pipeline.enabled)
     from spark_rapids_tpu.runtime.pipeline import insert_pipelines
     exec_root = insert_pipelines(exec_root, conf)
+    # plan-invariant verifier (spark.rapids.debug.planVerify.enabled):
+    # schema/fusion/pipeline legality of the FINAL tree, after every
+    # rewrite pass — a malformed plan must fail here, not on the device
+    if conf.get(C.PLAN_VERIFY_ENABLED):
+        from spark_rapids_tpu.analysis.plan_verify import verify_plan
+        verify_plan(exec_root)
     lore_dir = conf.get(C.LORE_DUMP_DIR)
     if lore_dir:
         from spark_rapids_tpu.runtime.lore import LoreDumper

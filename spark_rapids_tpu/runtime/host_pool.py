@@ -27,10 +27,47 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, List, Optional
 
+from spark_rapids_tpu.analysis import sanitizer as _san
+
 _PREFIX0 = "rapids-host-pool-t0"
 _PREFIX1 = "rapids-host-pool-t1"
-_LOCK = threading.Lock()
+_PREFIX_TASK = "rapids-task"
+_LOCK = _san.lock("hostPool.registry")
 _POOL: "Optional[HostTaskPool]" = None
+
+
+def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
+    """Run one action's top-level partition tasks (the Spark task-set
+    role) and return [fn(item)] in input order.
+
+    This is the ONE sanctioned place the engine fans partition tasks out
+    to threads (TPU-L002 funnels every other call site here or to the
+    shared pool). The wave owns a throwaway executor ON PURPOSE, unlike
+    everything else in this module: task threads block for whole-task
+    lifetimes (semaphore waits, nested actions — broadcast
+    materialization collects from inside a task), so waves sharing one
+    bounded executor could deadlock nested waves behind blocked outer
+    tasks. Wave threads carry the `rapids-task` prefix, which `_depth()`
+    maps to 0 — their submissions land on tier 0 exactly like the old
+    per-call pools' did."""
+    items = list(items)
+    if len(items) <= 1:
+        return [fn(i) for i in items]
+    with ThreadPoolExecutor(max_workers=min(len(items), max_concurrency),
+                            thread_name_prefix=_PREFIX_TASK) as tp:
+        return list(tp.map(fn, items))
+
+
+def spawn_service_thread(target, name: str, daemon: bool = True
+                         ) -> threading.Thread:
+    """Sanctioned creation point for long-lived or abandonable SERVICE
+    threads (the obs HTTP server's serve_forever, the healthz device
+    probe). These must never ride a bounded pool worker: serve_forever
+    never returns, and a wedged device probe must be abandonable without
+    poisoning a pool slot. Returns the started thread."""
+    t = threading.Thread(target=target, name=name, daemon=daemon)
+    t.start()
+    return t
 
 
 class HostTaskPool:

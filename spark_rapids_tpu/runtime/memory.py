@@ -32,6 +32,7 @@ import numpy as np
 
 import jax
 
+from spark_rapids_tpu.analysis import sanitizer as _san
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 
@@ -63,7 +64,7 @@ class SpillableHandle:
         self.fw = framework
         self.handle_id = uuid.uuid4().hex
         self.size = batch.device_memory_size()
-        self._lock = threading.Lock()
+        self._lock = _san.lock("memory.handle")
         self._tier = DEVICE
         self._device: Optional[ColumnarBatch] = batch
         self._host = None  # leaves (host numpy)
@@ -101,6 +102,7 @@ class SpillableHandle:
         """host -> disk. Returns bytes freed from the host tier."""
         import time as _time
         t0 = _time.perf_counter_ns()
+        # tpulint: disable=TPU-L001 np.save must be atomic with the HOST->DISK tier transition; the lock is per-handle and a handle spills at most once per tier, so no hot path ever waits on this write
         with self._lock:
             if self._tier != HOST or self._closed or self._pinned:
                 return 0
@@ -123,6 +125,7 @@ class SpillableHandle:
         themselves rematerializing — as victims; holding the lock across
         that is an ABBA deadlock). The handle is pinned for the duration so
         concurrent spills skip it."""
+        # tpulint: disable=TPU-L001 np.load/unlink must be atomic with the DISK->HOST tier transition (a concurrent spill observing DISK mid-load would double-free the paths); per-handle lock, rematerialization path only
         with self._lock:
             if self._closed:
                 raise ValueError("handle closed")
@@ -161,14 +164,17 @@ class SpillableHandle:
             if self._closed:
                 return
             self._closed = True
-            if self._disk_paths:
-                for p in self._disk_paths:
-                    try:
-                        os.unlink(p)
-                    except OSError:
-                        pass
+            paths, self._disk_paths = self._disk_paths, None
             self._device = None
             self._host = None
+        # disk cleanup OUTSIDE the handle lock (TPU-L001): once _closed
+        # is set no transition can race, and unlink latency must not
+        # block spill-victim scans probing this handle
+        for p in paths or ():
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
         self.fw.unregister(self)
 
 
@@ -180,7 +186,7 @@ class SpillFramework:
         self.device_budget = device_budget_bytes
         self.host_budget = host_budget_bytes
         self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="srt_spill_")
-        self._lock = threading.Lock()
+        self._lock = _san.lock("memory.framework")
         self._handles: Dict[str, SpillableHandle] = {}
         self.metrics = {"spill_to_host_bytes": 0, "spill_to_disk_bytes": 0,
                         "spill_count": 0, "oom_drains": 0}
@@ -363,7 +369,7 @@ class SpillableColumnarBatch:
 
 
 _GLOBAL: Optional[SpillFramework] = None
-_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_LOCK = _san.lock("memory.global")
 
 
 def get_spill_framework(conf=None) -> SpillFramework:
@@ -372,16 +378,20 @@ def get_spill_framework(conf=None) -> SpillFramework:
     silently ignored."""
     global _GLOBAL
     with _GLOBAL_LOCK:
-        if conf is None and _GLOBAL is not None:
-            return _GLOBAL
-        if conf is None:
-            from spark_rapids_tpu.config import conf as _active
-            conf = _active()
-        budget = _device_budget_from(conf)
+        existing = _GLOBAL
+    if conf is None and existing is not None:
+        return existing
+    if conf is None:
+        from spark_rapids_tpu.config import conf as _active
+        conf = _active()
+    budget = _device_budget_from(conf)
+    # directory creation OUTSIDE the global lock (TPU-L001): the spill
+    # dir is only touched by disk spills, long after this returns
+    sd = conf.get(C.SPILL_DIR)
+    if sd:
+        os.makedirs(sd, exist_ok=True)
+    with _GLOBAL_LOCK:
         if _GLOBAL is None:
-            sd = conf.get(C.SPILL_DIR)
-            if sd:
-                os.makedirs(sd, exist_ok=True)
             _GLOBAL = SpillFramework(
                 budget,
                 conf.get(C.HOST_SPILL_LIMIT),

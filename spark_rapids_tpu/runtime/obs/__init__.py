@@ -34,8 +34,10 @@ from spark_rapids_tpu.runtime.obs.history import (  # noqa: F401 (re-export)
 )
 from spark_rapids_tpu.runtime.obs.registry import MetricsRegistry
 
+from spark_rapids_tpu.analysis import sanitizer as _san  # noqa: E402
+
 _STATE: "Optional[ObsState]" = None
-_STATE_LOCK = threading.Lock()
+_STATE_LOCK = _san.lock("obs.state")
 
 #: TaskContext accumulator -> process counter (folded once per task)
 _TASK_COUNTERS = {

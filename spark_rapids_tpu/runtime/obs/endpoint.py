@@ -81,8 +81,9 @@ class DeviceProbe:
 
                 cur = (done, holder, t0)
                 self._current = cur
-                threading.Thread(target=run, name="rapids-obs-probe",
-                                 daemon=True).start()
+                from spark_rapids_tpu.runtime.host_pool import \
+                    spawn_service_thread
+                spawn_service_thread(run, name="rapids-obs-probe")
         done, holder, t0 = cur
         remaining = self.timeout_s - (time.perf_counter() - t0)
         if remaining <= 0 or not done.wait(remaining):
@@ -143,9 +144,9 @@ class ObsHttpServer:
         return self._server.server_address[1]
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        name="rapids-obs-http", daemon=True)
-        self._thread.start()
+        from spark_rapids_tpu.runtime.host_pool import spawn_service_thread
+        self._thread = spawn_service_thread(self._server.serve_forever,
+                                            name="rapids-obs-http")
 
     def stop(self) -> None:
         self._server.shutdown()

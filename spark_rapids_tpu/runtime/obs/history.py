@@ -22,7 +22,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
 from typing import Dict, List, Optional
 
 HISTORY_FILE = "query_history.jsonl"
@@ -65,20 +64,28 @@ def conf_delta(conf) -> Dict[str, object]:
 
 class QueryHistoryStore:
     """Append-only JSONL store (one line per query record). Appends are
-    single write() calls under a process lock — concurrent sessions in
-    one process interleave whole lines, never partial ones."""
+    single O_APPEND write() syscalls: the kernel serializes the offset,
+    so concurrent sessions — in this process OR another (tools/
+    nds_probe.py appends from its own process, which the old in-process
+    lock never covered) — interleave whole lines, never partial ones,
+    and no lock is held across the file I/O (TPU-L001)."""
 
     def __init__(self, history_dir: str):
         self.dir = history_dir
         os.makedirs(history_dir, exist_ok=True)
         self.path = os.path.join(history_dir, HISTORY_FILE)
-        self._lock = threading.Lock()
 
     def append(self, record: dict) -> None:
-        line = json.dumps(record, default=str) + "\n"
-        with self._lock:
-            with open(self.path, "a") as f:
-                f.write(line)
+        data = (json.dumps(record, default=str) + "\n").encode()
+        fd = os.open(self.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+        try:
+            # os.write may write short (near-full disk): loop so a record
+            # is never torn mid-line
+            while data:
+                data = data[os.write(fd, data):]
+        finally:
+            os.close(fd)
 
     def read_all(self) -> List[dict]:
         if not os.path.exists(self.path):

@@ -108,8 +108,9 @@ class PipelinedIterator:
         self._label = label
         self._stall = stall_metric
         self._prod = producer_metric
+        from spark_rapids_tpu.analysis import sanitizer as _san
         self._pool = get_host_pool(conf)
-        self._lock = threading.Lock()
+        self._lock = _san.lock("pipeline.iterator")
         self._cancel = False
         self._refill_running = False
         self._finished = False      # terminal item produced (DONE/error)

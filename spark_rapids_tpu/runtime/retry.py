@@ -21,6 +21,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterator, List, Optional
 
+from spark_rapids_tpu.analysis import sanitizer as _san
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.ops import kernels as K
 
@@ -50,7 +51,7 @@ class OomInjector:
     thread-local counters configured on the driver thread would never
     fire where the retries actually happen."""
 
-    _lock = threading.Lock()
+    _lock = _san.lock("retry.injector")
     _num = 0
     _skip = 0
     _split = False

@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from spark_rapids_tpu.analysis import sanitizer as _san
 from spark_rapids_tpu.runtime import trace
 
 
@@ -27,7 +28,7 @@ class PrioritySemaphore:
     def __init__(self, permits: int):
         self._permits = permits
         self._available = permits
-        self._lock = threading.Lock()
+        self._lock = _san.lock("semaphore.priority")
         self._waiters = []  # heap of [-priority, seq, n, event]
         self._seq = 0
 
@@ -83,7 +84,7 @@ class TpuSemaphore:
         #: task_id -> perf_counter_ns at acquisition (truthy while held;
         #: the timestamp feeds the semaphoreHoldTime task accumulator)
         self._held: Dict[int, int] = {}
-        self._lock = threading.Lock()
+        self._lock = _san.lock("semaphore.held")
 
     def acquire_if_necessary(self, task_ctx) -> None:
         tid = task_ctx.task_id
@@ -129,7 +130,7 @@ class TpuSemaphore:
 
 
 _global: Optional[TpuSemaphore] = None
-_glock = threading.Lock()
+_glock = _san.lock("semaphore.global")
 
 
 def get_semaphore(conf=None) -> TpuSemaphore:

@@ -9,12 +9,13 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+from spark_rapids_tpu.analysis import sanitizer as _san
 from spark_rapids_tpu.runtime.metrics import GpuMetric
 
 
 class TaskContext:
     _counter = 0
-    _counter_lock = threading.Lock()
+    _counter_lock = _san.lock("task.counter")
     _local = threading.local()
 
     def __init__(self, partition_id: int = 0, stage_id: int = 0):
@@ -43,8 +44,13 @@ class TaskContext:
         for fn in reversed(self._completion):
             try:
                 fn()
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 - remaining callbacks
+                # (semaphore release!) must still run; but a silently
+                # swallowed failure hid real bugs — surface it
+                import logging
+                logging.getLogger("spark_rapids_tpu").warning(
+                    "task %d completion callback failed", self.task_id,
+                    exc_info=True)
         self._completion.clear()
         # roll the task accumulators into the active query trace's event
         # log AFTER the completion callbacks (the semaphore release hook

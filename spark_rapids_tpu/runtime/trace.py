@@ -51,8 +51,10 @@ TASK_METRIC_NAMES = (
     "maxDeviceBytesHeld",
 )
 
+from spark_rapids_tpu.analysis import sanitizer as _san  # noqa: E402
+
 _TRACER: "Optional[Tracer]" = None
-_STATE_LOCK = threading.Lock()
+_STATE_LOCK = _san.lock("trace.state")
 _QUERY_SEQ = 0
 
 
@@ -85,7 +87,7 @@ class Tracer:
         self.pid = os.getpid()
         self._t0 = time.perf_counter_ns()
         self._wall0 = time.time()
-        self._lock = threading.Lock()
+        self._lock = _san.lock("trace.buffer")
         self._events: List[dict] = []
         self._task_records: List[dict] = []
         self._named_tids: set = set()

@@ -40,8 +40,9 @@ class ShuffleStore:
     def __init__(self, n_partitions: int, host_budget_bytes: int,
                  spill_dir: Optional[str] = None):
         self.n_partitions = n_partitions
+        from spark_rapids_tpu.analysis import sanitizer as _san
         self.host_budget = host_budget_bytes
-        self._lock = threading.Lock()
+        self._lock = _san.lock("shuffle.store")
         #: partition -> ordered blob list; bytes = resident, _DiskSeg = spilled
         self._parts: List[List[object]] = [[] for _ in range(n_partitions)]
         self._resident = 0
@@ -50,6 +51,9 @@ class ShuffleStore:
         self._dir = spill_dir
         self._owns_dir = spill_dir is None
         self._closed = False
+        #: partitions with a spill write in flight (guards a victim from
+        #: concurrent spills while the file write runs outside the lock)
+        self._spilling: set = set()
 
     def _spill_path(self, p: int) -> str:
         if self._dir is None:
@@ -67,27 +71,58 @@ class ShuffleStore:
             self._parts[partition].append(blob)
             self._resident += len(blob)
             self.bytes_written += len(blob)
-            self._enforce_budget()
+        self._enforce_budget()
 
     def _enforce_budget(self) -> None:
         # flush the partitions holding the most resident bytes first
-        # (largest-victim-first, the spill framework's discipline)
-        while self._resident > self.host_budget:
-            sizes = [(sum(len(b) for b in part if isinstance(b, bytes)), p)
-                     for p, part in enumerate(self._parts)]
-            size, victim = max(sizes)
-            if size == 0:
-                break
-            path = self._spill_path(victim)
-            with open(path, "ab") as f:
-                part = self._parts[victim]
-                for i, b in enumerate(part):
-                    if isinstance(b, bytes):
-                        off = f.tell()
-                        f.write(b)
-                        part[i] = _DiskSeg(path, off, len(b))
-                        self._resident -= len(b)
-                        self.bytes_spilled += len(b)
+        # (largest-victim-first, the spill framework's discipline). The
+        # spill-file write runs OUTSIDE self._lock (the TPU-L001 bug
+        # class: disk latency was blocking every concurrent writer's
+        # add() bookkeeping): victim selection and the bookkeeping swap
+        # take the lock, `_spilling` keeps two spills off one partition
+        # file, and blob indexes stay stable because partition lists
+        # only ever append (always under the lock).
+        while True:
+            with self._lock:
+                if self._closed or self._resident <= self.host_budget:
+                    return
+                sizes = [(sum(len(b) for b in part if isinstance(b, bytes)),
+                          p)
+                         for p, part in enumerate(self._parts)
+                         if p not in self._spilling]
+                if not sizes:
+                    return  # every candidate is already being spilled
+                size, victim = max(sizes)
+                if size == 0:
+                    return
+                self._spilling.add(victim)
+                snapshot = list(self._parts[victim])
+                path = self._spill_path(victim)
+            try:
+                segs = []
+                try:
+                    with open(path, "ab") as f:
+                        for i, b in enumerate(snapshot):
+                            if isinstance(b, bytes):
+                                off = f.tell()
+                                f.write(b)
+                                segs.append((i, off, len(b)))
+                except OSError:
+                    if self._closed:  # close() raced the spill: the dir
+                        return        # is gone and so is the data's owner
+                    raise
+                with self._lock:
+                    if self._closed:
+                        return
+                    part = self._parts[victim]
+                    for i, off, ln in segs:
+                        if isinstance(part[i], bytes):
+                            part[i] = _DiskSeg(path, off, ln)
+                            self._resident -= ln
+                            self.bytes_spilled += ln
+            finally:
+                with self._lock:
+                    self._spilling.discard(victim)
 
     def totals(self) -> dict:
         """Byte totals for the exchange's metric export (folded into the
@@ -112,9 +147,13 @@ class ShuffleStore:
             self._closed = True
             self._parts = [[] for _ in range(self.n_partitions)]
             self._resident = 0
-            if self._owns_dir and self._dir and os.path.isdir(self._dir):
-                shutil.rmtree(self._dir, ignore_errors=True)
-                self._dir = None
+            rm_dir, self._dir = (self._dir if self._owns_dir else None), \
+                (None if self._owns_dir else self._dir)
+        # directory removal OUTSIDE the lock (TPU-L001): _closed already
+        # fences every other method, and rmtree of a large spill dir is
+        # unbounded I/O
+        if rm_dir and os.path.isdir(rm_dir):
+            shutil.rmtree(rm_dir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------

@@ -3,7 +3,6 @@ bootstrap + the collect path). Owns config, converts plans through the
 overrides engine, and runs root partitions as concurrent tasks."""
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import pyarrow as pa
@@ -168,11 +167,13 @@ class TpuSession:
         """Session preamble shared by every action (collect, write):
         activate this session's conf, sync the spill budgets, arm OOM
         injection, convert the plan. Returns (exec_root, meta)."""
+        from spark_rapids_tpu.analysis import sanitizer
         from spark_rapids_tpu.config import set_session_conf
         from spark_rapids_tpu.plan.overrides import convert_plan
         from spark_rapids_tpu.runtime.memory import get_spill_framework
         from spark_rapids_tpu.runtime.retry import OomInjector
         set_session_conf(self.conf)
+        sanitizer.maybe_install(self.conf)
         OomInjector.from_conf(self.conf)
         get_spill_framework(self.conf)  # sync budgets to this session
         exec_root, meta = convert_plan(plan, self.conf)
@@ -328,10 +329,10 @@ class TpuSession:
 
         if nparts == 1:
             return run(0)
+        from spark_rapids_tpu.runtime.host_pool import run_task_wave
         out = []
-        with ThreadPoolExecutor(max_workers=min(nparts, 16)) as pool:
-            for res in pool.map(run, range(nparts)):
-                out.extend(res)
+        for res in run_task_wave(run, range(nparts)):
+            out.extend(res)
         return out
 
     def _collect_inner(self, plan: P.PlanNode) -> pa.Table:

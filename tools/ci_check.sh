@@ -1,9 +1,9 @@
 #!/usr/bin/env bash
-# CI gate: docs drift, trace-overhead smoke, obs smoke, pipeline smoke,
-# tier-1 tests.
+# CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
+# obs smoke, pipeline smoke, tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
-#   tools/ci_check.sh --fast     # skip tier-1 (docs drift + smokes)
+#   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
 #
 # Mirrors the reference's build checks: generated docs must match the
 # committed ones (SupportedOpsDocs/RapidsConf.help regeneration), the
@@ -15,11 +15,16 @@ cd "$(dirname "$0")/.."
 fail=0
 step() { echo; echo "=== $1 ==="; }
 
+step "tpulint --strict (engine-invariant static analysis, <10s budget)"
+if ! python tools/tpulint.py --strict; then
+    fail=1
+fi
+
 step "docs drift (tools/gen_docs.py output == committed docs)"
 if ! python tools/gen_docs.py >/dev/null; then
     echo "FAIL: gen_docs.py errored"; fail=1
 elif ! git diff --exit-code -- docs/configs.md docs/supported_ops.md \
-        tools/generated_files; then
+        docs/metrics.md tools/generated_files; then
     echo "FAIL: regenerate docs with 'python tools/gen_docs.py' and commit"
     fail=1
 else
@@ -28,6 +33,11 @@ fi
 
 step "trace-overhead smoke (disabled <2% of no-trace baseline; enabled run emits Perfetto-loadable JSON)"
 if ! python tools/trace_overhead.py; then
+    fail=1
+fi
+
+step "sanitizer smoke (disabled lock proxies <2%; seeded inversion + held-lock caught; clean engine silent)"
+if ! python tools/sanitizer_smoke.py; then
     fail=1
 fi
 

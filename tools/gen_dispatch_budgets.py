@@ -1,0 +1,96 @@
+"""Golden dispatch-budget generator for the NDS probe queries.
+
+Writes tests/golden_plans/dispatch_budgets.json: for every translated
+NDS query (tools/nds_probe.py QUERIES), the static per-batch device-
+dispatch budget of its CONVERTED plan as computed by
+``analysis.plan_verify.dispatch_budget`` — narrow dispatches per batch,
+fusion groups, pipeline boundaries, exec census. The tables are the
+same tiny SF / seed the tier-1 NDS regression uses, so the committed
+budgets pin exactly the plans CI sees.
+
+tests/test_analysis.py re-derives each budget and diffs it against this
+file (``compare_budget``): a stage-fusion or pipeline-insertion
+regression then fails loudly with the changed dimension named, instead
+of showing up as silent perf loss in a later benchmark round. The same
+test also runs ``verify_plan`` on every probe plan, so the invariant
+checks gate CI unconditionally (the debug conf only adds per-query
+verification in live sessions).
+
+Run after any INTENDED plan-shape change:
+
+    python tools/gen_dispatch_budgets.py
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# mirror tests/conftest.py EXACTLY: the budgets pin the plans the tier-1
+# suite converts, and plan shape depends on the device count (the
+# single-device complete-agg path in overrides.py vs partial+exchange)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_enable_fast_math" not in _flags:
+    _flags = (_flags + " --xla_cpu_enable_fast_math=false").strip()
+os.environ["XLA_FLAGS"] = _flags
+
+#: keep in lockstep with tests/test_nds_probe.py's fixture — the golden
+#: budgets must pin the exact plans the tier-1 suite converts
+SF = 0.002
+SEED = 7
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                   "tests", "golden_plans", "dispatch_budgets.json")
+
+
+def _load_nds():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "nds_probe.py")
+    spec = importlib.util.spec_from_file_location("nds_probe", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def build_budgets():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.analysis.plan_verify import (dispatch_budget,
+                                                       verify_plan)
+    from spark_rapids_tpu.sql.session import TpuSession
+
+    nds = _load_nds()
+    sess = TpuSession()
+    tables = nds.gen_tables(SF, seed=SEED)
+    d = {name: sess.create_dataframe(t).cache()
+         for name, t in tables.items()}
+    budgets = {}
+    for qn in sorted(nds.QUERIES):
+        df = nds.QUERIES[qn](sess, d)
+        exec_root, _meta = sess.prepare_execution(df.plan)
+        verify_plan(exec_root)  # a golden pin of an ILLEGAL plan is void
+        budgets[qn] = dispatch_budget(exec_root)
+    return budgets
+
+
+def main() -> int:
+    budgets = build_budgets()
+    doc = {"_generator": "tools/gen_dispatch_budgets.py",
+           "_sf": SF, "_seed": SEED, "budgets": budgets}
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    total = sum(b["narrow_dispatches_per_batch"] for b in budgets.values())
+    print(f"wrote {os.path.relpath(OUT)}: {len(budgets)} queries, "
+          f"{total} narrow dispatches/batch total")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
