@@ -35,6 +35,12 @@ TPU-L007  every string-literal metric name at a ``.metric("...")`` /
           roster in ``runtime/trace.py``, and present in the generated
           ``docs/metrics.md`` — ad-hoc names silently vanish from the
           rollups and the docs.
+TPU-L008  every string-literal fault-site name at a
+          ``faults.site("...")`` / ``faults.site_bytes("...")`` call
+          must be registered in the ``SITES`` roster of
+          ``runtime/faults.py`` — an unregistered site can never fire
+          from a conf spec, silently shrinking chaos coverage (the
+          fault-site twin of TPU-L007).
 
 Suppression
 -----------
@@ -68,7 +74,13 @@ RULES: Dict[str, str] = {
                 "justification comment",
     "TPU-L007": "metric name not registered in runtime/metrics.py (or "
                 "absent from docs/metrics.md)",
+    "TPU-L008": "fault-site name not registered in the runtime/faults.py "
+                "SITES roster",
 }
+
+#: receiver names under which a .site()/.site_bytes() call is the fault
+#: injector (the engine imports it as `faults`, `_faults`, or `FLT`)
+_FAULTS_BASES = {"faults", "_faults", "flt"}
 
 _DISABLE_RE = re.compile(
     r"#\s*tpulint:\s*disable=(TPU-L\d{3})\b[ \t]*(.*)")
@@ -164,11 +176,12 @@ def _is_span_call(expr: ast.AST) -> bool:
 
 class _FileLinter(ast.NodeVisitor):
     def __init__(self, path: str, source: str, known_metrics: Set[str],
-                 relpath: str):
+                 relpath: str, known_sites: Optional[Set[str]] = None):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
         self.known_metrics = known_metrics
+        self.known_sites = known_sites
         self.violations: List[Violation] = []
         # stack of (lock_keys, with_lineno) for held-lock regions
         self._lock_stack: List[Tuple[Set[str], int]] = []
@@ -314,6 +327,7 @@ class _FileLinter(ast.NodeVisitor):
         self._check_timer_bypass(node)
         self._check_host_sync(node)
         self._check_metric_name(node)
+        self._check_fault_site(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -428,6 +442,28 @@ class _FileLinter(ast.NodeVisitor):
                        f"runtime/trace.py) — register it so rollups and "
                        f"docs/metrics.md stay complete")
 
+    # -- TPU-L008 ----------------------------------------------------------
+
+    def _check_fault_site(self, node: ast.Call) -> None:
+        if self.known_sites is None:
+            return
+        term = _terminal(node.func)
+        if term not in ("site", "site_bytes"):
+            return
+        base = _base_name(node.func)
+        if base is None or base.lower() not in _FAULTS_BASES:
+            return
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            return
+        name = node.args[0].value
+        if name not in self.known_sites:
+            self._emit("TPU-L008", node,
+                       f"fault site {name!r} is not registered in the "
+                       f"runtime/faults.py SITES roster — register it so "
+                       f"conf specs, /healthz counters, and chaos "
+                       f"coverage know it exists")
+
 
 # ---------------------------------------------------------------------------
 # Registry extraction (AST-only: no engine import)
@@ -462,6 +498,27 @@ def known_metric_names(pkg_root: str) -> Set[str]:
     return names
 
 
+def known_fault_sites(pkg_root: str) -> Set[str]:
+    """Registered fault-site names: the keys of the SITES dict literal in
+    runtime/faults.py (AST-only, like known_metric_names)."""
+    sites: Set[str] = set()
+    fpath = os.path.join(pkg_root, "runtime", "faults.py")
+    if not os.path.exists(fpath):
+        return sites
+    tree = ast.parse(open(fpath).read(), fpath)
+    for stmt in tree.body:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else \
+            [stmt.target] if isinstance(stmt, ast.AnnAssign) else []
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "SITES" \
+                    and isinstance(getattr(stmt, "value", None), ast.Dict):
+                for k in stmt.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        sites.add(k.value)
+    return sites
+
+
 def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
     """Metric names documented in docs/metrics.md (None when the file is
     missing — the doc-presence half of TPU-L007 then reports once)."""
@@ -479,10 +536,12 @@ def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
 # ---------------------------------------------------------------------------
 
 def lint_source(source: str, path: str, known_metrics: Set[str],
-                relpath: Optional[str] = None) -> List[Violation]:
+                relpath: Optional[str] = None,
+                known_sites: Optional[Set[str]] = None) -> List[Violation]:
     tree = ast.parse(source, path)
     linter = _FileLinter(path, source, known_metrics,
-                         relpath if relpath is not None else path)
+                         relpath if relpath is not None else path,
+                         known_sites=known_sites)
     linter.visit(tree)
     return linter.violations
 
@@ -493,6 +552,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
     docs/metrics.md (the docs half of TPU-L007)."""
     pkg_root = os.path.join(repo_root, "spark_rapids_tpu")
     known = known_metric_names(pkg_root)
+    sites = known_fault_sites(pkg_root)
     violations: List[Violation] = []
     n_files = 0
     for dirpath, dirnames, filenames in os.walk(pkg_root):
@@ -504,7 +564,8 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
             n_files += 1
             rel = os.path.relpath(path, pkg_root)
             violations.extend(lint_source(
-                open(path).read(), path, known, relpath=rel))
+                open(path).read(), path, known, relpath=rel,
+                known_sites=sites))
     documented = docs_metric_names(repo_root)
     mpath = os.path.join(pkg_root, "runtime", "metrics.py")
     if documented is None:

@@ -262,6 +262,100 @@ RETRY_OOM_INJECT = conf_str(
     "Fault-injection grammar 'count[,skip]' forcing retry-OOMs for tests "
     "(reference RapidsConf.scala:1627,2753).", internal=True)
 
+FAULTS_SPEC = conf_str(
+    "spark.rapids.debug.faults", "",
+    "General fault-injection schedule (runtime/faults.py): "
+    "'site:kind[:count[,skip]]' entries joined by ';', where site is a "
+    "registered fault site (scan.decode, shuffle.read, shuffle.write, "
+    "spill.disk, device.dispatch, pipeline.producer, exchange.fetch, "
+    "retry.oom — tpulint TPU-L008 keeps the roster honest) and kind is "
+    "ioerror, corrupt (data sites only), delay, wedge, or oom. Every "
+    "fired fault emits a faultInjected trace instant and counts into "
+    "rapids_faults_injected_total and /healthz. Empty disables injection "
+    "(one global read per site pass — gated <2% by tools/chaos_smoke.py). "
+    "Generalizes injectRetryOOM, which remains the retry.oom facade.")
+
+FAULTS_DELAY_MS = conf_float(
+    "spark.rapids.debug.faults.delayMs", 50.0,
+    "Sleep injected by a 'delay'-kind fault, in milliseconds.")
+
+FAULTS_WEDGE_S = conf_float(
+    "spark.rapids.debug.faults.wedgeSeconds", 0.25,
+    "Sleep injected by a 'wedge'-kind fault, in seconds. To exercise "
+    "the watchdog detection path end-to-end, set this ABOVE "
+    "spark.rapids.watchdog.dispatchTimeoutSeconds (tools/chaos_smoke.py "
+    "does) — a wedge shorter than the timeout completes unnoticed.")
+
+WATCHDOG_ENABLED = conf_bool(
+    "spark.rapids.watchdog.enabled", False,
+    "Run the device dispatch watchdog (runtime/watchdog.py): a heartbeat "
+    "service thread detects fused dispatches exceeding "
+    "dispatchTimeoutSeconds, reports each wedge once (log + "
+    "watchdogDispatchTimeout trace instant + obs counter) and records a "
+    "circuit-breaker failure so later queries degrade to CPU instead of "
+    "joining the wedge (a wedged libtpu holds the GIL — the call itself "
+    "cannot be interrupted). Disabled, dispatches run unwrapped at zero "
+    "added cost.")
+
+WATCHDOG_DISPATCH_TIMEOUT_S = conf_float(
+    "spark.rapids.watchdog.dispatchTimeoutSeconds", 60.0,
+    "Deadline for one fused device dispatch before the watchdog reports "
+    "it wedged and records a breaker failure.")
+
+WATCHDOG_BREAKER_THRESHOLD = conf_int(
+    "spark.rapids.watchdog.breakerFailureThreshold", 3,
+    "Consecutive device failures (failed/degraded queries, dispatch "
+    "timeouts) that open the device circuit breaker. While open — and "
+    "CPU fallback is enabled — queries skip the device entirely and run "
+    "degraded on the CPU backend.")
+
+WATCHDOG_BREAKER_BACKOFF_S = conf_float(
+    "spark.rapids.watchdog.breakerBaseBackoffSeconds", 1.0,
+    "Initial open-state backoff before the breaker half-opens and lets "
+    "one probe query try the device again; doubles on each failed probe "
+    "up to breakerMaxBackoffSeconds, resets on success.")
+
+WATCHDOG_BREAKER_MAX_BACKOFF_S = conf_float(
+    "spark.rapids.watchdog.breakerMaxBackoffSeconds", 60.0,
+    "Cap on the breaker's exponential open-state backoff.")
+
+FALLBACK_CPU_ENABLED = conf_bool(
+    "spark.rapids.fallback.cpu.enabled", False,
+    "Graceful degradation: when a top-level query fails with an engine/"
+    "device error (exhausted OOM retries, corrupted shuffle data, a "
+    "device error, an injected fault — NOT user-semantic errors like "
+    "ANSI overflow, which surface unchanged), re-execute it on the CPU "
+    "backend and record it as status=degraded (with the triggering "
+    "error class) in query history, /metrics and /healthz instead of "
+    "failed. Also consults the device circuit breaker: while the "
+    "breaker is open, queries skip the device entirely. Off by default: "
+    "batch/test workloads want failures loud; serving deployments flip "
+    "this on (the reference's per-operator CPU fallback generalized to "
+    "the query failure domain).", commonly_used=True)
+
+RETRY_BACKOFF_BASE_MS = conf_float(
+    "spark.rapids.retry.backoffBaseMs", 10.0,
+    "Base of the bounded exponential backoff between OOM retry attempts "
+    "(after the spill-store drain): attempt n sleeps "
+    "base*2^(n-1) ms, jittered to 50-100%, capped at backoffMaxMs — so "
+    "concurrent tasks that OOMed together do not re-dispatch together "
+    "(thundering herd). Folded into the retryBlockTime accumulator. "
+    "0 disables the backoff (drain-then-immediate-retry).")
+
+RETRY_BACKOFF_MAX_MS = conf_float(
+    "spark.rapids.retry.backoffMaxMs", 500.0,
+    "Cap on the per-attempt OOM retry backoff.")
+
+SHUFFLE_VERIFY_CHECKSUMS = conf_bool(
+    "spark.rapids.shuffle.verifyChecksums", True,
+    "Verify the CRC32 wire checksum on every serialized shuffle blob at "
+    "read time (the serde header carries it; the frame body also keeps "
+    "its xxhash64). A corrupt blob triggers ONE transparent re-fetch "
+    "from the shuffle store (counted in shuffleCorruptionRetries) "
+    "before the error surfaces — a transient disk bit-flip recovers, a "
+    "persistent corruption fails the query (and degrades to CPU when "
+    "spark.rapids.fallback.cpu.enabled).")
+
 SHUFFLE_MODE = conf_str(
     "spark.rapids.shuffle.mode", "MULTITHREADED",
     "MULTITHREADED: in-process exchange by zero-copy selection-mask "

@@ -23,6 +23,9 @@ from typing import Callable, Dict, Optional, Tuple
 
 import jax
 
+from spark_rapids_tpu.runtime import faults as _faults
+from spark_rapids_tpu.runtime import watchdog as _watchdog
+
 _FUSE_CACHE: Dict[Tuple, Callable] = {}
 
 #: test/diagnostic hook called with the fuse key once per device dispatch
@@ -49,12 +52,24 @@ def fused(key: Tuple, builder: Callable[[], Callable]) -> Callable:
     if fn is None:
         fn = jax.jit(builder())
         _FUSE_CACHE[key] = fn
-    if _DISPATCH_HOOK is None:
+    # fused() is THE per-batch device-dispatch choke point, so it is
+    # also where the failure-domain hooks live: the device.dispatch
+    # fault site and the dispatch watchdog's in-flight registration.
+    # All three gates are module-global reads; with nothing armed the
+    # raw jitted function returns and a dispatch costs exactly what it
+    # did before any of this machinery existed.
+    if _DISPATCH_HOOK is None and not _faults.armed("device.dispatch") \
+            and not _watchdog.active():
         return fn
 
     def counted(*args, **kwargs):
-        notify_dispatch(key)
-        return fn(*args, **kwargs)
+        if _DISPATCH_HOOK is not None:
+            notify_dispatch(key)
+        with _watchdog.guard("device.dispatch"):
+            # inside the guard so a wedge-kind fault is exactly what the
+            # watchdog exists to detect
+            _faults.site("device.dispatch")
+            return fn(*args, **kwargs)
 
     return counted
 

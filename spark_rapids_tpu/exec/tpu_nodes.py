@@ -42,6 +42,7 @@ from spark_rapids_tpu.ops import kernels as K
 from spark_rapids_tpu.ops import radix as R
 from spark_rapids_tpu.ops import repartition as RP
 from spark_rapids_tpu.plan import nodes as P
+from spark_rapids_tpu.runtime import faults as FLT
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime import trace as TR
 from spark_rapids_tpu.runtime.semaphore import get_semaphore
@@ -122,6 +123,7 @@ class InMemoryScanExec(TpuExec):
             take = min(max_rows, n - off)
             chunk = table.slice(start + off, take)
             self._acquire(ctx)
+            FLT.site("scan.decode")
             with self.span(copy_t):
                 b = from_arrow(chunk)
             yield b
@@ -197,6 +199,7 @@ class ParquetScanExec(TpuExec):
         def load(g):
             # one ParquetFile per call: parquet-cpp FileReader is NOT
             # thread-safe and loads run on prefetch workers
+            FLT.site("scan.decode")
             with self.span(decode_t):
                 f = pq.ParquetFile(path)
                 if g < 0:
@@ -265,6 +268,7 @@ class TextScanExec(TpuExec):
         decode_t = self.metrics.metric(M.DECODE_TIME)
         copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
         out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        FLT.site("scan.decode")
         with self.span(decode_t):
             table = self.plan.read_host(self.plan.paths[pidx])
         batch_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
@@ -2962,6 +2966,7 @@ class ExchangeExec(TpuExec):
         disp, fetch, rows_m = self._partition_metrics()
         sorted_b, off_dev = fused_out
         disp.add(1)
+        FLT.site("exchange.fetch")
         offsets = np.asarray(jax.device_get(off_dev))
         fetch.add(1)
         for p, sub in enumerate(
@@ -3138,7 +3143,8 @@ class ShuffleExchangeExec(ExchangeExec):
             p, b = item
             if rows_int(b.num_rows) == 0:
                 return p, None  # empty sub-batches never ship
-            return p, serde.serialize_batch(b, codec)
+            return p, FLT.site_bytes("shuffle.write",
+                                     serde.serialize_batch(b, codec))
 
         if pipeline_conf(self.conf) > 0 and nthreads > 1:
             self._serialize_streaming(child_results, store, ser, nthreads,
@@ -3461,7 +3467,16 @@ class _LazyShuffleBlobs:
     """A reduce partition's serialized blobs; deserializes at read time.
     Host-side decode (decompression + frame parsing) runs on the shuffle
     reader pool (spark.rapids.shuffle.multiThreaded.reader.threads);
-    device upload stays ordered."""
+    device upload stays ordered.
+
+    Integrity recovery: each blob's wire CRC (and frame xxhash64) is
+    verified during deserialization (spark.rapids.shuffle.
+    verifyChecksums); a ShuffleCorruptionError triggers ONE transparent
+    re-fetch of the same blob from the store — disk-resident blobs
+    re-read their spill-file segment, so a transient read corruption
+    heals — counted in the shuffleCorruptionRetries task accumulator
+    before a second failure surfaces (and, under
+    spark.rapids.fallback.cpu.enabled, degrades the query to CPU)."""
 
     def __init__(self, store, partition: int, reader_threads: int = 1,
                  conf=None):
@@ -3469,18 +3484,48 @@ class _LazyShuffleBlobs:
         self.partition = partition
         self.reader_threads = max(1, reader_threads)
         self.conf = conf
+        self.verify = True if conf is None \
+            else bool(conf.get(C.SHUFFLE_VERIFY_CHECKSUMS))
+        self._task_ctx = None
+
+    def _read(self, index: int) -> bytes:
+        return FLT.site_bytes(
+            "shuffle.read", self.store.read_blob(self.partition, index))
+
+    def _decode(self, index: int):
+        from spark_rapids_tpu.shuffle import serde
+        try:
+            return serde.deserialize_batch(self._read(index),
+                                           verify=self.verify)
+        except serde.ShuffleCorruptionError as e:
+            # decode may run on a host-pool worker with no TaskContext
+            # bound: the retry accounts to the CONSUMING task captured
+            # in batches()
+            ctx = TaskContext.peek() or self._task_ctx
+            if ctx is not None:
+                ctx.metric("shuffleCorruptionRetries").add(1)
+            TR.instant("shuffleCorruptionRetry", cat="shuffle", args={
+                "partition": self.partition, "blob": index,
+                "error": str(e)[:120]})
+            import logging
+            logging.getLogger("spark_rapids_tpu").warning(
+                "shuffle blob %d of partition %d failed verification "
+                "(%s); re-fetching from the store once", index,
+                self.partition, e)
+            return serde.deserialize_batch(self._read(index),
+                                           verify=self.verify)
 
     def batches(self):
-        from spark_rapids_tpu.shuffle import serde
-        blobs = list(self.store.iter_partition(self.partition))
-        if self.reader_threads > 1 and len(blobs) > 1:
+        self._task_ctx = TaskContext.peek()
+        n = self.store.num_blobs(self.partition)
+        if self.reader_threads > 1 and n > 1:
             from spark_rapids_tpu.runtime.host_pool import get_host_pool
             yield from get_host_pool(self.conf).map_ordered(
-                serde.deserialize_batch, blobs,
+                self._decode, range(n),
                 max_concurrency=self.reader_threads)
             return
-        for blob in blobs:
-            yield serde.deserialize_batch(blob)
+        for i in range(n):
+            yield self._decode(i)
 
 
 class RoundRobinExchangeExec(ExchangeExec):

@@ -1,0 +1,295 @@
+"""Fault injection: named engine sites, scheduled fault kinds, chaos runs.
+
+Reference parity: RapidsConf's test-fault surface (injectRetryOOM,
+RapidsConf.scala:1627) generalized the way the reference's integration
+harness wishes it were: ONE injector with a named site wherever the
+engine crosses a failure domain, instead of one bespoke knob per fault
+class. The OomInjector in runtime/retry.py remains the legacy facade for
+the `retry.oom` site (its conf and classmethods are unchanged); every
+other fault class — dead producer threads, corrupted shuffle blobs,
+disk errors mid-spill, wedged device dispatch — injects here.
+
+Sites (the roster tpulint TPU-L008 enforces, the way TPU-L007 enforces
+metric names): call sites pass a literal site name to :func:`site` (an
+action site — the fault raises, sleeps, or wedges *at* the call) or
+:func:`site_bytes` (a data site — the fault may additionally corrupt the
+bytes flowing through). An unregistered literal fails the lint; an
+unregistered name in the conf spec fails `from_conf` fast.
+
+Conf grammar (``spark.rapids.debug.faults``)::
+
+    site:kind[:count[,skip]][;site:kind[:count[,skip]]...]
+
+with kinds ``ioerror`` (raise InjectedFaultError, an OSError), ``corrupt``
+(flip bytes — data sites only), ``delay`` (sleep debug.faults.delayMs),
+``wedge`` (sleep debug.faults.wedgeSeconds — long enough for the
+dispatch watchdog to notice), and ``oom`` (raise TpuRetryOOM, feeding the
+retry framework). ``count`` defaults to 1; ``skip`` delays the first
+firing by that many site passes. `tools/chaos_smoke.py` drives seeded
+chaos runs by generating spec strings from a fixed-seed RNG, so a chaos
+schedule is reproducible from its seed alone.
+
+Overhead discipline (the tracing/sanitizer bar): with no schedule armed
+every hook is ONE module-global read (``_STATE is None``) — gated < 2%
+of a query drive by tools/chaos_smoke.py's overhead half. Every fired
+fault emits a `faultInjected` trace instant, increments the
+`rapids_faults_injected_total{site=...}` obs counter, and counts into the
+process-wide per-site tally that /healthz reports.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.analysis import sanitizer as _san
+
+log = logging.getLogger("spark_rapids_tpu")
+
+#: The fault-site roster: every `faults.site("...")` / `site_bytes("...")`
+#: literal in the engine must name one of these (tpulint TPU-L008), and
+#: every site in a `spark.rapids.debug.faults` spec must exist here.
+SITES: Dict[str, str] = {
+    "scan.decode": "host-side scan decode/upload of one source batch "
+                   "(parquet/text/in-memory scans)",
+    "shuffle.read": "serialized shuffle blob fetched from the store for "
+                    "deserialization (data site: corruptible)",
+    "shuffle.write": "serialized shuffle blob about to enter the host "
+                     "store (data site: corruptible)",
+    "spill.disk": "a spill-file write: shuffle-store budget overflow or "
+                  "the memory framework's host->disk tier transition",
+    "device.dispatch": "one fused device computation dispatched through "
+                       "exec/fuse.py (the per-batch XLA entry)",
+    "pipeline.producer": "a pipelined stage's producer refill pulling the "
+                         "next upstream batch (runtime/pipeline.py)",
+    "exchange.fetch": "the compact exchange's per-batch offsets fetch "
+                      "(the host sync sizing partition slices)",
+    "retry.oom": "the retry framework's attempt entry (the legacy "
+                 "injectRetryOOM site, shared with OomInjector)",
+}
+
+#: data sites: the only sites a `corrupt` schedule may target
+BYTE_SITES = frozenset(("shuffle.read", "shuffle.write"))
+
+KINDS = ("ioerror", "corrupt", "delay", "wedge", "oom")
+
+
+class InjectedFaultError(OSError):
+    """An ioerror-kind injected fault (an OSError so existing disk-error
+    handling treats it exactly like the real thing)."""
+
+
+class _Sched:
+    __slots__ = ("kind", "remaining", "skip")
+
+    def __init__(self, kind: str, count: int, skip: int):
+        self.kind = kind
+        self.remaining = count
+        self.skip = skip
+
+
+_LOCK = _san.lock("faults.state")
+#: THE armed flag: None = disabled, every hook returns after one global
+#: read. Otherwise: site -> ordered schedule list.
+_STATE: "Optional[Dict[str, List[_Sched]]]" = None
+#: process-lifetime per-site fired tally (site -> count); survives
+#: re-configuration so /healthz and chaos accounting see totals
+_FIRED: Dict[str, int] = {}
+_DELAY_MS = 50.0
+_WEDGE_S = 0.25
+
+
+def parse_spec(spec: str) -> Dict[str, List[_Sched]]:
+    """Parse the conf grammar; raises ValueError on unknown sites/kinds
+    (fail fast at configure time, not mid-query)."""
+    out: Dict[str, List[_Sched]] = {}
+    for part in str(spec).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) < 2:
+            raise ValueError(
+                f"invalid fault spec {part!r}: expected "
+                f"'site:kind[:count[,skip]]'")
+        sname, kind = bits[0].strip(), bits[1].strip().lower()
+        if sname not in SITES:
+            raise ValueError(
+                f"unknown fault site {sname!r}; registered sites: "
+                f"{', '.join(sorted(SITES))}")
+        if kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r}; kinds: {', '.join(KINDS)}")
+        if kind == "corrupt" and sname not in BYTE_SITES:
+            raise ValueError(
+                f"fault kind 'corrupt' needs a data site "
+                f"({', '.join(sorted(BYTE_SITES))}); {sname!r} is an "
+                f"action site")
+        count, skip = 1, 0
+        if len(bits) > 2 and bits[2].strip():
+            cs = bits[2].split(",")
+            try:
+                count = int(cs[0])
+                skip = int(cs[1]) if len(cs) > 1 and cs[1].strip() else 0
+            except ValueError as e:
+                raise ValueError(
+                    f"invalid fault count/skip in {part!r}: expected "
+                    f"'count[,skip]'") from e
+        out.setdefault(sname, []).append(_Sched(kind, count, skip))
+    return out
+
+
+def configure(spec: str = "", delay_ms: float = 50.0,
+              wedge_s: float = 0.25) -> None:
+    """Install (or, with an empty spec, clear) the process-wide fault
+    schedule. An empty spec clears leftovers exactly like
+    OomInjector.from_conf — a session without injection must not inherit
+    a previous session's chaos."""
+    global _STATE, _DELAY_MS, _WEDGE_S
+    parsed = parse_spec(spec) if spec else None
+    with _LOCK:
+        _STATE = parsed if parsed else None
+        _DELAY_MS = float(delay_ms)
+        _WEDGE_S = float(wedge_s)
+
+
+def from_conf(conf) -> None:
+    from spark_rapids_tpu import config as C
+    configure(conf.get(C.FAULTS_SPEC) or "",
+              delay_ms=conf.get(C.FAULTS_DELAY_MS),
+              wedge_s=conf.get(C.FAULTS_WEDGE_S))
+
+
+def armed(site_name: str) -> bool:
+    """Does an uncommitted schedule exist for this site? (exec/fuse.py
+    uses this to keep the zero-cost raw-function path when nothing can
+    fire at device.dispatch.)"""
+    st = _STATE
+    return st is not None and site_name in st
+
+
+def fault_counts() -> Dict[str, int]:
+    """Process-lifetime fired tally per site (the /healthz surface)."""
+    with _LOCK:
+        return dict(_FIRED)
+
+
+def total_fired() -> int:
+    with _LOCK:
+        return sum(_FIRED.values())
+
+
+def reset_counters() -> None:
+    """Test/chaos hook: zero the fired tally (schedules unaffected)."""
+    with _LOCK:
+        _FIRED.clear()
+
+
+def _next_kind(site_name: str):
+    """Pop the next due fault for a site, or None. Lock held only for
+    the bookkeeping; the action (sleep/raise/emit) runs outside."""
+    global _STATE
+    with _LOCK:
+        st = _STATE
+        if st is None:
+            return None
+        scheds = st.get(site_name)
+        if not scheds:
+            return None
+        s = scheds[0]
+        if s.skip > 0:
+            s.skip -= 1
+            return None
+        s.remaining -= 1
+        if s.remaining <= 0:
+            scheds.pop(0)
+            if not scheds:
+                st.pop(site_name, None)
+                if not st:
+                    _STATE = None
+        _FIRED[site_name] = _FIRED.get(site_name, 0) + 1
+        delay_ms, wedge_s = _DELAY_MS, _WEDGE_S
+    return s.kind, delay_ms, wedge_s
+
+
+def _emit(site_name: str, kind: str) -> None:
+    """Observability for one fired fault: trace instant + obs counter +
+    debug log. Never raises; never called under the faults lock."""
+    try:
+        from spark_rapids_tpu.runtime import trace
+        trace.instant("faultInjected", cat="faults",
+                      args={"site": site_name, "kind": kind})
+    except Exception:  # noqa: BLE001 - injection must not need a tracer
+        pass
+    try:
+        from spark_rapids_tpu.runtime import obs
+        st = obs.state()
+        if st is not None:
+            st.registry.counter(
+                "rapids_faults_injected_total",
+                "Injected faults fired (spark.rapids.debug.faults)",
+                labels={"site": site_name}).inc()
+    except Exception:  # noqa: BLE001 - injection must not need obs
+        pass
+    log.debug("fault injected: site=%s kind=%s", site_name, kind)
+
+
+def _act(site_name: str, kind: str, delay_ms: float, wedge_s: float) -> None:
+    """Perform an action-kind fault (everything but corrupt)."""
+    _emit(site_name, kind)
+    if kind == "ioerror":
+        raise InjectedFaultError(
+            f"injected ioerror at fault site {site_name!r}")
+    if kind == "oom":
+        from spark_rapids_tpu.runtime.retry import TpuRetryOOM
+        raise TpuRetryOOM(f"injected OOM at fault site {site_name!r}")
+    if kind == "delay":
+        time.sleep(delay_ms / 1000.0)
+    elif kind == "wedge":
+        time.sleep(wedge_s)
+
+
+def site(site_name: str) -> None:
+    """Action injection point. Disabled path: one module-global read."""
+    if _STATE is None:
+        return
+    due = _next_kind(site_name)
+    if due is None:
+        return
+    kind, delay_ms, wedge_s = due
+    if kind == "corrupt":
+        # a corrupt schedule reaching an action site (configure rejects
+        # this for conf specs; programmatic schedules could still) acts
+        # as an ioerror rather than silently not firing
+        _emit(site_name, kind)
+        raise InjectedFaultError(
+            f"injected corrupt-as-ioerror at action site {site_name!r}")
+    _act(site_name, kind, delay_ms, wedge_s)
+
+
+def site_bytes(site_name: str, data: bytes) -> bytes:
+    """Data injection point: like :func:`site`, but a `corrupt` fault
+    returns a bit-flipped copy of `data` instead of raising. Disabled
+    path: one module-global read."""
+    if _STATE is None:
+        return data
+    due = _next_kind(site_name)
+    if due is None:
+        return data
+    kind, delay_ms, wedge_s = due
+    if kind == "corrupt":
+        _emit(site_name, kind)
+        return corrupt_bytes(data)
+    _act(site_name, kind, delay_ms, wedge_s)
+    return data
+
+
+def corrupt_bytes(data: bytes) -> bytes:
+    """Deterministic corruption: flip a byte in the middle and one near
+    the end (past any header), so checksums must catch it."""
+    if not data:
+        return b"\xff"
+    buf = bytearray(data)
+    buf[len(buf) // 2] ^= 0xFF
+    buf[-1] ^= 0x55
+    return bytes(buf)

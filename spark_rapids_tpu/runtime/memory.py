@@ -101,6 +101,11 @@ class SpillableHandle:
     def spill_to_disk(self) -> int:
         """host -> disk. Returns bytes freed from the host tier."""
         import time as _time
+        from spark_rapids_tpu.runtime import faults as _faults
+        # fault site OUTSIDE the handle lock: an injected disk error (or
+        # wedge-sleep) must behave like np.save failing, not extend the
+        # critical section
+        _faults.site("spill.disk")
         t0 = _time.perf_counter_ns()
         # tpulint: disable=TPU-L001 np.save must be atomic with the HOST->DISK tier transition; the lock is per-handle and a handle spills at most once per tier, so no hot path ever waits on this write
         with self._lock:

@@ -63,6 +63,10 @@ _TASK_COUNTERS = {
                         "Total ns spent spilling device->host"),
     "spillToDiskTime": ("rapids_spill_to_disk_ns_total",
                         "Total ns spent spilling host->disk"),
+    "shuffleCorruptionRetries": (
+        "rapids_shuffle_corruption_retries_total",
+        "Shuffle blobs that failed integrity verification and were "
+        "transparently re-fetched from the store"),
 }
 
 
@@ -104,6 +108,25 @@ def _preregister(reg: MetricsRegistry) -> None:
                 labels={"status": "ok"})
     reg.counter("rapids_queries_total", "Queries completed",
                 labels={"status": "failed"})
+    reg.counter("rapids_queries_total", "Queries completed",
+                labels={"status": "degraded"})
+    reg.counter("rapids_faults_injected_total",
+                "Injected faults fired (spark.rapids.debug.faults)")
+    reg.counter("rapids_watchdog_dispatch_timeouts_total",
+                "Device dispatches that exceeded the watchdog deadline")
+    reg.counter("rapids_breaker_transitions_total",
+                "Circuit-breaker state transitions",
+                labels={"to": "open"})
+
+    def _breaker_open():
+        from spark_rapids_tpu.runtime import watchdog as WD
+        brk = WD.peek_breaker()
+        return 0 if brk is None or brk.state == "closed" else (
+            2 if brk.state == "open" else 1)
+
+    reg.gauge_fn("rapids_breaker_state", _breaker_open,
+                 "Device circuit-breaker state "
+                 "(0 closed, 1 half-open, 2 open)")
     reg.counter("rapids_shuffle_bytes_written_total",
                 "Serialized shuffle bytes written to the host store")
     reg.counter("rapids_shuffle_bytes_spilled_total",
@@ -296,7 +319,8 @@ def on_query_end(token, *, session, plan, status: str,
                  error: Optional[BaseException], duration_ns: int,
                  wall_start_unix: float,
                  trace_paths: Optional[dict],
-                 last_metrics: Optional[Dict[str, dict]] = None
+                 last_metrics: Optional[Dict[str, dict]] = None,
+                 degraded_reason: Optional[str] = None
                  ) -> Optional[dict]:
     """Publish one finished top-level action: registry rollups + the
     history record. Returns the record (None when history is off).
@@ -332,7 +356,7 @@ def on_query_end(token, *, session, plan, status: str,
                 query_id=token, wall_start_unix=wall_start_unix,
                 duration_ns=duration_ns, status=status, error=error,
                 plan=plan, session=session, trace_paths=trace_paths,
-                snaps=snaps)
+                snaps=snaps, degraded_reason=degraded_reason)
             st.history.append(rec)
         st.last_query = {
             "query_id": token, "status": status,
@@ -340,6 +364,8 @@ def on_query_end(token, *, session, plan, status: str,
             "error_class": type(error).__name__ if error else None,
             "finished_unix": time.time(),
         }
+        if degraded_reason is not None:
+            st.last_query["degraded_reason"] = degraded_reason
         return rec
     except Exception:  # noqa: BLE001 - observability never fails a query
         return None
@@ -394,12 +420,17 @@ def _publish_exec_rollups(reg: MetricsRegistry, snaps: Dict[str, dict]
 
 def healthz() -> dict:
     """The /healthz document. Degraded when the device probe is blocked
-    or failing; everything else is informational pressure data."""
+    or failing OR the device circuit breaker is open (the engine is
+    serving, but on the CPU fallback path); breaker state and per-site
+    injected-fault counts ride along so a prober can tell a degraded
+    serving process from a healthy one without parsing logs."""
     st = _STATE
     if st is None:
         return {"status": "degraded", "reason": "obs not installed"}
+    from spark_rapids_tpu.runtime import faults as FLT
     from spark_rapids_tpu.runtime import memory as MEM
     from spark_rapids_tpu.runtime import semaphore as SEM
+    from spark_rapids_tpu.runtime import watchdog as WD
     if st.probe is None:
         from spark_rapids_tpu.runtime.obs.endpoint import DeviceProbe
         st.probe = DeviceProbe()
@@ -427,9 +458,16 @@ def healthz() -> dict:
     # direct counter reads: a full registry snapshot would walk every
     # histogram's quantiles per poll, and load balancers poll often
     reg = st.registry
+    brk = WD.peek_breaker()
+    breaker_doc = brk.state_doc() if brk is not None else {
+        "backend": "device", "state": "closed"}
     return {
-        "status": "ok" if device.get("alive") else "degraded",
+        "status": "ok" if (device.get("alive")
+                           and breaker_doc["state"] != "open")
+        else "degraded",
         "device": device,
+        "breaker": breaker_doc,
+        "faults": FLT.fault_counts(),
         "semaphore": sem_doc,
         "spill": spill_doc,
         "queries": {
@@ -439,6 +477,9 @@ def healthz() -> dict:
             "failed": reg.counter(
                 "rapids_queries_total",
                 labels={"status": "failed"}).value,
+            "degraded": reg.counter(
+                "rapids_queries_total",
+                labels={"status": "degraded"}).value,
             "last": st.last_query,
         },
     }

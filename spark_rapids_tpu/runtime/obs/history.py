@@ -115,11 +115,17 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
                        error: Optional[BaseException],
                        plan, session,
                        trace_paths: Optional[dict],
-                       snaps: Optional[dict] = None) -> dict:
+                       snaps: Optional[dict] = None,
+                       degraded_reason: Optional[str] = None) -> dict:
     """Assemble one history record from a finished action's state. Every
     sub-extraction is best-effort: history must never fail a query.
     `snaps` is the caller's last_metrics() snapshot when it already took
-    one — re-snapshotting would redo the lazy-count device syncs."""
+    one — re-snapshotting would redo the lazy-count device syncs.
+    `status` may be "degraded": the query's results came from the CPU
+    fallback after a device-path failure — `error_class` then names the
+    triggering error and `degraded_reason` the policy that fired
+    (error class, or "circuit_open" when the breaker skipped the device
+    entirely), so the history server can tell degraded from healthy."""
     rec: Dict[str, object] = {
         "type": "query",
         "query_id": query_id,
@@ -127,6 +133,8 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
         "duration_ns": int(duration_ns),
         "status": status,
     }
+    if degraded_reason is not None:
+        rec["degraded_reason"] = degraded_reason
     if error is not None:
         rec["error_class"] = type(error).__name__
         rec["error"] = str(error)[:500]

@@ -167,6 +167,7 @@ class PipelinedIterator:
                     TaskContext.clear()
 
     def _refill_loop(self) -> None:
+        from spark_rapids_tpu.runtime import faults as _faults
         from spark_rapids_tpu.runtime import trace
         while True:
             with self._lock:
@@ -186,6 +187,9 @@ class PipelinedIterator:
                     return
             t0 = time.perf_counter_ns()
             try:
+                # producer-death injection: a fault here travels the same
+                # envelope as a real upstream decode failure
+                _faults.site("pipeline.producer")
                 item = next(self._source)
             except StopIteration:
                 item = _DONE

@@ -39,9 +39,50 @@ class TpuSplitAndRetryOOM(TpuOOM):
 
 
 def is_device_oom(exc: BaseException) -> bool:
+    """Is this exception a PHYSICAL device OOM surfaced by the jax/XLA
+    runtime? Substring matching applies ONLY to exception types whose
+    class originates in jax/jaxlib (XlaRuntimeError et al.): a user
+    exception whose *message* happens to contain "Out of memory" must
+    surface to the user, not be swallowed into the retry-drain loop."""
+    mod = getattr(type(exc), "__module__", "") or ""
+    if not mod.startswith(("jax", "jaxlib")):
+        return False
     s = str(exc)
     return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s \
         or "Resource exhausted" in s
+
+
+#: bounded exponential backoff between OOM retry attempts (process-wide
+#: like the OomInjector: retries run on pool/task threads where no
+#: session conf is bound). Synced from spark.rapids.retry.backoff* by
+#: TpuSession.prepare_execution.
+_BACKOFF_BASE_MS = 10.0
+_BACKOFF_MAX_MS = 500.0
+
+
+def set_backoff(base_ms: float, max_ms: float) -> None:
+    global _BACKOFF_BASE_MS, _BACKOFF_MAX_MS
+    _BACKOFF_BASE_MS = max(0.0, float(base_ms))
+    _BACKOFF_MAX_MS = max(0.0, float(max_ms))
+
+
+def backoff_from_conf(conf) -> None:
+    from spark_rapids_tpu import config as C
+    set_backoff(conf.get(C.RETRY_BACKOFF_BASE_MS),
+                conf.get(C.RETRY_BACKOFF_MAX_MS))
+
+
+def _backoff_seconds(attempt: int) -> float:
+    """Jittered bounded exponential backoff for retry attempt n (1-based):
+    base*2^(n-1) ms capped at the max, scaled by a uniform 50-100% jitter
+    so concurrent tasks that OOMed together fan back in spread out
+    instead of thundering-herding the freshly drained budget."""
+    import random
+    if _BACKOFF_BASE_MS <= 0:
+        return 0.0
+    raw_ms = min(_BACKOFF_BASE_MS * (2.0 ** (attempt - 1)),
+                 _BACKOFF_MAX_MS)
+    return (raw_ms / 1000.0) * (0.5 + random.random() * 0.5)
 
 
 class OomInjector:
@@ -49,7 +90,13 @@ class OomInjector:
     (reference RmmSpark.forceRetryOOM / the injectRetryOOM conf). State is
     process-global: exec partitions run on pool worker threads, so
     thread-local counters configured on the driver thread would never
-    fire where the retries actually happen."""
+    fire where the retries actually happen.
+
+    Legacy facade: the general FaultInjector (runtime/faults.py) covers
+    the same site as `retry.oom` in its roster — `_attempt_with_drain`
+    checks both, so either `spark.rapids.sql.test.injectRetryOOM` or a
+    `retry.oom:oom:count[,skip]` schedule in `spark.rapids.debug.faults`
+    fires here."""
 
     _lock = _san.lock("retry.injector")
     _num = 0
@@ -131,7 +178,7 @@ def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
     total time separately."""
     import time as _time
 
-    from spark_rapids_tpu.runtime import trace
+    from spark_rapids_tpu.runtime import faults, trace
     from spark_rapids_tpu.runtime.memory import get_spill_framework
     from spark_rapids_tpu.runtime.task import TaskContext
 
@@ -140,6 +187,7 @@ def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
         t0a = _time.perf_counter_ns()
         try:
             OomInjector.maybe_throw()
+            faults.site("retry.oom")
             result = attempt()
             if retries and trace.active() is not None:
                 # the attempt that finally landed, tagged with how many
@@ -185,9 +233,19 @@ def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
                 raise
             t0 = _time.perf_counter_ns()
             get_spill_framework().drain_all()
+            # bounded exponential backoff + jitter before the re-attempt:
+            # a drain-then-immediate-retry lets every concurrently OOMed
+            # task re-dispatch into the same freshly drained budget at
+            # once (thundering herd); the backoff spreads them out
+            delay_s = _backoff_seconds(retries)
+            if delay_s > 0:
+                trace.instant("retryBackoff", cat="retry", args={
+                    "attempt": retries,
+                    "ms": round(delay_s * 1000.0, 3)})
+                _time.sleep(delay_s)
             if ctx is not None:
-                # time spent freeing memory before the re-attempt
-                # (GpuTaskMetrics retryBlockTime analog)
+                # time spent freeing memory (and backing off) before the
+                # re-attempt (GpuTaskMetrics retryBlockTime analog)
                 ctx.metric("retryBlockTime").add(
                     _time.perf_counter_ns() - t0)
 

@@ -49,6 +49,7 @@ TASK_METRIC_NAMES = (
     "spillToHostBytes", "spillToDiskBytes",
     "spillToHostTime", "spillToDiskTime",
     "maxDeviceBytesHeld",
+    "shuffleCorruptionRetries",
 )
 
 from spark_rapids_tpu.analysis import sanitizer as _san  # noqa: E402

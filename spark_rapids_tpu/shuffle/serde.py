@@ -11,6 +11,15 @@ spark.rapids.shuffle.compression.codec conf).
 Planes are TRIMMED to live sizes on the wire (capacity padding never
 ships) and re-padded to capacity buckets on deserialize, so a spilled or
 remote batch costs bandwidth proportional to data, not to padding.
+
+Integrity: the wire header carries a CRC32 over the codec byte + the
+(possibly compressed) payload, verified on read BEFORE decompression —
+so corruption anywhere in the blob (header, codec payload, frame) raises
+ShuffleCorruptionError instead of a codec-dependent error soup. The
+frame body keeps its xxhash64 as a second, codec-independent check.
+Readers (exec/tpu_nodes._LazyShuffleBlobs) re-fetch a failing blob from
+the shuffle store ONCE before surfacing the error, which recovers
+transient disk corruption on the spill path.
 """
 from __future__ import annotations
 
@@ -37,6 +46,16 @@ CODEC_NONE = 0
 CODEC_ZSTD = 1
 CODEC_ZLIB = 2
 _CODEC_NAMES = {"none": CODEC_NONE, "zstd": CODEC_ZSTD, "zlib": CODEC_ZLIB}
+
+#: wire layout: [codec byte][CRC32 LE u32 over codec byte + payload][payload]
+_WIRE_HEADER = 5
+
+
+class ShuffleCorruptionError(ValueError):
+    """A shuffle blob failed integrity verification (wire CRC or frame
+    checksum). A ValueError subclass so pre-existing handlers of frame
+    parse errors keep working; readers catch THIS type to drive the
+    one-shot re-fetch recovery."""
 
 
 _AUTO_CODEC: Optional[str] = None
@@ -335,14 +354,15 @@ def _unpack_frame(data: bytes, verify: bool = True
             ctypes.byref(n_bufs), offs, lens, max_bufs,
             1 if verify else 0)
         if rc < 0:
-            raise ValueError(f"kudo frame parse failed (code {rc})")
+            raise ShuffleCorruptionError(
+                f"kudo frame parse failed (code {rc})")
         meta = data[meta_off.value: meta_off.value + meta_len.value]
         bufs = [data[offs[i]: offs[i] + lens[i]]
                 for i in range(n_bufs.value)]
         return meta, bufs
     magic, version, nb = struct.unpack_from("<QII", data, 0)
     if magic != _MAGIC:
-        raise ValueError("bad kudo magic")
+        raise ShuffleCorruptionError("bad kudo magic")
     if version != _VERSION:
         raise ValueError(f"unsupported kudo version {version}")
     (ml,) = struct.unpack_from("<Q", data, 16)
@@ -361,7 +381,7 @@ def _unpack_frame(data: bytes, verify: bool = True
     if verify:
         (want,) = struct.unpack_from("<Q", data, pos)
         if _py_xxhash64(data[:pos]) != want:
-            raise ValueError("kudo frame checksum mismatch")
+            raise ShuffleCorruptionError("kudo frame checksum mismatch")
     return meta, bufs
 
 
@@ -372,6 +392,8 @@ def _unpack_frame(data: bytes, verify: bool = True
 def serialize_batch(batch: ColumnarBatch, codec: str = "auto") -> bytes:
     """Device batch -> wire bytes. Masked batches are compacted first (dead
     rows never ship)."""
+    import zlib
+
     from spark_rapids_tpu.ops import kernels as K
     from spark_rapids_tpu.columnar.batch import fetch_batch_host
     from spark_rapids_tpu.runtime import trace as TR
@@ -390,11 +412,12 @@ def serialize_batch(batch: ColumnarBatch, codec: str = "auto") -> bytes:
             import zstandard
             payload = zstandard.ZstdCompressor(level=1).compress(frame)
         elif cid == CODEC_ZLIB:
-            import zlib
             payload = zlib.compress(frame, 1)
         else:
             payload = frame
-        out = bytes([cid]) + payload
+        head = bytes([cid])
+        crc = zlib.crc32(payload, zlib.crc32(head)) & 0xFFFFFFFF
+        out = head + struct.pack("<I", crc) + payload
         if sp is not None:
             sp.args.update(rows=n, frame_bytes=len(frame),
                            wire_bytes=len(out))
@@ -403,16 +426,27 @@ def serialize_batch(batch: ColumnarBatch, codec: str = "auto") -> bytes:
 
 def deserialize_batch(data: bytes, verify: bool = True) -> ColumnarBatch:
     """Wire bytes -> device batch (planes re-padded to capacity buckets)."""
+    import zlib
+
     from spark_rapids_tpu.runtime import trace as TR
     with TR.span("shuffle.deserialize", cat="shuffle", level=TR.DEBUG,
                  args={"wire_bytes": len(data)}):
+        if len(data) < _WIRE_HEADER:
+            raise ShuffleCorruptionError(
+                f"short shuffle blob ({len(data)} bytes)")
         cid = data[0]
-        payload = data[1:]
+        (want,) = struct.unpack_from("<I", data, 1)
+        payload = data[_WIRE_HEADER:]
+        if verify:
+            got = zlib.crc32(payload, zlib.crc32(data[:1])) & 0xFFFFFFFF
+            if got != want:
+                raise ShuffleCorruptionError(
+                    f"shuffle blob CRC mismatch (stored {want:#010x}, "
+                    f"computed {got:#010x}, {len(data)} wire bytes)")
         if cid == CODEC_ZSTD:
             import zstandard
             frame = zstandard.ZstdDecompressor().decompress(payload)
         elif cid == CODEC_ZLIB:
-            import zlib
             frame = zlib.decompress(payload)
         elif cid == CODEC_NONE:
             frame = payload

@@ -99,8 +99,12 @@ class ShuffleStore:
                 snapshot = list(self._parts[victim])
                 path = self._spill_path(victim)
             try:
+                from spark_rapids_tpu.runtime import faults as _faults
                 segs = []
                 try:
+                    # injected disk faults surface exactly like real ones
+                    # (the OSError handling below)
+                    _faults.site("spill.disk")
                     with open(path, "ab") as f:
                         for i, b in enumerate(snapshot):
                             if isinstance(b, bytes):
@@ -137,6 +141,19 @@ class ShuffleStore:
     def iter_partition(self, partition: int) -> Iterator[bytes]:
         for b in list(self._parts[partition]):
             yield b if isinstance(b, bytes) else b.read()
+
+    def num_blobs(self, partition: int) -> int:
+        with self._lock:
+            return len(self._parts[partition])
+
+    def read_blob(self, partition: int, index: int) -> bytes:
+        """One blob by stable index (partition lists only ever append).
+        Disk-resident blobs re-read their file segment on every call —
+        the integrity-recovery path re-fetches a corrupt blob through
+        here, so a transient disk read error heals on the second pass."""
+        with self._lock:
+            b = self._parts[partition][index]
+        return b if isinstance(b, bytes) else b.read()
 
     def partition_bytes(self, partition: int) -> int:
         return sum(len(b) if isinstance(b, bytes) else b.length

@@ -3,6 +3,7 @@ bootstrap + the collect path). Owns config, converts plans through the
 overrides engine, and runs root partitions as concurrent tasks."""
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 import pyarrow as pa
@@ -15,6 +16,11 @@ from spark_rapids_tpu.plan import nodes as P
 from spark_rapids_tpu.runtime.metrics import walk_exec_tree
 from spark_rapids_tpu.runtime.task import TaskContext
 from spark_rapids_tpu.sql.dataframe import DataFrame
+
+#: per-thread collect nesting depth: degradation policy and breaker
+#: accounting apply only to top-level actions (depth 0 at entry) — a
+#: nested collect's failure propagates to its enclosing query
+_COLLECT_DEPTH = threading.local()
 
 
 def _discover_hive(root: str):
@@ -62,6 +68,9 @@ class TpuSession:
         #: artifact paths of the most recent traced action
         #: ({"trace","events","metrics"}; None until a traced collect runs)
         self.last_trace_paths = None
+        #: (status, degraded_reason) of the most recent top-level action:
+        #: ("ok", None), ("failed", None), or ("degraded", reason)
+        self.last_action_status = ("ok", None)
         from spark_rapids_tpu.ops import pallas_kernels as PK
         PK.set_enabled(self.conf.get(C.PALLAS_ENABLED))
         # live observability (spark.rapids.obs.*): process-wide registry,
@@ -165,16 +174,24 @@ class TpuSession:
     # -- execution ---------------------------------------------------------
     def prepare_execution(self, plan: P.PlanNode):
         """Session preamble shared by every action (collect, write):
-        activate this session's conf, sync the spill budgets, arm OOM
-        injection, convert the plan. Returns (exec_root, meta)."""
+        activate this session's conf, sync the spill budgets, arm fault
+        injection (general sites + the legacy OOM injector), sync the
+        retry backoff and the dispatch watchdog/breaker, convert the
+        plan. Returns (exec_root, meta)."""
         from spark_rapids_tpu.analysis import sanitizer
         from spark_rapids_tpu.config import set_session_conf
         from spark_rapids_tpu.plan.overrides import convert_plan
+        from spark_rapids_tpu.runtime import faults, watchdog
         from spark_rapids_tpu.runtime.memory import get_spill_framework
-        from spark_rapids_tpu.runtime.retry import OomInjector
+        from spark_rapids_tpu.runtime.retry import (
+            OomInjector, backoff_from_conf,
+        )
         set_session_conf(self.conf)
         sanitizer.maybe_install(self.conf)
         OomInjector.from_conf(self.conf)
+        faults.from_conf(self.conf)
+        backoff_from_conf(self.conf)
+        watchdog.maybe_install(self.conf)
         get_spill_framework(self.conf)  # sync budgets to this session
         exec_root, meta = convert_plan(plan, self.conf)
         self._last_meta = meta
@@ -228,7 +245,36 @@ class TpuSession:
         t0 = _time.perf_counter_ns()
         wall0 = _time.time()
         error: Optional[BaseException] = None
+        status = "ok"
+        degraded_reason: Optional[str] = None
+        # degradation is a TOP-LEVEL policy: a nested collect (broadcast
+        # materialization inside a running device query) must propagate
+        # its failure to the outer query, which then degrades whole
+        depth = getattr(_COLLECT_DEPTH, "d", 0)
+        _COLLECT_DEPTH.d = depth + 1
+        cpu_gate_failed = False
         try:
+            if depth == 0 and self._fallback_enabled():
+                from spark_rapids_tpu.runtime import watchdog as WD
+                brk = WD.peek_breaker()
+                if brk is not None and not brk.allow():
+                    # breaker open: skip the device entirely instead of
+                    # feeding queries into a known-bad backend; allow()
+                    # lets exactly one probe query through per backoff
+                    # window to test recovery (half-open)
+                    status = "degraded"
+                    degraded_reason = "circuit_open"
+                    try:
+                        return self._execute_cpu_fallback(plan)
+                    except BaseException:
+                        # a CPU-path failure: the device never ran, so
+                        # the outer handler must neither record a device
+                        # breaker failure nor re-run the identical CPU
+                        # fallback a second time
+                        cpu_gate_failed = True
+                        status = "failed"
+                        degraded_reason = None
+                        raise
             prof_dir = self.conf.get(C.PROFILE_DIR)
             if prof_dir:
                 # XProf trace per action (reference ProfilerOnExecutor /
@@ -236,17 +282,97 @@ class TpuSession:
                 # this capture so both timelines share operator names
                 import jax
                 with jax.profiler.trace(prof_dir):
-                    return self._collect_inner(plan)
-            return self._collect_inner(plan)
+                    result = self._collect_inner(plan)
+            else:
+                result = self._collect_inner(plan)
+            if depth == 0:
+                self._record_device_success()
+            return result
         except BaseException as e:
             error = e
-            raise
+            fallback = self._maybe_degrade_cpu(plan, e) \
+                if depth == 0 and not cpu_gate_failed else None
+            if fallback is None:
+                status = "failed"
+                raise
+            status = "degraded"
+            degraded_reason = type(e).__name__
+            return fallback
         finally:
+            _COLLECT_DEPTH.d = depth
+            #: (status, degraded_reason) of the most recent top-level
+            #: action — ok / failed / degraded (chaos + serving callers
+            #: read this without needing the obs registry)
+            if depth == 0:
+                self.last_action_status = (status, degraded_reason)
             self._finish_action(plan, qt, ot, error,
-                                _time.perf_counter_ns() - t0, wall0)
+                                _time.perf_counter_ns() - t0, wall0,
+                                status=status,
+                                degraded_reason=degraded_reason)
+
+    def _fallback_enabled(self) -> bool:
+        return bool(self.conf.get(C.FALLBACK_CPU_ENABLED))
+
+    def _record_device_success(self) -> None:
+        """Close the circuit on a successful device query (half-open
+        probe succeeded, or plain success resetting the failure count).
+        Only consulted when fallback is on — the breaker must not
+        accumulate state from test suites that intentionally fail
+        queries with fallback off."""
+        if not self._fallback_enabled():
+            return
+        from spark_rapids_tpu.runtime import watchdog as WD
+        brk = WD.peek_breaker()
+        if brk is not None:
+            brk.record_success()
+
+    @staticmethod
+    def _degradable(error: BaseException) -> bool:
+        """Degradation policy: engine/device failures degrade (exhausted
+        OOM retries, corrupted shuffle data, injected faults, wedged or
+        failing device dispatch); user-semantic errors do NOT — an ANSI
+        overflow or an unsupported-operation SparkException would raise
+        identically on the CPU backend, so re-executing only delays the
+        answer the user must see."""
+        if isinstance(error, (KeyboardInterrupt, SystemExit,
+                              GeneratorExit)):
+            return False
+        return not isinstance(error, SparkException)
+
+    def _execute_cpu_fallback(self, plan: P.PlanNode) -> pa.Table:
+        from spark_rapids_tpu.config import set_session_conf
+        from spark_rapids_tpu.exec.cpu_backend import execute_cpu
+        set_session_conf(self.conf)
+        return execute_cpu(plan, self.conf.get(C.ANSI_ENABLED))
+
+    def _maybe_degrade_cpu(self, plan: P.PlanNode,
+                           error: BaseException) -> Optional[pa.Table]:
+        """Graceful degradation (spark.rapids.fallback.cpu.enabled): the
+        device path failed a top-level query — re-execute it on the CPU
+        backend and report `degraded` instead of `failed`. Returns the
+        CPU result, or None when degradation is off, the error is
+        user-semantic, or the CPU re-execution itself fails (the
+        original device error then propagates)."""
+        import logging
+        if not self._fallback_enabled() or not self._degradable(error):
+            return None
+        from spark_rapids_tpu.runtime import watchdog as WD
+        WD.breaker().record_failure(type(error).__name__)
+        log = logging.getLogger("spark_rapids_tpu")
+        log.warning(
+            "query failed on the device path (%s: %s); degrading to CPU "
+            "re-execution", type(error).__name__, str(error)[:200])
+        try:
+            return self._execute_cpu_fallback(plan)
+        except Exception:  # noqa: BLE001 - surface the ORIGINAL device
+            # error, with the CPU failure logged beside it
+            log.warning("CPU fallback re-execution also failed",
+                        exc_info=True)
+            return None
 
     def _finish_action(self, plan, qt, ot, error, duration_ns,
-                       wall0) -> None:
+                       wall0, status: Optional[str] = None,
+                       degraded_reason: Optional[str] = None) -> None:
         """Query epilogue: finalize the trace (success OR failure) and
         publish the action to the live observability layer. Every step is
         fenced — a failed query must still flush its buffered trace
@@ -259,7 +385,8 @@ class TpuSession:
         from spark_rapids_tpu.runtime import obs as OBS
         from spark_rapids_tpu.runtime import trace as TR
         log = logging.getLogger("spark_rapids_tpu")
-        status = "ok" if error is None else "failed"
+        if status is None:
+            status = "ok" if error is None else "failed"
         # ONE metric snapshot serves the trace finalize, the registry
         # rollups, and the history record (resolving lazy device row
         # counts costs real syncs) — and it is taken at all only when
@@ -283,7 +410,16 @@ class TpuSession:
             # PREVIOUS query's artifacts looking like this one's
             self.last_trace_paths = None
             try:
-                if error is not None:
+                if status == "degraded":
+                    # the device path failed (or the breaker was open)
+                    # but the CPU fallback answered: mark the trace so
+                    # the report attributes the tail to degradation
+                    TR.instant("queryDegraded", cat="query", args={
+                        "reason": degraded_reason,
+                        "error": (type(error).__name__
+                                  if error is not None else None)},
+                        level=TR.ESSENTIAL)
+                elif error is not None:
                     # flush-time marker: the trace ends HERE because the
                     # query raised, not because instrumentation stopped
                     TR.instant("queryError", cat="query", args={
@@ -310,7 +446,8 @@ class TpuSession:
                     # to the wrong query)
                     trace_paths=(self.last_trace_paths
                                  if qt is not None else None),
-                    last_metrics=lm)
+                    last_metrics=lm,
+                    degraded_reason=degraded_reason)
             except Exception:  # noqa: BLE001
                 log.warning("failed to publish query to obs",
                             exc_info=True)

@@ -27,9 +27,15 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def _reset_runtime():
     yield
+    from spark_rapids_tpu.runtime import faults, watchdog
     from spark_rapids_tpu.runtime.semaphore import reset_semaphore
     from spark_rapids_tpu.runtime.memory import reset_spill_framework
-    from spark_rapids_tpu.runtime.retry import OomInjector
+    from spark_rapids_tpu.runtime.retry import OomInjector, set_backoff
     reset_semaphore()
     reset_spill_framework()
     OomInjector.configure(0)
+    faults.configure("")
+    set_backoff(10.0, 500.0)
+    # a test that tripped the breaker (or started the watchdog) must not
+    # leak degraded routing into the next test's queries
+    watchdog.uninstall_for_tests()

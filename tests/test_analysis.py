@@ -211,6 +211,56 @@ def test_l007_unregistered_metric_name():
     assert _rules(vs) == ["TPU-L007"]
 
 
+def _lint_sites(src, sites=frozenset({"scan.decode", "shuffle.read"})):
+    return lint.lint_source(textwrap.dedent(src), "/x/runtime/x.py",
+                            {"opTime"}, relpath="runtime/x.py",
+                            known_sites=set(sites))
+
+
+def test_l008_unregistered_fault_site():
+    vs = _lint_sites("""
+        from spark_rapids_tpu.runtime import faults
+        def f(data):
+            faults.site("scan.decode")
+            faults.site("made.up.site")
+            data = faults.site_bytes("also.bogus", data)
+            return faults.site_bytes("shuffle.read", data)
+    """)
+    assert _rules(vs) == ["TPU-L008", "TPU-L008"]
+
+
+def test_l008_only_fault_injector_receivers_match():
+    # .site() on an unrelated receiver (an HTTP client, a config object)
+    # is not a fault-injection point
+    vs = _lint_sites("""
+        def f(client, data):
+            client.site("whatever.name")
+            return data
+    """)
+    assert _rules(vs) == []
+
+
+def test_l008_roster_extraction_matches_faults_module():
+    sites = lint.known_fault_sites(
+        os.path.join(REPO, "spark_rapids_tpu"))
+    from spark_rapids_tpu.runtime.faults import SITES
+    assert sites == set(SITES)
+    assert {"scan.decode", "shuffle.read", "shuffle.write", "spill.disk",
+            "device.dispatch", "pipeline.producer", "exchange.fetch",
+            "retry.oom"} <= sites
+
+
+def test_l008_skipped_without_roster():
+    # lint_source without known_sites (older fixtures, partial runs)
+    # must not report L008
+    vs = _lint("""
+        from spark_rapids_tpu.runtime import faults
+        def f():
+            faults.site("made.up.site")
+    """)
+    assert _rules(vs) == []
+
+
 def test_lint_full_tree_is_clean():
     """The acceptance bar: zero unsuppressed violations over the whole
     package, <=5 suppressions, every one carrying a reason."""

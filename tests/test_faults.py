@@ -1,0 +1,477 @@
+"""Failure domains: fault injection (runtime/faults.py), the dispatch
+watchdog + circuit breaker (runtime/watchdog.py), graceful CPU
+degradation, shuffle blob integrity recovery, and the retry-backoff +
+is_device_oom satellites."""
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.expr.core import SparkException, col
+from spark_rapids_tpu.runtime import faults, watchdog
+from spark_rapids_tpu.runtime.faults import InjectedFaultError
+from spark_rapids_tpu.runtime.retry import (
+    OomInjector, TpuRetryOOM, is_device_oom, set_backoff, with_retry,
+    with_retry_no_split,
+)
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSession
+
+
+def _table(rows=2000, seed=11):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 7, rows),
+        "v": rng.integers(-1000, 1000, rows),
+    })
+
+
+def _session(**conf):
+    base = {"spark.rapids.sql.reader.batchSizeRows": "512"}
+    base.update(conf)
+    return TpuSession(base)
+
+
+def _agg(sess, t, parts=1):
+    return sess.create_dataframe(t, num_partitions=parts) \
+        .group_by("k").agg(F.sum(col("v")).alias("s"))
+
+
+def _canon(table):
+    return sorted(table.to_pylist(), key=repr)
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector unit behavior
+# ---------------------------------------------------------------------------
+
+def test_spec_grammar_roundtrip():
+    sched = faults.parse_spec(
+        "scan.decode:ioerror:3,1;shuffle.read:corrupt;retry.oom:oom:2")
+    assert set(sched) == {"scan.decode", "shuffle.read", "retry.oom"}
+    s = sched["scan.decode"][0]
+    assert (s.kind, s.remaining, s.skip) == ("ioerror", 3, 1)
+    assert sched["shuffle.read"][0].remaining == 1
+
+
+@pytest.mark.parametrize("spec,frag", [
+    ("nosuch.site:ioerror", "unknown fault site"),
+    ("scan.decode:explode", "unknown fault kind"),
+    ("scan.decode:corrupt", "data site"),
+    ("scan.decode", "expected"),
+    ("scan.decode:ioerror:x", "count/skip"),
+])
+def test_spec_grammar_rejects(spec, frag):
+    with pytest.raises(ValueError, match=frag):
+        faults.parse_spec(spec)
+
+
+def test_site_count_skip_and_disarm():
+    faults.configure("scan.decode:ioerror:2,1")
+    faults.site("scan.decode")  # skipped pass
+    with pytest.raises(InjectedFaultError):
+        faults.site("scan.decode")
+    with pytest.raises(InjectedFaultError):
+        faults.site("scan.decode")
+    faults.site("scan.decode")  # schedule exhausted -> disarmed
+    assert not faults.armed("scan.decode")
+    assert faults.fault_counts().get("scan.decode", 0) >= 2
+
+
+def test_site_bytes_corrupt_and_delay():
+    faults.configure("shuffle.read:corrupt:1", delay_ms=1.0)
+    data = b"x" * 64
+    bad = faults.site_bytes("shuffle.read", data)
+    assert bad != data and len(bad) == len(data)
+    assert faults.site_bytes("shuffle.read", data) == data  # exhausted
+    faults.configure("scan.decode:delay:1", delay_ms=40.0)
+    t0 = time.perf_counter()
+    faults.site("scan.decode")
+    assert time.perf_counter() - t0 >= 0.03
+
+
+def test_oom_kind_raises_retryable():
+    faults.configure("retry.oom:oom:1")
+    with pytest.raises(TpuRetryOOM):
+        faults.site("retry.oom")
+
+
+def test_disabled_is_noop():
+    faults.configure("")
+    assert not faults.armed("scan.decode")
+    faults.site("scan.decode")
+    assert faults.site_bytes("shuffle.read", b"ab") == b"ab"
+
+
+def test_retry_loop_consumes_injected_oom():
+    faults.configure("retry.oom:oom:2")
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        return 42
+
+    set_backoff(0.0, 0.0)
+    assert with_retry_no_split(attempt) == 42
+    assert len(calls) == 1  # two injected OOMs fired BEFORE the attempt
+
+
+# ---------------------------------------------------------------------------
+# retry satellites: backoff + narrowed is_device_oom
+# ---------------------------------------------------------------------------
+
+def test_retry_backoff_folds_into_block_time():
+    from spark_rapids_tpu.runtime.task import TaskContext
+    OomInjector.configure(num_ooms=2)
+    set_backoff(30.0, 100.0)
+    t0 = time.perf_counter()
+    with TaskContext() as ctx:
+        assert with_retry_no_split(lambda: 7) == 7
+        blocked = ctx.metric("retryBlockTime").value
+    elapsed = time.perf_counter() - t0
+    # attempts 1+2 back off >= (30+60)/2 ms at minimum jitter
+    assert elapsed >= 0.04, elapsed
+    assert blocked >= 0.04e9, blocked
+
+
+def test_retry_backoff_zero_base_disables():
+    OomInjector.configure(num_ooms=2)
+    set_backoff(0.0, 0.0)
+    t0 = time.perf_counter()
+    assert with_retry_no_split(lambda: 7) == 7
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_is_device_oom_requires_jax_origin():
+    # a USER exception whose message merely contains the magic strings
+    # must not be swallowed into the retry loop
+    assert not is_device_oom(RuntimeError("Out of memory"))
+    assert not is_device_oom(ValueError("RESOURCE_EXHAUSTED"))
+
+    class FakeXla(RuntimeError):
+        pass
+
+    FakeXla.__module__ = "jaxlib.xla_extension"
+    assert is_device_oom(FakeXla("RESOURCE_EXHAUSTED: Out of memory"))
+    assert not is_device_oom(FakeXla("something else entirely"))
+
+
+def test_user_oom_message_not_retried():
+    set_backoff(0.0, 0.0)
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        raise RuntimeError("Out of memory in user code")
+
+    with pytest.raises(RuntimeError, match="user code"):
+        with_retry_no_split(attempt)
+    assert len(calls) == 1  # no retry loop, no drain
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    b = watchdog.CircuitBreaker(failure_threshold=2, base_backoff_s=0.05,
+                                max_backoff_s=1.0)
+    assert b.allow() and b.state == "closed"
+    b.record_failure("E1")
+    assert b.state == "closed"
+    b.record_failure("E2")
+    assert b.state == "open"
+    assert not b.allow()  # backoff not elapsed
+    time.sleep(0.06)
+    assert b.allow()  # transitions to half-open, grants ONE probe
+    assert b.state == "half_open"
+    assert not b.allow()  # second caller waits for the probe's verdict
+    b.record_failure("E3")  # probe failed: open again, doubled backoff
+    assert b.state == "open"
+    assert b.state_doc()["backoff_s"] == pytest.approx(0.1)
+    time.sleep(0.11)
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+    assert b.state_doc()["backoff_s"] == pytest.approx(0.05)
+
+
+def test_breaker_half_open_reprobe_after_unrecorded_verdict():
+    """A probe whose outcome is never recorded (the probe query failed
+    with a user error, or was interrupted) must not wedge the breaker
+    half-open forever: after another backoff window a new probe is
+    granted."""
+    b = watchdog.CircuitBreaker(failure_threshold=1, base_backoff_s=0.05,
+                                max_backoff_s=1.0)
+    b.record_failure("E")
+    time.sleep(0.06)
+    assert b.allow()  # half-open probe granted
+    assert not b.allow()  # probe in flight
+    time.sleep(0.06)  # ... and its verdict never arrives
+    assert b.allow()  # re-probe instead of permanent half-open
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_watchdog_detects_wedged_dispatch():
+    watchdog.uninstall_for_tests()
+    wd = watchdog.DispatchWatchdog(timeout_s=0.05)
+    wd.start()
+    try:
+        with wd.guard("device.dispatch"):
+            time.sleep(0.2)
+        deadline = time.time() + 2
+        while wd.timeouts_reported == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert wd.timeouts_reported == 1
+        with wd.guard("device.dispatch"):
+            pass  # fast dispatch: no report
+        time.sleep(0.1)
+        assert wd.timeouts_reported == 1
+        assert watchdog.breaker().state_doc()["last_error_class"] == \
+            "DispatchTimeout"
+    finally:
+        wd.stop()
+        watchdog.uninstall_for_tests()
+
+
+def test_watchdog_disabled_guard_is_null():
+    watchdog.uninstall_for_tests()
+    assert not watchdog.active()
+    with watchdog.guard("device.dispatch") as g:
+        assert g is None
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation (session layer)
+# ---------------------------------------------------------------------------
+
+def test_degrades_to_cpu_with_correct_results():
+    t = _table()
+    clean = _canon(_agg(_session(), t).collect())
+    s = _session(**{"spark.rapids.fallback.cpu.enabled": "true",
+                    "spark.rapids.debug.faults": "scan.decode:ioerror:99"})
+    out = _agg(s, t).collect()
+    assert _canon(out) == clean
+    assert s.last_action_status == ("degraded", "InjectedFaultError")
+
+
+def test_no_fallback_conf_raises():
+    s = _session(**{"spark.rapids.debug.faults": "scan.decode:ioerror:99"})
+    with pytest.raises(InjectedFaultError):
+        _agg(s, _table()).collect()
+    assert s.last_action_status == ("failed", None)
+
+
+def test_user_semantic_error_never_degrades():
+    # an ANSI arithmetic error is a USER error: it must surface even
+    # with fallback on (the CPU backend would raise it identically)
+    s = _session(**{"spark.rapids.fallback.cpu.enabled": "true",
+                    "spark.sql.ansi.enabled": "true"})
+    df = s.create_dataframe({"a": [1, 2, 3], "b": [1, 0, 2]}) \
+        .select((col("a") / col("b")).alias("q"))
+    with pytest.raises(SparkException):
+        df.collect()
+    assert s.last_action_status[0] == "failed"
+
+
+def test_exhausted_oom_retries_degrade():
+    s = _session(**{"spark.rapids.fallback.cpu.enabled": "true",
+                    "spark.rapids.retry.backoffBaseMs": "0",
+                    "spark.rapids.debug.faults": "retry.oom:oom:50"})
+    t = _table()
+    out = _agg(s, t).collect()
+    assert s.last_action_status[0] == "degraded"
+    assert _canon(out) == _canon(_agg(_session(), t).collect())
+
+
+def test_breaker_opens_and_skips_device():
+    watchdog.uninstall_for_tests()
+    t = _table()
+    s = _session(**{
+        "spark.rapids.fallback.cpu.enabled": "true",
+        "spark.rapids.watchdog.breakerFailureThreshold": "2",
+        "spark.rapids.watchdog.breakerBaseBackoffSeconds": "60",
+        "spark.rapids.debug.faults": "scan.decode:ioerror:99"})
+    for _ in range(2):
+        s.conf.set(C.FAULTS_SPEC, "scan.decode:ioerror:99")
+        _agg(s, t).collect()
+    assert watchdog.breaker().state == "open"
+    # breaker open: the device path is skipped entirely — the armed
+    # fault cannot fire because no scan runs on the engine
+    s.conf.set(C.FAULTS_SPEC, "scan.decode:ioerror:99")
+    before = faults.fault_counts().get("scan.decode", 0)
+    out = _agg(s, t).collect()
+    assert s.last_action_status == ("degraded", "circuit_open")
+    assert faults.fault_counts().get("scan.decode", 0) == before
+    assert _canon(out) == _canon(_agg(_session(), t).collect())
+
+
+def test_breaker_half_open_probe_recovers():
+    watchdog.uninstall_for_tests()
+    t = _table()
+    s = _session(**{
+        "spark.rapids.fallback.cpu.enabled": "true",
+        "spark.rapids.watchdog.breakerFailureThreshold": "1",
+        "spark.rapids.watchdog.breakerBaseBackoffSeconds": "0.05",
+        "spark.rapids.debug.faults": "scan.decode:ioerror:99"})
+    _agg(s, t).collect()
+    assert watchdog.breaker().state == "open"
+    time.sleep(0.06)
+    s.conf.set(C.FAULTS_SPEC, "")  # the fault "repaired itself"
+    out = _agg(s, t).collect()  # half-open probe succeeds on device
+    assert s.last_action_status == ("ok", None)
+    assert watchdog.breaker().state == "closed"
+    assert out.num_rows == 7
+
+
+def test_degradation_surfaces_in_history_and_obs(tmp_path):
+    from spark_rapids_tpu.runtime import obs
+    from spark_rapids_tpu.runtime.obs.history import QueryHistoryStore
+    obs.shutdown_for_tests()
+    try:
+        s = _session(**{
+            "spark.rapids.obs.historyDir": str(tmp_path),
+            "spark.rapids.fallback.cpu.enabled": "true",
+            "spark.rapids.debug.faults": "scan.decode:ioerror:99"})
+        _agg(s, _table()).collect()
+        recs = [r for r in QueryHistoryStore(str(tmp_path)).read_all()
+                if r.get("type") == "query"]
+        assert recs and recs[-1]["status"] == "degraded"
+        assert recs[-1]["degraded_reason"] == "InjectedFaultError"
+        assert recs[-1]["error_class"] == "InjectedFaultError"
+        st = obs.state()
+        assert st.registry.counter(
+            "rapids_queries_total", labels={"status": "degraded"}).value == 1
+        assert st.last_query["status"] == "degraded"
+        doc = obs.healthz()
+        assert doc["breaker"]["state"] in ("closed", "open")
+        assert doc["faults"].get("scan.decode", 0) >= 1
+        assert doc["queries"]["degraded"] == 1
+    finally:
+        obs.shutdown_for_tests()
+
+
+def test_healthz_degraded_while_breaker_open():
+    from spark_rapids_tpu.runtime import obs
+    obs.shutdown_for_tests()
+    watchdog.uninstall_for_tests()
+    try:
+        s = _session(**{
+            "spark.rapids.fallback.cpu.enabled": "true",
+            "spark.rapids.watchdog.breakerFailureThreshold": "1",
+            "spark.rapids.watchdog.breakerBaseBackoffSeconds": "60",
+            "spark.rapids.debug.faults": "scan.decode:ioerror:99"})
+        _agg(s, _table()).collect()
+        assert watchdog.breaker().state == "open"
+        doc = obs.healthz()
+        assert doc["status"] == "degraded"
+        assert doc["breaker"]["state"] == "open"
+    finally:
+        obs.shutdown_for_tests()
+        watchdog.uninstall_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# shuffle integrity: wire CRC + one-shot re-fetch recovery
+# ---------------------------------------------------------------------------
+
+def _shuffle_df(sess, t):
+    return sess.create_dataframe(t, num_partitions=2) \
+        .repartition(2, "k").group_by("k") \
+        .agg(F.sum(col("v")).alias("s"))
+
+
+def test_serde_crc_detects_corruption():
+    from spark_rapids_tpu.columnar.batch import from_arrow
+    from spark_rapids_tpu.shuffle import serde
+    blob = serde.serialize_batch(from_arrow(_table(200)), "zlib")
+    ok = serde.deserialize_batch(blob)
+    assert int(ok.num_rows) == 200
+    with pytest.raises(serde.ShuffleCorruptionError):
+        serde.deserialize_batch(faults.corrupt_bytes(blob))
+    # corruption in the codec/header region is caught too
+    bad = bytes([blob[0] ^ 0xFF]) + blob[1:]
+    with pytest.raises(serde.ShuffleCorruptionError):
+        serde.deserialize_batch(bad)
+    with pytest.raises(serde.ShuffleCorruptionError):
+        serde.deserialize_batch(b"\x01\x02")
+
+
+def test_shuffle_read_one_shot_corruption_recovers():
+    t = _table()
+    clean = _canon(_shuffle_df(_session(
+        **{"spark.rapids.shuffle.mode": "SERIALIZED"}), t).collect())
+    from spark_rapids_tpu.runtime import obs
+    obs.shutdown_for_tests()
+    try:
+        s = _session(**{"spark.rapids.shuffle.mode": "SERIALIZED",
+                        "spark.rapids.debug.faults":
+                        "shuffle.read:corrupt:1"})
+        out = _shuffle_df(s, t).collect()
+        assert s.last_action_status == ("ok", None)
+        assert _canon(out) == clean
+        st = obs.state()
+        assert st.registry.counter(
+            "rapids_shuffle_corruption_retries_total").value == 1
+    finally:
+        obs.shutdown_for_tests()
+
+
+def test_shuffle_write_persistent_corruption_degrades():
+    t = _table()
+    clean = _canon(_shuffle_df(_session(
+        **{"spark.rapids.shuffle.mode": "SERIALIZED"}), t).collect())
+    s = _session(**{"spark.rapids.shuffle.mode": "SERIALIZED",
+                    "spark.rapids.fallback.cpu.enabled": "true",
+                    "spark.rapids.debug.faults": "shuffle.write:corrupt:1"})
+    out = _shuffle_df(s, t).collect()
+    assert s.last_action_status == ("degraded", "ShuffleCorruptionError")
+    assert _canon(out) == clean
+
+
+def test_shuffle_write_corruption_without_fallback_raises():
+    from spark_rapids_tpu.shuffle.serde import ShuffleCorruptionError
+    s = _session(**{"spark.rapids.shuffle.mode": "SERIALIZED",
+                    "spark.rapids.debug.faults": "shuffle.write:corrupt:1"})
+    with pytest.raises(ShuffleCorruptionError):
+        _shuffle_df(s, _table()).collect()
+
+
+def test_spill_disk_fault_degrades():
+    t = _table()
+    s = _session(**{"spark.rapids.shuffle.mode": "SERIALIZED",
+                    "spark.rapids.shuffle.hostSpillBudget": "1024",
+                    "spark.rapids.fallback.cpu.enabled": "true",
+                    "spark.rapids.debug.faults": "spill.disk:ioerror:99"})
+    out = _shuffle_df(s, t).collect()
+    assert s.last_action_status == ("degraded", "InjectedFaultError")
+    assert _canon(out) == _canon(_shuffle_df(_session(
+        **{"spark.rapids.shuffle.mode": "SERIALIZED"}), t).collect())
+
+
+# ---------------------------------------------------------------------------
+# no leaked threads across chaos-shaped failures
+# ---------------------------------------------------------------------------
+
+def _non_service_threads():
+    allowed = ("rapids-host-pool", "rapids-obs", "rapids-watchdog")
+    return {t.name for t in threading.enumerate()
+            if not t.name.startswith(allowed)}
+
+
+def test_faulted_queries_leak_no_threads():
+    before = _non_service_threads()
+    t = _table()
+    for spec in ("scan.decode:ioerror:99", "pipeline.producer:ioerror:99",
+                 "device.dispatch:oom:50"):
+        s = _session(**{"spark.rapids.fallback.cpu.enabled": "true",
+                        "spark.rapids.retry.backoffBaseMs": "0",
+                        "spark.rapids.debug.faults": spec})
+        _agg(s, t, parts=2).collect()
+        assert s.last_action_status[0] in ("ok", "degraded")
+    time.sleep(0.2)
+    assert _non_service_threads() <= before

@@ -399,3 +399,92 @@ def test_traffic_controller_no_warning_below_threshold(caplog):
         ctrl.acquire(80)
     ctrl.release(80)
     assert not any("stalled" in r.message for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# producer-thread faults under the pipelined path (runtime/faults.py)
+# ---------------------------------------------------------------------------
+
+def test_injected_producer_death_fails_cleanly_no_leak():
+    """A pipeline.producer fault killing the refill must surface as the
+    query's exception (fallback off), with no leaked refill threads and
+    the pipeline boundary provably engaged."""
+    from spark_rapids_tpu.runtime.faults import InjectedFaultError
+    t = _table(60_000)
+    s = _session(**{"spark.rapids.debug.faults":
+                    "pipeline.producer:ioerror:1,3"})
+    df = (s.create_dataframe(t, num_partitions=1)
+          .filter(col("v") > lit(-900))
+          .group_by("k").agg(F.sum(col("v")).alias("sv")))
+    before = _non_pool_threads()
+    with pytest.raises(InjectedFaultError):
+        df.collect()
+    assert s.last_action_status == ("failed", None)
+    time.sleep(0.2)
+    assert _non_pool_threads() == before
+
+
+def test_injected_producer_death_degrades_with_correct_results():
+    """Same producer death with CPU fallback on: the query must end
+    degraded with results identical to the clean run."""
+    t = _table(60_000)
+
+    def q(s):
+        return (s.create_dataframe(t, num_partitions=1)
+                .filter(col("v") > lit(-900))
+                .group_by("k").agg(F.sum(col("v")).alias("sv")))
+
+    expected = _norm(q(_session()).collect())
+    s = _session(**{"spark.rapids.fallback.cpu.enabled": "true",
+                    "spark.rapids.debug.faults":
+                    "pipeline.producer:ioerror:1,3"})
+    before = _non_pool_threads()
+    got = _norm(q(s).collect())
+    assert s.last_action_status == ("degraded", "InjectedFaultError")
+    assert got == expected
+    time.sleep(0.2)
+    assert _non_pool_threads() == before
+
+
+def test_shuffle_read_corruption_recovers_under_pipelined_path():
+    """One-shot shuffle.read corruption with the pipelined SERIALIZED
+    writer engaged: the blob re-fetch must recover transparently and the
+    result must match the clean pipelined run."""
+    t = _table(24_000)
+    conf = {"spark.rapids.shuffle.mode": "SERIALIZED",
+            "spark.rapids.shuffle.multiThreaded.writer.threads": "4"}
+
+    def q(s):
+        return (s.create_dataframe(t, num_partitions=4)
+                .group_by("k").agg(F.count().alias("n"),
+                                   F.sum(col("v")).alias("sv")))
+
+    expected = _norm(q(_session(**conf)).collect())
+    s = _session(**dict(conf, **{
+        "spark.rapids.debug.faults": "shuffle.read:corrupt:1"}))
+    before = _non_pool_threads()
+    got = _norm(q(s).collect())
+    assert s.last_action_status == ("ok", None)
+    assert got == expected
+    time.sleep(0.2)
+    assert _non_pool_threads() == before
+
+
+def test_shuffle_read_persistent_corruption_fails_cleanly():
+    """Corruption on BOTH the read and its re-fetch must fail the query
+    (fallback off) without hanging or leaking refill threads."""
+    from spark_rapids_tpu.shuffle.serde import ShuffleCorruptionError
+    t = _table(24_000)
+    # count 99 = PERSISTENT corruption: every read AND every re-fetch
+    # corrupts, so recovery must give up after its single retry (small
+    # counts can spread over concurrent partition tasks' reads, each
+    # recovering independently)
+    s = _session(**{"spark.rapids.shuffle.mode": "SERIALIZED",
+                    "spark.rapids.debug.faults": "shuffle.read:corrupt:99"})
+    df = (s.create_dataframe(t, num_partitions=4)
+          .group_by("k").agg(F.sum(col("v")).alias("sv")))
+    before = _non_pool_threads()
+    with pytest.raises(ShuffleCorruptionError):
+        df.collect()
+    time.sleep(0.2)
+    assert _non_pool_threads() == before

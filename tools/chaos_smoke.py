@@ -1,0 +1,329 @@
+"""Chaos smoke: seeded fault injection over NDS probe queries.
+
+The failure-domain acceptance gate (the robustness twin of
+sanitizer_smoke/trace_overhead):
+
+Gate 1 (overhead, the tracing bar): the DISABLED fault hooks
+(`faults.site`/`site_bytes` with no schedule armed — one module-global
+read each; the watchdog adds literally nothing when off because
+exec/fuse.py returns the raw jitted function) must cost under
+--tolerance (2%) of a clean query drive. Same methodology as
+tools/sanitizer_smoke.py: count hook passes in one drive, measure the
+disabled per-pass cost minus an empty-call baseline over tight-loop
+iterations, multiply.
+
+Gate 2 (chaos): with a FIXED seed, run the probe query set under
+randomized injection schedules (spec strings generated from the seeded
+RNG — a failing schedule is reproducible from the seed alone) until at
+least --min-faults faults have fired across at least --min-sites
+distinct sites. EVERY run must end status ok or degraded with results
+identical to the clean run of the same query — never a wrong answer,
+never an unhandled failure.
+
+Gate 3 (no hangs, no leaks): the whole smoke runs under a global
+deadline enforced by a watchdog thread (stack dump + hard exit on
+breach), and the thread census at the end must contain nothing beyond
+the sanctioned long-lived services (host pool, obs, watchdog) — a
+leaked pipeline refill or task thread fails the gate.
+
+Run:  python tools/chaos_smoke.py [--seed 20260803] [--sf 0.002]
+          [--max-rounds 14] [--min-faults 200] [--min-sites 6]
+          [--deadline 480] [--tolerance 0.02]
+"""
+from __future__ import annotations
+
+import argparse
+import faulthandler
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import nds_probe as NDS  # noqa: E402
+
+from spark_rapids_tpu import config as C  # noqa: E402
+from spark_rapids_tpu.runtime import faults, watchdog  # noqa: E402
+from spark_rapids_tpu.sql.session import TpuSession  # noqa: E402
+
+#: probe queries: join + aggregate shapes so exchanges, retries, spills
+#: and pipelines all engage (broadcast disabled below forces the joins
+#: through real SERIALIZED shuffles)
+CHAOS_QUERIES = (3, 7, 42, 52, 55)
+
+#: (site, eligible kinds) the schedule generator draws from. Kind
+#: weights favor delay (fires without failing the run, so fault volume
+#: accumulates fast) while keeping every failure class in rotation.
+SITE_KINDS = (
+    ("scan.decode", ("delay", "delay", "ioerror", "oom")),
+    ("shuffle.read", ("delay", "corrupt", "corrupt", "ioerror")),
+    ("shuffle.write", ("delay", "delay", "corrupt")),
+    ("spill.disk", ("delay", "delay", "ioerror")),
+    ("device.dispatch", ("delay", "delay", "wedge", "oom")),
+    ("pipeline.producer", ("delay", "delay", "ioerror", "oom")),
+    ("exchange.fetch", ("delay", "delay", "ioerror")),
+    ("retry.oom", ("oom",)),
+)
+
+CHAOS_CONF = {
+    # real serialized shuffles (blob integrity, store spill) on every
+    # exchange; broadcast disabled so the probe joins actually shuffle
+    "spark.rapids.shuffle.mode": "SERIALIZED",
+    "spark.rapids.sql.join.broadcastRowThreshold": "1",
+    "spark.rapids.sql.adaptive.enabled": "false",
+    "spark.rapids.sql.reader.batchSizeRows": "2048",
+    # tiny store budget: every few blobs spill to disk (spill.disk site)
+    "spark.rapids.shuffle.hostSpillBudget": "8192",
+    "spark.rapids.fallback.cpu.enabled": "true",
+    "spark.rapids.watchdog.enabled": "true",
+    # wedge (1.0s) ABOVE the watchdog timeout (0.6s): every wedge-kind
+    # fault must drive the full wedge -> watchdogDispatchTimeout ->
+    # breaker-failure path, not just sleep unnoticed. Steady dispatches
+    # stay well under 0.6s; a first-compile overshoot merely adds a
+    # harmless report against the high breaker threshold.
+    "spark.rapids.watchdog.dispatchTimeoutSeconds": "0.6",
+    # chaos wants the DEVICE path exercised every round: a latched-open
+    # breaker would route everything to CPU and starve the fault sites
+    "spark.rapids.watchdog.breakerFailureThreshold": "1000",
+    "spark.rapids.retry.backoffBaseMs": "1",
+    "spark.rapids.debug.faults.delayMs": "5",
+    "spark.rapids.debug.faults.wedgeSeconds": "1.0",
+}
+
+
+def _arm_deadline(seconds: float):
+    """Global hang-breaker: past the deadline, dump every thread's stack
+    and hard-exit — a wedged chaos run must fail loudly, not hang CI."""
+    done = threading.Event()
+
+    def trip():
+        if not done.wait(seconds):
+            print(f"FAIL: chaos smoke exceeded the {seconds:.0f}s global "
+                  f"deadline — dumping stacks", file=sys.stderr)
+            faulthandler.dump_traceback(file=sys.stderr)
+            os._exit(3)
+
+    t = threading.Thread(target=trip, name="chaos-deadline", daemon=True)
+    t.start()
+    return done
+
+
+def _gen_spec(rng: random.Random) -> str:
+    """One round's injection schedule: 2-4 entries drawn from the
+    site/kind table with small counts and skips."""
+    n = rng.randint(2, 4)
+    parts = []
+    for _ in range(n):
+        site, kinds = SITE_KINDS[rng.randrange(len(SITE_KINDS))]
+        kind = kinds[rng.randrange(len(kinds))]
+        count = rng.randint(1, 4)
+        skip = rng.randint(0, 2)
+        parts.append(f"{site}:{kind}:{count},{skip}")
+    return ";".join(parts)
+
+
+def _canon(table):
+    return NDS._canon_rows(table)
+
+
+def _overhead_gate(session, dfs, tolerance: float) -> dict:
+    """Gate 1: disabled-hook cost of one clean drive (sanitizer_smoke
+    methodology)."""
+    session.conf.set(C.FAULTS_SPEC, "")
+    session.conf.set(C.WATCHDOG_ENABLED, False)
+
+    def drive():
+        NDS.QUERIES[CHAOS_QUERIES[0]](session, dfs).collect()
+
+    drive()  # warm kernel caches
+    best = min((lambda t0=time.perf_counter(): (drive(),
+                time.perf_counter() - t0)[1])() for _ in range(3))
+
+    counts = {"passes": 0}
+    orig_site, orig_bytes = faults.site, faults.site_bytes
+
+    def csite(name):
+        counts["passes"] += 1
+        return orig_site(name)
+
+    def cbytes(name, data):
+        counts["passes"] += 1
+        return orig_bytes(name, data)
+
+    faults.site, faults.site_bytes = csite, cbytes
+    try:
+        drive()
+    finally:
+        faults.site, faults.site_bytes = orig_site, orig_bytes
+
+    def loop(fn, iters=100_000):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn("scan.decode")
+        return (time.perf_counter() - t0) / iters
+
+    def baseline(_name):
+        return None
+
+    base = min(loop(baseline) for _ in range(3))
+    cost = min(loop(orig_site) for _ in range(3))
+    delta = max(cost - base, 0.0)
+    added = counts["passes"] * delta
+    overhead = added / best if best else 0.0
+    return {
+        "drive_best_s": round(best, 5),
+        "hook_passes_per_drive": counts["passes"],
+        "per_pass_delta_ns": round(delta * 1e9, 1),
+        "disabled_overhead_pct": round(overhead * 100, 4),
+        "ok": counts["passes"] > 0 and overhead <= tolerance,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=20260803)
+    ap.add_argument("--sf", type=float, default=0.002)
+    ap.add_argument("--max-rounds", type=int, default=14)
+    ap.add_argument("--min-faults", type=int, default=200)
+    ap.add_argument("--min-sites", type=int, default=6)
+    ap.add_argument("--deadline", type=float, default=480.0)
+    ap.add_argument("--tolerance", type=float, default=0.02)
+    args = ap.parse_args()
+
+    deadline_done = _arm_deadline(args.deadline)
+    threads_before = {t.name for t in threading.enumerate()}
+
+    watchdog.uninstall_for_tests()
+    faults.reset_counters()
+    session = TpuSession(dict(CHAOS_CONF))
+    dfs = {name: session.create_dataframe(t, num_partitions=2)
+           for name, t in NDS.gen_tables(args.sf, seed=args.seed).items()}
+
+    # gate 1 first: the overhead half measures DISABLED hooks, before
+    # any chaos schedule or watchdog state exists
+    ov = _overhead_gate(session, dfs, args.tolerance)
+    session.conf.set(C.WATCHDOG_ENABLED, True)
+
+    # clean expected results (same confs, no faults)
+    session.conf.set(C.FAULTS_SPEC, "")
+    expected = {}
+    for qn in CHAOS_QUERIES:
+        expected[qn] = _canon(NDS.QUERIES[qn](session, dfs).collect())
+        assert session.last_action_status[0] == "ok", \
+            f"clean run of q{qn} not ok: {session.last_action_status}"
+
+    rng = random.Random(args.seed)
+    runs = []
+    failures = []
+    rounds = 0
+    while rounds < args.max_rounds:
+        rounds += 1
+        for qn in CHAOS_QUERIES:
+            spec = _gen_spec(rng)
+            session.conf.set(C.FAULTS_SPEC, spec)
+            fired0 = faults.total_fired()
+            t0 = time.perf_counter()
+            try:
+                result = NDS.QUERIES[qn](session, dfs).collect()
+                status, reason = session.last_action_status
+                correct = _canon(result) == expected[qn]
+            except BaseException as e:  # noqa: BLE001 - a chaos run may
+                # never raise: ok or degraded are the only legal ends
+                status, reason, correct = "raised", type(e).__name__, False
+            rec = {"q": qn, "spec": spec, "status": status,
+                   "reason": reason, "correct": correct,
+                   "fired": faults.total_fired() - fired0,
+                   "seconds": round(time.perf_counter() - t0, 3)}
+            runs.append(rec)
+            if status not in ("ok", "degraded") or not correct:
+                failures.append(rec)
+        if faults.total_fired() >= args.min_faults and \
+                len(faults.fault_counts()) >= args.min_sites:
+            break
+
+    session.conf.set(C.FAULTS_SPEC, "")
+    faults.configure("")  # disarm leftovers before the thread census
+    wedge_specs = sum(1 for r in runs if ":wedge" in r["spec"])
+    from spark_rapids_tpu.runtime import obs
+    st = obs.state()
+    watchdog_timeouts = int(st.registry.counter(
+        "rapids_watchdog_dispatch_timeouts_total").value) if st else 0
+    watchdog.uninstall_for_tests()
+    time.sleep(0.3)  # drained pool/service threads settle
+
+    allowed = ("rapids-host-pool", "rapids-obs", "rapids-task",
+               "chaos-deadline", "pymain", "MainThread")
+    leaked = sorted(
+        t.name for t in threading.enumerate()
+        if t.name not in threads_before
+        and not any(t.name.startswith(p) for p in allowed))
+
+    counts = faults.fault_counts()
+    result = {
+        "seed": args.seed,
+        "rounds": rounds,
+        "runs": len(runs),
+        "faults_fired": faults.total_fired(),
+        "distinct_sites": sorted(counts),
+        "per_site": counts,
+        "degraded_runs": sum(1 for r in runs if r["status"] == "degraded"),
+        "ok_runs": sum(1 for r in runs if r["status"] == "ok"
+                       and r["correct"]),
+        "failures": failures[:10],
+        "leaked_threads": leaked,
+        "wedge_specs": wedge_specs,
+        "watchdog_timeouts": watchdog_timeouts,
+        "overhead": ov,
+    }
+    print(json.dumps(result))
+
+    ok = True
+    if failures:
+        print(f"FAIL: {len(failures)} chaos run(s) ended outside "
+              f"ok/degraded or with wrong results:\n"
+              + "\n".join(json.dumps(f) for f in failures[:10]),
+              file=sys.stderr)
+        ok = False
+    if result["faults_fired"] < args.min_faults:
+        print(f"FAIL: only {result['faults_fired']} faults fired "
+              f"(need >= {args.min_faults})", file=sys.stderr)
+        ok = False
+    if len(counts) < args.min_sites:
+        print(f"FAIL: only {len(counts)} distinct sites fired "
+              f"({sorted(counts)}; need >= {args.min_sites})",
+              file=sys.stderr)
+        ok = False
+    if leaked:
+        print(f"FAIL: leaked threads after chaos: {leaked}",
+              file=sys.stderr)
+        ok = False
+    if wedge_specs and watchdog_timeouts == 0:
+        print(f"FAIL: {wedge_specs} schedule(s) included a wedge fault "
+              f"but the watchdog reported no dispatch timeouts — the "
+              f"wedge->watchdog->breaker path never ran", file=sys.stderr)
+        ok = False
+    if not ov["ok"]:
+        print(f"FAIL: disabled fault-hook overhead "
+              f"{ov['disabled_overhead_pct']}% exceeds "
+              f"{args.tolerance * 100:.1f}% (or no hook passes counted)",
+              file=sys.stderr)
+        ok = False
+
+    deadline_done.set()
+    if not ok:
+        return 1
+    print(f"PASS: {result['faults_fired']} faults across "
+          f"{len(counts)} sites over {len(runs)} runs "
+          f"({result['degraded_runs']} degraded, all correct); no "
+          f"leaked threads; disabled-hook overhead "
+          f"{ov['disabled_overhead_pct']}%")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
-# obs smoke, pipeline smoke, tier-1 tests.
+# chaos smoke, obs smoke, pipeline smoke, tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
@@ -38,6 +38,11 @@ fi
 
 step "sanitizer smoke (disabled lock proxies <2%; seeded inversion + held-lock caught; clean engine silent)"
 if ! python tools/sanitizer_smoke.py; then
+    fail=1
+fi
+
+step "chaos smoke (seeded fault injection over NDS probe queries: every run ok/degraded with clean-run results, no hangs/leaks; disabled fault-hook overhead <2%)"
+if ! python tools/chaos_smoke.py; then
     fail=1
 fi
 

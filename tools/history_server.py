@@ -45,6 +45,7 @@ th, td { border: 1px solid #d0d0e0; padding: 4px 10px; text-align: left;
          font-size: 14px; }
 th { background: #f0f0f8; }
 tr.failed td { background: #fde8e8; }
+tr.degraded td { background: #fff4de; }
 pre { background: #f6f6fb; padding: 1em; overflow-x: auto;
       font-size: 13px; line-height: 1.45; }
 .hot { background: #ffe2b8; font-weight: bold; }
@@ -52,6 +53,7 @@ pre { background: #f6f6fb; padding: 1em; overflow-x: auto;
 .delta-up { color: #b00020; font-weight: bold; }
 .delta-down { color: #0a7a2f; font-weight: bold; }
 .badge-ok { color: #0a7a2f; } .badge-failed { color: #b00020; }
+.badge-degraded { color: #b06f00; }
 h1, h2 { font-weight: 600; }
 a { color: #3949ab; }
 small.digest { font-family: monospace; color: #666; }
@@ -119,6 +121,8 @@ def render_query_page(rec: dict) -> str:
 
     body = [f"<p>status <b class='badge-{rec.get('status', 'ok')}'>"
             f"{_esc(rec.get('status'))}</b>"
+            + (f" [degraded to CPU: {_esc(rec.get('degraded_reason'))}]"
+               if rec.get("degraded_reason") else "")
             + (f" ({_esc(rec.get('error_class'))}: "
                f"{_esc(rec.get('error', ''))})"
                if rec.get("error_class") else "")
