@@ -41,6 +41,12 @@ TPU-L008  every string-literal fault-site name at a
           ``runtime/faults.py`` — an unregistered site can never fire
           from a conf spec, silently shrinking chaos coverage (the
           fault-site twin of TPU-L007).
+TPU-L009  every string-literal attribution-bucket name at an
+          ``attribution.record("...")`` call must be registered in the
+          ``BUCKETS`` roster of ``runtime/obs/attribution.py`` (and
+          every roster bucket must appear in generated docs/metrics.md)
+          — an unregistered bucket's time silently vanishes from every
+          attribution surface (the bucket twin of TPU-L007/L008).
 
 Suppression
 -----------
@@ -76,11 +82,17 @@ RULES: Dict[str, str] = {
                 "absent from docs/metrics.md)",
     "TPU-L008": "fault-site name not registered in the runtime/faults.py "
                 "SITES roster",
+    "TPU-L009": "attribution-bucket name not registered in the "
+                "runtime/obs/attribution.py BUCKETS roster",
 }
 
 #: receiver names under which a .site()/.site_bytes() call is the fault
 #: injector (the engine imports it as `faults`, `_faults`, or `FLT`)
 _FAULTS_BASES = {"faults", "_faults", "flt"}
+
+#: receiver names under which a .record() call is the attribution engine
+#: (imported as `attribution`, `_attr`, `ATTR`, or `attr`)
+_ATTR_BASES = {"attribution", "_attr", "attr"}
 
 _DISABLE_RE = re.compile(
     r"#\s*tpulint:\s*disable=(TPU-L\d{3})\b[ \t]*(.*)")
@@ -176,12 +188,14 @@ def _is_span_call(expr: ast.AST) -> bool:
 
 class _FileLinter(ast.NodeVisitor):
     def __init__(self, path: str, source: str, known_metrics: Set[str],
-                 relpath: str, known_sites: Optional[Set[str]] = None):
+                 relpath: str, known_sites: Optional[Set[str]] = None,
+                 known_buckets: Optional[Set[str]] = None):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
         self.known_metrics = known_metrics
         self.known_sites = known_sites
+        self.known_buckets = known_buckets
         self.violations: List[Violation] = []
         # stack of (lock_keys, with_lineno) for held-lock regions
         self._lock_stack: List[Tuple[Set[str], int]] = []
@@ -328,6 +342,7 @@ class _FileLinter(ast.NodeVisitor):
         self._check_host_sync(node)
         self._check_metric_name(node)
         self._check_fault_site(node)
+        self._check_attr_bucket(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -464,6 +479,28 @@ class _FileLinter(ast.NodeVisitor):
                        f"conf specs, /healthz counters, and chaos "
                        f"coverage know it exists")
 
+    # -- TPU-L009 ----------------------------------------------------------
+
+    def _check_attr_bucket(self, node: ast.Call) -> None:
+        if self.known_buckets is None:
+            return
+        if _terminal(node.func) != "record":
+            return
+        base = _base_name(node.func)
+        if base is None or base.lower() not in _ATTR_BASES:
+            return
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            return
+        name = node.args[0].value
+        if name not in self.known_buckets:
+            self._emit("TPU-L009", node,
+                       f"attribution bucket {name!r} is not registered "
+                       f"in the runtime/obs/attribution.py BUCKETS "
+                       f"roster — register it so explain/history/"
+                       f"metrics/docs attribution surfaces stay "
+                       f"complete")
+
 
 # ---------------------------------------------------------------------------
 # Registry extraction (AST-only: no engine import)
@@ -519,6 +556,28 @@ def known_fault_sites(pkg_root: str) -> Set[str]:
     return sites
 
 
+def known_attr_buckets(pkg_root: str) -> Set[str]:
+    """Registered attribution-bucket names: the keys of the BUCKETS dict
+    literal in runtime/obs/attribution.py (AST-only, like
+    known_fault_sites)."""
+    buckets: Set[str] = set()
+    apath = os.path.join(pkg_root, "runtime", "obs", "attribution.py")
+    if not os.path.exists(apath):
+        return buckets
+    tree = ast.parse(open(apath).read(), apath)
+    for stmt in tree.body:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else \
+            [stmt.target] if isinstance(stmt, ast.AnnAssign) else []
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "BUCKETS" \
+                    and isinstance(getattr(stmt, "value", None), ast.Dict):
+                for k in stmt.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        buckets.add(k.value)
+    return buckets
+
+
 def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
     """Metric names documented in docs/metrics.md (None when the file is
     missing — the doc-presence half of TPU-L007 then reports once)."""
@@ -537,11 +596,14 @@ def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
 
 def lint_source(source: str, path: str, known_metrics: Set[str],
                 relpath: Optional[str] = None,
-                known_sites: Optional[Set[str]] = None) -> List[Violation]:
+                known_sites: Optional[Set[str]] = None,
+                known_buckets: Optional[Set[str]] = None
+                ) -> List[Violation]:
     tree = ast.parse(source, path)
     linter = _FileLinter(path, source, known_metrics,
                          relpath if relpath is not None else path,
-                         known_sites=known_sites)
+                         known_sites=known_sites,
+                         known_buckets=known_buckets)
     linter.visit(tree)
     return linter.violations
 
@@ -553,6 +615,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
     pkg_root = os.path.join(repo_root, "spark_rapids_tpu")
     known = known_metric_names(pkg_root)
     sites = known_fault_sites(pkg_root)
+    buckets = known_attr_buckets(pkg_root)
     violations: List[Violation] = []
     n_files = 0
     for dirpath, dirnames, filenames in os.walk(pkg_root):
@@ -565,7 +628,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
             rel = os.path.relpath(path, pkg_root)
             violations.extend(lint_source(
                 open(path).read(), path, known, relpath=rel,
-                known_sites=sites))
+                known_sites=sites, known_buckets=buckets))
     documented = docs_metric_names(repo_root)
     mpath = os.path.join(pkg_root, "runtime", "metrics.py")
     if documented is None:
@@ -579,6 +642,13 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 "TPU-L007", mpath, 1,
                 f"registered metric {name!r} absent from docs/metrics.md "
                 f"— regenerate with 'python tools/gen_docs.py'"))
+        apath = os.path.join(pkg_root, "runtime", "obs", "attribution.py")
+        for name in sorted(buckets - documented):
+            violations.append(Violation(
+                "TPU-L009", apath, 1,
+                f"attribution bucket {name!r} absent from "
+                f"docs/metrics.md — regenerate with "
+                f"'python tools/gen_docs.py'"))
     stats = {
         "files": n_files,
         "violations": sum(1 for v in violations if not v.suppressed),

@@ -220,6 +220,76 @@ OBS_PROBE_TIMEOUT_MS = conf_int(
     "exceeds it reports the device as blocked and flips the endpoint "
     "to degraded (503).")
 
+OBS_FLIGHT_ENABLED = conf_bool(
+    "spark.rapids.obs.flight.enabled", True,
+    "Run the always-on flight recorder (runtime/obs/flight.py): a "
+    "bounded per-thread ring of the most recent span/instant events, "
+    "fed from the SAME instrumentation points structured tracing uses, "
+    "auto-dumped as a Chrome-trace file when a query fails or degrades, "
+    "the dispatch watchdog reports a wedge, the circuit breaker opens, "
+    "or a query breaches its SLO — so failures get a timeline "
+    "retroactively even with spark.rapids.sql.trace.enabled off. The "
+    "hot path takes no locks (one tuple store per recorded event; "
+    "DEBUG-level events are filtered); overhead is gated <2% by "
+    "tools/flight_smoke.py.", commonly_used=True)
+
+OBS_FLIGHT_PATH = conf_str(
+    "spark.rapids.obs.flight.path", "/tmp/rapids_tpu_flight",
+    "Directory receiving flight-recorder dumps "
+    "(flight_<seq>_<reason>.json, Chrome-trace/Perfetto loadable).")
+
+OBS_FLIGHT_EVENTS = conf_int(
+    "spark.rapids.obs.flight.events", 2048,
+    "Per-thread ring capacity of the flight recorder: how many recent "
+    "span/instant events each thread retains for a retroactive dump. "
+    "Older events are overwritten; the dump reports how many were "
+    "dropped.")
+
+OBS_FLIGHT_MIN_INTERVAL_S = conf_float(
+    "spark.rapids.obs.flight.minIntervalSeconds", 5.0,
+    "Rate limit between flight-recorder dumps: a failure storm dumps at "
+    "most one timeline per interval instead of one per failing query. "
+    "0 disables the limit (tests).")
+
+OBS_FLIGHT_MAX_DUMPS = conf_int(
+    "spark.rapids.obs.flight.maxDumps", 50,
+    "Bounded retention: only the newest N flight dump files are kept in "
+    "spark.rapids.obs.flight.path; older ones are pruned after each "
+    "dump.")
+
+OBS_SLO_ENABLED = conf_bool(
+    "spark.rapids.obs.slo.enabled", True,
+    "Check every successful top-level query against its SLO "
+    "(runtime/obs/slo.py): a per-plan-digest latency baseline built "
+    "from the query history (mean of the last slo.baselineWindow ok "
+    "runs, armed after slo.minRuns samples) times slo.baselineFactor, "
+    "plus the absolute bound slo.latencySeconds. A breach emits a "
+    "slowQuery instant, bumps rapids_slo_breaches_total, surfaces on "
+    "/healthz with its attribution summary, and triggers a "
+    "flight-recorder dump. Baselines seed from "
+    "spark.rapids.obs.historyDir when set, so they survive restarts.")
+
+OBS_SLO_FACTOR = conf_float(
+    "spark.rapids.obs.slo.baselineFactor", 3.0,
+    "A query breaches its SLO when its wall time exceeds the per-digest "
+    "baseline mean times this factor.")
+
+OBS_SLO_MIN_RUNS = conf_int(
+    "spark.rapids.obs.slo.minRuns", 5,
+    "Successful runs of a plan digest required before its baseline arms "
+    "(fewer samples would flag ordinary warm-up variance).")
+
+OBS_SLO_ABS_SECONDS = conf_float(
+    "spark.rapids.obs.slo.latencySeconds", 0.0,
+    "Absolute per-query latency SLO in seconds, checked regardless of "
+    "baseline state. 0 disables the absolute bound (the baseline check "
+    "still applies).")
+
+OBS_SLO_WINDOW = conf_int(
+    "spark.rapids.obs.slo.baselineWindow", 32,
+    "Successful runs per plan digest retained for the baseline mean "
+    "(a bounded sliding window, newest runs win).")
+
 LORE_DUMP_DIR = conf_str(
     "spark.rapids.sql.lore.dumpPath", "",
     "When set, every exec's input batches dump as parquet under "

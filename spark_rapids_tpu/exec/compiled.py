@@ -13,6 +13,7 @@ happen inside a trace).
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnVector, ColumnarBatch
 from spark_rapids_tpu.expr.core import EvalCtx, Expression, SparkException
+from spark_rapids_tpu.runtime.obs import attribution as _attr
 
 _STAGE_CACHE: Dict[Tuple, object] = {}
 
@@ -69,6 +71,7 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
     layout = tuple(_layout_key(c) for c in batch.columns)
     key = (fp, layout, batch.capacity, ansi)
     fn = _STAGE_CACHE.get(key)
+    fresh = fn is None
     in_dtypes = [c.dtype for c in batch.columns]
     out_dtypes = [e.data_type() for e in exprs]
 
@@ -94,10 +97,15 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
     col_planes = [_planes_of(c) for c in batch.columns]
     with TR.span("compiled.run_stage", cat="dispatch", level=TR.DEBUG,
                  args={"exprs": len(exprs)}):
+        _t0 = time.perf_counter_ns() if fresh else 0
         out_planes, err = fn(col_planes,
                              jnp.asarray(traced_rows(batch.num_rows),
                                          jnp.int32),
                              batch.live_mask())
+        if fresh:
+            # a fresh stage entry's first call pays XLA trace+compile:
+            # attribute it to the 'compile' bucket (attribution.py)
+            _attr.record("compile", time.perf_counter_ns() - _t0)
     raise_errors(err)
     outs = [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
     carry_bounds(exprs, batch.columns, outs)

@@ -29,10 +29,12 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from spark_rapids_tpu.runtime.obs import attribution, flight
 from spark_rapids_tpu.runtime.obs.history import (  # noqa: F401 (re-export)
     QueryHistoryStore, build_query_record, conf_delta, plan_digest,
 )
 from spark_rapids_tpu.runtime.obs.registry import MetricsRegistry
+from spark_rapids_tpu.runtime.obs.slo import SloDetector
 
 from spark_rapids_tpu.analysis import sanitizer as _san  # noqa: E402
 
@@ -78,10 +80,14 @@ class ObsState:
         self.history: Optional[QueryHistoryStore] = None
         self.server = None  # ObsHttpServer
         self.probe = None   # DeviceProbe
+        self.slo: Optional[SloDetector] = None
         self._lock = threading.Lock()
         self._query_seq = 0
         self._active = 0  # top-level queries currently running
         self.last_query: Optional[dict] = None
+        #: the most recent SLO breach: digest, breach doc, attribution
+        #: summary, flight-dump path (the /healthz slow-query surface)
+        self.last_slow: Optional[dict] = None
 
 
 #: per-thread collect depth: a re-entrant collect on the SAME thread is
@@ -131,6 +137,18 @@ def _preregister(reg: MetricsRegistry) -> None:
                 "Serialized shuffle bytes written to the host store")
     reg.counter("rapids_shuffle_bytes_spilled_total",
                 "Serialized shuffle bytes spilled to disk")
+    reg.counter("rapids_slo_breaches_total",
+                "Queries that exceeded their latency SLO "
+                "(spark.rapids.obs.slo.*)")
+    reg.counter("rapids_flight_dumps_total",
+                "Flight-recorder dumps written, by trigger",
+                labels={"reason": "query_failed"})
+    for phase in attribution.BUCKETS:
+        reg.float_counter(
+            "rapids_query_seconds_bucket",
+            "Per-query wall time attributed to each phase bucket "
+            "(seconds; runtime/obs/attribution.py)",
+            labels={"phase": phase})
     reg.histogram("rapids_query_wall_time_ms",
                   "Per-query wall time (ms)")
     reg.histogram("rapids_task_duration_ms", "Per-task duration (ms)")
@@ -184,6 +202,9 @@ def install(conf) -> "Optional[ObsState]":
     session's conf. Idempotent; called from TpuSession.__init__."""
     global _STATE
     from spark_rapids_tpu import config as Cf
+    # the flight recorder is its own conf's concern: always-on unless
+    # spark.rapids.obs.flight.enabled=false, even with the live layer off
+    flight.maybe_install(conf)
     if not conf.get(Cf.OBS_ENABLED):
         return _STATE
     with _STATE_LOCK:
@@ -195,6 +216,13 @@ def install(conf) -> "Optional[ObsState]":
         hist_dir = conf.get(Cf.OBS_HISTORY_DIR)
         if hist_dir and st.history is None:
             st.history = QueryHistoryStore(hist_dir)
+        if st.slo is None:
+            st.slo = SloDetector()
+        st.slo.configure(conf.get(Cf.OBS_SLO_ENABLED),
+                         conf.get(Cf.OBS_SLO_FACTOR),
+                         conf.get(Cf.OBS_SLO_MIN_RUNS),
+                         conf.get(Cf.OBS_SLO_ABS_SECONDS),
+                         conf.get(Cf.OBS_SLO_WINDOW))
         port = int(conf.get(Cf.OBS_PORT))
         if port > 0 and st.server is None:
             from spark_rapids_tpu.runtime.obs.endpoint import (
@@ -216,7 +244,11 @@ def install(conf) -> "Optional[ObsState]":
                 logging.getLogger("spark_rapids_tpu").warning(
                     "failed to start obs endpoint on port %d", port,
                     exc_info=True)
-        return st
+    if st.history is not None:
+        # baselines survive restarts: seed once from the store (outside
+        # the state lock — seeding reads the history file)
+        st.slo.seed_from_history(st.history)
+    return st
 
 
 def state() -> "Optional[ObsState]":
@@ -320,12 +352,15 @@ def on_query_end(token, *, session, plan, status: str,
                  wall_start_unix: float,
                  trace_paths: Optional[dict],
                  last_metrics: Optional[Dict[str, dict]] = None,
-                 degraded_reason: Optional[str] = None
+                 degraded_reason: Optional[str] = None,
+                 attribution_doc: Optional[dict] = None,
+                 flight_dump: Optional[str] = None
                  ) -> Optional[dict]:
-    """Publish one finished top-level action: registry rollups + the
-    history record. Returns the record (None when history is off).
-    MUST be called for every non-None token (including NESTED) — it
-    unwinds the thread's collect depth."""
+    """Publish one finished top-level action: registry rollups, the SLO
+    check, the attribution export, and the history record. Returns the
+    record (None when history is off). MUST be called for every
+    non-None token (including NESTED) — it unwinds the thread's collect
+    depth."""
     _TLS.depth = max(0, getattr(_TLS, "depth", 1) - 1)
     st = _STATE
     if st is None or token is NESTED:
@@ -335,6 +370,47 @@ def on_query_end(token, *, session, plan, status: str,
         reg.counter("rapids_queries_total",
                     labels={"status": status}).inc()
         reg.histogram("rapids_query_wall_time_ms").observe(duration_ns / 1e6)
+        if attribution_doc:
+            for phase, secs in attribution_doc.get("buckets", {}).items():
+                if secs:
+                    reg.float_counter("rapids_query_seconds_bucket",
+                                      labels={"phase": phase}).inc(secs)
+        digest = None
+        try:
+            digest = plan_digest(plan)
+        except Exception:  # noqa: BLE001 - an undigestable plan still
+            pass  # publishes; it just cannot baseline or diff
+        breach = None
+        if st.slo is not None and status == "ok" and digest:
+            breach = st.slo.record(digest, duration_ns / 1e9)
+        if breach is not None:
+            if attribution_doc is None:
+                # no rollup consumer took a snapshot for this query —
+                # a breach is worth the lazy-count syncs of one now
+                try:
+                    attribution_doc = session.last_attribution()
+                except Exception:  # noqa: BLE001 - advisory
+                    pass
+            reg.counter("rapids_slo_breaches_total").inc()
+            try:
+                from spark_rapids_tpu.runtime import trace as _tr
+                _tr.instant("slowQuery", cat="query", args=dict(breach),
+                            level=_tr.ESSENTIAL)
+            except Exception:  # noqa: BLE001 - slo must not need a tracer
+                pass
+            if flight_dump is None:
+                flight_dump = flight.dump(
+                    "slo_breach",
+                    query_id=token if isinstance(token, int) else None)
+            st.last_slow = {
+                "query_id": token,
+                "plan_digest": digest,
+                "wall_ms": round(duration_ns / 1e6, 3),
+                "breach": breach,
+                "attribution": attribution.summary(attribution_doc),
+                "flight_dump": flight_dump,
+                "finished_unix": time.time(),
+            }
         # per-exec rollups resolve lazy device row counts (real syncs):
         # pay them only when something consumes the result — a scrape
         # endpoint or the history store. A bare registry (obs enabled,
@@ -356,7 +432,9 @@ def on_query_end(token, *, session, plan, status: str,
                 query_id=token, wall_start_unix=wall_start_unix,
                 duration_ns=duration_ns, status=status, error=error,
                 plan=plan, session=session, trace_paths=trace_paths,
-                snaps=snaps, degraded_reason=degraded_reason)
+                snaps=snaps, degraded_reason=degraded_reason,
+                attribution=attribution_doc, slo_breach=breach,
+                flight_dump=flight_dump, digest=digest)
             st.history.append(rec)
         st.last_query = {
             "query_id": token, "status": status,
@@ -366,6 +444,8 @@ def on_query_end(token, *, session, plan, status: str,
         }
         if degraded_reason is not None:
             st.last_query["degraded_reason"] = degraded_reason
+        if breach is not None:
+            st.last_query["slo_breach"] = True
         return rec
     except Exception:  # noqa: BLE001 - observability never fails a query
         return None
@@ -470,6 +550,11 @@ def healthz() -> dict:
         "faults": FLT.fault_counts(),
         "semaphore": sem_doc,
         "spill": spill_doc,
+        # the retroactive surfaces: most recent flight dump + the last
+        # slow query (digest, breach, attribution summary, dump path)
+        "flight": flight.doc(),
+        "slo": dict(st.slo.doc(), last_slow=st.last_slow)
+        if st.slo is not None else None,
         "queries": {
             "active": active,
             "completed_ok": reg.counter(

@@ -5,7 +5,9 @@ metrics otherwise die with the process: every top-level action appends
 one JSONL record under `spark.rapids.obs.historyDir` — plan digest,
 physical plan text, per-exec metric rollups, fusion groups, fallback
 reasons, config delta, wall time, status (ok/failed + exception class),
-and the trace artifact paths when tracing was on. `tools/history_server.py`
+the wall-time attribution breakdown (obs/attribution.py), any SLO
+breach and flight-recorder dump path, and the trace artifact paths
+when tracing was on. `tools/history_server.py`
 renders the store as static HTML (query list -> annotated plan with
 hot-path highlighting -> run-over-run diff of the same plan digest), and
 `tools/profiler_report.py --history` cross-links a trace file to its
@@ -116,7 +118,11 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
                        plan, session,
                        trace_paths: Optional[dict],
                        snaps: Optional[dict] = None,
-                       degraded_reason: Optional[str] = None) -> dict:
+                       degraded_reason: Optional[str] = None,
+                       attribution: Optional[dict] = None,
+                       slo_breach: Optional[dict] = None,
+                       flight_dump: Optional[str] = None,
+                       digest: Optional[str] = None) -> dict:
     """Assemble one history record from a finished action's state. Every
     sub-extraction is best-effort: history must never fail a query.
     `snaps` is the caller's last_metrics() snapshot when it already took
@@ -135,13 +141,24 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
     }
     if degraded_reason is not None:
         rec["degraded_reason"] = degraded_reason
+    if attribution is not None:
+        # the per-query wall-time decomposition (obs/attribution.py);
+        # tools/history_server.py renders it as the breakdown bar
+        rec["attribution"] = attribution
+    if slo_breach is not None:
+        rec["slo_breach"] = slo_breach
+    if flight_dump is not None:
+        rec["flight_dump"] = flight_dump
     if error is not None:
         rec["error_class"] = type(error).__name__
         rec["error"] = str(error)[:500]
-    try:
-        rec["plan_digest"] = plan_digest(plan)
-    except Exception:  # noqa: BLE001
-        rec["plan_digest"] = None
+    if digest is not None:
+        rec["plan_digest"] = digest
+    else:
+        try:
+            rec["plan_digest"] = plan_digest(plan)
+        except Exception:  # noqa: BLE001
+            rec["plan_digest"] = None
     try:
         exec_root = getattr(session, "_last_exec", None)
         if exec_root is not None:

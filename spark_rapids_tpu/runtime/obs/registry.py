@@ -70,6 +70,19 @@ class Counter:
             return self._value
 
 
+class FloatCounter(Counter):
+    """Monotonic float counter (Prometheus counters are floats natively;
+    the int base class keeps existing series rendering as integers).
+    Used for accumulated-seconds totals like
+    rapids_query_seconds_bucket{phase=...}."""
+
+    __slots__ = ()
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._value += float(v)
+
+
 class Gauge:
     """Point-in-time value. Either set explicitly or backed by a callback
     evaluated at render/snapshot time (queue depths, semaphore state —
@@ -210,6 +223,11 @@ class MetricsRegistry:
     def counter(self, name: str, help: str = "",
                 labels: Optional[Dict[str, str]] = None) -> Counter:
         return self._get_or_create(Counter, name, help, labels)
+
+    def float_counter(self, name: str, help: str = "",
+                      labels: Optional[Dict[str, str]] = None
+                      ) -> FloatCounter:
+        return self._get_or_create(FloatCounter, name, help, labels)
 
     def gauge(self, name: str, help: str = "",
               labels: Optional[Dict[str, str]] = None) -> Gauge:

@@ -55,11 +55,14 @@ class TaskContext:
         # roll the task accumulators into the active query trace's event
         # log AFTER the completion callbacks (the semaphore release hook
         # runs first, so its final wait total is included), then fold
-        # them into the live observability registry — ONE registry write
-        # batch per task, the only obs cost on the execution path
+        # them into the live observability registry and the per-query
+        # attribution aggregate — ONE write batch per task, the only
+        # obs cost on the execution path
         from spark_rapids_tpu.runtime import obs, trace
+        from spark_rapids_tpu.runtime.obs import attribution
         trace.on_task_complete(self)
         obs.on_task_complete(self)
+        attribution.fold_task(self._metrics)
 
     # -- thread association ------------------------------------------------
     @staticmethod

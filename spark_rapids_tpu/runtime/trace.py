@@ -53,6 +53,12 @@ TASK_METRIC_NAMES = (
 )
 
 from spark_rapids_tpu.analysis import sanitizer as _san  # noqa: E402
+# the always-on flight recorder shares these instrumentation points: a
+# span/instant that the tracer is not consuming (tracing off, or above
+# the configured level) still lands in the bounded per-thread ring so a
+# failure can dump a retroactive timeline. _flight._REC is None when the
+# recorder is off — one module-global read past the tracer check.
+from spark_rapids_tpu.runtime.obs import flight as _flight  # noqa: E402
 
 _TRACER: "Optional[Tracer]" = None
 _STATE_LOCK = _san.lock("trace.state")
@@ -213,16 +219,18 @@ class _Span:
     NvtxWithMetrics contract) and emits a complete event; forwards the
     range to jax.profiler.TraceAnnotation when available."""
 
-    __slots__ = ("tracer", "name", "metric", "cat", "args", "t0", "_ann")
+    __slots__ = ("tracer", "name", "metric", "cat", "args", "t0", "_ann",
+                 "level")
 
     def __init__(self, tracer: Tracer, name: str, metric, cat: str,
-                 args: Optional[dict]):
+                 args: Optional[dict], level: int = MODERATE):
         self.tracer = tracer
         self.name = name
         self.metric = metric
         self.cat = cat
         self.args = dict(args) if args else {}
         self._ann = None
+        self.level = level
 
     def __enter__(self):
         ann_cls = self.tracer._annotation
@@ -246,6 +254,14 @@ class _Span:
             self.metric.add(dur)
         self.tracer.complete(self.name, self.t0, dur, self.cat,
                              self.args or None)
+        # traced spans also feed the flight ring so a dump taken while
+        # tracing is on still covers the current query — same DEBUG
+        # filter as every other flight entry point, or a DEBUG-level
+        # tracer would flush the bounded ring with serde chatter
+        fr = _flight._REC
+        if fr is not None and self.level < DEBUG:
+            fr.record(self.name, self.cat, self.t0, dur,
+                      self.args or None)
         return False
 
 
@@ -266,8 +282,15 @@ def metric_span(name: str, metric, cat: str = "exec",
     tr = _TRACER
     if tr is None or (level if level is not None
                       else getattr(metric, "level", MODERATE)) > tr.level:
+        fr = _flight._REC
+        if fr is not None and (level if level is not None
+                               else getattr(metric, "level",
+                                            MODERATE)) < DEBUG:
+            return fr.span(name, metric, cat)
         return metric.ns() if metric is not None else _NULL
-    return _Span(tr, name, metric, cat, args)
+    return _Span(tr, name, metric, cat, args,
+                 level=(level if level is not None
+                        else getattr(metric, "level", MODERATE)))
 
 
 def exec_span(node, metric, name: Optional[str] = None):
@@ -277,13 +300,17 @@ def exec_span(node, metric, name: Optional[str] = None):
     LORE↔trace cross-link)."""
     tr = _TRACER
     if tr is None or metric.level > tr.level:
+        fr = _flight._REC
+        if fr is not None and metric.level < DEBUG:
+            return fr.span(name or f"{node.name()}.{metric.name}",
+                           metric, "exec")
         return metric.ns()
     args = None
     lid = getattr(node, "lore_id", None)
     if lid is not None:
         args = {"lore_id": lid}
     return _Span(tr, name or f"{node.name()}.{metric.name}", metric,
-                 "exec", args)
+                 "exec", args, level=metric.level)
 
 
 def span(name: str, cat: str = "runtime", args: Optional[dict] = None,
@@ -291,8 +318,11 @@ def span(name: str, cat: str = "runtime", args: Optional[dict] = None,
     """Metric-less span (serde, async writes, report-only ranges)."""
     tr = _TRACER
     if tr is None or level > tr.level:
+        fr = _flight._REC
+        if fr is not None and level < DEBUG:
+            return fr.span(name, None, cat)
         return _NULL
-    return _Span(tr, name, None, cat, args)
+    return _Span(tr, name, None, cat, args, level=level)
 
 
 def instant(name: str, cat: str = "runtime", args: Optional[dict] = None,
@@ -300,6 +330,9 @@ def instant(name: str, cat: str = "runtime", args: Optional[dict] = None,
     tr = _TRACER
     if tr is not None and level <= tr.level:
         tr.instant(name, cat, args)
+    fr = _flight._REC
+    if fr is not None and level < DEBUG:
+        fr.instant(name, cat, args)
 
 
 def emit_span(name: str, t0_ns: int, dur_ns: int, cat: str = "exec",
@@ -310,6 +343,9 @@ def emit_span(name: str, t0_ns: int, dur_ns: int, cat: str = "exec",
     tr = _TRACER
     if tr is not None and level <= tr.level:
         tr.complete(name, t0_ns, dur_ns, cat, args)
+    fr = _flight._REC
+    if fr is not None and level < DEBUG:
+        fr.record(name, cat, t0_ns, dur_ns, args)
 
 
 def on_task_complete(ctx) -> None:

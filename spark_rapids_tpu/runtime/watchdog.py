@@ -174,6 +174,10 @@ class CircuitBreaker:
         except Exception:  # noqa: BLE001 - breaker must not need obs
             pass
         if to_state == OPEN:
+            # an opening breaker is a failure-domain event: capture the
+            # timeline that led here (flight.dump never raises)
+            from spark_rapids_tpu.runtime.obs import flight
+            flight.dump("breaker_open", error=error_class or None)
             log.warning("circuit breaker OPEN for backend %s (after %s); "
                         "queries degrade to CPU while open",
                         self.backend, error_class or "failures")
@@ -287,6 +291,11 @@ class DispatchWatchdog:
                     "deadline").inc()
         except Exception:  # noqa: BLE001 - watchdog must not need obs
             pass
+        # the wedge's retroactive timeline: dump the flight rings now,
+        # while the events leading into the stuck dispatch are still in
+        # the buffers (flight.dump never raises)
+        from spark_rapids_tpu.runtime.obs import flight
+        flight.dump("watchdog_timeout", error="DispatchTimeout")
         breaker().record_failure("DispatchTimeout")
 
 

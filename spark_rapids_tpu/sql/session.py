@@ -252,6 +252,12 @@ class TpuSession:
         # its failure to the outer query, which then degrades whole
         depth = getattr(_COLLECT_DEPTH, "d", 0)
         _COLLECT_DEPTH.d = depth + 1
+        if depth == 0:
+            # open the per-query attribution aggregate (compile timing,
+            # task accumulators) — runs regardless of obs state so
+            # explain(mode="analyze") always has a breakdown
+            from spark_rapids_tpu.runtime.obs import attribution as ATTR
+            ATTR.on_query_start()
         cpu_gate_failed = False
         try:
             if depth == 0 and self._fallback_enabled():
@@ -308,7 +314,8 @@ class TpuSession:
             self._finish_action(plan, qt, ot, error,
                                 _time.perf_counter_ns() - t0, wall0,
                                 status=status,
-                                degraded_reason=degraded_reason)
+                                degraded_reason=degraded_reason,
+                                top_level=depth == 0)
 
     def _fallback_enabled(self) -> bool:
         return bool(self.conf.get(C.FALLBACK_CPU_ENABLED))
@@ -372,18 +379,23 @@ class TpuSession:
 
     def _finish_action(self, plan, qt, ot, error, duration_ns,
                        wall0, status: Optional[str] = None,
-                       degraded_reason: Optional[str] = None) -> None:
-        """Query epilogue: finalize the trace (success OR failure) and
-        publish the action to the live observability layer. Every step is
-        fenced — a failed query must still flush its buffered trace
-        events (with an `error` instant and status=failed), and a
-        last_metrics() snapshot that itself raises (a lazy device count
-        on a poisoned buffer) must not swallow the artifacts, which it
-        previously did by raising between the two finalize halves."""
+                       degraded_reason: Optional[str] = None,
+                       top_level: bool = False) -> None:
+        """Query epilogue: finalize the trace (success OR failure),
+        compute the wall-time attribution, trigger a flight-recorder
+        dump on failure/degradation, and publish the action to the live
+        observability layer. Every step is fenced — a failed query must
+        still flush its buffered trace events (with an `error` instant
+        and status=failed), and a last_metrics() snapshot that itself
+        raises (a lazy device count on a poisoned buffer) must not
+        swallow the artifacts, which it previously did by raising
+        between the two finalize halves."""
         import logging
 
         from spark_rapids_tpu.runtime import obs as OBS
         from spark_rapids_tpu.runtime import trace as TR
+        from spark_rapids_tpu.runtime.obs import attribution as ATTR
+        from spark_rapids_tpu.runtime.obs import flight as FLIGHT
         log = logging.getLogger("spark_rapids_tpu")
         if status is None:
             status = "ok" if error is None else "failed"
@@ -391,10 +403,10 @@ class TpuSession:
         # rollups, and the history record (resolving lazy device row
         # counts costs real syncs) — and it is taken at all only when
         # something consumes it: a tracer, the endpoint, or the store
-        top_level = ot is not None and ot is not OBS.NESTED
+        obs_top = ot is not None and ot is not OBS.NESTED
         digest = None
         lm = None
-        if qt is not None or (top_level and OBS.wants_rollups()):
+        if qt is not None or (obs_top and OBS.wants_rollups()):
             try:
                 lm = self.last_metrics()
             except Exception:  # noqa: BLE001 - snapshot must not block
@@ -405,27 +417,60 @@ class TpuSession:
                 digest = OBS.plan_digest(plan)
             except Exception:  # noqa: BLE001
                 pass
-        if qt is not None:
-            # cleared first so a finalize failure can never leave a
-            # PREVIOUS query's artifacts looking like this one's
-            self.last_trace_paths = None
+        if top_level:
+            # close the attribution aggregate and record the wall time
+            # whether or not anything consumes them now — last_
+            # attribution() / explain(mode="analyze") recompute on
+            # demand from these plus a fresh metric snapshot
+            try:
+                self._last_attr_extra = ATTR.finish()
+            except Exception:  # noqa: BLE001
+                self._last_attr_extra = None
+            self._last_duration_ns = duration_ns
+            self._last_attribution = None
+            if lm is not None:
+                try:
+                    self._last_attribution = ATTR.attribute(
+                        lm, duration_ns, extra=self._last_attr_extra)
+                except Exception:  # noqa: BLE001
+                    log.warning("failed to attribute query time",
+                                exc_info=True)
+        flight_dump = None
+        if top_level and status in ("failed", "degraded"):
+            # emit the outcome marker (tracer AND/OR flight ring), then
+            # dump the flight rings: the failing query's timeline exists
+            # retroactively even with tracing off
             try:
                 if status == "degraded":
                     # the device path failed (or the breaker was open)
-                    # but the CPU fallback answered: mark the trace so
-                    # the report attributes the tail to degradation
+                    # but the CPU fallback answered: mark the timeline
+                    # so the report attributes the tail to degradation
                     TR.instant("queryDegraded", cat="query", args={
                         "reason": degraded_reason,
                         "error": (type(error).__name__
                                   if error is not None else None)},
                         level=TR.ESSENTIAL)
-                elif error is not None:
+                else:
                     # flush-time marker: the trace ends HERE because the
                     # query raised, not because instrumentation stopped
                     TR.instant("queryError", cat="query", args={
                         "error": type(error).__name__,
                         "message": str(error)[:200]},
                         level=TR.ESSENTIAL)
+            except Exception:  # noqa: BLE001 - a marker failure must
+                # not mask the query's own error
+                log.warning("failed to emit query outcome instant",
+                            exc_info=True)
+            flight_dump = FLIGHT.dump(
+                "query_" + status,
+                query_id=ot if isinstance(ot, int) else None,
+                error=(type(error).__name__ if error is not None
+                       else degraded_reason))
+        if qt is not None:
+            # cleared first so a finalize failure can never leave a
+            # PREVIOUS query's artifacts looking like this one's
+            self.last_trace_paths = None
+            try:
                 self.last_trace_paths = TR.end_query(
                     qt, last_metrics=lm, status=status, error=error,
                     plan_digest=digest)
@@ -447,7 +492,10 @@ class TpuSession:
                     trace_paths=(self.last_trace_paths
                                  if qt is not None else None),
                     last_metrics=lm,
-                    degraded_reason=degraded_reason)
+                    degraded_reason=degraded_reason,
+                    attribution_doc=getattr(self, "_last_attribution",
+                                            None),
+                    flight_dump=flight_dump)
             except Exception:  # noqa: BLE001
                 log.warning("failed to publish query to obs",
                             exc_info=True)
@@ -515,6 +563,28 @@ class TpuSession:
     def last_plan_explain(self) -> str:
         return self._last_meta.explain(all_ops=True) if self._last_meta else ""
 
+    def last_attribution(self) -> Optional[dict]:
+        """Wall-time attribution of the most recent top-level action
+        (runtime/obs/attribution.py): named phase buckets summing to the
+        measured wall time. Uses the epilogue's precomputed document
+        when one exists; otherwise recomputes from a fresh metric
+        snapshot plus the stored per-query aggregate (compile timing,
+        task accumulators). None before any action."""
+        doc = getattr(self, "_last_attribution", None)
+        if doc is not None:
+            return doc
+        dur = getattr(self, "_last_duration_ns", 0)
+        if not dur or getattr(self, "_last_exec", None) is None:
+            return None
+        from spark_rapids_tpu.runtime.obs import attribution as ATTR
+        try:
+            return ATTR.attribute(
+                self.last_metrics(), dur,
+                extra=getattr(self, "_last_attr_extra", None))
+        except Exception:  # noqa: BLE001 - attribution is advisory: a
+            # poisoned lazy count must not fail an explain
+            return None
+
     def explain_analyze(self) -> str:
         """The physical exec tree of the MOST RECENT action annotated
         with its actual runtime metrics — rows, batches, dispatches, and
@@ -544,4 +614,9 @@ class TpuSession:
                 tag = "fused" if role == "member" else role
                 lines.append(f"{pad}  *({sid}) {type(node).__name__} "
                              f"[{tag}]  [{annot}]")
+        attr = self.last_attribution()
+        if attr is not None:
+            from spark_rapids_tpu.runtime.obs import attribution as ATTR
+            lines.append("")
+            lines.extend(ATTR.render_text(attr))
         return "\n".join(lines)

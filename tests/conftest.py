@@ -39,3 +39,14 @@ def _reset_runtime():
     # a test that tripped the breaker (or started the watchdog) must not
     # leak degraded routing into the next test's queries
     watchdog.uninstall_for_tests()
+    # flight rings / dump rate-limit state, the per-query attribution
+    # aggregate, and SLO baselines are process-global too
+    from spark_rapids_tpu.runtime import obs
+    from spark_rapids_tpu.runtime.obs import attribution, flight
+    flight.uninstall_for_tests()
+    attribution.reset_for_tests()
+    st = obs.state()
+    if st is not None:
+        if st.slo is not None:
+            st.slo.reset_for_tests()
+        st.last_slow = None

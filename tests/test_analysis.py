@@ -261,6 +261,51 @@ def test_l008_skipped_without_roster():
     assert _rules(vs) == []
 
 
+def _lint_buckets(src, buckets=frozenset({"compile", "device_compute"})):
+    return lint.lint_source(textwrap.dedent(src), "/x/runtime/x.py",
+                            {"opTime"}, relpath="runtime/x.py",
+                            known_buckets=set(buckets))
+
+
+def test_l009_unregistered_bucket():
+    vs = _lint_buckets("""
+        from spark_rapids_tpu.runtime.obs import attribution as _attr
+        def f(ns):
+            _attr.record("compile", ns)
+            _attr.record("made_up_bucket", ns)
+    """)
+    assert _rules(vs) == ["TPU-L009"]
+
+
+def test_l009_only_attribution_receivers_match():
+    # .record() on an unrelated receiver (a history store, an audio
+    # object) is not an attribution point
+    vs = _lint_buckets("""
+        def f(store, ns):
+            store.record("whatever_name", ns)
+    """)
+    assert _rules(vs) == []
+
+
+def test_l009_roster_extraction_matches_attribution_module():
+    buckets = lint.known_attr_buckets(
+        os.path.join(REPO, "spark_rapids_tpu"))
+    from spark_rapids_tpu.runtime.obs.attribution import BUCKETS
+    assert buckets == set(BUCKETS)
+    assert {"compile", "device_compute", "host_decode", "shuffle",
+            "semaphore_wait", "pipeline_stall", "retry_backoff",
+            "spill", "other"} <= buckets
+
+
+def test_l009_skipped_without_roster():
+    vs = _lint("""
+        from spark_rapids_tpu.runtime.obs import attribution
+        def f(ns):
+            attribution.record("made_up_bucket", ns)
+    """)
+    assert _rules(vs) == []
+
+
 def test_lint_full_tree_is_clean():
     """The acceptance bar: zero unsuppressed violations over the whole
     package, <=5 suppressions, every one carrying a reason."""

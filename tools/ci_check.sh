@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
-# chaos smoke, obs smoke, pipeline smoke, tier-1 tests.
+# chaos smoke, obs smoke, flight smoke, pipeline smoke, tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
@@ -48,6 +48,11 @@ fi
 
 step "obs smoke (/metrics scrape while a query runs, /healthz degraded flip, history round-trip)"
 if ! python tools/obs_smoke.py; then
+    fail=1
+fi
+
+step "flight smoke (always-on recorder overhead <2%; failure/degrade/SLO/breaker triggers each dump a readable Chrome trace; clean runs silent; attribution reconciles <1%)"
+if ! python tools/flight_smoke.py; then
     fail=1
 fi
 

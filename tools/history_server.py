@@ -9,8 +9,10 @@ renders:
   fallback count) newest first, plus the NDS scorecard records;
 - query_<n>.html — one page per query: the physical plan annotated with
   per-exec rollups, HOT-PATH HIGHLIGHTING (execs above 15% of total
-  operator time render highlighted), fusion groups, fallback reasons,
-  config delta, trace artifact paths;
+  operator time render highlighted), the wall-time ATTRIBUTION BAR
+  (phase buckets from runtime/obs/attribution.py), SLO-breach details,
+  fusion groups, fallback reasons, config delta, trace artifact paths
+  and the flight-recorder dump of failed/degraded/slow queries;
 - diff_<digest>.html — for every plan digest with >= 2 runs, a
   run-over-run diff of the latest two runs: per-exec metric deltas side
   by side (the regression-hunting view: same plan, what moved?).
@@ -53,11 +55,28 @@ pre { background: #f6f6fb; padding: 1em; overflow-x: auto;
 .delta-up { color: #b00020; font-weight: bold; }
 .delta-down { color: #0a7a2f; font-weight: bold; }
 .badge-ok { color: #0a7a2f; } .badge-failed { color: #b00020; }
-.badge-degraded { color: #b06f00; }
+.badge-degraded { color: #b06f00; } .badge-slow { color: #6a1b9a; }
+tr.slow td { background: #f3e8fd; }
+.attr-bar { display: flex; height: 22px; width: 100%; margin: 0.5em 0;
+            border: 1px solid #d0d0e0; border-radius: 3px;
+            overflow: hidden; }
+.attr-bar span { display: block; height: 100%; }
+.attr-legend { font-size: 13px; }
+.attr-chip { display: inline-block; width: 0.8em; height: 0.8em;
+             margin-right: 0.3em; border-radius: 2px;
+             vertical-align: baseline; }
 h1, h2 { font-weight: 600; }
 a { color: #3949ab; }
 small.digest { font-family: monospace; color: #666; }
 """
+
+#: one stable color per attribution bucket (the bar + legend share it)
+_BUCKET_COLORS = {
+    "compile": "#8e7cc3", "device_compute": "#3949ab",
+    "host_decode": "#43a047", "shuffle": "#fb8c00",
+    "semaphore_wait": "#fdd835", "pipeline_stall": "#e53935",
+    "retry_backoff": "#d81b60", "spill": "#6d4c41", "other": "#b0bec5",
+}
 
 
 def _page(title: str, body: str) -> str:
@@ -101,6 +120,37 @@ def _page_names(records: List[dict]) -> Dict[int, str]:
 _TIME_RE = re.compile(r"time=([0-9.]+)ms")
 
 
+def render_attribution(attr: dict) -> str:
+    """The wall-time breakdown bar: one colored segment per nonzero
+    bucket (width = fraction of wall), plus a legend table."""
+    buckets = attr.get("buckets") or {}
+    fracs = attr.get("fractions") or {}
+    ranked = sorted(((b, s) for b, s in buckets.items() if s > 0),
+                    key=lambda kv: -kv[1])
+    if not ranked:
+        return ""
+    segs, legend = [], []
+    for b, s in ranked:
+        frac = fracs.get(b, 0.0)
+        color = _BUCKET_COLORS.get(b, "#999")
+        segs.append(f"<span style='width:{frac * 100:.2f}%;"
+                    f"background:{color}' "
+                    f"title='{_esc(b)} {s:.3f}s ({frac * 100:.1f}%)'>"
+                    f"</span>")
+        legend.append(f"<span class='attr-chip' style='background:"
+                      f"{color}'></span>{_esc(b)} {s:.3f}s "
+                      f"({frac * 100:.1f}%)")
+    conc = attr.get("concurrency_factor", 1.0)
+    note = (f" · measured {attr.get('measured_seconds', 0):.3f}s across "
+            f"concurrent tasks ({conc:.1f}x wall, shown as "
+            f"critical-path shares)" if conc and conc > 1.0 else "")
+    return (f"<h2>Time attribution</h2>"
+            f"<p class='attr-legend'>wall "
+            f"{attr.get('wall_seconds', 0):.3f}s{note}</p>"
+            f"<div class='attr-bar'>{''.join(segs)}</div>"
+            f"<p class='attr-legend'>{' · '.join(legend)}</p>")
+
+
 def render_query_page(rec: dict) -> str:
     # the record carries the plan ALREADY annotated by the engine's own
     # canonical walk (session.explain_analyze) — renderer-side matching
@@ -121,6 +171,8 @@ def render_query_page(rec: dict) -> str:
 
     body = [f"<p>status <b class='badge-{rec.get('status', 'ok')}'>"
             f"{_esc(rec.get('status'))}</b>"
+            + (" <b class='badge-slow'>[SLO breach]</b>"
+               if rec.get("slo_breach") else "")
             + (f" [degraded to CPU: {_esc(rec.get('degraded_reason'))}]"
                if rec.get("degraded_reason") else "")
             + (f" ({_esc(rec.get('error_class'))}: "
@@ -130,6 +182,22 @@ def render_query_page(rec: dict) -> str:
             f" · wall {rec.get('duration_ns', 0) / 1e6:.1f} ms"
             f" · digest <small class='digest'>"
             f"{_esc(rec.get('plan_digest'))}</small></p>"]
+    if rec.get("slo_breach"):
+        b = rec["slo_breach"]
+        body.append(
+            f"<p class='badge-slow'>SLO breach ({_esc(b.get('kind'))}): "
+            f"{b.get('seconds', 0):.3f}s against threshold "
+            f"{b.get('threshold_seconds', 0):.3f}s"
+            + (f" (baseline {b.get('baseline_seconds', 0):.3f}s over "
+               f"{_esc(b.get('runs'))} runs)"
+               if b.get("kind") == "baseline" else "") + "</p>")
+    if rec.get("attribution"):
+        body.append(render_attribution(rec["attribution"]))
+    if rec.get("flight_dump"):
+        # the retroactive timeline of a failed/degraded/slow query
+        body.append(f"<p>Flight-recorder dump: <code>"
+                    f"{_esc(rec['flight_dump'])}</code> "
+                    f"(Chrome-trace/Perfetto loadable)</p>")
     body.append("<h2>Annotated plan</h2><pre>"
                 + "\n".join(out_lines) + "</pre>")
 
@@ -217,10 +285,14 @@ def render_index(records: List[dict], diff_digests: List[str],
         if rec.get("type") == "nds_scorecard":
             continue
         st = rec.get("status", "?")
+        slow = rec.get("slo_breach") is not None
+        row_cls = "slow" if slow and st == "ok" else st
+        st_cell = _esc(st) + (" <span class='badge-slow'>slow</span>"
+                              if slow else "")
         body.append(
-            f"<tr class='{st}'><td>{_esc(rec.get('query_id'))}</td>"
+            f"<tr class='{row_cls}'><td>{_esc(rec.get('query_id'))}</td>"
             f"<td>{_fmt_time(rec.get('wall_start_unix'))}</td>"
-            f"<td class='badge-{st}'>{_esc(st)}</td>"
+            f"<td class='badge-{st}'>{st_cell}</td>"
             f"<td class='num'>{rec.get('duration_ns', 0) / 1e6:.1f}</td>"
             f"<td><small class='digest'>{_esc(rec.get('plan_digest'))}"
             f"</small></td>"

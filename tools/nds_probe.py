@@ -2595,6 +2595,13 @@ def run_one(sess, dfs, qn: int, history_dir: str = "",
     t0 = time.perf_counter()
     tpu_table = df.collect()
     first = time.perf_counter() - t0
+    # the FIRST run's attribution (it carries the compile bucket), taken
+    # before df.count() replaces the session's last-action state
+    attr = None
+    try:
+        attr = sess.last_attribution()
+    except Exception:  # noqa: BLE001 - attribution is advisory
+        attr = None
     t0 = time.perf_counter()
     df.count()
     dt = time.perf_counter() - t0  # steady state (kernels cached)
@@ -2609,6 +2616,22 @@ def run_one(sess, dfs, qn: int, history_dir: str = "",
            # the compile cost — splitting it out makes compile-cache
            # regressions visible instead of smearing into "slow query"
            "compile_seconds": round(max(first - dt, 0.0), 4)}
+    if attr:
+        b = attr.get("buckets", {})
+        # the engine's own wall-time decomposition of the first run
+        # (obs/attribution.py): compile vs device vs host vs stall per
+        # query — the columns ROADMAP item 4's compile-latency war is
+        # measured by
+        rec["attribution"] = {k: round(v, 4) for k, v in b.items() if v}
+        rec["attr_compile_seconds"] = round(b.get("compile", 0.0), 4)
+        rec["attr_device_seconds"] = round(
+            b.get("device_compute", 0.0), 4)
+        rec["attr_host_seconds"] = round(
+            b.get("host_decode", 0.0) + b.get("shuffle", 0.0)
+            + b.get("spill", 0.0), 4)
+        rec["attr_stall_seconds"] = round(
+            b.get("semaphore_wait", 0.0) + b.get("pipeline_stall", 0.0)
+            + b.get("retry_backoff", 0.0), 4)
     if history_dir:
         append_scorecard(history_dir, qn, rec, df.plan, wall0, sf=sf)
     return rec
@@ -2664,6 +2687,21 @@ def summarize_card(card: dict, sf: float) -> dict:
             sum(float(q.get("seconds", 0.0)) for q in measured), 4),
         "compile_seconds_total": round(
             sum(_compile_seconds(q) for q in measured), 4),
+        # engine-attributed first-run totals (obs/attribution.py): where
+        # wall-clock goes across the probe — compile vs device vs host
+        # vs stall (ROADMAP item 4 reads attr_compile_seconds_total)
+        "attr_compile_seconds_total": round(
+            sum(float(q.get("attr_compile_seconds", 0.0))
+                for q in measured), 4),
+        "attr_device_seconds_total": round(
+            sum(float(q.get("attr_device_seconds", 0.0))
+                for q in measured), 4),
+        "attr_host_seconds_total": round(
+            sum(float(q.get("attr_host_seconds", 0.0))
+                for q in measured), 4),
+        "attr_stall_seconds_total": round(
+            sum(float(q.get("attr_stall_seconds", 0.0))
+                for q in measured), 4),
         "queries": card,
     }
 
