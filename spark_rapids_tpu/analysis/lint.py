@@ -47,6 +47,14 @@ TPU-L009  every string-literal attribution-bucket name at an
           every roster bucket must appear in generated docs/metrics.md)
           — an unregistered bucket's time silently vanishes from every
           attribution surface (the bucket twin of TPU-L007/L008).
+TPU-L010  no raw ``jax.jit``/``jax.pjit`` (or ``partial(jax.jit, …)``)
+          compile entry outside ``runtime/compile_cache.py`` — every
+          compilation routes through the sanctioned choke point so the
+          warm-trace cache, the hit/miss/compile-second counters, the
+          attribution ``compile`` bucket, and AOT warmup see it (the
+          L002/L003 pattern). ``pl.pallas_call`` sites are likewise
+          confined to the modules rostered in
+          ``compile_cache.SANCTIONED_PALLAS_MODULES``.
 
 Suppression
 -----------
@@ -84,6 +92,8 @@ RULES: Dict[str, str] = {
                 "SITES roster",
     "TPU-L009": "attribution-bucket name not registered in the "
                 "runtime/obs/attribution.py BUCKETS roster",
+    "TPU-L010": "raw jax.jit/pallas_call compile entry outside the "
+                "sanctioned compile-cache choke point",
 }
 
 #: receiver names under which a .site()/.site_bytes() call is the fault
@@ -189,7 +199,8 @@ def _is_span_call(expr: ast.AST) -> bool:
 class _FileLinter(ast.NodeVisitor):
     def __init__(self, path: str, source: str, known_metrics: Set[str],
                  relpath: str, known_sites: Optional[Set[str]] = None,
-                 known_buckets: Optional[Set[str]] = None):
+                 known_buckets: Optional[Set[str]] = None,
+                 pallas_modules: Optional[Set[str]] = None):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
@@ -203,6 +214,11 @@ class _FileLinter(ast.NodeVisitor):
         self._in_host_pool = self.relpath.endswith("runtime/host_pool.py")
         self._in_exec_layer = "/exec/" in "/" + self.relpath
         self._in_analysis = "/analysis/" in "/" + self.relpath
+        self._in_compile_cache = self.relpath.endswith(
+            "runtime/compile_cache.py")
+        self._pallas_sanctioned = self._in_compile_cache or (
+            pallas_modules is not None
+            and any(self.relpath.endswith(m) for m in pallas_modules))
 
     # -- helpers -----------------------------------------------------------
 
@@ -320,6 +336,7 @@ class _FileLinter(ast.NodeVisitor):
     # nested defs/lambdas inside a with-block do NOT run under the lock
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._check_defaults(node)
+        self._check_jit_decorators(node)
         saved, self._lock_stack = self._lock_stack, []
         saved_span, self._span_depth = self._span_depth, 0
         self.generic_visit(node)
@@ -343,6 +360,7 @@ class _FileLinter(ast.NodeVisitor):
         self._check_metric_name(node)
         self._check_fault_site(node)
         self._check_attr_bucket(node)
+        self._check_compile_entry(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -502,6 +520,60 @@ class _FileLinter(ast.NodeVisitor):
                        f"complete")
 
 
+    # -- TPU-L010 ----------------------------------------------------------
+
+    #: receiver names under which .jit/.pjit is the jax compiler
+    _JAX_BASES = {"jax", "_jax"}
+
+    def _check_jit_decorators(self, node: ast.FunctionDef) -> None:
+        """Bare `@jax.jit` decorators are Attribute nodes, not Calls —
+        the Call visitor never sees them (`@partial(jax.jit, ...)` and
+        `@jax.jit(...)` are Calls and route through
+        _check_compile_entry)."""
+        if self._in_compile_cache:
+            return
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Attribute) \
+                    and dec.attr in ("jit", "pjit") \
+                    and (_base_name(dec) or "").lower() in self._JAX_BASES:
+                self._emit("TPU-L010", dec,
+                           "raw @jax.jit decorator — use "
+                           "@compile_cache.jit so the sanctioned choke "
+                           "point audits the compile entry")
+
+    def _check_compile_entry(self, node: ast.Call) -> None:
+        if self._in_compile_cache:
+            return
+        func = node.func
+        term = _terminal(func)
+        if term == "pallas_call":
+            if not self._pallas_sanctioned:
+                self._emit("TPU-L010", node,
+                           "pl.pallas_call outside the sanctioned pallas "
+                           "kernel modules (compile_cache."
+                           "SANCTIONED_PALLAS_MODULES) — hand-tiled "
+                           "kernels live there so every compile entry "
+                           "stays audited")
+            return
+        hit = False
+        if term in ("jit", "pjit"):
+            base = _base_name(func)
+            hit = base is not None and base.lower() in self._JAX_BASES
+        elif term == "partial":
+            # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+            hit = bool(node.args) and isinstance(
+                node.args[0], ast.Attribute) and _terminal(
+                node.args[0]) in ("jit", "pjit") and (
+                _base_name(node.args[0]) or "").lower() in self._JAX_BASES
+        if hit:
+            self._emit("TPU-L010", node,
+                       "raw jax.jit compile entry — route it through "
+                       "runtime/compile_cache.py (get for keyed fused "
+                       "entries, jit for module-level kernels) so the "
+                       "warm-trace cache, compile counters, attribution "
+                       "and AOT warmup see the compile")
+
+
 # ---------------------------------------------------------------------------
 # Registry extraction (AST-only: no engine import)
 # ---------------------------------------------------------------------------
@@ -578,6 +650,30 @@ def known_attr_buckets(pkg_root: str) -> Set[str]:
     return buckets
 
 
+def known_pallas_modules(pkg_root: str) -> Set[str]:
+    """Modules allowed to contain raw pallas_call sites: the
+    SANCTIONED_PALLAS_MODULES tuple in runtime/compile_cache.py
+    (AST-only, like known_fault_sites)."""
+    mods: Set[str] = set()
+    cpath = os.path.join(pkg_root, "runtime", "compile_cache.py")
+    if not os.path.exists(cpath):
+        return mods
+    tree = ast.parse(open(cpath).read(), cpath)
+    for stmt in tree.body:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else \
+            [stmt.target] if isinstance(stmt, ast.AnnAssign) else []
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) \
+                    and tgt.id == "SANCTIONED_PALLAS_MODULES" \
+                    and isinstance(getattr(stmt, "value", None),
+                                   (ast.Tuple, ast.List)):
+                for el in stmt.value.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str):
+                        mods.add(el.value)
+    return mods
+
+
 def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
     """Metric names documented in docs/metrics.md (None when the file is
     missing — the doc-presence half of TPU-L007 then reports once)."""
@@ -597,13 +693,15 @@ def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
 def lint_source(source: str, path: str, known_metrics: Set[str],
                 relpath: Optional[str] = None,
                 known_sites: Optional[Set[str]] = None,
-                known_buckets: Optional[Set[str]] = None
+                known_buckets: Optional[Set[str]] = None,
+                pallas_modules: Optional[Set[str]] = None
                 ) -> List[Violation]:
     tree = ast.parse(source, path)
     linter = _FileLinter(path, source, known_metrics,
                          relpath if relpath is not None else path,
                          known_sites=known_sites,
-                         known_buckets=known_buckets)
+                         known_buckets=known_buckets,
+                         pallas_modules=pallas_modules)
     linter.visit(tree)
     return linter.violations
 
@@ -616,6 +714,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
     known = known_metric_names(pkg_root)
     sites = known_fault_sites(pkg_root)
     buckets = known_attr_buckets(pkg_root)
+    pallas_mods = known_pallas_modules(pkg_root)
     violations: List[Violation] = []
     n_files = 0
     for dirpath, dirnames, filenames in os.walk(pkg_root):
@@ -628,7 +727,8 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
             rel = os.path.relpath(path, pkg_root)
             violations.extend(lint_source(
                 open(path).read(), path, known, relpath=rel,
-                known_sites=sites, known_buckets=buckets))
+                known_sites=sites, known_buckets=buckets,
+                pallas_modules=pallas_mods))
     documented = docs_metric_names(repo_root)
     mpath = os.path.join(pkg_root, "runtime", "metrics.py")
     if documented is None:

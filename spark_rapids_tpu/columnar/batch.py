@@ -28,16 +28,20 @@ import jax
 import jax.numpy as jnp
 
 from spark_rapids_tpu import types as T
+from spark_rapids_tpu.runtime import shapes as _shapes
 
 MIN_CAPACITY = 8
 
 
-def round_capacity(n: int, minimum: Optional[int] = None) -> int:
+def round_capacity(n: int, minimum: Optional[int] = None,
+                   itemsize: Optional[int] = None) -> int:
+    """Round a row count up to its capacity bucket. The bucket policy
+    (geometric growth factor, per-dtype tile alignment) lives in
+    runtime/shapes.py — spark.rapids.compile.shapes.*; the default
+    reproduces the historical next-power-of-two capacities exactly."""
     if minimum is None:
         minimum = MIN_CAPACITY
-    """Round a row count up to the capacity bucket (next power of two)."""
-    n = max(int(n), 1, minimum)
-    return 1 << (n - 1).bit_length()
+    return _shapes.bucket_rows(n, minimum, itemsize)
 
 
 class LazyRowCount:
@@ -395,7 +399,7 @@ def column_from_arrow(arr, dtype: T.DataType, capacity: int) -> ColumnVector:
         base = int(buf_offsets[0])
         bytes_np = data_buf[base: base + byte_len]
         offsets_np = (buf_offsets - base).astype(np.int32)
-        byte_cap = round_capacity(max(byte_len, 1))
+        byte_cap = round_capacity(max(byte_len, 1), itemsize=1)
         off_padded = np.full(capacity + 1, offsets_np[-1], dtype=np.int32)
         off_padded[: n + 1] = offsets_np
         data = {

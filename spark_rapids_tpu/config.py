@@ -611,6 +611,74 @@ PIPELINE_DEPTH = conf_int(
     "batches may sit decoded/uploaded ahead of the consumer. 0 disables "
     "pipelining (identical to pipeline.enabled=false).")
 
+COMPILE_CACHE_DIR = conf_str(
+    "spark.rapids.compile.cacheDir", "",
+    "When set, enable jax's persistent compilation cache in this "
+    "directory (jax_compilation_cache_dir with the minimum-entry "
+    "thresholds zeroed): compiled XLA executables are reused ACROSS "
+    "processes, so a restarted engine pays trace + deserialize instead "
+    "of a full backend compile on its first run of a known computation. "
+    "Process-global — the first session to configure it wins (jax "
+    "config is global); tools/compile_smoke.py CI-gates that the "
+    "cross-process hits actually happen. Empty disables the persistent "
+    "layer (the in-process warm-trace cache in runtime/compile_cache.py "
+    "is always on).", commonly_used=True)
+
+COMPILE_WARMUP_ENABLED = conf_bool(
+    "spark.rapids.compile.warmup.enabled", False,
+    "AOT warmup (runtime/warmup.py): at session start, replay the most "
+    "recurrent successful queries recorded in spark.rapids.obs."
+    "historyDir (their SQL text rides in the history records) on a "
+    "background service thread as each referenced table is registered, "
+    "pre-tracing and pre-compiling the hot exec set before the first "
+    "user query needs it. Replays run on a shadow session: they touch "
+    "no user-visible session state, produce no history records, and "
+    "never fail the session. Progress is surfaced on /healthz "
+    "(warmup document) and as warmupReplay trace instants.",
+    commonly_used=True)
+
+COMPILE_WARMUP_MAX_PLANS = conf_int(
+    "spark.rapids.compile.warmup.maxPlans", 8,
+    "Upper bound on distinct recurring plans the AOT warmup replays "
+    "(ranked by recurrence count, most-recurrent first).")
+
+COMPILE_WARMUP_MIN_RUNS = conf_int(
+    "spark.rapids.compile.warmup.minRuns", 2,
+    "Successful history runs of a plan digest required before warmup "
+    "considers it recurring (1 replays everything ever run once).")
+
+COMPILE_SHAPES_GROWTH = conf_float(
+    "spark.rapids.compile.shapes.growthFactor", 2.0,
+    "Geometric growth factor of the capacity padding buckets "
+    "(runtime/shapes.py): every device batch capacity snaps to the "
+    "smallest bucket >= its row count so XLA traces are shared across "
+    "batches and queries. 2.0 (default) is next-power-of-two (up to 2x "
+    "padding waste, fewest buckets/compiles); smaller factors (1.25, "
+    "1.5) pad tighter at the cost of more distinct shapes to compile. "
+    "Clamped to (1.06, 4.0].")
+
+COMPILE_SHAPES_DTYPE_ALIGN = conf_bool(
+    "spark.rapids.compile.shapes.dtypeAlign", True,
+    "Round capacity buckets up to whole native TPU tiles for the "
+    "plane's dtype width (8x128 elements for 4-byte lanes, 16x128 for "
+    "2-byte, 32x128 for 1-byte) on bucket requests that carry an "
+    "itemsize — today the string/byte planes; dtype-agnostic row "
+    "buckets are unaligned. Power-of-two buckets are always aligned "
+    "already; this keeps non-2.0 growth factors from paying a "
+    "partial-tile relayout on byte-plane kernels.")
+
+SHUFFLE_COALESCE_TINY_ROWS = conf_int(
+    "spark.rapids.shuffle.coalesceTinyRows", 1024,
+    "Post-shuffle tiny-partition coalescing: after a compact exchange, "
+    "adjacent device sub-batches carrying fewer than this many rows "
+    "each merge into one batch (bounded by 4x this target) before "
+    "downstream dispatch — ragged post-shuffle slice sizes otherwise "
+    "make nearly every batch shape a fresh trace AND a separate "
+    "dispatch. The decision is free: the compact path's already-"
+    "fetched offsets vector supplies exact host-side row counts. "
+    "Merges count into the shuffleCoalescedBatches metric (visible in "
+    "EXPLAIN ANALYZE). 0 disables coalescing.")
+
 STAGE_FUSION_ENABLED = conf_bool(
     "spark.rapids.sql.stageFusion.enabled", True,
     "Collapse maximal linear chains of narrow operators (project, filter, "
@@ -656,6 +724,11 @@ class RapidsConf:
         if key in _REGISTRY and isinstance(value, str):
             value = _REGISTRY[key].conv(value)
         self._values[key] = value
+        # the compile cache memoizes its conf fingerprint on this object
+        # (runtime/compile_cache._conf_fingerprint): any mutation must
+        # drop it, or an ANSI/float-mode flip would keep hitting
+        # executables compiled under the old semantics
+        self.__dict__.pop("_compile_fp", None)
         return self
 
     def is_op_enabled(self, op_key: str, default: bool = True) -> bool:
@@ -685,10 +758,16 @@ def conf() -> RapidsConf:
 
 def set_session_conf(c: RapidsConf) -> None:
     _local.conf = c
-    # capacity bucketing minimum is consulted deep inside kernels where no
-    # conf rides along: publish it as the module floor
+    # capacity bucketing policy is consulted deep inside kernels where no
+    # conf rides along: publish the floor and the bucket shape as module
+    # globals (runtime/shapes.py is the one home of the policy)
     from spark_rapids_tpu.columnar import batch as _b
+    from spark_rapids_tpu.runtime import compile_cache as _cc
+    from spark_rapids_tpu.runtime import shapes as _sh
     _b.MIN_CAPACITY = max(8, int(c.get(BATCH_CAPACITY_MIN)))
+    _sh.configure(c.get(COMPILE_SHAPES_GROWTH),
+                  c.get(COMPILE_SHAPES_DTYPE_ALIGN))
+    _cc.publish_conf(c)
 
 
 class session_conf:

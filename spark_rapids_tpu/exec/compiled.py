@@ -13,7 +13,6 @@ happen inside a trace).
 """
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
@@ -24,9 +23,7 @@ import jax.numpy as jnp
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnVector, ColumnarBatch
 from spark_rapids_tpu.expr.core import EvalCtx, Expression, SparkException
-from spark_rapids_tpu.runtime.obs import attribution as _attr
-
-_STAGE_CACHE: Dict[Tuple, object] = {}
+from spark_rapids_tpu.runtime import compile_cache as _cc
 
 
 def _planes_of(col: ColumnVector):
@@ -67,18 +64,25 @@ def _layout_key(col: ColumnVector):
 def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
               ansi: bool = False) -> List[ColumnVector]:
     """Evaluate expressions over a batch as one jitted stage."""
+    # shape discipline (runtime/shapes.py): capacities arriving here are
+    # bucketed BY CONSTRUCTION — every capacity decision in the engine
+    # routes through round_capacity, which delegates to the bucket
+    # ladder — so the capacity in the cache key below ranges over a
+    # small set and traces share across batches and queries. (Padding
+    # in-place here would be unsound: callers hold the ORIGINAL batch's
+    # planes and combine them with these outputs — see
+    # shapes.ensure_bucketed for the ingestion-side canonicalizer.
+    # The one deliberate off-ladder source, masked concat's
+    # sum-of-capacities, is bounded by its input buckets.)
     fp = tuple(e.fingerprint() for e in exprs)
     layout = tuple(_layout_key(c) for c in batch.columns)
     key = (fp, layout, batch.capacity, ansi)
-    fn = _STAGE_CACHE.get(key)
-    fresh = fn is None
     in_dtypes = [c.dtype for c in batch.columns]
     out_dtypes = [e.data_type() for e in exprs]
+    cap = batch.capacity  # capture the int, NOT the batch (a closure
+    # holding the batch would pin its device planes in the stage cache)
 
-    if fn is None:
-        cap = batch.capacity  # capture the int, NOT the batch (a closure
-        # holding the batch would pin its device planes in the stage cache)
-
+    def build():
         def stage(col_planes, num_rows, live):
             cols = [_col_from_planes(p, dt) for p, dt in zip(col_planes, in_dtypes)]
             ctx = EvalCtx(cols, num_rows, cap, ansi, live=live)
@@ -86,9 +90,11 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
             out_planes = [_planes_of(c) for c in outs]
             err = {code: mask for code, mask in ctx.errors}
             return out_planes, err
+        return stage
 
-        fn = jax.jit(stage)
-        _STAGE_CACHE[key] = fn
+    # the sanctioned compile choke point (runtime/compile_cache.py):
+    # storage, hit/miss stats, first-call compile attribution
+    fn = _cc.get("run_stage", key, build)
 
     from spark_rapids_tpu.columnar.batch import traced_rows
     from spark_rapids_tpu.exec import fuse
@@ -97,15 +103,10 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
     col_planes = [_planes_of(c) for c in batch.columns]
     with TR.span("compiled.run_stage", cat="dispatch", level=TR.DEBUG,
                  args={"exprs": len(exprs)}):
-        _t0 = time.perf_counter_ns() if fresh else 0
         out_planes, err = fn(col_planes,
                              jnp.asarray(traced_rows(batch.num_rows),
                                          jnp.int32),
                              batch.live_mask())
-        if fresh:
-            # a fresh stage entry's first call pays XLA trace+compile:
-            # attribute it to the 'compile' bucket (attribution.py)
-            _attr.record("compile", time.perf_counter_ns() - _t0)
     raise_errors(err)
     outs = [_col_from_planes(p, dt) for p, dt in zip(out_planes, out_dtypes)]
     carry_bounds(exprs, batch.columns, outs)

@@ -15,20 +15,19 @@ PIPELINE STAGE per batch.
 
 The cache is keyed by a semantic fingerprint (expression fingerprints +
 operator shape); jax.jit's own signature cache handles layout/capacity
-variation beneath each entry.
+variation beneath each entry — and runtime/shapes.py guarantees those
+capacities come from a small bucket set, so the variation is bounded.
+Storage, stats and first-call compile attribution live in the sanctioned
+compile choke point (runtime/compile_cache.py); this module remains the
+per-batch DISPATCH choke point where the failure-domain hooks hang.
 """
 from __future__ import annotations
 
-import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Optional, Tuple
 
-import jax
-
+from spark_rapids_tpu.runtime import compile_cache as _cc
 from spark_rapids_tpu.runtime import faults as _faults
 from spark_rapids_tpu.runtime import watchdog as _watchdog
-from spark_rapids_tpu.runtime.obs import attribution as _attr
-
-_FUSE_CACHE: Dict[Tuple, Callable] = {}
 
 #: test/diagnostic hook called with the fuse key once per device dispatch
 #: issued through fused() (the dispatch-budget regression harness; see
@@ -49,32 +48,14 @@ def notify_dispatch(key: Tuple) -> None:
         _DISPATCH_HOOK(key)
 
 
-def _timed_first_call(key: Tuple, jfn: Callable) -> Callable:
-    """Attribute the first execution of a fresh fuse entry to the
-    'compile' bucket (runtime/obs/attribution.py): the first call pays
-    XLA trace+compile (7-11s first-run vs 0.6s steady on NDS — compile
-    dominates the first batch's compute 10x+). After it completes, the
-    raw jitted fn swaps back into the cache so steady-state dispatches
-    pay nothing."""
-    done = [False]
-
-    def first(*args, **kwargs):
-        t0 = time.perf_counter_ns()
-        out = jfn(*args, **kwargs)
-        if not done[0]:
-            done[0] = True
-            _FUSE_CACHE[key] = jfn
-            _attr.record("compile", time.perf_counter_ns() - t0)
-        return out
-
-    return first
-
-
 def fused(key: Tuple, builder: Callable[[], Callable]) -> Callable:
-    fn = _FUSE_CACHE.get(key)
-    if fn is None:
-        fn = _timed_first_call(key, jax.jit(builder()))
-        _FUSE_CACHE[key] = fn
+    # key[0] names the operator family ("hash_exchange_compact",
+    # "stage", ...): it doubles as the compile cache's exec-class so
+    # hit/miss stats and warmup coverage group by operator kind. The
+    # cache owns storage, conf fingerprinting, and first-call compile
+    # attribution (7-11s first-run vs 0.6s steady on NDS).
+    exec_class = key[0] if key and isinstance(key[0], str) else "fuse"
+    fn = _cc.get(exec_class, key, builder)
     # fused() is THE per-batch device-dispatch choke point, so it is
     # also where the failure-domain hooks live: the device.dispatch
     # fault site and the dispatch watchdog's in-flight registration.
@@ -98,7 +79,10 @@ def fused(key: Tuple, builder: Callable[[], Callable]) -> Callable:
 
 
 def clear_cache() -> None:
-    _FUSE_CACHE.clear()
+    """Drop every cached fused entry (tests/profiling; delegates to the
+    process-wide compile cache, which also drops the run_stage and
+    absorbed-agg entries)."""
+    _cc.clear()
 
 
 class StageBody:

@@ -2675,8 +2675,12 @@ class HashAggregateExec(TpuExec):
     # -- phase helpers -----------------------------------------------------
 
     def _merge(self, partials: List[ColumnarBatch]) -> ColumnarBatch:
-        if len(partials) == 1:
-            # A single partial already has unique keys — merging is identity.
+        if len(partials) == 1 and not getattr(partials[0], "coalesced",
+                                              False):
+            # A single partial already has unique keys — merging is
+            # identity. NOT true of an exchange-coalesced batch: that is
+            # a concat of several partials (duplicate keys across the
+            # seams), exactly what the merge kernel below exists to fold.
             return partials[0]
         batch = K.concat_batches(partials)
         nkeys = len(self.plan.group_exprs)
@@ -3025,7 +3029,62 @@ class ExchangeExec(TpuExec):
 
     def execute_partition(self, ctx, pidx):
         out = self._materialize()
-        yield from out[pidx]
+        yield from self._coalesce_tiny(out[pidx])
+
+    def _coalesce_tiny(self, batches):
+        """Post-shuffle tiny-partition coalescing (spark.rapids.shuffle.
+        coalesceTinyRows): ragged post-shuffle slice sizes make nearly
+        every sub-batch shape a fresh downstream trace AND a separate
+        dispatch — the q72shfl shape zoo. Adjacent device sub-batches
+        under the tiny threshold merge (bounded at 4x the threshold)
+        before downstream dispatch. The decision is free: compact slices
+        carry plain host-int row counts from the already-fetched offsets
+        vector, so nothing here ever syncs a lazy count (batches whose
+        count is still on device pass through untouched, as do masked
+        batches and lazily-deserialized shuffle blobs). Merges count
+        into shuffleCoalescedBatches — visible in EXPLAIN ANALYZE."""
+        tiny = int(self.conf.get(C.SHUFFLE_COALESCE_TINY_ROWS))
+        if tiny <= 0 or getattr(self, "n_out", 1) <= 1:
+            yield from batches
+            return
+        budget = tiny * 4
+        run: List[ColumnarBatch] = []
+        run_rows = 0
+        for b in batches:
+            small = (isinstance(b, ColumnarBatch)
+                     and b.row_mask is None
+                     and isinstance(b.num_rows, int)
+                     and 0 < b.num_rows < tiny)
+            if small and run_rows + b.num_rows <= budget:
+                run.append(b)
+                run_rows += b.num_rows
+                continue
+            yield from self._flush_coalesce_run(run)
+            if small:
+                run, run_rows = [b], b.num_rows
+            else:
+                run, run_rows = [], 0
+                yield b
+        yield from self._flush_coalesce_run(run)
+
+    def _flush_coalesce_run(self, run):
+        if not run:
+            return
+        if len(run) == 1:
+            yield run[0]
+            return
+        merged = K.concat_batches(run)
+        # a coalesced batch is a CONCAT of exchange sub-batches: any
+        # per-batch invariant the sources carried individually (a final
+        # agg's "one partial has unique keys") no longer holds — the
+        # flag tells _merge to run its merge kernel even for a single
+        # input batch
+        merged.coalesced = True
+        self.metrics.metric(M.SHUFFLE_COALESCED_BATCHES).add(len(run))
+        TR.instant("shuffleCoalesce", cat="exchange",
+                   args={"merged": len(run),
+                         "rows": int(merged.num_rows)}, level=TR.DEBUG)
+        yield merged
 
 
 class CollectExchangeExec(ExchangeExec):
@@ -3235,11 +3294,17 @@ class ShuffleExchangeExec(ExchangeExec):
 
     def execute_partition(self, ctx, pidx):
         out = self._materialize()
-        for item in out[pidx]:
-            if isinstance(item, _LazyShuffleBlobs):
-                yield from item.batches()
-            else:
-                yield item
+
+        def decoded():
+            for item in out[pidx]:
+                if isinstance(item, _LazyShuffleBlobs):
+                    yield from item.batches()
+                else:
+                    yield item
+
+        # deserialized blobs coalesce exactly like device sub-batches:
+        # the serialized path chops partitions even finer
+        yield from self._coalesce_tiny(decoded())
 
     def _ici_eligible(self, child_results):
         import jax as _jax
@@ -3393,9 +3458,10 @@ class ShuffleExchangeExec(ExchangeExec):
             return X.all_to_all_exchange(planes, live, target, ("part",),
                                          send_cap=send_cap)
 
-        fn = _jax.jit(shard_map(shard_fn, mesh=mesh,
-                                in_specs=(spec, spec, spec),
-                                out_specs=({k: spec for k in planes}, spec)))
+        from spark_rapids_tpu.runtime import compile_cache as _cc
+        fn = _cc.jit(shard_map(shard_fn, mesh=mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=({k: spec for k in planes}, spec)))
         out_planes, out_live = fn(planes, live, target)
 
         # slice the global result back into per-partition, PER-SENDER

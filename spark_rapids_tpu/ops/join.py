@@ -21,7 +21,6 @@ mirror of the reference's incompatOps discipline).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -31,6 +30,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.batch import ColumnVector, round_capacity
 from spark_rapids_tpu.ops import kernels as K
+from spark_rapids_tpu.runtime import compile_cache as _cc
 
 
 def _combine_keys(cols: List[ColumnVector], num_rows: int, live=None
@@ -220,7 +220,7 @@ def join_pairs(build_keys: List[ColumnVector], build_rows: int,
     return out_p, out_b, match_count
 
 
-@partial(jax.jit, static_argnames=("bcap", "span"))
+@_cc.jit(static_argnames=("bcap", "span"))
 def _dense_table(bv, b_in, bcap, bmin, span):
     """(starts[span+1], sorted_orig[bcap]): direct-address layout of build
     rows grouped by key value (counting sort by key)."""

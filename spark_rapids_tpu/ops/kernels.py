@@ -13,7 +13,6 @@ scalar, then a jitted gather pass compiled per output-capacity bucket
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -27,6 +26,7 @@ from spark_rapids_tpu.columnar.batch import (
     ColumnVector, ColumnarBatch, LazyRowCount, materialize_counts,
     round_capacity, traced_rows,
 )
+from spark_rapids_tpu.runtime import compile_cache as _cc
 
 # ---------------------------------------------------------------------------
 # Spark-compatible Murmur3 (x86_32, seed 42) -- reference jni.Hash murmur3.
@@ -564,13 +564,13 @@ def gather_batch(batch: ColumnarBatch, indices: jax.Array, out_rows: int) -> Col
 # Filter: count-then-gather compaction
 # ---------------------------------------------------------------------------
 
-@jax.jit
+@_cc.jit
 def _count_true(mask: jax.Array, num_rows) -> jax.Array:
     cap = mask.shape[0]
     return jnp.sum((mask & (jnp.arange(cap) < num_rows)).astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnums=(2,))
+@_cc.jit(static_argnums=(2,))
 def _compact_indices(mask: jax.Array, num_rows, out_cap: int) -> jax.Array:
     cap = mask.shape[0]
     mask = mask & (jnp.arange(cap) < num_rows)
@@ -622,7 +622,7 @@ def compact_batch(batch: ColumnarBatch) -> ColumnarBatch:
 # Slice / concat (reference cudf Table.concatenate / contiguous split)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(1,))
+@_cc.jit(static_argnums=(1,))
 def _shrink_gather(batch, new_cap: int):
     n = traced_rows(batch.num_rows)
     idx = jnp.arange(new_cap, dtype=jnp.int32)

@@ -23,7 +23,6 @@ exactly once. Untouched tails are masked out host-side.
 """
 from __future__ import annotations
 
-import functools
 from typing import List, Tuple
 
 import numpy as np
@@ -31,6 +30,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from spark_rapids_tpu.runtime import compile_cache as _cc
 
 TILE = 1024  # 1-D i32 blocks must match XLA's 1024-element tiling
 #: per-group row-count bound: 8-bit digits reach 2^8, so counts <= 2^16
@@ -85,7 +86,7 @@ def _kernel_factory(P: int):
 CHUNK_ROWS = 1 << 23
 
 
-@functools.partial(jax.jit, static_argnames=("outcap",))
+@_cc.jit(static_argnames=("outcap",))
 def segsum_window(gid: jax.Array, payload: jax.Array, outcap: int
                   ) -> jax.Array:
     """gid i32[N] sorted ascending (dense ids); payload bf16[N, P] (8-bit

@@ -27,7 +27,6 @@ further synchronization.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import List, Optional
 
 import numpy as np
@@ -37,6 +36,7 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, round_capacity
 from spark_rapids_tpu.ops import kernels as K
+from spark_rapids_tpu.runtime import compile_cache as _cc
 
 
 def partition_counts(pid: jax.Array, live: jax.Array, n_out: int
@@ -75,7 +75,7 @@ def counting_sort_by_pid(batch: ColumnarBatch, pid: jax.Array, n_out: int):
     return out, offsets
 
 
-@partial(jax.jit, static_argnums=(3,))
+@_cc.jit(static_argnums=(3,))
 def _slice_kernel(batch, start, length, out_cap: int):
     """One jitted gather per output slice. start/length ride as TRACED
     scalars so the executable caches per (input layout, out_cap) bucket

@@ -25,6 +25,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from spark_rapids_tpu.parallel import exchange as X
+from spark_rapids_tpu.runtime import compile_cache as _cc
 
 
 def splitmix64(x: jax.Array) -> jax.Array:
@@ -72,7 +73,7 @@ def make_distributed_groupby_sum(mesh: Mesh, filter_fn: Callable,
         return shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
                          out_specs=out_spec)(key, valid, values)
 
-    return jax.jit(step)
+    return _cc.jit(step)
 
 
 def make_distributed_reduction(mesh: Mesh, reduce_fn: Callable):
@@ -93,7 +94,7 @@ def make_distributed_reduction(mesh: Mesh, reduce_fn: Callable):
         return shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
                          out_specs=P())(valid, values)
 
-    return jax.jit(step)
+    return _cc.jit(step)
 
 
 def shard_global(mesh: Mesh, arr: jax.Array) -> jax.Array:

@@ -49,13 +49,32 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
     bounded executor could deadlock nested waves behind blocked outer
     tasks. Wave threads carry the `rapids-task` prefix, which `_depth()`
     maps to 0 — their submissions land on tier 0 exactly like the old
-    per-call pools' did."""
+    per-call pools' did.
+
+    Wave threads inherit the SUBMITTER's thread-bound session conf and
+    attribution-suppression state: the compile cache's conf fingerprint
+    and the warmup-replay suppression are thread-local, and a wave
+    thread deciding them from process defaults would key one query's
+    executables under two fingerprints (or leak a warmup replay's
+    compile seconds into a user query's attribution)."""
     items = list(items)
     if len(items) <= 1:
         return [fn(i) for i in items]
+    from spark_rapids_tpu import config as _cfg
+    from spark_rapids_tpu.runtime.obs import attribution as _attr
+    conf = getattr(_cfg._local, "conf", None)
+    suppress = _attr.thread_suppressed()
+
+    def bound(item):
+        if conf is not None:
+            _cfg.set_session_conf(conf)
+        if suppress:
+            _attr.set_thread_suppressed(True)
+        return fn(item)
+
     with ThreadPoolExecutor(max_workers=min(len(items), max_concurrency),
                             thread_name_prefix=_PREFIX_TASK) as tp:
-        return list(tp.map(fn, items))
+        return list(tp.map(bound, items))
 
 
 def spawn_service_thread(target, name: str, daemon: bool = True

@@ -55,6 +55,12 @@ PARTITION_HOST_FETCHES = "partitionHostFetches"
 #: computation per batch; the unfused chain pays one per member operator.
 #: Dispatch-budget tests assert stageDispatches == input batch count.
 STAGE_DISPATCHES = "stageDispatches"
+#: post-shuffle sub-batches merged by tiny-partition coalescing
+#: (spark.rapids.shuffle.coalesceTinyRows): adjacent device sub-batches
+#: under the threshold concat into one batch before downstream
+#: dispatch, shrinking both the dispatch count and the shape zoo the
+#: compile cache must cover
+SHUFFLE_COALESCED_BATCHES = "shuffleCoalescedBatches"
 #: serialized-shuffle bytes an exchange wrote into its host store
 #: (post-compression wire bytes; reference shuffle write metrics)
 SHUFFLE_BYTES_WRITTEN = "shuffleBytesWritten"

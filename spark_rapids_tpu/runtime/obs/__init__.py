@@ -140,6 +140,35 @@ def _preregister(reg: MetricsRegistry) -> None:
     reg.counter("rapids_slo_breaches_total",
                 "Queries that exceeded their latency SLO "
                 "(spark.rapids.obs.slo.*)")
+    # compile accounting (runtime/compile_cache.py): backend compiles
+    # and persistent-cache traffic count via the jax.monitoring
+    # listener; warm-trace hit/miss read live from the cache stats
+    reg.counter("rapids_xla_compiles_total",
+                "XLA backend compiles observed process-wide (including "
+                "jit signature-cache re-traces)")
+    reg.float_counter("rapids_xla_compile_seconds_total",
+                      "Seconds spent in XLA backend compiles")
+    reg.counter("rapids_persistent_cache_hits_total",
+                "Compile requests served from the persistent "
+                "compilation cache (spark.rapids.compile.cacheDir)")
+    reg.counter("rapids_persistent_cache_misses_total",
+                "Compile requests the persistent compilation cache "
+                "missed")
+
+    def _cc_stat(name):
+        def read():
+            from spark_rapids_tpu.runtime import compile_cache as CC
+            return CC.stats()[name]
+        return read
+
+    reg.gauge_fn("rapids_compile_cache_hits", _cc_stat("hits"),
+                 "Warm-trace compile-cache hits (keyed entries resolved "
+                 "without building)")
+    reg.gauge_fn("rapids_compile_cache_misses", _cc_stat("misses"),
+                 "Warm-trace compile-cache misses (fresh entries built "
+                 "and first-call compile paid)")
+    reg.gauge_fn("rapids_compile_cache_entries", _cc_stat("entries"),
+                 "Live warm-trace compile-cache entries")
     reg.counter("rapids_flight_dumps_total",
                 "Flight-recorder dumps written, by trigger",
                 labels={"reason": "query_failed"})
@@ -498,6 +527,40 @@ def _publish_exec_rollups(reg: MetricsRegistry, snaps: Dict[str, dict]
 # health
 # ---------------------------------------------------------------------------
 
+def _compile_doc():
+    try:
+        from spark_rapids_tpu.runtime import compile_cache as CC
+        return CC.doc()
+    except Exception:  # noqa: BLE001 - health must always render
+        return None
+
+
+def _warmup_doc():
+    try:
+        from spark_rapids_tpu.runtime import warmup as WU
+        return WU.doc()
+    except Exception:  # noqa: BLE001 - health must always render
+        return None
+
+
+def suppressed_actions():
+    """Context manager making every collect on the CURRENT thread look
+    nested to the live layer (on_query_start returns NESTED: no history
+    record, no SLO fold, no query counters). The AOT warmup replays run
+    under this — they are cache-priming work, not user queries."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        _TLS.depth = getattr(_TLS, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            _TLS.depth = max(0, getattr(_TLS, "depth", 1) - 1)
+
+    return _cm()
+
+
 def healthz() -> dict:
     """The /healthz document. Degraded when the device probe is blocked
     or failing OR the device circuit breaker is open (the engine is
@@ -553,6 +616,11 @@ def healthz() -> dict:
         # the retroactive surfaces: most recent flight dump + the last
         # slow query (digest, breach, attribution summary, dump path)
         "flight": flight.doc(),
+        # compile tax: warm-trace hit/miss, backend compile totals, the
+        # persistent layer's cross-process traffic, and AOT warmup
+        # progress (runtime/compile_cache.py + runtime/warmup.py)
+        "compile": _compile_doc(),
+        "warmup": _warmup_doc(),
         "slo": dict(st.slo.doc(), last_slow=st.last_slow)
         if st.slo is not None else None,
         "queries": {

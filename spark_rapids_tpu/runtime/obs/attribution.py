@@ -107,6 +107,39 @@ _LOCK = _san.lock("obs.attribution")
 #: no top-level action is running — record() is then one global read
 _AGG: Optional[Dict[str, int]] = None
 
+import threading as _threading  # noqa: E402 (module-local alias)
+
+#: per-thread suppression: the AOT warmup replays set this (and the
+#: task-wave factory propagates it to their task threads) so a replay's
+#: compile/task records cannot land in a CONCURRENT user query's
+#: aggregate — the one module-global _AGG cannot tell callers apart
+_SUPPRESS = _threading.local()
+
+
+def thread_suppressed() -> bool:
+    return bool(getattr(_SUPPRESS, "on", False))
+
+
+def set_thread_suppressed(on: bool) -> None:
+    _SUPPRESS.on = bool(on)
+
+
+def suppress_scope():
+    """Context manager suppressing record()/fold_task() on the CURRENT
+    thread (task waves submitted within inherit it)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = thread_suppressed()
+        _SUPPRESS.on = True
+        try:
+            yield
+        finally:
+            _SUPPRESS.on = prev
+
+    return _cm()
+
 
 # ---------------------------------------------------------------------------
 # per-query aggregate lifecycle (driven by TpuSession.collect)
@@ -138,6 +171,8 @@ def record(bucket: str, ns: int) -> None:
     compile timing). No active query: one module-global read."""
     if _AGG is None:
         return
+    if thread_suppressed():
+        return  # warmup-replay work: not this user query's time
     with _LOCK:
         agg = _AGG
         if agg is not None:
@@ -148,7 +183,7 @@ def fold_task(metrics: Dict[str, object]) -> None:
     """Fold one finished task's accumulators into the active aggregate
     (called from TaskContext.complete — one fold per task, never per
     batch; no active query: one module-global read)."""
-    if _AGG is None:
+    if _AGG is None or thread_suppressed():
         return
     for name, bucket in TASK_BUCKETS.items():
         m = metrics.get(name)

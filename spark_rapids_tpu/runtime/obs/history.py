@@ -159,6 +159,11 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
             rec["plan_digest"] = plan_digest(plan)
         except Exception:  # noqa: BLE001
             rec["plan_digest"] = None
+    sql = getattr(plan, "_sql_text", None)
+    if isinstance(sql, str) and sql:
+        # the replayable spec: AOT warmup (runtime/warmup.py) re-executes
+        # recurring SQL-born plans from the store at session start
+        rec["sql"] = sql
     try:
         exec_root = getattr(session, "_last_exec", None)
         if exec_root is not None:

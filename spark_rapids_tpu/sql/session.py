@@ -23,6 +23,26 @@ from spark_rapids_tpu.sql.dataframe import DataFrame
 _COLLECT_DEPTH = threading.local()
 
 
+def nested_action_scope():
+    """Context manager making collects on the CURRENT thread run as
+    nested actions: no attribution aggregate open/reset, no breaker
+    probe consumption, no degradation policy, no last_action_status.
+    The AOT warmup replays (runtime/warmup.py) run under this — they
+    are cache-priming work sharing the process with real queries."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        d = getattr(_COLLECT_DEPTH, "d", 0)
+        _COLLECT_DEPTH.d = d + 1
+        try:
+            yield
+        finally:
+            _COLLECT_DEPTH.d = d
+
+    return _cm()
+
+
 def _discover_hive(root: str):
     """Walk a directory for hive-layout partitions (k=v subdirs). Returns
     (files, per_file_partition_values) or (files, None) when the layout is
@@ -77,6 +97,12 @@ class TpuSession:
         # optional /metrics+/healthz endpoint, optional history store
         from spark_rapids_tpu.runtime import obs
         obs.install(self.conf)
+        # persistent compilation cache + AOT warmup
+        # (spark.rapids.compile.*): the cache dir applies immediately;
+        # warmup arms now and launches replays as tables register
+        from spark_rapids_tpu.runtime import compile_cache, warmup
+        compile_cache.configure(self.conf)
+        warmup.maybe_arm(self)
 
     def _activate(self):
         # name binding (case sensitivity) consults the active session conf
@@ -88,6 +114,10 @@ class TpuSession:
     def create_or_replace_temp_view(self, name: str, df) -> None:
         """Register a DataFrame for session.sql() FROM resolution."""
         self._views[name.lower()] = df
+        # a new table may unblock pending AOT warmup replays (one
+        # module-global read when warmup is unarmed)
+        from spark_rapids_tpu.runtime import warmup
+        warmup.notify_view_registered(self)
 
     createOrReplaceTempView = create_or_replace_temp_view
 
@@ -100,7 +130,15 @@ class TpuSession:
         """Run a SQL string over registered temp views (the analytic
         subset grammar — sql/parser.py)."""
         from spark_rapids_tpu.sql.parser import parse_sql
-        return parse_sql(query, self)
+        df = parse_sql(query, self)
+        try:
+            # the replayable spec: history records carry the SQL text so
+            # AOT warmup (runtime/warmup.py) can re-execute recurring
+            # plans at session start
+            df.plan._sql_text = query
+        except Exception:  # noqa: BLE001 - a slotted plan node just
+            pass  # isn't warmup-replayable
+        return df
 
     def create_dataframe(self, data, num_partitions: int = 1) -> DataFrame:
         self._activate()

@@ -50,3 +50,10 @@ def _reset_runtime():
         if st.slo is not None:
             st.slo.reset_for_tests()
         st.last_slow = None
+    # a test that armed AOT warmup must not leak its manager (and its
+    # captured session) into the next test; the warm-trace cache itself
+    # deliberately persists — it is process-global by design and tests
+    # asserting compile counts diff the stats around their own queries
+    from spark_rapids_tpu.runtime import shapes, warmup
+    warmup.reset_for_tests()
+    shapes.configure(2.0, True)

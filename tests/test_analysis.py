@@ -306,6 +306,67 @@ def test_l009_skipped_without_roster():
     assert _rules(vs) == []
 
 
+def _lint_compile(src, relpath="ops/x.py",
+                  pallas=frozenset({"ops/pallas_kernels.py"})):
+    return lint.lint_source(textwrap.dedent(src), "/x/" + relpath,
+                            {"opTime"}, relpath=relpath,
+                            pallas_modules=set(pallas))
+
+
+def test_l010_raw_jit_flagged():
+    vs = _lint_compile("""
+        import jax
+        from functools import partial
+        @jax.jit
+        def f(x):
+            return x + 1
+        @partial(jax.jit, static_argnums=(1,))
+        def g(x, n):
+            return x[:n]
+        def h(step):
+            return jax.jit(step)
+    """)
+    assert _rules(vs) == ["TPU-L010"] * 3
+
+
+def test_l010_compile_cache_and_wrapper_allowed():
+    # the choke point itself, and code routing THROUGH it, are clean
+    vs = _lint_compile("""
+        import jax
+        def get(key, builder):
+            return jax.jit(builder())
+    """, relpath="runtime/compile_cache.py")
+    assert _rules(vs) == []
+    vs = _lint_compile("""
+        from spark_rapids_tpu.runtime import compile_cache as _cc
+        @_cc.jit(static_argnums=(1,))
+        def g(x, n):
+            return x[:n]
+    """)
+    assert _rules(vs) == []
+
+
+def test_l010_pallas_confined_to_roster():
+    src = """
+        from jax.experimental import pallas as pl
+        def k(kern, x):
+            return pl.pallas_call(kern, out_shape=x)(x)
+    """
+    assert _rules(_lint_compile(src, relpath="ops/x.py")) == ["TPU-L010"]
+    assert _rules(_lint_compile(
+        src, relpath="ops/pallas_kernels.py")) == []
+
+
+def test_l010_roster_extraction_matches_compile_cache():
+    mods = lint.known_pallas_modules(
+        os.path.join(REPO, "spark_rapids_tpu"))
+    from spark_rapids_tpu.runtime.compile_cache import (
+        SANCTIONED_PALLAS_MODULES,
+    )
+    assert mods == set(SANCTIONED_PALLAS_MODULES)
+    assert "ops/pallas_segsum.py" in mods
+
+
 def test_lint_full_tree_is_clean():
     """The acceptance bar: zero unsuppressed violations over the whole
     package, <=5 suppressions, every one carrying a reason."""

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
-# chaos smoke, obs smoke, flight smoke, pipeline smoke, tier-1 tests.
+# chaos smoke, obs smoke, flight smoke, pipeline smoke, compile smoke,
+# tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
@@ -58,6 +59,11 @@ fi
 
 step "pipeline smoke (overlap engaged on a multi-batch query, LIMIT cancel, no thread leak)"
 if ! python tools/pipeline_smoke.py; then
+    fail=1
+fi
+
+step "compile smoke (cross-process persistent-cache hits; warm-history AOT warmup drops first-run compile_seconds >=5x; warm choke-point overhead <2%)"
+if ! python tools/compile_smoke.py; then
     fail=1
 fi
 

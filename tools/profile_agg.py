@@ -45,4 +45,5 @@ for k, v in m.items():
     if it:
         print(f"  {k}: " + ", ".join(f"{mk}={mv:.3f}s" for mk, mv in
                                      sorted(it.items(), key=lambda x: -x[1])))
-print("fused:", sorted({k[0] for k in fuse._FUSE_CACHE}))
+from spark_rapids_tpu.runtime import compile_cache
+print("fused:", sorted({k[0] for k in compile_cache.cache_keys()}))
