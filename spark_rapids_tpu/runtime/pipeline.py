@@ -65,6 +65,32 @@ _DONE = object()
 #: hand sentinel: no stashed overflow item
 _EMPTY = object()
 
+#: consumers currently blocked waiting on a producer refill — the live
+#: "pipeline stall state" gauge the resource sampler reads
+#: (runtime/obs/sampler.py). Guarded by its own tiny lock: the counter
+#: moves only on the SLOW path (the consumer is about to block on an
+#: empty queue), never per batch.
+_STALLED = 0
+_STALL_LOCK = threading.Lock()
+
+
+def stalled_consumers() -> int:
+    """Pipeline consumers blocked on a producer right now (racy read by
+    design — it feeds a sampler gauge)."""
+    return _STALLED
+
+
+def _stall_enter() -> None:
+    global _STALLED
+    with _STALL_LOCK:
+        _STALLED += 1
+
+
+def _stall_exit() -> None:
+    global _STALLED
+    with _STALL_LOCK:
+        _STALLED = max(0, _STALLED - 1)
+
 
 def start_d2h(dev) -> None:
     """Begin an async device->host copy of `dev` (a jax array) without
@@ -109,7 +135,14 @@ class PipelinedIterator:
         self._stall = stall_metric
         self._prod = producer_metric
         from spark_rapids_tpu.analysis import sanitizer as _san
+        from spark_rapids_tpu.runtime.obs import live as _live
         self._pool = get_host_pool(conf)
+        # the consumer's bound query id: refills re-bind it (with the
+        # TaskContext) so producer-side spans/instants/ring entries
+        # attribute to the owning query even from pool workers that the
+        # submit-time wrapper cannot cover (the refill re-arms ITSELF
+        # from inside _refill_loop's exit paths via the consumer)
+        self._query_id = _live.current_query_id()
         self._lock = _san.lock("pipeline.iterator")
         self._cancel = False
         self._refill_running = False
@@ -141,8 +174,10 @@ class PipelinedIterator:
         flag in a finally instead would leave a window where the
         consumer drains the queue against a stale True and blocks with
         nobody left to re-arm."""
+        from spark_rapids_tpu.runtime.obs import live as _live
         from spark_rapids_tpu.runtime.task import TaskContext
         prev = TaskContext.peek()
+        prev_qid = _live.bind(self._query_id)
         if self._ctx is not None:
             TaskContext.set_current(self._ctx)
         try:
@@ -160,6 +195,7 @@ class PipelinedIterator:
                         except queue.Full:
                             self._hand = _ProducerError(e)
         finally:
+            _live.bind(prev_qid)
             if self._ctx is not None:
                 if prev is not None:
                     TaskContext.set_current(prev)
@@ -226,7 +262,11 @@ class PipelinedIterator:
                 item = self._q.get_nowait()
             except queue.Empty:
                 t0 = time.perf_counter_ns()
-                item = self._q.get()
+                _stall_enter()
+                try:
+                    item = self._q.get()
+                finally:
+                    _stall_exit()
                 dt = time.perf_counter_ns() - t0
                 if self._stall is not None:
                     self._stall.add(dt)

@@ -25,6 +25,12 @@ class TaskContext:
             self.task_id = TaskContext._counter
         self.partition_id = partition_id
         self.stage_id = stage_id
+        # cross-thread query correlation: the constructing thread's
+        # bound query id (runtime/obs/live.py) — task waves bind it
+        # before constructing contexts, so every task knows which
+        # in-flight query it works for (None outside any query)
+        from spark_rapids_tpu.runtime.obs import live as _live
+        self.query_id = _live.current_query_id()
         self.holds_device_data = False
         self.start_ns = time.perf_counter_ns()
         self._metrics: Dict[str, GpuMetric] = {}

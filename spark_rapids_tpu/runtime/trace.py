@@ -59,6 +59,10 @@ from spark_rapids_tpu.analysis import sanitizer as _san  # noqa: E402
 # failure can dump a retroactive timeline. _flight._REC is None when the
 # recorder is off — one module-global read past the tracer check.
 from spark_rapids_tpu.runtime.obs import flight as _flight  # noqa: E402
+# cross-thread query correlation (runtime/obs/live.py): traced events
+# carry the emitting thread's bound query id so two queries' events in
+# one trace (nested collects, pool threads) stay attributable
+from spark_rapids_tpu.runtime.obs import live as _live  # noqa: E402
 
 _TRACER: "Optional[Tracer]" = None
 _STATE_LOCK = _san.lock("trace.state")
@@ -134,8 +138,20 @@ class Tracer:
 
     # -- event emission ----------------------------------------------------
 
+    @staticmethod
+    def _with_qid(args: Optional[dict]) -> Optional[dict]:
+        """args + the emitting thread's bound query id (one thread-local
+        read; None binding leaves args untouched)."""
+        qid = _live.current_query_id()
+        if qid is None:
+            return args
+        out = dict(args) if args else {}
+        out.setdefault("query_id", qid)
+        return out
+
     def complete(self, name: str, t0_ns: int, dur_ns: int, cat: str,
                  args: Optional[dict] = None) -> None:
+        args = self._with_qid(args)
         ev = {"ph": "X", "name": name, "cat": cat, "pid": self.pid,
               "tid": self._track(), "ts": self._ts_us(t0_ns),
               "dur": dur_ns / 1000.0}
@@ -146,6 +162,7 @@ class Tracer:
 
     def instant(self, name: str, cat: str,
                 args: Optional[dict] = None) -> None:
+        args = self._with_qid(args)
         ev = {"ph": "i", "name": name, "cat": cat, "pid": self.pid,
               "tid": self._track(), "ts": self._ts_us(time.perf_counter_ns()),
               "s": "t"}
@@ -372,6 +389,10 @@ def on_task_complete(ctx) -> None:
     tr.task_rollup({
         "type": "task",
         "query_id": tr.query_id,
+        # the LIVE registry's id (runtime/obs/live.py; the tracer's own
+        # query_id is its per-tracer sequence) — lets the event log of a
+        # trace shared by nested/concurrent work split per real query
+        "live_query_id": ctx.query_id,
         "task_id": ctx.task_id,
         "partition_id": ctx.partition_id,
         "stage_id": ctx.stage_id,

@@ -234,6 +234,15 @@ class TpuSession:
         exec_root, meta = convert_plan(plan, self.conf)
         self._last_meta = meta
         self._last_exec = exec_root
+        # attach the converted tree to THIS query's live context (the
+        # thread's bound query id) so /queries progress walks the
+        # query's OWN execs — not session._last_exec, which concurrent
+        # queries in one session clobber. First attach wins: a nested
+        # collect re-enters here while the outer query executes
+        from spark_rapids_tpu.runtime.obs import live as _live
+        qc = _live.current_context()
+        if qc is not None:
+            qc.attach_exec(exec_root)
         return exec_root, meta
 
     def last_metrics(self):
@@ -270,8 +279,33 @@ class TpuSession:
             # restores its own paths when it finalizes.
             self.last_trace_paths = None
         # live-observability token: None when obs is off or this is a
-        # nested collect (only top-level actions publish + make history)
-        ot = OBS.on_query_start()
+        # nested collect (only top-level actions publish + make history).
+        # The digest is computed UP FRONT (a cheap logical-tree hash) so
+        # the live registry and the queryStart marker can carry it while
+        # the query is still running — a hung query's flight dump needs
+        # its t0 and identity without waiting for the epilogue
+        start_digest = None
+        if getattr(_COLLECT_DEPTH, "d", 0) == 0:
+            try:
+                start_digest = OBS.plan_digest(plan)
+            except Exception:  # noqa: BLE001 - an undigestable plan
+                pass  # still runs and registers
+        ot = OBS.on_query_start(plan_digest=start_digest,
+                                sql=getattr(plan, "_sql_text", None))
+        if getattr(_COLLECT_DEPTH, "d", 0) == 0:
+            # queryStart instant for EVERY top-level action, traced or
+            # not (the flight ring records it too): ring timelines of a
+            # hung or failed query get a t0 marker with the query's
+            # identity, pairing with the queryError/queryDegraded
+            # epilogue markers
+            try:
+                TR.instant("queryStart", cat="query", args={
+                    "query_id": ot if isinstance(ot, int) else None,
+                    "plan_digest": start_digest},
+                    level=TR.ESSENTIAL)
+            except Exception:  # noqa: BLE001 - a marker failure must
+                pass  # not fail the query
+
         if qt is not None or (ot is not None and ot is not OBS.NESTED):
             # drop the PREVIOUS action's exec tree before this one runs:
             # a failure before convert_plan rebuilds it must publish
@@ -437,6 +471,18 @@ class TpuSession:
         log = logging.getLogger("spark_rapids_tpu")
         if status is None:
             status = "ok" if error is None else "failed"
+        if top_level and isinstance(ot, int):
+            # the epilogue (metric snapshot, attribution, trace
+            # finalize, history publish) runs with the query visible as
+            # `finishing` — a scrape during a slow lazy-count resolve
+            # must not show a finished query as still executing
+            try:
+                from spark_rapids_tpu.runtime.obs import live as _live
+                qc = _live.get(ot)
+                if qc is not None:
+                    qc.transition("finishing")
+            except Exception:  # noqa: BLE001 - registry is advisory
+                pass
         # ONE metric snapshot serves the trace finalize, the registry
         # rollups, and the history record (resolving lazy device row
         # counts costs real syncs) — and it is taken at all only when
@@ -597,6 +643,16 @@ class TpuSession:
             return pa.Table.from_arrays(
                 [pa.array([], type=f.type) for f in fields], schema=pa.schema(fields))
         return pa.concat_tables(tables)
+
+    def running_queries(self) -> List[dict]:
+        """Live progress snapshots of every in-flight top-level query in
+        this PROCESS (runtime/obs/live.py; the registry is process-wide,
+        like the obs endpoint it feeds): query id, state, elapsed,
+        per-exec batches/rows, %-complete and ETA. Pull-based and
+        sync-free — scraping never adds device round trips to the
+        running queries. Empty when obs or progress tracking is off."""
+        from spark_rapids_tpu.runtime.obs import live as _live
+        return _live.running_docs(with_execs=True)
 
     def last_plan_explain(self) -> str:
         return self._last_meta.explain(all_ops=True) if self._last_meta else ""

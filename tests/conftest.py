@@ -42,9 +42,13 @@ def _reset_runtime():
     # flight rings / dump rate-limit state, the per-query attribution
     # aggregate, and SLO baselines are process-global too
     from spark_rapids_tpu.runtime import obs
-    from spark_rapids_tpu.runtime.obs import attribution, flight
+    from spark_rapids_tpu.runtime.obs import attribution, flight, live
     flight.uninstall_for_tests()
     attribution.reset_for_tests()
+    # the live query registry and this thread's query-id binding are
+    # process-global (the sampler's one daemon thread deliberately
+    # persists — it is process-global by design and reads only peeks)
+    live.reset_for_tests()
     st = obs.state()
     if st is not None:
         if st.slo is not None:

@@ -367,6 +367,63 @@ def test_l010_roster_extraction_matches_compile_cache():
     assert "ops/pallas_segsum.py" in mods
 
 
+def _lint_live(src, states=frozenset({"executing", "ok"}),
+               series=frozenset({"process_rss_bytes"})):
+    return lint.lint_source(textwrap.dedent(src), "/x/runtime/x.py",
+                            {"opTime"}, relpath="runtime/x.py",
+                            known_states=set(states),
+                            known_series=set(series))
+
+
+def test_l011_unregistered_query_state():
+    vs = _lint_live("""
+        def f(qc):
+            qc.transition("executing")
+            qc.transition("warp_speed")
+    """)
+    assert _rules(vs) == ["TPU-L011"]
+
+
+def test_l011_unregistered_sampler_series():
+    vs = _lint_live("""
+        def f(smp, v):
+            smp.series_point("process_rss_bytes", v)
+            smp.series_point("made_up_series", v)
+            smp.sample_series("also_made_up", v)
+    """)
+    assert _rules(vs) == ["TPU-L011", "TPU-L011"]
+
+
+def test_l011_non_literal_and_other_calls_skipped():
+    vs = _lint_live("""
+        def f(qc, state, store):
+            qc.transition(state)
+            store.record("whatever", 1)
+    """)
+    assert _rules(vs) == []
+
+
+def test_l011_roster_extraction_matches_live_modules():
+    pkg = os.path.join(REPO, "spark_rapids_tpu")
+    from spark_rapids_tpu.runtime.obs.live import STATES
+    from spark_rapids_tpu.runtime.obs.sampler import SERIES
+    assert lint.known_query_states(pkg) == set(STATES)
+    assert lint.known_sampler_series(pkg) == set(SERIES)
+    assert {"queued", "planning", "executing", "finishing", "ok",
+            "failed", "degraded"} == set(STATES)
+    assert {"device_bytes_held", "semaphore_waiting", "breaker_state",
+            "process_rss_bytes",
+            "pipeline_stalled_consumers"} <= set(SERIES)
+
+
+def test_l011_skipped_without_roster():
+    vs = _lint("""
+        def f(qc):
+            qc.transition("warp_speed")
+    """)
+    assert _rules(vs) == []
+
+
 def test_lint_full_tree_is_clean():
     """The acceptance bar: zero unsuppressed violations over the whole
     package, <=5 suppressions, every one carrying a reason."""

@@ -1,0 +1,624 @@
+"""Live-engine-console tests: the in-flight query registry + state
+machine (runtime/obs/live.py), pull-based progress with %-complete/ETA,
+cross-thread query-id correlation (host pool, task waves, pipeline
+refills, TaskContext, flight ring, log records), the resource
+time-series sampler (runtime/obs/sampler.py), the /queries endpoint
+under concurrent scrape-while-running, and the /healthz probe deferral
+while a query holds every semaphore permit."""
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.expr.core import col, lit
+from spark_rapids_tpu.runtime import obs
+from spark_rapids_tpu.runtime.obs import flight, live, sampler
+from spark_rapids_tpu.runtime.obs.history import plan_digest
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Each test gets its own obs singleton (ports, registries, live
+    query registry, sampler)."""
+    obs.shutdown_for_tests()
+    yield
+    obs.shutdown_for_tests()
+
+
+def _table(n=20_000, seed=3):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": rng.integers(0, 40, n),
+                     "v": rng.integers(1, 1000, n)})
+
+
+def _df(s, t, threshold=10):
+    return (s.create_dataframe(t, num_partitions=2)
+            .filter(col("v") > lit(threshold))
+            .select(col("k"), (col("v") * lit(2)).alias("v2"))
+            .group_by("k").agg(F.sum(col("v2")).alias("sv")))
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+def test_state_machine_happy_path_and_history():
+    qc = live.QueryContext(1, plan_digest="d1")
+    assert qc.state == "queued"
+    for st in ("planning", "executing", "finishing", "ok"):
+        qc.transition(st)
+        assert qc.state == st
+    assert [s for s, _ in qc.state_history] == [
+        "queued", "planning", "executing", "finishing", "ok"]
+
+
+def test_state_machine_rejects_unknown_state():
+    qc = live.QueryContext(1)
+    with pytest.raises(ValueError, match="unknown query state"):
+        qc.transition("warp_speed")
+
+
+def test_state_machine_terminal_is_sticky_and_hops_clamp():
+    qc = live.QueryContext(1)
+    qc.transition("planning")
+    # a failure can land from ANY non-terminal state
+    qc.transition("failed")
+    assert qc.state == "failed"
+    qc.transition("executing")  # terminal sticky: ignored
+    assert qc.state == "failed"
+    qc2 = live.QueryContext(2)
+    qc2.transition("finishing")  # out-of-order non-terminal hop ignored
+    assert qc2.state == "queued"
+
+
+def test_states_roster_covers_machine():
+    assert set(live.TERMINAL_STATES) <= set(live.STATES)
+    for cur, nxts in live._EDGES.items():
+        assert cur in live.STATES
+        assert set(nxts) <= set(live.STATES)
+
+
+# ---------------------------------------------------------------------------
+# registry + progress lifecycle
+# ---------------------------------------------------------------------------
+
+def test_query_lifecycle_registers_progresses_and_lands_terminal():
+    s = TpuSession()
+    t = _table()
+    df = _df(s, t)
+    assert s.running_queries() == []
+    df.collect()
+    assert s.running_queries() == []  # nothing left in flight
+    doc = live.queries_doc()
+    last = doc["last_completed"]
+    assert last is not None and last["state"] == "ok"
+    assert last["plan_digest"] == plan_digest(df.plan)
+    assert last["scan_rows"] == t.num_rows
+    assert last["scan_rows_estimated"] == t.num_rows
+    assert last["percent_complete"] == 100.0
+    assert last["eta_seconds"] == 0.0
+    states = [d["state"] for d in last["states"]]
+    assert states == ["queued", "planning", "executing", "finishing",
+                      "ok"]
+    # per-exec progress survives into the completed doc
+    assert any(e["rows"] for e in last["execs"])
+
+
+def test_failed_query_lands_failed_state():
+    from spark_rapids_tpu.expr.core import SparkException
+    s = TpuSession({"spark.sql.ansi.enabled": "true"})
+    t = pa.table({"v": [1, 2, 3, 4], "z": [1, 1, 0, 1]})
+    df = s.create_dataframe(t).select((col("v") / col("z")).alias("x"))
+    with pytest.raises(SparkException):
+        df.collect()
+    last = live.queries_doc()["last_completed"]
+    assert last is not None and last["state"] == "failed"
+    assert live.running_count() == 0
+
+
+def test_progress_disabled_conf_keeps_registry_empty():
+    s = TpuSession({"spark.rapids.obs.progress.enabled": "false"})
+    _df(s, _table()).collect()
+    assert live.queries_doc()["last_completed"] is None
+    assert s.running_queries() == []
+
+
+def test_mid_flight_progress_is_live_and_monotone():
+    s = TpuSession({"spark.rapids.sql.reader.batchSizeRows": "1024"})
+    t = _table(n=120_000)
+    df = _df(s, t)
+    seen = []
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            for d in live.running_docs(with_execs=False):
+                if d["state"] == "executing":
+                    seen.append((d["query_id"], d["scan_rows"],
+                                 d.get("percent_complete")))
+            time.sleep(0.002)
+
+    th = threading.Thread(target=poll)
+    th.start()
+    try:
+        df.collect()
+    finally:
+        stop.set()
+        th.join()
+    assert len(seen) >= 2, f"query too fast to observe: {seen}"
+    rows = [r for _, r, _ in seen]
+    assert rows == sorted(rows)
+    assert all(r <= t.num_rows for r in rows)
+    pcts = [p for _, _, p in seen if p is not None]
+    assert pcts and all(0.0 <= p <= 100.0 for p in pcts)
+
+
+def test_nested_collect_joins_outer_query():
+    """A broadcast-materializing join's nested collect must not register
+    its own live query or clobber the outer exec tree."""
+    s = TpuSession()
+    t = _table(n=4000)
+    small = pa.table({"k": np.arange(40), "name": np.arange(40) * 2})
+    s.create_or_replace_temp_view("big", s.create_dataframe(t, 2))
+    s.create_or_replace_temp_view("small", s.create_dataframe(small))
+    df = s.sql("select b.k, sum(s.name) from big b join small s on "
+               "b.k = s.k group by b.k")
+    df.collect()
+    last = live.queries_doc()["last_completed"]
+    assert last is not None and last["state"] == "ok"
+    assert last["query_id"] is not None
+    assert live.running_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# concurrent queries: each context owns its own tree
+# ---------------------------------------------------------------------------
+
+def test_concurrent_queries_see_only_their_own_progress():
+    """N threads run distinct queries simultaneously; every mid-flight
+    snapshot of a given query id must carry THAT query's digest, and
+    its scan-row progress must be monotone and bounded by its own
+    input — cross-contamination of exec trees would break either."""
+    n_threads = 4
+    tables = {i: _table(n=60_000 + 10_000 * i, seed=i) for i in
+              range(n_threads)}
+    sessions = {i: TpuSession(
+        {"spark.rapids.sql.reader.batchSizeRows": "1024"})
+        for i in range(n_threads)}
+    # distinct filter thresholds -> distinct plan digests
+    dfs = {i: _df(sessions[i], tables[i], threshold=10 + i)
+           for i in range(n_threads)}
+    digests = {plan_digest(dfs[i].plan): i for i in range(n_threads)}
+    assert len(digests) == n_threads
+    samples: dict = {}
+    errors: list = []
+    stop = threading.Event()
+
+    def poll():
+        while not stop.is_set():
+            for d in live.running_docs(with_execs=False):
+                if d["state"] != "executing":
+                    continue  # scan_rows exists once a tree attached
+                samples.setdefault(d["query_id"], []).append(
+                    (d["plan_digest"], d["scan_rows"]))
+            time.sleep(0.002)
+
+    def run(i):
+        try:
+            dfs[i].collect()
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    poller = threading.Thread(target=poll)
+    poller.start()
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    stop.set()
+    poller.join()
+    assert not errors, errors
+    assert live.running_count() == 0
+    assert len(samples) == n_threads, \
+        f"expected {n_threads} concurrent live queries, saw {samples}"
+    for qid, snaps in samples.items():
+        ds = {d for d, _ in snaps if d is not None}
+        assert len(ds) == 1, \
+            f"query {qid} showed multiple digests {ds} (tree bleed)"
+        i = digests[next(iter(ds))]
+        rows = [r for _, r in snaps]
+        assert rows == sorted(rows), f"query {qid} progress not monotone"
+        assert all(r <= tables[i].num_rows for r in rows), \
+            f"query {qid} shows rows beyond its own input"
+
+
+# ---------------------------------------------------------------------------
+# cross-thread correlation
+# ---------------------------------------------------------------------------
+
+def test_bind_and_run_bound_restore():
+    assert live.current_query_id() is None
+    prev = live.bind(7)
+    assert prev is None and live.current_query_id() == 7
+    out = live.run_bound(9, live.current_query_id)
+    assert out == 9 and live.current_query_id() == 7
+    live.bind(None)
+    assert live.current_query_id() is None
+
+
+def test_host_pool_submit_propagates_binding():
+    from spark_rapids_tpu.runtime.host_pool import get_host_pool
+    pool = get_host_pool()
+    live.bind(42)
+    try:
+        assert pool.submit(live.current_query_id).result() == 42
+    finally:
+        live.bind(None)
+    # an unbound submitter's work runs unbound (the pool worker's
+    # binding was restored, not leaked)
+    assert pool.submit(live.current_query_id).result() is None
+
+
+def test_task_wave_propagates_binding_and_task_context():
+    from spark_rapids_tpu.runtime.host_pool import run_task_wave
+    from spark_rapids_tpu.runtime.task import TaskContext
+
+    def work(i):
+        ctx = TaskContext()
+        return live.current_query_id(), ctx.query_id
+
+    live.bind(11)
+    try:
+        out = run_task_wave(work, range(4))
+    finally:
+        live.bind(None)
+    assert out == [(11, 11)] * 4
+
+
+def test_pipeline_refill_propagates_binding():
+    from spark_rapids_tpu.runtime.pipeline import PipelinedIterator
+
+    def source():
+        for _ in range(6):
+            yield live.current_query_id()
+
+    live.bind(5)
+    try:
+        pit = PipelinedIterator(source(), depth=2, label="t")
+    finally:
+        live.bind(None)
+    got = list(pit)
+    pit.close()
+    assert got == [5] * 6
+
+
+def test_flight_ring_entries_tagged_with_query_id():
+    rec = flight.install(capacity=64, min_interval_s=0.0)
+    live.bind(33)
+    try:
+        rec.record("tagged", "t", 0, 1)
+        rec.instant("mark", "t")
+    finally:
+        live.bind(None)
+    rec.record("untagged", "t", 2, 1)
+    ring = rec._rings[0]
+    by_name = {e[0]: e for e in ring.buf if e is not None}
+    assert by_name["tagged"][5] == 33
+    assert by_name["mark"][5] == 33
+    assert by_name["untagged"][5] is None
+    path = rec.dump("test")
+    events = {e["name"]: e for e in
+              json.load(open(path))["traceEvents"]}
+    assert events["tagged"]["args"]["query_id"] == 33
+    assert "args" not in events["untagged"] or \
+        "query_id" not in events["untagged"]["args"]
+
+
+def test_query_log_filter_stamps_records():
+    f = live.QueryLogFilter()
+    rec = logging.LogRecord("spark_rapids_tpu", logging.INFO, "x", 1,
+                            "msg", (), None)
+    f.filter(rec)
+    assert rec.query_id == "-"
+    live.bind(8)
+    try:
+        f.filter(rec)
+        assert rec.query_id == 8
+    finally:
+        live.bind(None)
+
+
+def test_log_filter_installed_by_obs_install():
+    TpuSession()
+    lg = logging.getLogger("spark_rapids_tpu")
+    filters = [f for f in lg.filters
+               if isinstance(f, live.QueryLogFilter)]
+    assert len(filters) == 1
+    TpuSession()  # idempotent: a second install adds no second filter
+    assert len([f for f in lg.filters
+                if isinstance(f, live.QueryLogFilter)]) == 1
+
+
+def test_query_start_marker_in_flight_dump():
+    """Every top-level action (untraced!) leaves a queryStart t0 marker
+    with its id + digest in the flight ring, pairing with the PR 9
+    queryError/queryDegraded epilogue markers."""
+    flight.install(capacity=2048, min_interval_s=0.0)
+    s = TpuSession()
+    df = _df(s, _table(n=4000))
+    df.collect()
+    path = flight.dump("test")
+    events = [e for e in json.load(open(path))["traceEvents"]
+              if e["name"] == "queryStart"]
+    assert events, "no queryStart instant reached the flight ring"
+    args = events[-1].get("args") or {}
+    assert args.get("query_id") is not None
+    assert args.get("plan_digest") == plan_digest(df.plan)
+
+
+# ---------------------------------------------------------------------------
+# sampler
+# ---------------------------------------------------------------------------
+
+def test_sampler_rings_bounded_and_series_complete():
+    smp = sampler.install(interval_ms=50, ring_size=16, start=False)
+    try:
+        for _ in range(40):
+            smp.sample_once()
+        assert smp.ticks == 40
+        assert set(smp.rings) == set(sampler.SERIES)
+        for name, ring in smp.rings.items():
+            snap = ring.snapshot()
+            assert len(snap) <= 16, f"{name} ring unbounded"
+            assert ring.idx == 40
+            # newest kept: timestamps strictly the LAST 16 ticks
+            assert all(isinstance(s[1], float) for s in snap)
+        latest = smp.latest()
+        assert set(latest) == set(sampler.SERIES)
+        # rss is a real read on linux
+        assert latest["process_rss_bytes"] >= 0.0
+    finally:
+        sampler.uninstall_for_tests()
+
+
+def test_sampler_ticks_annotated_with_running_queries():
+    smp = sampler.install(interval_ms=50, ring_size=8, start=False)
+    try:
+        live.register(77)
+        smp.sample_once()
+        s = smp.rings["running_queries"].latest()
+        assert s[1] == 1.0 and s[2] == (77,)
+        live.finish(77, "ok")
+        smp.sample_once()
+        s = smp.rings["running_queries"].latest()
+        assert s[1] == 0.0 and s[2] == ()
+    finally:
+        sampler.uninstall_for_tests()
+
+
+def test_sampler_chrome_events_and_flight_embed():
+    rec = flight.install(capacity=64, min_interval_s=0.0)
+    smp = sampler.install(interval_ms=50, ring_size=8, start=False)
+    try:
+        smp.sample_once()
+        evs = smp.chrome_events(0, 1)
+        assert evs and all(e["ph"] == "C" for e in evs)
+        assert {e["name"] for e in evs} == \
+            {f"sampler/{s}" for s in sampler.SERIES}
+        assert all("value" in e["args"] for e in evs)
+        rec.record("e", "t", 0, 1)
+        path = rec.dump("test")
+        counters = {e["name"] for e in
+                    json.load(open(path))["traceEvents"]
+                    if e.get("ph") == "C"}
+        assert {f"sampler/{s}" for s in sampler.SERIES} <= counters
+    finally:
+        sampler.uninstall_for_tests()
+
+
+def test_sampler_pipeline_stall_gauge():
+    from spark_rapids_tpu.runtime import pipeline as PL
+    assert PL.stalled_consumers() == 0
+    PL._stall_enter()
+    try:
+        assert PL.stalled_consumers() == 1
+        smp = sampler.install(interval_ms=50, ring_size=8, start=False)
+        smp.sample_once()
+        assert smp.rings["pipeline_stalled_consumers"].latest()[1] == 1.0
+    finally:
+        PL._stall_exit()
+        sampler.uninstall_for_tests()
+    assert PL.stalled_consumers() == 0
+
+
+def test_sampler_service_thread_ticks():
+    smp = sampler.install(interval_ms=10, ring_size=32, start=True)
+    try:
+        deadline = time.time() + 5.0
+        while smp.ticks < 3 and time.time() < deadline:
+            time.sleep(0.02)
+        assert smp.ticks >= 3, "sampler service thread never ticked"
+    finally:
+        sampler.uninstall_for_tests()
+
+
+def test_sampler_gauges_on_metrics_and_console_renders():
+    s = TpuSession({"spark.rapids.obs.port": "0"})
+    _df(s, _table(n=4000)).collect()
+    st = obs.state()
+    text = st.registry.render_prometheus()
+    for series in sampler.SERIES:
+        assert f"rapids_sampler_{series}" in text
+    from spark_rapids_tpu.runtime.obs.console import render_live
+    html = render_live()
+    assert "Last completed" in html and "svg" in html
+
+
+# ---------------------------------------------------------------------------
+# endpoint + healthz
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _free_port():
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_queries_endpoint_scrape_while_running_race_clean():
+    port = _free_port()
+    s = TpuSession({"spark.rapids.obs.port": str(port),
+                    "spark.rapids.sql.reader.batchSizeRows": "1024"})
+    t = _table(n=80_000)
+    errors: list = []
+
+    def driver():
+        try:
+            for _ in range(2):
+                _df(s, t).collect()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=driver)
+    th.start()
+    scrapes, executing = 0, 0
+    while th.is_alive():
+        code, body = _get(f"http://127.0.0.1:{port}/queries")
+        assert code == 200, body
+        doc = json.loads(body)  # race-clean: always valid JSON
+        scrapes += 1
+        for d in doc.get("running") or []:
+            assert d["state"] in live.STATES
+            if d["state"] == "executing":
+                executing += 1
+        time.sleep(0.005)
+    th.join()
+    assert not errors, errors
+    assert scrapes >= 3
+    assert executing >= 1, "no scrape caught the query executing"
+    code, body = _get(f"http://127.0.0.1:{port}/console")
+    assert code == 200 and "Running queries" in body
+    code, body = _get(f"http://127.0.0.1:{port}/queries")
+    assert json.loads(body)["last_completed"]["state"] == "ok"
+
+
+def test_healthz_queries_doc_shape():
+    s = TpuSession()
+    _df(s, _table(n=4000)).collect()
+    doc = obs.healthz()
+    q = doc["queries"]
+    assert q["running"] == []
+    assert q["last_completed"]["status"] == "ok"
+    assert q["completed_ok"] >= 1
+    assert doc["sampler"] is not None and doc["sampler"]["enabled"]
+
+
+def test_healthz_defers_probe_while_query_holds_all_permits(monkeypatch):
+    TpuSession()
+
+    class _Sem:
+        permits = 2
+        available = 0
+        waiting = 1
+
+    from spark_rapids_tpu.runtime import semaphore as SEM
+    monkeypatch.setattr(SEM, "peek_semaphore", lambda: _Sem())
+    # a probe that would wedge: proves deferral never calls it
+    obs.set_device_probe(lambda: time.sleep(60) or True)
+    live.register(123).transition("planning")
+    st = obs.state()
+    with st._lock:
+        st._active += 1  # what on_query_start does for a real query
+    try:
+        t0 = time.time()
+        doc = obs.healthz()
+        assert time.time() - t0 < 1.0, "deferred probe still ran"
+        assert doc["device"]["deferred"] is True
+        assert doc["device"]["alive"] is None
+        assert doc["status"] == "ok", doc["status"]
+        assert [d["query_id"] for d in doc["queries"]["running"]] == [123]
+    finally:
+        live.finish(123, "ok")
+        with st._lock:
+            st._active -= 1
+    # permits still saturated but NO running query: the probe runs
+    # again (and this one blocks -> degraded)
+    doc = obs.healthz()
+    assert doc["device"]["blocked"] and doc["status"] == "degraded"
+
+
+def test_healthz_defers_probe_with_progress_disabled(monkeypatch):
+    """Deferral keys off the unconditional active-query counter, so it
+    still protects a busy engine when the live registry is off."""
+    TpuSession({"spark.rapids.obs.progress.enabled": "false"})
+
+    class _Sem:
+        permits = 2
+        available = 0
+        waiting = 1
+
+    from spark_rapids_tpu.runtime import semaphore as SEM
+    monkeypatch.setattr(SEM, "peek_semaphore", lambda: _Sem())
+    obs.set_device_probe(lambda: time.sleep(60) or True)
+    st = obs.state()
+    with st._lock:
+        st._active += 1
+    try:
+        doc = obs.healthz()
+        assert doc["device"]["deferred"] is True
+        assert doc["status"] == "ok"
+        assert doc["queries"]["running"] == []  # registry off
+    finally:
+        with st._lock:
+            st._active -= 1
+
+
+def test_failed_query_progress_not_forced_complete():
+    qc = live.QueryContext(9)
+    qc.transition("planning")
+
+    class _Leaf:
+        children = ()
+        members = None
+
+        class plan:
+            @staticmethod
+            def estimated_rows():
+                return 1000
+
+        class metrics:
+            metrics: dict = {}
+
+    from spark_rapids_tpu.runtime.metrics import (GpuMetric,
+                                                  NUM_OUTPUT_ROWS)
+    leaf = _Leaf()
+    m = GpuMetric(NUM_OUTPUT_ROWS)
+    m.add(100)
+    leaf.metrics.metrics = {NUM_OUTPUT_ROWS: m}
+    qc.attach_exec(leaf)
+    qc.transition("failed")
+    doc = qc.progress_doc()
+    assert doc["percent_complete"] == 10.0  # where it died, not 100
+    qc2 = live.QueryContext(10)
+    qc2.transition("planning")
+    qc2.attach_exec(leaf)
+    qc2.transition("degraded")  # CPU answered: work DID finish
+    assert qc2.progress_doc()["percent_complete"] == 100.0

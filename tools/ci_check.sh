@@ -47,7 +47,7 @@ if ! python tools/chaos_smoke.py; then
     fail=1
 fi
 
-step "obs smoke (/metrics scrape while a query runs, /healthz degraded flip, history round-trip)"
+step "obs smoke (/metrics scrape while a query runs, /healthz degraded flip, history round-trip, monotone mid-flight /queries progress to 100%, sampler on /metrics + in flight dumps, live-layer overhead <2%)"
 if ! python tools/obs_smoke.py; then
     fail=1
 fi

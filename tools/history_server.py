@@ -15,18 +15,30 @@ renders:
   and the flight-recorder dump of failed/degraded/slow queries;
 - diff_<digest>.html — for every plan digest with >= 2 runs, a
   run-over-run diff of the latest two runs: per-exec metric deltas side
-  by side (the regression-hunting view: same plan, what moved?).
+  by side (the regression-hunting view: same plan, what moved?);
+- console.html (with --engine) — the LIVE console: an auto-refreshing
+  page polling a running engine's /queries + /healthz endpoint
+  (spark.rapids.obs.port) from the browser, rendering in-flight query
+  progress bars, state timelines and sampler gauges next to the static
+  history. Cross-origin polling requires the engine to opt in with
+  spark.rapids.obs.corsOrigin (this site's origin, or '*' on a
+  trusted host) — /queries carries in-flight SQL text, so CORS is off
+  by default. The engine also serves the same view server-side at
+  /console (runtime/obs/console.py), which needs no CORS.
 
-Everything is self-contained static HTML (inline CSS, no JS deps) so the
-output can be dropped behind any file server.
+Everything is self-contained static HTML (inline CSS; the live console
+is the one page with inline JS, because a static site cannot poll) so
+the output can be dropped behind any file server.
 
 Run:  python tools/history_server.py <historyDir> [--out DIR]
       python tools/history_server.py <historyDir> --serve PORT
+      python tools/history_server.py <historyDir> --engine http://127.0.0.1:9090
 """
 from __future__ import annotations
 
 import argparse
 import html
+import json
 import os
 import re
 import sys
@@ -272,12 +284,87 @@ def render_diff_page(digest: str, older: dict, newer: dict) -> str:
 
 
 # ---------------------------------------------------------------------------
+# live console (polls a running engine's obs endpoint)
+# ---------------------------------------------------------------------------
+
+def render_live_console(engine_url: str, refresh_seconds: int = 2) -> str:
+    """The live half of the history site: a self-contained page whose
+    inline JS polls the engine's /queries and /healthz (CORS is open on
+    the obs endpoint) and redraws the running-query table, progress
+    bars and the sampler's latest gauges. Degrades gracefully to an
+    'engine unreachable' banner when the process is down."""
+    eng = engine_url.rstrip("/")
+    return f"""<!doctype html><html><head><meta charset='utf-8'>
+<title>live console</title><style>{_CSS}
+.pbar {{ background: #e8e8f2; border-radius: 3px; width: 140px;
+        height: 12px; display: inline-block; vertical-align: middle; }}
+.pbar span {{ background: #3949ab; height: 100%; display: block;
+             border-radius: 3px; }}
+#err {{ color: #b00020; }}</style></head><body>
+<h1>spark-rapids-tpu live console</h1>
+<p><small>engine <code>{html.escape(eng)}</code> · refresh
+{refresh_seconds}s · <a href='{html.escape(eng)}/console'>server-rendered
+view</a> · <a href='index.html'>&larr; history</a></small></p>
+<p id='err'></p>
+<h2>Running queries</h2><div id='running'>-</div>
+<h2>Last completed</h2><div id='last'>-</div>
+<h2>Resources (latest samples)</h2><div id='sampler'>-</div>
+<script>
+const ENG = {json.dumps(eng)};
+function row(d) {{
+  const pct = d.percent_complete;
+  const bar = pct == null ? (d.scan_rows || 0) + ' rows'
+    : "<span class='pbar'><span style='width:" + pct.toFixed(0)
+      + "%'></span></span> " + pct.toFixed(1) + "%"
+      + (d.eta_seconds ? " · eta " + d.eta_seconds.toFixed(1) + "s" : "");
+  return "<tr><td>" + d.query_id + "</td><td>" + d.state + "</td>"
+    + "<td class='num'>" + (d.elapsed_seconds || 0).toFixed(2) + "s</td>"
+    + "<td>" + bar + "</td><td><small class='digest'>"
+    + (d.plan_digest || "") + "</small></td></tr>";
+}}
+function table(docs) {{
+  if (!docs || !docs.length) return "<p>idle</p>";
+  return "<table><tr><th>id</th><th>state</th><th>elapsed</th>"
+    + "<th>progress</th><th>digest</th></tr>"
+    + docs.map(row).join("") + "</table>";
+}}
+async function tick() {{
+  try {{
+    const q = await (await fetch(ENG + "/queries")).json();
+    document.getElementById("running").innerHTML = table(q.running);
+    document.getElementById("last").innerHTML =
+      table(q.last_completed ? [q.last_completed] : []);
+    const hz = await (await fetch(ENG + "/healthz")).json().catch(e => null);
+    if (hz && hz.sampler && hz.sampler.latest) {{
+      const rows = Object.entries(hz.sampler.latest).map(
+        ([k, v]) => "<tr><td>" + k + "</td><td class='num'>" + v
+          + "</td></tr>").join("");
+      document.getElementById("sampler").innerHTML =
+        "<table><tr><th>series</th><th>value</th></tr>" + rows + "</table>";
+    }}
+    document.getElementById("err").textContent = "";
+  }} catch (e) {{
+    document.getElementById("err").textContent =
+      "engine unreachable: " + e;
+  }}
+}}
+tick(); setInterval(tick, {refresh_seconds * 1000});
+</script></body></html>"""
+
+
+# ---------------------------------------------------------------------------
 # index
 # ---------------------------------------------------------------------------
 
 def render_index(records: List[dict], diff_digests: List[str],
-                 page_names: Dict[int, str]) -> str:
-    body = ["<h2>Queries</h2><table><tr><th>id</th><th>started</th>"
+                 page_names: Dict[int, str],
+                 engine_url: Optional[str] = None) -> str:
+    body = []
+    if engine_url:
+        body.append(f"<p><b><a href='console.html'>live console</a></b> "
+                    f"— in-flight query progress + resource gauges "
+                    f"(polls {_esc(engine_url)})</p>")
+    body += ["<h2>Queries</h2><table><tr><th>id</th><th>started</th>"
             "<th>status</th><th class='num'>wall ms</th><th>digest</th>"
             "<th class='num'>fallbacks</th><th></th></tr>"]
     for i in reversed(range(len(records))):
@@ -325,8 +412,11 @@ def render_index(records: List[dict], diff_digests: List[str],
 # driver
 # ---------------------------------------------------------------------------
 
-def render_site(history_dir: str, out_dir: str) -> Dict[str, str]:
-    """Render everything; returns {page_name: path}."""
+def render_site(history_dir: str, out_dir: str,
+                engine_url: Optional[str] = None) -> Dict[str, str]:
+    """Render everything; returns {page_name: path}. With engine_url,
+    also writes the live console page polling that engine's obs
+    endpoint."""
     store = QueryHistoryStore(history_dir)
     records = store.read_all()
     os.makedirs(out_dir, exist_ok=True)
@@ -352,7 +442,10 @@ def render_site(history_dir: str, out_dir: str) -> Dict[str, str]:
         if len(recs) >= 2:
             write(f"diff_{d}.html", render_diff_page(d, recs[-2], recs[-1]))
             diff_digests.append(d)
-    write("index.html", render_index(records, diff_digests, page_names))
+    if engine_url:
+        write("console.html", render_live_console(engine_url))
+    write("index.html", render_index(records, diff_digests, page_names,
+                                     engine_url=engine_url))
     return written
 
 
@@ -364,9 +457,14 @@ def main() -> int:
     ap.add_argument("--serve", type=int, default=0,
                     help="after rendering, serve the output dir on this "
                     "port (blocking)")
+    ap.add_argument("--engine", default=None,
+                    help="base URL of a running engine's obs endpoint "
+                    "(http://host:port from spark.rapids.obs.port); "
+                    "adds the live console page polling its /queries")
     args = ap.parse_args()
     out_dir = args.out or os.path.join(args.history_dir, "html")
-    written = render_site(args.history_dir, out_dir)
+    written = render_site(args.history_dir, out_dir,
+                          engine_url=args.engine)
     print(f"wrote {len(written)} page(s) under {out_dir}")
     if args.serve:
         import functools
