@@ -55,6 +55,19 @@ TPU-L010  no raw ``jax.jit``/``jax.pjit`` (or ``partial(jax.jit, …)``)
           L002/L003 pattern). ``pl.pallas_call`` sites are likewise
           confined to the modules rostered in
           ``compile_cache.SANCTIONED_PALLAS_MODULES``.
+TPU-L011  every string-literal query-state at a ``transition("...")``
+          call must be registered in the ``STATES`` roster of
+          ``runtime/obs/live.py``, and every rostered state and sampler
+          series must appear in generated docs/metrics.md — a typo'd
+          state renders as a phantom phase on the live console and an
+          off-roster series never reaches /metrics, sparklines, or
+          flight dumps (the live-observability twin of TPU-L007/L009).
+          The sampler's scheduled writer is its roster-keyed collector
+          table, pinned by an import-time assert; the
+          ``series_point("...")`` / ``sample_series("...")`` call-site
+          check reserves the names for a future push-style sampling
+          API so it is born lint-pinned (no such call sites exist
+          today).
 
 Suppression
 -----------
@@ -94,6 +107,9 @@ RULES: Dict[str, str] = {
                 "runtime/obs/attribution.py BUCKETS roster",
     "TPU-L010": "raw jax.jit/pallas_call compile entry outside the "
                 "sanctioned compile-cache choke point",
+    "TPU-L011": "query-state / sampler-series name not registered in the "
+                "runtime/obs/live.py STATES or runtime/obs/sampler.py "
+                "SERIES roster",
 }
 
 #: receiver names under which a .site()/.site_bytes() call is the fault
@@ -200,13 +216,17 @@ class _FileLinter(ast.NodeVisitor):
     def __init__(self, path: str, source: str, known_metrics: Set[str],
                  relpath: str, known_sites: Optional[Set[str]] = None,
                  known_buckets: Optional[Set[str]] = None,
-                 pallas_modules: Optional[Set[str]] = None):
+                 pallas_modules: Optional[Set[str]] = None,
+                 known_states: Optional[Set[str]] = None,
+                 known_series: Optional[Set[str]] = None):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
         self.known_metrics = known_metrics
         self.known_sites = known_sites
         self.known_buckets = known_buckets
+        self.known_states = known_states
+        self.known_series = known_series
         self.violations: List[Violation] = []
         # stack of (lock_keys, with_lineno) for held-lock regions
         self._lock_stack: List[Tuple[Set[str], int]] = []
@@ -360,6 +380,7 @@ class _FileLinter(ast.NodeVisitor):
         self._check_metric_name(node)
         self._check_fault_site(node)
         self._check_attr_bucket(node)
+        self._check_live_obs_names(node)
         self._check_compile_entry(node)
         self.generic_visit(node)
 
@@ -520,6 +541,37 @@ class _FileLinter(ast.NodeVisitor):
                        f"complete")
 
 
+    # -- TPU-L011 ----------------------------------------------------------
+
+    def _check_live_obs_names(self, node: ast.Call) -> None:
+        """Query-state literals at transition() sites must be in the
+        live.py STATES roster; sampler-series literals at
+        series_point()/sample_series() sites in the sampler.py SERIES
+        roster. `transition` needs no receiver guard: the name is the
+        QueryContext state-machine verb in this codebase (grep-verified
+        unique), and a future non-state transition() can suppress."""
+        term = _terminal(node.func)
+        if term == "transition":
+            roster, kind, home = (self.known_states, "query state",
+                                  "runtime/obs/live.py STATES")
+        elif term in ("series_point", "sample_series"):
+            roster, kind, home = (self.known_series, "sampler series",
+                                  "runtime/obs/sampler.py SERIES")
+        else:
+            return
+        if roster is None:
+            return
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            return
+        name = node.args[0].value
+        if name not in roster:
+            self._emit("TPU-L011", node,
+                       f"{kind} {name!r} is not registered in the "
+                       f"{home} roster — register it so the live "
+                       f"console, /queries, /metrics gauges and flight "
+                       f"dumps stay complete")
+
     # -- TPU-L010 ----------------------------------------------------------
 
     #: receiver names under which .jit/.pjit is the jax compiler
@@ -650,6 +702,40 @@ def known_attr_buckets(pkg_root: str) -> Set[str]:
     return buckets
 
 
+def _dict_literal_keys(path: str, var_name: str) -> Set[str]:
+    """Keys of a module-level ``VAR = {...}`` dict literal (AST-only,
+    the known_fault_sites/known_attr_buckets pattern factored out)."""
+    keys: Set[str] = set()
+    if not os.path.exists(path):
+        return keys
+    tree = ast.parse(open(path).read(), path)
+    for stmt in tree.body:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else \
+            [stmt.target] if isinstance(stmt, ast.AnnAssign) else []
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == var_name \
+                    and isinstance(getattr(stmt, "value", None), ast.Dict):
+                for k in stmt.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        keys.add(k.value)
+    return keys
+
+
+def known_query_states(pkg_root: str) -> Set[str]:
+    """Registered query-state names: the keys of the STATES dict literal
+    in runtime/obs/live.py."""
+    return _dict_literal_keys(
+        os.path.join(pkg_root, "runtime", "obs", "live.py"), "STATES")
+
+
+def known_sampler_series(pkg_root: str) -> Set[str]:
+    """Registered sampler-series names: the keys of the SERIES dict
+    literal in runtime/obs/sampler.py."""
+    return _dict_literal_keys(
+        os.path.join(pkg_root, "runtime", "obs", "sampler.py"), "SERIES")
+
+
 def known_pallas_modules(pkg_root: str) -> Set[str]:
     """Modules allowed to contain raw pallas_call sites: the
     SANCTIONED_PALLAS_MODULES tuple in runtime/compile_cache.py
@@ -694,14 +780,18 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                 relpath: Optional[str] = None,
                 known_sites: Optional[Set[str]] = None,
                 known_buckets: Optional[Set[str]] = None,
-                pallas_modules: Optional[Set[str]] = None
+                pallas_modules: Optional[Set[str]] = None,
+                known_states: Optional[Set[str]] = None,
+                known_series: Optional[Set[str]] = None
                 ) -> List[Violation]:
     tree = ast.parse(source, path)
     linter = _FileLinter(path, source, known_metrics,
                          relpath if relpath is not None else path,
                          known_sites=known_sites,
                          known_buckets=known_buckets,
-                         pallas_modules=pallas_modules)
+                         pallas_modules=pallas_modules,
+                         known_states=known_states,
+                         known_series=known_series)
     linter.visit(tree)
     return linter.violations
 
@@ -715,6 +805,8 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
     sites = known_fault_sites(pkg_root)
     buckets = known_attr_buckets(pkg_root)
     pallas_mods = known_pallas_modules(pkg_root)
+    states = known_query_states(pkg_root)
+    series = known_sampler_series(pkg_root)
     violations: List[Violation] = []
     n_files = 0
     for dirpath, dirnames, filenames in os.walk(pkg_root):
@@ -728,7 +820,8 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
             violations.extend(lint_source(
                 open(path).read(), path, known, relpath=rel,
                 known_sites=sites, known_buckets=buckets,
-                pallas_modules=pallas_mods))
+                pallas_modules=pallas_mods,
+                known_states=states, known_series=series))
     documented = docs_metric_names(repo_root)
     mpath = os.path.join(pkg_root, "runtime", "metrics.py")
     if documented is None:
@@ -749,6 +842,18 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 f"attribution bucket {name!r} absent from "
                 f"docs/metrics.md — regenerate with "
                 f"'python tools/gen_docs.py'"))
+        lpath = os.path.join(pkg_root, "runtime", "obs", "live.py")
+        for name in sorted(states - documented):
+            violations.append(Violation(
+                "TPU-L011", lpath, 1,
+                f"query state {name!r} absent from docs/metrics.md — "
+                f"regenerate with 'python tools/gen_docs.py'"))
+        spath = os.path.join(pkg_root, "runtime", "obs", "sampler.py")
+        for name in sorted(series - documented):
+            violations.append(Violation(
+                "TPU-L011", spath, 1,
+                f"sampler series {name!r} absent from docs/metrics.md "
+                f"— regenerate with 'python tools/gen_docs.py'"))
     stats = {
         "files": n_files,
         "violations": sum(1 for v in violations if not v.suppressed),

@@ -290,6 +290,54 @@ OBS_SLO_WINDOW = conf_int(
     "Successful runs per plan digest retained for the baseline mean "
     "(a bounded sliding window, newest runs win).")
 
+OBS_CORS_ORIGIN = conf_str(
+    "spark.rapids.obs.corsOrigin", "",
+    "Value for the Access-Control-Allow-Origin header on obs endpoint "
+    "responses. Empty (the default) sends no CORS header, so browser "
+    "pages from other origins cannot read /queries (which carries "
+    "in-flight SQL text) or /healthz. Set it to the history server's "
+    "origin (or '*' on a trusted host) to enable the "
+    "tools/history_server.py --engine live-console page, which polls "
+    "the endpoint cross-origin from the browser.")
+
+OBS_PROGRESS_ENABLED = conf_bool(
+    "spark.rapids.obs.progress.enabled", True,
+    "Register every top-level action in the live query registry "
+    "(runtime/obs/live.py): query id, plan digest, state machine "
+    "(queued -> planning -> executing -> finishing -> ok/failed/"
+    "degraded), and per-exec batches/rows progress with %-complete and "
+    "ETA derived from the plan's scan-size estimates. Surfaced by "
+    "session.running_queries(), the /queries JSON endpoint, and the "
+    "/console live page. Progress reads are pull-based snapshots of "
+    "the metrics the execs already keep (no per-batch publish) and "
+    "never resolve lazy device counts, so a scrape adds no device "
+    "syncs to a running query.")
+
+OBS_SAMPLER_ENABLED = conf_bool(
+    "spark.rapids.obs.sampler.enabled", True,
+    "Run the always-on resource time-series sampler "
+    "(runtime/obs/sampler.py): a service thread samples the SERIES "
+    "roster (device/host bytes held, semaphore permits and waiters, "
+    "host-pool queue depths, pipeline stall state, breaker state, "
+    "process RSS, running queries) into bounded per-series rings "
+    "every sampler.intervalMs. Exported as rapids_sampler_* gauges on "
+    "/metrics, rendered as sparklines on /console, and embedded as "
+    "Chrome counter tracks in every flight-recorder dump so a "
+    "post-mortem carries the resource context leading up to the "
+    "trigger.")
+
+OBS_SAMPLER_INTERVAL_MS = conf_int(
+    "spark.rapids.obs.sampler.intervalMs", 200,
+    "Resource-sampler period in milliseconds. Each tick reads ~10 "
+    "in-process gauges (no locks shared with query hot paths, no "
+    "device syncs); the ring covers ringSize*intervalMs of history.")
+
+OBS_SAMPLER_RING = conf_int(
+    "spark.rapids.obs.sampler.ringSize", 512,
+    "Samples retained per sampler series (a bounded ring, newest "
+    "kept — the flight-recorder ring discipline). At the default "
+    "200ms interval, 512 samples cover the last ~102 seconds.")
+
 LORE_DUMP_DIR = conf_str(
     "spark.rapids.sql.lore.dumpPath", "",
     "When set, every exec's input batches dump as parquet under "

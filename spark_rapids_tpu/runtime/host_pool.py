@@ -62,15 +62,26 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
         return [fn(i) for i in items]
     from spark_rapids_tpu import config as _cfg
     from spark_rapids_tpu.runtime.obs import attribution as _attr
+    from spark_rapids_tpu.runtime.obs import live as _live
     conf = getattr(_cfg._local, "conf", None)
     suppress = _attr.thread_suppressed()
+    # the submitter's bound query id rides to the wave threads the same
+    # way the conf fingerprint does: a task constructed on a wave thread
+    # must attribute to the query that fanned it out
+    qid = _live.current_query_id()
 
     def bound(item):
         if conf is not None:
             _cfg.set_session_conf(conf)
         if suppress:
             _attr.set_thread_suppressed(True)
-        return fn(item)
+        if qid is not None:
+            _live.bind(qid)
+        try:
+            return fn(item)
+        finally:
+            if qid is not None:
+                _live.bind(None)
 
     with ThreadPoolExecutor(max_workers=min(len(items), max_concurrency),
                             thread_name_prefix=_PREFIX_TASK) as tp:
@@ -126,6 +137,21 @@ class HostTaskPool:
                     "tier": depth, "fn": name},
                     level=trace.DEBUG)
                 return inner(*a)
+        # cross-thread query correlation (OUTERMOST wrapper, so even the
+        # dequeue instant above runs bound): pool workers are shared
+        # across queries, so every submission captures the SUBMITTER's
+        # bound query id and re-binds it (with restore) around the work
+        # — exchange materialization, scan prefetch, serde, async
+        # writes and blob decode all attribute to the right in-flight
+        # query. One thread-local read per submit; unbound submitters
+        # skip the wrapper entirely.
+        from spark_rapids_tpu.runtime.obs import live as _live
+        qid = _live.current_query_id()
+        if qid is not None:
+            inner_fn = fn
+
+            def fn(*a):  # noqa: F811 - bound wrapper replaces fn
+                return _live.run_bound(qid, inner_fn, *a)
         if depth == 0:
             return self._tier0.submit(fn, *args)
         if depth == 1:

@@ -136,6 +136,19 @@ class GpuMetric:
                 self._deferred = []
             return self._value
 
+    def peek(self) -> int:
+        """Materialized value WITHOUT resolving deferred lazy device
+        counts (no device sync, unlike .value): the live-progress read.
+        A scrape of a RUNNING query must never inject host round trips
+        into its dispatch stream, so deferred counts that have not
+        materialized on their own yet are simply not included."""
+        with self._lock:
+            v = self._value
+            for d in self._deferred:
+                if d.is_materialized:
+                    v += int(d)
+            return v
+
     def ns(self):
         """Context manager timing a block in nanoseconds."""
         return _Timer(self)
@@ -169,6 +182,12 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, int]:
         return {k: m.value for k, m in self.metrics.items()
+                if m.level <= self.level}
+
+    def peek_snapshot(self) -> Dict[str, int]:
+        """snapshot() without resolving lazy device counts (GpuMetric.
+        peek) — what live-progress scrapes of a running query read."""
+        return {k: m.peek() for k, m in self.metrics.items()
                 if m.level <= self.level}
 
 

@@ -29,7 +29,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-from spark_rapids_tpu.runtime.obs import attribution, flight
+from spark_rapids_tpu.runtime.obs import attribution, flight, live, sampler
 from spark_rapids_tpu.runtime.obs.history import (  # noqa: F401 (re-export)
     QueryHistoryStore, build_query_record, conf_delta, plan_digest,
 )
@@ -81,6 +81,8 @@ class ObsState:
         self.server = None  # ObsHttpServer
         self.probe = None   # DeviceProbe
         self.slo: Optional[SloDetector] = None
+        #: live query registry gate (spark.rapids.obs.progress.enabled)
+        self.progress_enabled = True
         self._lock = threading.Lock()
         self._query_seq = 0
         self._active = 0  # top-level queries currently running
@@ -220,6 +222,26 @@ def _preregister(reg: MetricsRegistry) -> None:
                  "Registered (spillable) device bytes currently held")
     reg.gauge_fn("rapids_host_spill_bytes_held", _spill("host_bytes_held"),
                  "Spilled bytes currently resident in the host store")
+    # the live query registry + resource sampler (runtime/obs/live.py,
+    # runtime/obs/sampler.py): one gauge per rostered series reading
+    # the ring's newest sample, so Prometheus and the console agree on
+    # "current"; running-query count reads the registry live
+    reg.gauge_fn("rapids_queries_running", live.running_count,
+                 "Top-level queries currently in flight (live registry)")
+
+    def _smp(series):
+        def read():
+            s = sampler.sampler()
+            if s is None:
+                return 0.0
+            smp = s.rings[series].latest()
+            return smp[1] if smp is not None else 0.0
+        return read
+
+    for series, shelp in sampler.SERIES.items():
+        reg.gauge_fn(f"rapids_sampler_{series}", _smp(series),
+                     f"Sampled {shelp} (newest ring sample; "
+                     f"spark.rapids.obs.sampler.*)")
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +256,10 @@ def install(conf) -> "Optional[ObsState]":
     # the flight recorder is its own conf's concern: always-on unless
     # spark.rapids.obs.flight.enabled=false, even with the live layer off
     flight.maybe_install(conf)
+    # the resource sampler is likewise its own conf's concern: always-on
+    # (like the flight recorder) even with the live layer off, so every
+    # flight dump carries its promised counter tracks
+    sampler.maybe_install(conf)
     if not conf.get(Cf.OBS_ENABLED):
         return _STATE
     with _STATE_LOCK:
@@ -241,7 +267,16 @@ def install(conf) -> "Optional[ObsState]":
         if st is None:
             st = ObsState(MetricsRegistry())
             _preregister(st.registry)
+            # log lines from any thread attribute to the bound query:
+            # %(query_id)s becomes available to every formatter on the
+            # engine logger (idempotent: one filter instance per type)
+            import logging
+            lg = logging.getLogger("spark_rapids_tpu")
+            if not any(isinstance(f, live.QueryLogFilter)
+                       for f in lg.filters):
+                lg.addFilter(live.QueryLogFilter())
             _STATE = st
+        st.progress_enabled = bool(conf.get(Cf.OBS_PROGRESS_ENABLED))
         hist_dir = conf.get(Cf.OBS_HISTORY_DIR)
         if hist_dir and st.history is None:
             st.history = QueryHistoryStore(hist_dir)
@@ -261,8 +296,14 @@ def install(conf) -> "Optional[ObsState]":
                 st.probe = DeviceProbe(
                     timeout_s=conf.get(Cf.OBS_PROBE_TIMEOUT_MS) / 1000.0)
             try:
+                from spark_rapids_tpu.runtime.obs.console import \
+                    render_live
                 server = ObsHttpServer(port, st.registry.render_prometheus,
-                                       healthz)
+                                       healthz,
+                                       queries=live.queries_doc,
+                                       console=render_live,
+                                       cors_origin=conf.get(
+                                           Cf.OBS_CORS_ORIGIN))
                 server.start()
                 st.server = server
             except Exception:  # noqa: BLE001 - a bind failure (port in
@@ -290,7 +331,8 @@ def enabled() -> bool:
 
 def shutdown_for_tests() -> None:
     """Tear the singleton down (tests only: frees the port, drops the
-    registry so the next install starts clean)."""
+    registry so the next install starts clean). Also stops the resource
+    sampler's service thread and clears the live query registry."""
     global _STATE
     with _STATE_LOCK:
         st, _STATE = _STATE, None
@@ -299,6 +341,8 @@ def shutdown_for_tests() -> None:
             st.server.stop()
         except Exception:  # noqa: BLE001
             pass
+    sampler.uninstall_for_tests()
+    live.reset_for_tests()
 
 
 def set_device_probe(fn: Callable[[], bool]) -> None:
@@ -345,14 +389,18 @@ def on_task_complete(ctx) -> None:
         pass
 
 
-def on_query_start():
+def on_query_start(plan_digest: Optional[str] = None,
+                   sql: Optional[str] = None):
     """Returns a query token: None when obs is off, the NESTED sentinel
     for a re-entrant collect on this thread (it joins the enclosing
     query but must still reach on_query_end to unwind the depth), or a
     fresh query id. Concurrent top-level queries from other threads/
-    sessions each get their own token — they all count. (Known limit
-    shared with the tracer: concurrent queries in ONE session share
-    `_last_exec`, so their per-exec rollups can interleave.)"""
+    sessions each get their own token — they all count, and each gets
+    its OWN live QueryContext (runtime/obs/live.py) carrying its own
+    exec tree, so concurrent progress never interleaves the way the
+    tracer-singleton per-exec rollups can. The token also binds to the
+    calling thread as the correlation id (propagated by host_pool /
+    pipeline / task to every thread working for this query)."""
     st = _STATE
     if st is None:
         return None
@@ -363,7 +411,18 @@ def on_query_start():
     with st._lock:
         st._query_seq += 1
         st._active += 1
-        return st._query_seq
+        token = st._query_seq
+    live.bind(token)
+    if st.progress_enabled:
+        try:
+            qc = live.register(token, plan_digest=plan_digest, sql=sql)
+            # no admission control yet: a registered query starts
+            # planning immediately (queued exists for the item-1
+            # serving layer to park queries in)
+            qc.transition("planning")
+        except Exception:  # noqa: BLE001 - the registry must never
+            pass  # fail a query
+    return token
 
 
 def wants_rollups() -> bool:
@@ -394,6 +453,14 @@ def on_query_end(token, *, session, plan, status: str,
     st = _STATE
     if st is None or token is NESTED:
         return None
+    # land the terminal live-registry state and release this thread's
+    # correlation binding (a NESTED return above keeps the outer
+    # query's binding intact)
+    try:
+        live.finish(token, status, duration_ns=duration_ns)
+    except Exception:  # noqa: BLE001 - the registry must never fail a
+        pass  # query epilogue
+    live.bind(None)
     reg = st.registry
     try:
         reg.counter("rapids_queries_total",
@@ -577,11 +644,26 @@ def healthz() -> dict:
     if st.probe is None:
         from spark_rapids_tpu.runtime.obs.endpoint import DeviceProbe
         st.probe = DeviceProbe()
-    device = st.probe.check()
     sem = SEM.peek_semaphore()
     sem_doc = {"permits": sem.permits, "available": sem.available,
                "waiting": sem.waiting,
                "saturated": sem.available == 0} if sem is not None else None
+    # a busy device is not a degraded device: while a running query
+    # holds EVERY semaphore permit, the liveness probe's trivial
+    # dispatch would queue behind real work (or time out and flip the
+    # status) — defer it and report the reason instead. `_active` (not
+    # the live registry, which progress.enabled=false leaves empty)
+    # counts in-flight top-level queries unconditionally.
+    with st._lock:
+        active = st._active
+    if sem is not None and sem.available == 0 and active > 0:
+        device = {"alive": None, "deferred": True,
+                  "reason": "all semaphore permits held by a running "
+                            "query; probe skipped"}
+        device_ok = True
+    else:
+        device = st.probe.check()
+        device_ok = bool(device.get("alive"))
     fw = MEM.peek_spill_framework()
     if fw is not None:
         host_held = fw.host_bytes_held()
@@ -596,8 +678,6 @@ def healthz() -> dict:
         }
     else:
         spill_doc = None
-    with st._lock:
-        active = st._active
     # direct counter reads: a full registry snapshot would walk every
     # histogram's quantiles per poll, and load balancers poll often
     reg = st.registry
@@ -605,7 +685,7 @@ def healthz() -> dict:
     breaker_doc = brk.state_doc() if brk is not None else {
         "backend": "device", "state": "closed"}
     return {
-        "status": "ok" if (device.get("alive")
+        "status": "ok" if (device_ok
                            and breaker_doc["state"] != "open")
         else "degraded",
         "device": device,
@@ -623,8 +703,14 @@ def healthz() -> dict:
         "warmup": _warmup_doc(),
         "slo": dict(st.slo.doc(), last_slow=st.last_slow)
         if st.slo is not None else None,
+        # the resource time-series sampler's state + newest samples
+        "sampler": sampler.doc(),
+        # the prospective surface: every in-flight query's live state/
+        # progress (compact — /queries carries the per-exec detail) +
+        # the last completed record and the lifetime counters
         "queries": {
             "active": active,
+            "running": live.running_docs(with_execs=False),
             "completed_ok": reg.counter(
                 "rapids_queries_total", labels={"status": "ok"}).value,
             "failed": reg.counter(
@@ -633,6 +719,6 @@ def healthz() -> dict:
             "degraded": reg.counter(
                 "rapids_queries_total",
                 labels={"status": "degraded"}).value,
-            "last": st.last_query,
+            "last_completed": st.last_query,
         },
     }

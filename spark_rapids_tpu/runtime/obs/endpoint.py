@@ -93,14 +93,25 @@ class DeviceProbe:
 
 
 class ObsHttpServer:
-    """Daemon-thread HTTP server serving the registry + health callback."""
+    """Daemon-thread HTTP server serving the registry + health callback,
+    the live query registry (/queries JSON) and the auto-refreshing
+    /console page. CORS is OFF unless `cors_origin` is set
+    (``spark.rapids.obs.corsOrigin``): /queries carries in-flight SQL
+    text, so any page an operator browses must not be able to read it
+    cross-origin by default — the history server's live page needs the
+    operator to opt in with its origin (or '*' on a trusted host)."""
 
     def __init__(self, port: int,
                  render_metrics: Callable[[], str],
                  healthz: Callable[[], dict],
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 queries: Optional[Callable[[], dict]] = None,
+                 console: Optional[Callable[[], str]] = None,
+                 cors_origin: str = ""):
         self._render_metrics = render_metrics
         self._healthz = healthz
+        self._queries = queries
+        self._console = console
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -111,6 +122,9 @@ class ObsHttpServer:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                if cors_origin:
+                    self.send_header("Access-Control-Allow-Origin",
+                                     cors_origin)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -127,9 +141,17 @@ class ObsHttpServer:
                         code = 200 if doc.get("status") == "ok" else 503
                         self._send(code, json.dumps(doc, indent=1).encode(),
                                    "application/json")
+                    elif path == "/queries" and outer._queries is not None:
+                        self._send(200, json.dumps(outer._queries(),
+                                                   indent=1).encode(),
+                                   "application/json")
+                    elif path == "/console" and outer._console is not None:
+                        self._send(200, outer._console().encode(),
+                                   "text/html; charset=utf-8")
                     elif path == "/":
                         self._send(200, b"spark-rapids-tpu obs endpoint: "
-                                   b"/metrics /healthz\n", "text/plain")
+                                   b"/metrics /healthz /queries "
+                                   b"/console\n", "text/plain")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # noqa: BLE001 - scrape must answer

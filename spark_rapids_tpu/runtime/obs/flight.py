@@ -43,6 +43,11 @@ import time
 from typing import Dict, List, Optional
 
 from spark_rapids_tpu.analysis import sanitizer as _san
+# cross-thread query correlation: every ring entry captures the
+# submitting thread's bound query id (one thread-local read — the
+# flight hot path's whole budget is a tuple store, so this is the only
+# addition the correlation layer makes to it)
+from spark_rapids_tpu.runtime.obs import live as _live
 
 log = logging.getLogger("spark_rapids_tpu")
 
@@ -134,12 +139,14 @@ class FlightRecorder:
     def record(self, name: str, cat: str, t0_ns: int, dur_ns: int,
                args: Optional[dict] = None) -> None:
         """Store one complete event (dur_ns >= 0) or instant (dur_ns < 0)
-        in this thread's ring. Lock-free."""
+        in this thread's ring, tagged with the thread's bound query id.
+        Lock-free."""
         try:
             r = self._tls.ring
         except AttributeError:
             r = self._new_ring()
-        r.buf[r.idx % r.cap] = (name, cat, t0_ns, dur_ns, args)
+        r.buf[r.idx % r.cap] = (name, cat, t0_ns, dur_ns, args,
+                                _live.current_query_id())
         r.idx += 1
 
     def instant(self, name: str, cat: str,
@@ -177,7 +184,7 @@ class FlightRecorder:
             for ev in list(r.buf):
                 if ev is None:
                     continue
-                name, cat, t0_ns, dur_ns, args = ev
+                name, cat, t0_ns, dur_ns, args, qid = ev
                 if dur_ns < 0:
                     doc = {"ph": "i", "name": name, "cat": cat,
                            "pid": self.pid, "tid": r.tid,
@@ -187,9 +194,21 @@ class FlightRecorder:
                            "pid": self.pid, "tid": r.tid,
                            "ts": self._ts_us(t0_ns),
                            "dur": dur_ns / 1000.0}
-                if args:
-                    doc["args"] = args
+                if args or qid is not None:
+                    a = dict(args) if args else {}
+                    if qid is not None:
+                        a["query_id"] = qid
+                    doc["args"] = a
                 events.append(doc)
+        # the resource time-series leading up to the trigger: every
+        # sampler ring as a counter track, aligned to this recorder's
+        # clock (runtime/obs/sampler.py) — a post-mortem then shows
+        # memory/semaphore/queue pressure UNDER the event timeline
+        try:
+            from spark_rapids_tpu.runtime.obs import sampler as _sampler
+            events.extend(_sampler.chrome_events(self._t0, self.pid))
+        except Exception:  # noqa: BLE001 - the dump must not need the
+            pass  # sampler
         events.sort(key=lambda e: e.get("ts", -1.0))
         trigger = {"reason": reason}
         if query_id is not None:
