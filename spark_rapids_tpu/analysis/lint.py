@@ -68,6 +68,16 @@ TPU-L011  every string-literal query-state at a ``transition("...")``
           check reserves the names for a future push-style sampling
           API so it is born lint-pinned (no such call sites exist
           today).
+TPU-L012  no unbounded blocking wait (``Event.wait()`` /
+          ``Condition.wait()`` with no timeout) outside the sanctioned
+          waiter-protocol internals (``runtime/semaphore.py``,
+          ``runtime/lifecycle.py``, ``analysis/sanitizer.py``). A
+          thread parked forever on an event no cancel token reaches is
+          exactly how a cancelled query strands a pool worker — every
+          blocking wait must either be cancellation-aware (its event
+          registered as a token waiter, or waited in bounded slices
+          with a ``lifecycle.check_current()`` between them) or carry a
+          ``# tpulint: uncancellable <why>`` justification.
 
 Suppression
 -----------
@@ -110,7 +120,17 @@ RULES: Dict[str, str] = {
     "TPU-L011": "query-state / sampler-series name not registered in the "
                 "runtime/obs/live.py STATES or runtime/obs/sampler.py "
                 "SERIES roster",
+    "TPU-L012": "unbounded blocking wait (Event/Condition .wait() with "
+                "no timeout) outside the sanctioned waiter-protocol "
+                "internals, without an uncancellable justification",
 }
+
+#: modules owning the cancellation waiter protocol itself: their naked
+#: event waits ARE the cancel wakeup path (TPU-L012 sanctioned set)
+_WAIT_SANCTIONED_FILES = (
+    "runtime/semaphore.py", "runtime/lifecycle.py",
+    "analysis/sanitizer.py",
+)
 
 #: receiver names under which a .site()/.site_bytes() call is the fault
 #: injector (the engine imports it as `faults`, `_faults`, or `FLT`)
@@ -123,6 +143,7 @@ _ATTR_BASES = {"attribution", "_attr", "attr"}
 _DISABLE_RE = re.compile(
     r"#\s*tpulint:\s*disable=(TPU-L\d{3})\b[ \t]*(.*)")
 _DEFERRED_RE = re.compile(r"#\s*tpulint:\s*deferred-fetch\b[ \t]*(.*)")
+_UNCANCEL_RE = re.compile(r"#\s*tpulint:\s*uncancellable\b[ \t]*(.*)")
 _LOCKISH_RE = re.compile(
     r"(?:^|_)(lock|locks|glock|mutex|cv|cond|condition)$")
 
@@ -236,6 +257,8 @@ class _FileLinter(ast.NodeVisitor):
         self._in_analysis = "/analysis/" in "/" + self.relpath
         self._in_compile_cache = self.relpath.endswith(
             "runtime/compile_cache.py")
+        self._wait_sanctioned = any(
+            self.relpath.endswith(m) for m in _WAIT_SANCTIONED_FILES)
         self._pallas_sanctioned = self._in_compile_cache or (
             pallas_modules is not None
             and any(self.relpath.endswith(m) for m in pallas_modules))
@@ -252,6 +275,13 @@ class _FileLinter(ast.NodeVisitor):
         call often wraps across lines)."""
         for ln in (lineno - 1, lineno, lineno + 1):
             if _DEFERRED_RE.search(self._line(ln)):
+                return True
+        return False
+
+    def _annotated_uncancellable(self, lineno: int) -> bool:
+        """uncancellable annotation on the line or either neighbor."""
+        for ln in (lineno - 1, lineno, lineno + 1):
+            if _UNCANCEL_RE.search(self._line(ln)):
                 return True
         return False
 
@@ -382,6 +412,7 @@ class _FileLinter(ast.NodeVisitor):
         self._check_attr_bucket(node)
         self._check_live_obs_names(node)
         self._check_compile_entry(node)
+        self._check_unbounded_wait(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -571,6 +602,34 @@ class _FileLinter(ast.NodeVisitor):
                        f"{home} roster — register it so the live "
                        f"console, /queries, /metrics gauges and flight "
                        f"dumps stay complete")
+
+    # -- TPU-L012 ----------------------------------------------------------
+
+    def _check_unbounded_wait(self, node: ast.Call) -> None:
+        """``<event-or-condition>.wait()`` with no timeout parks its
+        thread until someone else's set()/notify() — forever, if the
+        query that owns the work was cancelled. Outside the waiter-
+        protocol internals every such site must either be rebuilt
+        cancellation-aware or justify itself with
+        '# tpulint: uncancellable <why>'."""
+        if self._wait_sanctioned:
+            return
+        func = node.func
+        if not isinstance(func, ast.Attribute) or func.attr != "wait":
+            return
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        if args and not any(isinstance(a, ast.Constant)
+                            and a.value is None for a in args):
+            return  # a timeout argument bounds the park — but a literal
+            # None timeout (Event.wait(None) blocks forever) does not
+        if self._annotated_uncancellable(node.lineno):
+            return
+        self._emit("TPU-L012", node,
+                   "unbounded blocking .wait() — register the event as "
+                   "a cancel-token waiter (runtime/lifecycle.py), wait "
+                   "in bounded slices with lifecycle.check_current() "
+                   "between them, or annotate "
+                   "'# tpulint: uncancellable <why>'")
 
     # -- TPU-L010 ----------------------------------------------------------
 

@@ -386,8 +386,11 @@ FAULTS_SPEC = conf_str(
     "'site:kind[:count[,skip]]' entries joined by ';', where site is a "
     "registered fault site (scan.decode, shuffle.read, shuffle.write, "
     "spill.disk, device.dispatch, pipeline.producer, exchange.fetch, "
-    "retry.oom — tpulint TPU-L008 keeps the roster honest) and kind is "
-    "ioerror, corrupt (data sites only), delay, wedge, or oom. Every "
+    "retry.oom, query.cancel, semaphore.wait — tpulint TPU-L008 keeps "
+    "the roster honest) and kind is ioerror, corrupt (data sites only), "
+    "delay, wedge, oom, or cancel (fire the current query's cancel "
+    "token at the site — chaos storms use it to deliver cancels at "
+    "named checkpoints). Every "
     "fired fault emits a faultInjected trace instant and counts into "
     "rapids_faults_injected_total and /healthz. Empty disables injection "
     "(one global read per site pass — gated <2% by tools/chaos_smoke.py). "
@@ -726,6 +729,49 @@ SHUFFLE_COALESCE_TINY_ROWS = conf_int(
     "fetched offsets vector supplies exact host-side row counts. "
     "Merges count into the shuffleCoalescedBatches metric (visible in "
     "EXPLAIN ANALYZE). 0 disables coalescing.")
+
+QUERY_TIMEOUT_S = conf_float(
+    "spark.rapids.query.timeoutSeconds", 0.0,
+    "Per-query deadline in seconds (0 disables). A watchdog-style "
+    "sweeper over the live query registry (runtime/lifecycle.py) fires "
+    "the query's cancel token with reason 'deadline' when the budget "
+    "lapses; the query terminates at its next cooperative checkpoint "
+    "with status=cancelled, and its wall-time attribution breakdown is "
+    "recorded at death so the history/trace show WHERE the budget went. "
+    "session.collect(plan, timeout_seconds=...) overrides per action.",
+    commonly_used=True)
+
+QUERY_MAX_CONCURRENT = conf_int(
+    "spark.rapids.query.maxConcurrent", 0,
+    "Admission control over top-level actions (0 = unlimited): at most "
+    "this many queries execute concurrently; excess queries park in a "
+    "bounded FIFO queue in the 'queued' live state. The complement of "
+    "spark.rapids.sql.concurrentTpuTasks (which bounds TASKS inside "
+    "admitted queries on the device semaphore) — the reference's "
+    "GpuSemaphore model lifted to whole queries for the serving layer.",
+    commonly_used=True)
+
+QUERY_MAX_QUEUED = conf_int(
+    "spark.rapids.query.maxQueued", 16,
+    "Bound on the admission queue behind spark.rapids.query."
+    "maxConcurrent: a query arriving past it is refused immediately "
+    "with a typed QueryRejectedError (the HTTP 503/429 analog).")
+
+QUERY_QUEUE_TIMEOUT_S = conf_float(
+    "spark.rapids.query.queueTimeoutSeconds", 30.0,
+    "Longest a query may wait in the admission queue before it is "
+    "refused with QueryRejectedError (0 = wait forever). Queued "
+    "queries remain cancellable while they wait.")
+
+QUERY_DEVICE_BUDGET = conf_int(
+    "spark.rapids.query.deviceBudgetBytes", 0,
+    "Per-query cooperative device-bytes quota (0 disables): the spill "
+    "framework keeps a per-query-id ledger of registered device "
+    "batches, and a query exceeding its own quota spills ITS OWN "
+    "batches (largest first) — or raises a retryable quota OOM that "
+    "drains only its own handles — instead of evicting its neighbors' "
+    "(the isolation primitive concurrent serving requires; composes "
+    "with the process-wide spark.rapids.memory.tpu.budgetBytes).")
 
 STAGE_FUSION_ENABLED = conf_bool(
     "spark.rapids.sql.stageFusion.enabled", True,

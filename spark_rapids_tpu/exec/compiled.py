@@ -98,7 +98,9 @@ def run_stage(exprs: Sequence[Expression], batch: ColumnarBatch,
 
     from spark_rapids_tpu.columnar.batch import traced_rows
     from spark_rapids_tpu.exec import fuse
+    from spark_rapids_tpu.runtime import lifecycle as _lc
     from spark_rapids_tpu.runtime import trace as TR
+    _lc.check_current()  # run_stage is the OTHER per-batch dispatch path
     fuse.notify_dispatch(("run_stage", fp))  # dispatch-budget hook
     col_planes = [_planes_of(c) for c in batch.columns]
     with TR.span("compiled.run_stage", cat="dispatch", level=TR.DEBUG,

@@ -27,6 +27,7 @@ from typing import Callable, Optional, Tuple
 
 from spark_rapids_tpu.runtime import compile_cache as _cc
 from spark_rapids_tpu.runtime import faults as _faults
+from spark_rapids_tpu.runtime import lifecycle as _lc
 from spark_rapids_tpu.runtime import watchdog as _watchdog
 
 #: test/diagnostic hook called with the fuse key once per device dispatch
@@ -58,15 +59,26 @@ def fused(key: Tuple, builder: Callable[[], Callable]) -> Callable:
     fn = _cc.get(exec_class, key, builder)
     # fused() is THE per-batch device-dispatch choke point, so it is
     # also where the failure-domain hooks live: the device.dispatch
-    # fault site and the dispatch watchdog's in-flight registration.
-    # All three gates are module-global reads; with nothing armed the
-    # raw jitted function returns and a dispatch costs exactly what it
-    # did before any of this machinery existed.
+    # fault site, the dispatch watchdog's in-flight registration, and
+    # the cooperative cancellation checkpoint. All gates are module-
+    # global reads; with nothing armed AND no query lifecycle in flight
+    # the raw jitted function returns and a dispatch costs exactly what
+    # it did before any of this machinery existed. With only a cancel
+    # token live (every real query), the wrapper is the checkpoint
+    # alone — one token-table read per dispatch.
     if _DISPATCH_HOOK is None and not _faults.armed("device.dispatch") \
             and not _watchdog.active():
-        return fn
+        if not _lc.active():
+            return fn
+
+        def checked(*args, **kwargs):
+            _lc.check_current()
+            return fn(*args, **kwargs)
+
+        return checked
 
     def counted(*args, **kwargs):
+        _lc.check_current()
         if _DISPATCH_HOOK is not None:
             notify_dispatch(key)
         with _watchdog.guard("device.dispatch"):

@@ -43,6 +43,7 @@ from spark_rapids_tpu.ops import radix as R
 from spark_rapids_tpu.ops import repartition as RP
 from spark_rapids_tpu.plan import nodes as P
 from spark_rapids_tpu.runtime import faults as FLT
+from spark_rapids_tpu.runtime import lifecycle as LC
 from spark_rapids_tpu.runtime import metrics as M
 from spark_rapids_tpu.runtime import trace as TR
 from spark_rapids_tpu.runtime.semaphore import get_semaphore
@@ -2899,10 +2900,17 @@ class ExchangeExec(TpuExec):
                 # cancels this task — it did not itself fail
                 status = "cancelled"
                 raise
+            except LC.QueryCancelledError:
+                # the query's cancel token fired at a checkpoint inside
+                # this producer: same rollup as the close path, and the
+                # error still travels to the consumer
+                status = "cancelled"
+                raise
             finally:
                 if not fin[0]:
                     fin[0] = True
-                    tctx.complete(failed=(status == "failed"))
+                    tctx.complete(failed=(status == "failed"),
+                                  cancelled=(status == "cancelled"))
 
         streams = []
         finals = []
@@ -2970,7 +2978,8 @@ class ExchangeExec(TpuExec):
         disp, fetch, rows_m = self._partition_metrics()
         sorted_b, off_dev = fused_out
         disp.add(1)
-        FLT.site("exchange.fetch")
+        LC.check_current()  # per-batch exchange checkpoint: the offsets
+        FLT.site("exchange.fetch")  # sync is where a shuffle blocks
         offsets = np.asarray(jax.device_get(off_dev))
         fetch.add(1)
         for p, sub in enumerate(

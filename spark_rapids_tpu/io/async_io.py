@@ -11,6 +11,12 @@ import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Optional
 
+#: bounded wait slice while blocked on admission: each wakeup re-checks
+#: the caller's query cancel token (runtime/lifecycle.py), so a
+#: cancelled query's writer unwinds instead of waiting out other
+#: queries' releases
+_CANCEL_SLICE_S = 0.25
+
 
 class TrafficController:
     """Blocks producers while more than max_in_flight_bytes of writes are
@@ -81,11 +87,20 @@ class TrafficController:
                             self._cv.acquire()
                         continue  # re-check admission: it may have freed
                     # timed wait ONLY until the warning threshold — once
-                    # fired (or when disabled), waits are untimed again,
-                    # so steady state has no polling
-                    self._cv.wait(timeout=self.stall_warn_s - waited)
+                    # fired (or when disabled), the wait drops to the
+                    # bounded cancellation slice below
+                    self._cv.wait(timeout=min(
+                        self.stall_warn_s - waited, _CANCEL_SLICE_S))
                 else:
-                    self._cv.wait()
+                    # cancellation-aware bounded slices (TPU-L012): a
+                    # cancelled query's writer parked on admission that
+                    # OTHER queries' releases control must wake and
+                    # unwind, not wait out their drain. Only the blocked
+                    # path pays the wakeups; steady state never enters
+                    # this loop.
+                    self._cv.wait(timeout=_CANCEL_SLICE_S)
+                from spark_rapids_tpu.runtime import lifecycle as _lc
+                _lc.check_current()
             self._inflight += nbytes
         if blocked:
             trace.instant("asyncWriteThrottled", cat="io", args={

@@ -23,8 +23,10 @@ Conf grammar (``spark.rapids.debug.faults``)::
 with kinds ``ioerror`` (raise InjectedFaultError, an OSError), ``corrupt``
 (flip bytes — data sites only), ``delay`` (sleep debug.faults.delayMs),
 ``wedge`` (sleep debug.faults.wedgeSeconds — long enough for the
-dispatch watchdog to notice), and ``oom`` (raise TpuRetryOOM, feeding the
-retry framework). ``count`` defaults to 1; ``skip`` delays the first
+dispatch watchdog to notice), ``oom`` (raise TpuRetryOOM, feeding the
+retry framework), and ``cancel`` (fire the current query's cancel token
+— runtime/lifecycle.py — so the site pass that fired it raises
+QueryCancelledError). ``count`` defaults to 1; ``skip`` delays the first
 firing by that many site passes. `tools/chaos_smoke.py` drives seeded
 chaos runs by generating spec strings from a fixed-seed RNG, so a chaos
 schedule is reproducible from its seed alone.
@@ -66,12 +68,21 @@ SITES: Dict[str, str] = {
                       "(the host sync sizing partition slices)",
     "retry.oom": "the retry framework's attempt entry (the legacy "
                  "injectRetryOOM site, shared with OomInjector)",
+    "query.cancel": "the cooperative cancellation checkpoint "
+                    "(lifecycle.check_current — fused dispatch, pipeline "
+                    "refill, wave start, backoff, exchange fetch); a "
+                    "`cancel`-kind schedule delivers a cancel at a "
+                    "named checkpoint pass",
+    "semaphore.wait": "a queued PrioritySemaphore acquire about to park "
+                      "on its waiter event (delay/wedge a contended "
+                      "acquire; ioerror exercises the abandoned-waiter "
+                      "cleanup path)",
 }
 
 #: data sites: the only sites a `corrupt` schedule may target
 BYTE_SITES = frozenset(("shuffle.read", "shuffle.write"))
 
-KINDS = ("ioerror", "corrupt", "delay", "wedge", "oom")
+KINDS = ("ioerror", "corrupt", "delay", "wedge", "oom", "cancel")
 
 
 class InjectedFaultError(OSError):
@@ -243,6 +254,14 @@ def _act(site_name: str, kind: str, delay_ms: float, wedge_s: float) -> None:
     if kind == "oom":
         from spark_rapids_tpu.runtime.retry import TpuRetryOOM
         raise TpuRetryOOM(f"injected OOM at fault site {site_name!r}")
+    if kind == "cancel":
+        # fire the CURRENT query's cancel token: the next checkpoint
+        # (usually the very site pass that fired this) observes it and
+        # raises QueryCancelledError — the chaos storm's way of
+        # delivering a cancel at a named engine crossing
+        from spark_rapids_tpu.runtime import lifecycle
+        lifecycle.cancel_current(reason="fault")
+        return
     if kind == "delay":
         time.sleep(delay_ms / 1000.0)
     elif kind == "wedge":

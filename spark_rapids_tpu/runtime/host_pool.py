@@ -61,6 +61,7 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
     if len(items) <= 1:
         return [fn(i) for i in items]
     from spark_rapids_tpu import config as _cfg
+    from spark_rapids_tpu.runtime import lifecycle as _lc
     from spark_rapids_tpu.runtime.obs import attribution as _attr
     from spark_rapids_tpu.runtime.obs import live as _live
     conf = getattr(_cfg._local, "conf", None)
@@ -78,6 +79,9 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
         if qid is not None:
             _live.bind(qid)
         try:
+            # wave-start cooperative checkpoint: partitions of an
+            # already-cancelled query unwind before doing any work
+            _lc.check_current()
             return fn(item)
         finally:
             if qid is not None:

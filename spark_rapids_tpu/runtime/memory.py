@@ -64,6 +64,11 @@ class SpillableHandle:
         self.fw = framework
         self.handle_id = uuid.uuid4().hex
         self.size = batch.device_memory_size()
+        # per-query ledger key (spark.rapids.query.deviceBudgetBytes):
+        # the registering thread's bound query id, so quota enforcement
+        # can pick victims from — and charge — the owning query only
+        from spark_rapids_tpu.runtime.obs import live as _live
+        self.query_id = _live.current_query_id()
         self._lock = _san.lock("memory.handle")
         self._tier = DEVICE
         self._device: Optional[ColumnarBatch] = batch
@@ -211,6 +216,11 @@ class SpillFramework:
         cooperative budget cannot un-allocate it) after draining."""
         h = SpillableHandle(self, batch)
         from spark_rapids_tpu.runtime.retry import TpuRetryOOM
+        # per-query quota FIRST, and its breach propagates (unlike the
+        # global budget below): the over-quota query self-spills, and
+        # when nothing of its own is left to spill the typed quota OOM
+        # feeds ITS retry/split cascade instead of evicting neighbors
+        self._enforce_query_budget(h.size)
         try:
             self.reserve(h.size)
         except TpuRetryOOM:
@@ -269,15 +279,68 @@ class SpillFramework:
 
     # -- accounting --------------------------------------------------------
 
-    def device_bytes_held(self) -> int:
+    def device_bytes_held(self, query_id=None) -> int:
+        """Registered device-tier bytes — process-wide, or one query's
+        ledger slice when `query_id` is passed (the per-query quota
+        read)."""
         with self._lock:
             return sum(h.size for h in self._handles.values()
-                       if h.tier == DEVICE)
+                       if h.tier == DEVICE
+                       and (query_id is None or h.query_id == query_id))
 
     def host_bytes_held(self) -> int:
         with self._lock:
             return sum(h.size for h in self._handles.values()
                        if h.tier == HOST)
+
+    def _enforce_query_budget(self, nbytes: int,
+                              exclude: Optional[SpillableHandle] = None
+                              ) -> None:
+        """Per-query device quota (spark.rapids.query.deviceBudgetBytes,
+        carried on the query's cancel token): when the CURRENT query's
+        ledger plus this reservation exceeds its own budget, spill the
+        query's OWN device handles (largest first). When nothing of its
+        own remains spillable, raise the typed TpuQueryQuotaOOM — the
+        retry framework then drains only this query's handles and
+        splits/replays ITS work, leaving neighbor queries' batches
+        resident (the isolation primitive concurrent serving needs)."""
+        from spark_rapids_tpu.runtime import lifecycle as _lc
+        tok = _lc.current_token()
+        if tok is None or tok.device_budget <= 0:
+            return
+        budget, qid = tok.device_budget, tok.query_id
+        from spark_rapids_tpu.runtime.retry import TpuQueryQuotaOOM
+        while self.device_bytes_held(query_id=qid) + nbytes > budget:
+            victim = self._pick_victim(exclude, query_id=qid)
+            if victim is None:
+                raise TpuQueryQuotaOOM(
+                    f"query {qid} holds "
+                    f"{self.device_bytes_held(query_id=qid)}B of device "
+                    f"batches and needs {nbytes}B more, over its "
+                    f"deviceBudgetBytes={budget} quota with nothing of "
+                    f"its own left to spill", query_id=qid)
+            freed = victim.spill_to_host()
+            if freed:
+                self.metrics["spill_to_host_bytes"] += freed
+                self.metrics["spill_count"] += 1
+                self._enforce_host_budget()
+
+    def drain_query(self, query_id) -> int:
+        """Spill every device handle the given query holds (the quota
+        twin of drain_all: the retry framework calls this on a
+        TpuQueryQuotaOOM so an over-quota query frees only its OWN
+        memory before re-attempting)."""
+        freed = 0
+        while True:
+            victim = self._pick_victim(None, query_id=query_id)
+            if victim is None:
+                return freed
+            got = victim.spill_to_host()
+            freed += got
+            if got:
+                self.metrics["spill_to_host_bytes"] += got
+                self.metrics["spill_count"] += 1
+                self._enforce_host_budget()
 
     def reserve(self, nbytes: int, exclude: Optional[SpillableHandle] = None,
                 best_effort: bool = False) -> None:
@@ -286,7 +349,9 @@ class SpillFramework:
         TpuRetryOOM when even a full drain cannot fit the reservation —
         the retry framework then splits the work. best_effort=True drains
         what it can and returns instead of raising (used to rematerialize
-        handles that were admitted over-budget)."""
+        handles that were admitted over-budget). The per-query quota is
+        enforced by register() (its breach must PROPAGATE, unlike the
+        global-budget swallow there), not here."""
         from spark_rapids_tpu.runtime.retry import TpuRetryOOM
         if nbytes > self.device_budget:
             if best_effort:
@@ -311,10 +376,12 @@ class SpillFramework:
             elif best_effort:
                 return
 
-    def _pick_victim(self, exclude) -> Optional[SpillableHandle]:
+    def _pick_victim(self, exclude,
+                     query_id=None) -> Optional[SpillableHandle]:
         with self._lock:
             cands = [h for h in self._handles.values()
-                     if h.spillable() and h is not exclude]
+                     if h.spillable() and h is not exclude
+                     and (query_id is None or h.query_id == query_id)]
         if not cands:
             return None
         return max(cands, key=lambda h: h.size)

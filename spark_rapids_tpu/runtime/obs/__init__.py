@@ -112,12 +112,15 @@ def _preregister(reg: MetricsRegistry) -> None:
         reg.counter(name, help_)
     reg.counter("rapids_tasks_completed_total", "Tasks completed")
     reg.counter("rapids_tasks_failed_total", "Tasks failed")
-    reg.counter("rapids_queries_total", "Queries completed",
-                labels={"status": "ok"})
-    reg.counter("rapids_queries_total", "Queries completed",
-                labels={"status": "failed"})
-    reg.counter("rapids_queries_total", "Queries completed",
-                labels={"status": "degraded"})
+    reg.counter("rapids_tasks_cancelled_total",
+                "Tasks unwound by a query cancel token or an early "
+                "sibling close (neither completed nor failed)")
+    for status in ("ok", "failed", "degraded", "cancelled"):
+        reg.counter("rapids_queries_total", "Queries completed",
+                    labels={"status": status})
+    reg.counter("rapids_queries_rejected_total",
+                "Queries refused by admission control "
+                "(spark.rapids.query.maxConcurrent)")
     reg.counter("rapids_faults_injected_total",
                 "Injected faults fired (spark.rapids.debug.faults)")
     reg.counter("rapids_watchdog_dispatch_timeouts_total",
@@ -303,7 +306,8 @@ def install(conf) -> "Optional[ObsState]":
                                        queries=live.queries_doc,
                                        console=render_live,
                                        cors_origin=conf.get(
-                                           Cf.OBS_CORS_ORIGIN))
+                                           Cf.OBS_CORS_ORIGIN),
+                                       cancel=_cancel_query)
                 server.start()
                 st.server = server
             except Exception:  # noqa: BLE001 - a bind failure (port in
@@ -368,8 +372,11 @@ def on_task_complete(ctx) -> None:
         return
     reg = st.registry
     try:
-        reg.counter("rapids_tasks_failed_total" if ctx._failed
-                    else "rapids_tasks_completed_total").inc()
+        if getattr(ctx, "_cancelled", False):
+            reg.counter("rapids_tasks_cancelled_total").inc()
+        else:
+            reg.counter("rapids_tasks_failed_total" if ctx._failed
+                        else "rapids_tasks_completed_total").inc()
         dur_ns = time.perf_counter_ns() - ctx.start_ns
         reg.histogram("rapids_task_duration_ms").observe(dur_ns / 1e6)
         for acc_name, (cname, chelp) in _TASK_COUNTERS.items():
@@ -415,11 +422,11 @@ def on_query_start(plan_digest: Optional[str] = None,
     live.bind(token)
     if st.progress_enabled:
         try:
-            qc = live.register(token, plan_digest=plan_digest, sql=sql)
-            # no admission control yet: a registered query starts
-            # planning immediately (queued exists for the item-1
-            # serving layer to park queries in)
-            qc.transition("planning")
+            # registered in the `queued` state: the session transitions
+            # it to `planning` once admission control
+            # (spark.rapids.query.maxConcurrent — runtime/lifecycle.py)
+            # grants the slot; ungated queries pass through immediately
+            live.register(token, plan_digest=plan_digest, sql=sql)
         except Exception:  # noqa: BLE001 - the registry must never
             pass  # fail a query
     return token
@@ -610,6 +617,20 @@ def _warmup_doc():
         return None
 
 
+def _lifecycle_doc():
+    try:
+        from spark_rapids_tpu.runtime import lifecycle as LC
+        return LC.doc()
+    except Exception:  # noqa: BLE001 - health must always render
+        return None
+
+
+def _cancel_query(query_id) -> bool:
+    """The POST /queries/<id>/cancel handler target."""
+    from spark_rapids_tpu.runtime import lifecycle as LC
+    return LC.cancel(query_id, reason="http")
+
+
 def suppressed_actions():
     """Context manager making every collect on the CURRENT thread look
     nested to the live layer (on_query_start returns NESTED: no history
@@ -719,6 +740,14 @@ def healthz() -> dict:
             "degraded": reg.counter(
                 "rapids_queries_total",
                 labels={"status": "degraded"}).value,
+            "cancelled": reg.counter(
+                "rapids_queries_total",
+                labels={"status": "cancelled"}).value,
+            "rejected": reg.counter(
+                "rapids_queries_rejected_total").value,
             "last_completed": st.last_query,
         },
+        # query lifecycle control (runtime/lifecycle.py): live cancel
+        # tokens, admission-gate occupancy, reject/cancel totals
+        "lifecycle": _lifecycle_doc(),
     }

@@ -28,6 +28,7 @@ th { background: #f0f0f8; }
 .state-executing { color: #0a7a2f; font-weight: 600; }
 .state-finishing { color: #b06f00; }
 .state-planning, .state-queued { color: #666; }
+.state-cancelled { color: #8a3ab9; }
 .pbar { background: #e8e8f2; border-radius: 3px; width: 140px;
         height: 12px; display: inline-block; vertical-align: middle; }
 .pbar span { background: #3949ab; height: 100%; display: block;

@@ -22,10 +22,15 @@ parser in the prober.
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+
+#: POST /queries/<id>/cancel (negative ids are lifecycle-local tokens
+#: of obs-disabled engines; the endpoint accepts both)
+_CANCEL_RE = re.compile(r"^/queries/(-?\d+)/cancel$")
 
 
 def default_device_probe() -> bool:
@@ -107,11 +112,13 @@ class ObsHttpServer:
                  host: str = "127.0.0.1",
                  queries: Optional[Callable[[], dict]] = None,
                  console: Optional[Callable[[], str]] = None,
-                 cors_origin: str = ""):
+                 cors_origin: str = "",
+                 cancel: Optional[Callable[[int], bool]] = None):
         self._render_metrics = render_metrics
         self._healthz = healthz
         self._queries = queries
         self._console = console
+        self._cancel = cancel
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -151,10 +158,29 @@ class ObsHttpServer:
                     elif path == "/":
                         self._send(200, b"spark-rapids-tpu obs endpoint: "
                                    b"/metrics /healthz /queries "
-                                   b"/console\n", "text/plain")
+                                   b"/console; POST /queries/<id>/cancel"
+                                   b"\n", "text/plain")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except Exception as e:  # noqa: BLE001 - scrape must answer
+                    self._send(500, f"error: {e}\n".encode(), "text/plain")
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                m = _CANCEL_RE.match(path)
+                try:
+                    if m is None or outer._cancel is None:
+                        self._send(404, b"not found\n", "text/plain")
+                        return
+                    qid = int(m.group(1))
+                    ok = bool(outer._cancel(qid))
+                    body = json.dumps(
+                        {"query_id": qid, "cancelled": ok}).encode()
+                    # 404 when the query is not in flight (finished, or
+                    # never existed): cancel-after-finish is a no-op
+                    self._send(200 if ok else 404, body,
+                               "application/json")
+                except Exception as e:  # noqa: BLE001 - must answer
                     self._send(500, f"error: {e}\n".encode(), "text/plain")
 
         self._server = ThreadingHTTPServer((host, int(port)), Handler)

@@ -75,17 +75,21 @@ STATES: Dict[str, str] = {
     "failed": "terminal: raised to the caller",
     "degraded": "terminal: device path failed, CPU fallback answered "
                 "(spark.rapids.fallback.cpu.enabled)",
+    "cancelled": "terminal: the query's cancel token fired (user cancel, "
+                 "deadline, or injected fault) and the engine unwound at "
+                 "a cooperative checkpoint (runtime/lifecycle.py)",
 }
 
 #: states a query can end in (the registry drops it on these)
-TERMINAL_STATES = ("ok", "failed", "degraded")
+TERMINAL_STATES = ("ok", "failed", "degraded", "cancelled")
 
 #: legal transition edges (state machine enforced in transition())
+_T = TERMINAL_STATES
 _EDGES = {
-    "queued": ("planning", "ok", "failed", "degraded"),
-    "planning": ("executing", "finishing", "ok", "failed", "degraded"),
-    "executing": ("finishing", "ok", "failed", "degraded"),
-    "finishing": ("ok", "failed", "degraded"),
+    "queued": ("planning",) + _T,
+    "planning": ("executing", "finishing") + _T,
+    "executing": ("finishing",) + _T,
+    "finishing": _T,
 }
 
 _LOCK = _san.lock("obs.live.registry")

@@ -204,6 +204,7 @@ class PipelinedIterator:
 
     def _refill_loop(self) -> None:
         from spark_rapids_tpu.runtime import faults as _faults
+        from spark_rapids_tpu.runtime import lifecycle as _lc
         from spark_rapids_tpu.runtime import trace
         while True:
             with self._lock:
@@ -223,6 +224,10 @@ class PipelinedIterator:
                     return
             t0 = time.perf_counter_ns()
             try:
+                # cooperative checkpoint: a cancelled query's refill
+                # raises here and the error travels the producer-error
+                # envelope to the consumer, which unwinds normally
+                _lc.check_current()
                 # producer-death injection: a fault here travels the same
                 # envelope as a real upstream decode failure
                 _faults.site("pipeline.producer")
@@ -324,6 +329,7 @@ def make_pipeline_exec():
     from spark_rapids_tpu.exec import tpu_nodes as X
     from spark_rapids_tpu.runtime import metrics as M
     from spark_rapids_tpu.runtime.host_pool import HostTaskPool
+    from spark_rapids_tpu.runtime.lifecycle import QueryCancelledError
 
     class PipelineExec(X.TpuExec):
         """Pipeline boundary: runs its child's generator on the host pool
@@ -375,6 +381,11 @@ def make_pipeline_exec():
                     stall_metric=self.metrics.metric(M.PIPELINE_STALL_TIME),
                     producer_metric=self.metrics.metric(
                         M.PIPELINE_PRODUCER_TIME))
+            except QueryCancelledError:
+                # a cancelled query's unwind is not a setup failure:
+                # running the stage synchronously would resurrect the
+                # killed work
+                raise
             except Exception:  # noqa: BLE001 - per-stage fallback: a
                 # pipeline setup failure must degrade to the synchronous
                 # path, never fail the query

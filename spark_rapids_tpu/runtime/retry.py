@@ -38,6 +38,18 @@ class TpuSplitAndRetryOOM(TpuOOM):
     """The work itself is too large: split the input and retry halves."""
 
 
+class TpuQueryQuotaOOM(TpuRetryOOM):
+    """A query exceeded its OWN spark.rapids.query.deviceBudgetBytes
+    quota with nothing of its own left to spill. Retried like any
+    TpuRetryOOM, but the pre-retry drain frees only the offending
+    query's handles (SpillFramework.drain_query) — neighbor queries'
+    batches stay resident."""
+
+    def __init__(self, msg: str, query_id=None):
+        super().__init__(msg)
+        self.query_id = query_id
+
+
 def is_device_oom(exc: BaseException) -> bool:
     """Is this exception a PHYSICAL device OOM surfaced by the jax/XLA
     runtime? Substring matching applies ONLY to exception types whose
@@ -232,7 +244,16 @@ def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
             if retries > max_retries:
                 raise
             t0 = _time.perf_counter_ns()
-            get_spill_framework().drain_all()
+            fw = get_spill_framework()
+            if isinstance(e, TpuQueryQuotaOOM):
+                # per-query quota breach: free only the OFFENDING
+                # query's handles — the whole point of the quota is that
+                # its pressure never evicts a neighbor query's batches
+                from spark_rapids_tpu.runtime.obs import live as _live
+                fw.drain_query(e.query_id if e.query_id is not None
+                               else _live.current_query_id())
+            else:
+                fw.drain_all()
             # bounded exponential backoff + jitter before the re-attempt:
             # a drain-then-immediate-retry lets every concurrently OOMed
             # task re-dispatch into the same freshly drained budget at
@@ -242,7 +263,11 @@ def _attempt_with_drain(attempt: Callable[[], object], max_retries: int,
                 trace.instant("retryBackoff", cat="retry", args={
                     "attempt": retries,
                     "ms": round(delay_s * 1000.0, 3)})
-                _time.sleep(delay_s)
+                # cancellation-aware: a cancelled query wakes out of its
+                # backoff immediately (QueryCancelledError) instead of
+                # sleeping out the full (possibly 500ms) delay
+                from spark_rapids_tpu.runtime import lifecycle as _lc
+                _lc.sleep(delay_s)
             if ctx is not None:
                 # time spent freeing memory (and backing off) before the
                 # re-attempt (GpuTaskMetrics retryBlockTime analog)

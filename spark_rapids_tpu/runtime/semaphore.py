@@ -12,6 +12,15 @@ and signals exactly those waiters' events — a waiter blocks on its event
 with no timeout, so the measured semaphoreWaitTime is real contention,
 never a 50 ms poll quantum (the reference PrioritySemaphore's
 condition-signal discipline).
+
+Interruptible acquire (runtime/lifecycle.py): a queued waiter's event is
+registered with the acquiring query's cancel token, so cancel() doubles
+as the wakeup. A waiter that leaves abnormally — cancelled, or killed by
+an exception on the wait path (the `semaphore.wait` fault site injects
+exactly this) — removes its heap entry and re-runs the handoff, so its
+reserved (or reservable) permits can never strand. Before this rework a
+waiter dying while queued left its entry at the heap head forever,
+blocking `_grant_head_locked` for every later waiter.
 """
 from __future__ import annotations
 
@@ -21,6 +30,7 @@ import time
 from typing import Dict, Optional
 
 from spark_rapids_tpu.analysis import sanitizer as _san
+from spark_rapids_tpu.runtime import faults as _faults
 from spark_rapids_tpu.runtime import trace
 
 
@@ -29,7 +39,7 @@ class PrioritySemaphore:
         self._permits = permits
         self._available = permits
         self._lock = _san.lock("semaphore.priority")
-        self._waiters = []  # heap of [-priority, seq, n, event]
+        self._waiters = []  # heap of [-priority, seq, n, event, granted]
         self._seq = 0
 
     def _grant_head_locked(self) -> None:
@@ -37,12 +47,33 @@ class PrioritySemaphore:
         their permits fit, reserving the permits FOR them before setting
         their event — the woken thread never re-contends."""
         while self._waiters and self._available >= self._waiters[0][2]:
-            _, _, n, ev = heapq.heappop(self._waiters)
-            self._available -= n
-            ev.set()
+            entry = heapq.heappop(self._waiters)
+            self._available -= entry[2]
+            entry[4] = True  # reserved: an abandoning waiter must refund
+            entry[3].set()
+
+    def _abandon_locked_entry(self, entry) -> None:
+        """A waiter is leaving abnormally (cancelled, or its wait path
+        raised): refund permits already reserved for it, or remove its
+        still-queued heap entry, then re-run the handoff — an abandoned
+        head entry must never block later waiters."""
+        with self._lock:
+            if entry[4]:
+                self._available += entry[2]
+            else:
+                try:
+                    self._waiters.remove(entry)
+                    heapq.heapify(self._waiters)
+                except ValueError:
+                    pass
+            self._grant_head_locked()
 
     def acquire(self, n: int = 1, priority: int = 0,
-                wait_metric=None) -> None:
+                wait_metric=None, cancel_token=None) -> None:
+        """Block until n permits are reserved for this caller. When
+        `cancel_token` (runtime/lifecycle.CancelToken) is passed, the
+        waiter event doubles as the cancel wakeup and a fired token
+        raises QueryCancelledError with the entry cleaned up."""
         t0 = time.perf_counter_ns()
         with self._lock:
             if self._available >= n and not self._waiters:
@@ -50,12 +81,31 @@ class PrioritySemaphore:
                 return
             ev = threading.Event()
             self._seq += 1
-            heapq.heappush(self._waiters, [-priority, self._seq, n, ev])
+            entry = [-priority, self._seq, n, ev, False]
+            heapq.heappush(self._waiters, entry)
             # a higher-priority arrival may jump an ineligible queue, and
             # permits freed while nobody dispatched must not strand: try
             # the handoff immediately (possibly granting ourselves)
             self._grant_head_locked()
-        ev.wait()  # event-driven: set only once our permits are reserved
+        if cancel_token is not None:
+            cancel_token.add_waiter(ev)
+        try:
+            # delay/wedge/ioerror a contended acquire; an injected error
+            # here exercises the abandoned-entry cleanup below
+            _faults.site("semaphore.wait")
+            ev.wait()  # set once our permits are reserved, or on cancel
+            if cancel_token is not None and cancel_token.cancelled:
+                from spark_rapids_tpu.runtime.lifecycle import (
+                    QueryCancelledError,
+                )
+                raise QueryCancelledError(cancel_token.query_id,
+                                          cancel_token.reason)
+        except BaseException:
+            self._abandon_locked_entry(entry)
+            raise
+        finally:
+            if cancel_token is not None:
+                cancel_token.remove_waiter(ev)
         if wait_metric is not None:
             wait_metric.add(time.perf_counter_ns() - t0)
 
@@ -94,8 +144,13 @@ class TpuSemaphore:
         prio = 1 if task_ctx.holds_device_data else 0
         traced = trace.active() is not None
         t0 = time.perf_counter_ns() if traced else 0
+        # the acquiring query's cancel token (if any) rides into the
+        # waiter so a cancelled query parked on the semaphore wakes and
+        # unwinds instead of holding its queue position forever
+        from spark_rapids_tpu.runtime import lifecycle as _lc
         self._sem.acquire(1, priority=prio,
-                          wait_metric=task_ctx.metric("semaphoreWaitTime"))
+                          wait_metric=task_ctx.metric("semaphoreWaitTime"),
+                          cancel_token=_lc.current_token())
         if traced:  # args gated: no dict/clock work when tracing is off
             trace.instant("semaphoreAcquire", cat="semaphore", args={
                 "task_id": tid, "priority": prio,

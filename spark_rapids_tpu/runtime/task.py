@@ -36,6 +36,7 @@ class TaskContext:
         self._metrics: Dict[str, GpuMetric] = {}
         self._completion: List[Callable[[], None]] = []
         self._failed = False
+        self._cancelled = False
 
     def metric(self, name: str) -> GpuMetric:
         if name not in self._metrics:
@@ -45,8 +46,15 @@ class TaskContext:
     def on_completion(self, fn: Callable[[], None]) -> None:
         self._completion.append(fn)
 
-    def complete(self, failed: bool = False) -> None:
+    def complete(self, failed: bool = False,
+                 cancelled: bool = False) -> None:
+        """Run completion callbacks and roll accumulators up. `cancelled`
+        marks a task unwound by its query's cancel token (or an early
+        sibling close): it did not fail, but it must not count as a
+        clean completion either — obs folds it into
+        rapids_tasks_cancelled_total."""
         self._failed = failed
+        self._cancelled = cancelled
         for fn in reversed(self._completion):
             try:
                 fn()
@@ -99,6 +107,13 @@ class TaskContext:
         return self
 
     def __exit__(self, et, ev, tb):
-        self.complete(failed=et is not None)
+        cancelled = False
+        if et is not None:
+            from spark_rapids_tpu.runtime.lifecycle import (
+                QueryCancelledError,
+            )
+            cancelled = issubclass(et, QueryCancelledError)
+        self.complete(failed=et is not None and not cancelled,
+                      cancelled=cancelled)
         TaskContext.clear()
         return False

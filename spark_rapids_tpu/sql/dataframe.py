@@ -649,9 +649,14 @@ class DataFrame:
 
     approxQuantile = approx_quantile
 
-    def collect(self):
-        """Execute with the TPU engine (per-op CPU fallback as tagged)."""
-        return self.session.collect(self.plan)
+    def collect(self, timeout_seconds=None):
+        """Execute with the TPU engine (per-op CPU fallback as tagged).
+        `timeout_seconds` overrides spark.rapids.query.timeoutSeconds
+        for THIS action: past the deadline the query's cancel token
+        fires and the action raises QueryCancelledError(reason=
+        'deadline') at its next cooperative checkpoint."""
+        return self.session.collect(self.plan,
+                                    timeout_seconds=timeout_seconds)
 
     def collect_cpu(self):
         """Execute entirely on the CPU reference backend."""

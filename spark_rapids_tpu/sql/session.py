@@ -260,9 +260,11 @@ class TpuSession:
                     out[key] = snap
         return out
 
-    def collect(self, plan: P.PlanNode) -> pa.Table:
+    def collect(self, plan: P.PlanNode,
+                timeout_seconds: Optional[float] = None) -> pa.Table:
         import time as _time
 
+        from spark_rapids_tpu.runtime import lifecycle as LC
         from spark_rapids_tpu.runtime import obs as OBS
         from spark_rapids_tpu.runtime import trace as TR
         # structured trace per action (spark.rapids.sql.trace.*): spans +
@@ -319,6 +321,8 @@ class TpuSession:
         error: Optional[BaseException] = None
         status = "ok"
         degraded_reason: Optional[str] = None
+        cancel_reason: Optional[str] = None
+        tok = None  # this action's CancelToken (top-level only)
         # degradation is a TOP-LEVEL policy: a nested collect (broadcast
         # materialization inside a running device query) must propagate
         # its failure to the outer query, which then degrades whole
@@ -332,6 +336,29 @@ class TpuSession:
             ATTR.on_query_start()
         cpu_gate_failed = False
         try:
+            if depth == 0:
+                # query lifecycle control (runtime/lifecycle.py): the
+                # cancel token (deadline-armed from the conf or the
+                # per-action override) registers FIRST so the query is
+                # cancellable even while queued for admission; admit()
+                # then parks this thread in the bounded `queued` state
+                # when spark.rapids.query.maxConcurrent is saturated —
+                # raising QueryRejectedError (queue full / wait timeout)
+                # or QueryCancelledError (cancelled while queued)
+                tok = LC.begin_action(
+                    ot if isinstance(ot, int) else None, self.conf,
+                    timeout_seconds=timeout_seconds)
+                LC.admit(tok, self.conf)
+                if isinstance(ot, int):
+                    try:
+                        from spark_rapids_tpu.runtime.obs import (
+                            live as _live,
+                        )
+                        qc = _live.get(ot)
+                        if qc is not None:
+                            qc.transition("planning")
+                    except Exception:  # noqa: BLE001 - registry is
+                        pass  # advisory
             if depth == 0 and self._fallback_enabled():
                 from spark_rapids_tpu.runtime import watchdog as WD
                 brk = WD.peek_breaker()
@@ -368,6 +395,13 @@ class TpuSession:
             return result
         except BaseException as e:
             error = e
+            if depth == 0 and isinstance(e, LC.QueryCancelledError):
+                # a cooperative cancel (user, deadline, or injected
+                # fault) is its own terminal status — never degraded to
+                # a CPU re-execution, never counted as a plain failure
+                status = "cancelled"
+                cancel_reason = e.reason
+                raise
             fallback = self._maybe_degrade_cpu(plan, e) \
                 if depth == 0 and not cpu_gate_failed else None
             if fallback is None:
@@ -378,15 +412,21 @@ class TpuSession:
             return fallback
         finally:
             _COLLECT_DEPTH.d = depth
-            #: (status, degraded_reason) of the most recent top-level
-            #: action — ok / failed / degraded (chaos + serving callers
-            #: read this without needing the obs registry)
+            #: (status, reason) of the most recent top-level action —
+            #: ok / failed / degraded / cancelled (chaos + serving
+            #: callers read this without needing the obs registry)
             if depth == 0:
-                self.last_action_status = (status, degraded_reason)
+                self.last_action_status = (
+                    status, degraded_reason or cancel_reason)
+                # the token leaves the registry BEFORE the epilogue so
+                # metric snapshots / history writes can never re-raise
+                # the cancel; its admission slot releases here too
+                LC.finish_action(tok, status)
             self._finish_action(plan, qt, ot, error,
                                 _time.perf_counter_ns() - t0, wall0,
                                 status=status,
                                 degraded_reason=degraded_reason,
+                                cancel_reason=cancel_reason,
                                 top_level=depth == 0)
 
     def _fallback_enabled(self) -> bool:
@@ -413,8 +453,15 @@ class TpuSession:
         overflow or an unsupported-operation SparkException would raise
         identically on the CPU backend, so re-executing only delays the
         answer the user must see."""
+        from spark_rapids_tpu.runtime.lifecycle import (
+            QueryCancelledError, QueryRejectedError,
+        )
         if isinstance(error, (KeyboardInterrupt, SystemExit,
-                              GeneratorExit)):
+                              GeneratorExit, QueryCancelledError,
+                              QueryRejectedError)):
+            # a cancelled query must terminate (re-executing it on the
+            # CPU would resurrect exactly the work the user killed), and
+            # a rejected query re-executing would bypass admission
             return False
         return not isinstance(error, SparkException)
 
@@ -452,6 +499,7 @@ class TpuSession:
     def _finish_action(self, plan, qt, ot, error, duration_ns,
                        wall0, status: Optional[str] = None,
                        degraded_reason: Optional[str] = None,
+                       cancel_reason: Optional[str] = None,
                        top_level: bool = False) -> None:
         """Query epilogue: finalize the trace (success OR failure),
         compute the wall-time attribution, trigger a flight-recorder
@@ -520,12 +568,22 @@ class TpuSession:
                     log.warning("failed to attribute query time",
                                 exc_info=True)
         flight_dump = None
-        if top_level and status in ("failed", "degraded"):
+        if top_level and status in ("failed", "degraded", "cancelled"):
             # emit the outcome marker (tracer AND/OR flight ring), then
             # dump the flight rings: the failing query's timeline exists
             # retroactively even with tracing off
             try:
-                if status == "degraded":
+                if status == "cancelled":
+                    # the terminal marker of a cooperative cancel: the
+                    # trace ends here because the token fired (reason
+                    # user/deadline/fault), with the attribution
+                    # breakdown computed above showing where the budget
+                    # went before death
+                    TR.instant("queryCancelled", cat="query", args={
+                        "query_id": ot if isinstance(ot, int) else None,
+                        "reason": cancel_reason},
+                        level=TR.ESSENTIAL)
+                elif status == "degraded":
                     # the device path failed (or the breaker was open)
                     # but the CPU fallback answered: mark the timeline
                     # so the report attributes the tail to degradation
@@ -643,6 +701,21 @@ class TpuSession:
             return pa.Table.from_arrays(
                 [pa.array([], type=f.type) for f in fields], schema=pa.schema(fields))
         return pa.concat_tables(tables)
+
+    def cancel(self, query_id, reason: str = "user") -> bool:
+        """Cooperatively cancel an in-flight top-level query by id (the
+        ids session.running_queries() / the /queries endpoint report).
+        The query's cancel token fires: threads parked on the semaphore,
+        the admission queue or a retry backoff wake immediately, and the
+        next cooperative checkpoint (per-batch dispatch, pipeline
+        refill, wave start, exchange fetch) raises QueryCancelledError,
+        which unwinds through normal task completion — permits, pool
+        slots and spill handles release on their usual paths. Returns
+        False when no such query is in flight (cancel-after-finish is a
+        no-op). Also exposed as POST /queries/<id>/cancel on the obs
+        endpoint."""
+        from spark_rapids_tpu.runtime import lifecycle as LC
+        return LC.cancel(query_id, reason=reason)
 
     def running_queries(self) -> List[dict]:
         """Live progress snapshots of every in-flight top-level query in

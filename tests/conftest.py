@@ -61,3 +61,8 @@ def _reset_runtime():
     from spark_rapids_tpu.runtime import shapes, warmup
     warmup.reset_for_tests()
     shapes.configure(2.0, True)
+    # query lifecycle control: cancel tokens, the admission gate, the
+    # deadline sweeper and reject/cancel counters are process-global —
+    # a cancelled or queued query must not leak into the next test
+    from spark_rapids_tpu.runtime import lifecycle
+    lifecycle.reset_for_tests()

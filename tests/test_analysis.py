@@ -403,6 +403,58 @@ def test_l011_non_literal_and_other_calls_skipped():
     assert _rules(vs) == []
 
 
+def test_l012_unbounded_wait_flagged():
+    vs = _lint("""
+        import threading
+        def f(ev):
+            ev.wait()
+    """)
+    assert _rules(vs) == ["TPU-L012"]
+
+
+def test_l012_bounded_and_annotated_waits_pass():
+    vs = _lint("""
+        def f(ev, cv, done):
+            ev.wait(5.0)
+            cv.wait(timeout=0.25)
+            done.wait()  # tpulint: uncancellable shutdown barrier only
+            wait()
+    """)
+    assert _rules(vs) == []
+
+
+def test_l012_literal_none_timeout_is_unbounded():
+    """Event.wait(None) blocks forever — a None timeout must not pass
+    as 'bounded'."""
+    vs = _lint("""
+        def f(ev, cv):
+            ev.wait(None)
+            cv.wait(timeout=None)
+    """)
+    assert _rules(vs) == ["TPU-L012", "TPU-L012"]
+
+
+def test_l012_sanctioned_waiter_protocol_files_exempt():
+    src = """
+        def f(ev):
+            ev.wait()
+    """
+    assert _rules(_lint(src, relpath="runtime/semaphore.py")) == []
+    assert _rules(_lint(src, relpath="runtime/lifecycle.py")) == []
+    assert _rules(_lint(src, relpath="analysis/sanitizer.py")) == []
+    assert _rules(_lint(src, relpath="runtime/pipeline.py")) \
+        == ["TPU-L012"]
+
+
+def test_l012_suppression_counts():
+    vs = _lint("""
+        def f(ev):
+            ev.wait()  # tpulint: disable=TPU-L012 test fixture wait
+    """)
+    assert _rules(vs) == []
+    assert _rules(vs, suppressed=True) == ["TPU-L012"]
+
+
 def test_l011_roster_extraction_matches_live_modules():
     pkg = os.path.join(REPO, "spark_rapids_tpu")
     from spark_rapids_tpu.runtime.obs.live import STATES
@@ -410,7 +462,7 @@ def test_l011_roster_extraction_matches_live_modules():
     assert lint.known_query_states(pkg) == set(STATES)
     assert lint.known_sampler_series(pkg) == set(SERIES)
     assert {"queued", "planning", "executing", "finishing", "ok",
-            "failed", "degraded"} == set(STATES)
+            "failed", "degraded", "cancelled"} == set(STATES)
     assert {"device_bytes_held", "semaphore_waiting", "breaker_state",
             "process_rss_bytes",
             "pipeline_stalled_consumers"} <= set(SERIES)

