@@ -318,6 +318,12 @@ def tpu_queries(t, orders):
     from spark_rapids_tpu.expr.core import col, lit
     from spark_rapids_tpu.expr.window import Window
 
+    # NOTE: the kernel cost auditor stays OFF during the timed reps —
+    # an audited COLD collect resolves every traced shape's cost
+    # analysis (extra lower+compile) inside its epilogue, which would
+    # inflate tpu_cold_s against BENCH_r01-r05. The measured-bandwidth
+    # columns come from a separate untimed audited pass after the
+    # timing loop (audit_pass below).
     sess = TpuSession()
 
     def _mat(df, what):
@@ -421,6 +427,63 @@ def validate(name, tpu_val, cpu_val) -> bool:
     return False
 
 
+def audit_pass(sess, tpu, detail, t_start) -> None:
+    """Untimed audited replay: arm the kernel cost auditor, drop the
+    warm caches so accounting is complete, and rerun each measured
+    query once to record measured_gb / measured_eff_gbps /
+    roofline_pct_measured + the boundedness verdict beside the
+    hand-estimated columns (which stay untouched, so BENCH_r01-r05
+    remain comparable). Runs AFTER all timing so the audit's
+    per-shape cost-analysis resolution never lands in a timed rep."""
+    try:
+        from spark_rapids_tpu.analysis import kernel_audit as KA
+    except Exception:  # noqa: BLE001 - the audit is advisory
+        return
+    try:
+        # arm via the CONF (not set_enabled): every collect re-applies
+        # the session conf to the auditor, so a bare module-level arm
+        # would be disarmed at the first audited query's entry
+        sess.conf.set("spark.rapids.obs.audit.enabled", "true")
+        KA.clear_for_cold_audit()
+        for name, q in tpu.items():
+            if not isinstance(detail.get(name), dict) \
+                    or "tpu_s" not in detail[name]:
+                continue  # skipped or failed query: nothing to audit
+            if time.perf_counter() - t_start > TIME_BUDGET_S:
+                break  # the budget guards the audit replay too
+            print(f"[bench] {name} audit...", file=sys.stderr,
+                  flush=True)
+            try:
+                q()  # cold: traces + audits every shape
+                q()  # warm: clean device seconds (the cold rep's are
+                # mostly consumed by the compile correction)
+                roof = sess.last_roofline()
+            except Exception as e:  # noqa: BLE001 - one query's audit
+                # failing must not hide the others' columns
+                detail[name]["audit_error"] = f"{type(e).__name__}: {e}"
+                continue
+            if not roof:
+                continue
+            tot = roof.get("total") or {}
+            detail[name]["measured_gb"] = round(
+                tot.get("bytes_accessed", 0) / 1e9, 4)
+            detail[name]["measured_eff_gbps"] = tot.get(
+                "achieved_gbps", 0.0)
+            detail[name]["roofline_pct_measured"] = tot.get(
+                "roofline_pct_bw", 0.0)
+            bounds = sorted({g.get("bound") for g in
+                             (roof.get("groups") or {}).values()
+                             if g.get("bound")})
+            if bounds:
+                detail[name]["bound"] = "+".join(bounds)
+    finally:
+        try:
+            sess.conf.set("spark.rapids.obs.audit.enabled", "false")
+            KA.set_enabled(False)
+        except Exception:  # noqa: BLE001 - disarm is best-effort
+            pass
+
+
 def cpu_only_detail(t, orders, t_start) -> dict:
     """Per-query CPU-baseline detail for rounds where the engine backend
     is unusable: the trajectory then carries real per-query numbers and
@@ -519,6 +582,8 @@ def main():
         }
         if compile_s is not None:
             detail[name]["tpu_compile_s"] = round(compile_s, 4)
+
+    audit_pass(sess, tpu, detail, t_start)
 
     if not speedups:
         emit_error("time budget exhausted before any query ran",

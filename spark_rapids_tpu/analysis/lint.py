@@ -78,6 +78,16 @@ TPU-L012  no unbounded blocking wait (``Event.wait()`` /
           registered as a token waiter, or waited in bounded slices
           with a ``lifecycle.check_current()`` between them) or carry a
           ``# tpulint: uncancellable <why>`` justification.
+TPU-L013  every kernel-emitting module — one containing a
+          ``compile_cache.jit`` decoration/call or a raw
+          ``pallas_call`` site — must be registered in the
+          ``KERNEL_PRIMITIVES`` roster of ``analysis/kernel_audit.py``
+          (and stale roster entries naming a module with no kernel
+          sites, or absent from generated docs/metrics.md, are flagged
+          too). The kernel cost auditor's coverage statement — "every
+          compiled computation routes through an audited entry point" —
+          holds only while the roster tracks reality (the L007-L012
+          roster pattern).
 
 Suppression
 -----------
@@ -123,6 +133,10 @@ RULES: Dict[str, str] = {
     "TPU-L012": "unbounded blocking wait (Event/Condition .wait() with "
                 "no timeout) outside the sanctioned waiter-protocol "
                 "internals, without an uncancellable justification",
+    "TPU-L013": "kernel-emitting module (compile_cache.jit / "
+                "pallas_call site) not registered in the "
+                "analysis/kernel_audit.py KERNEL_PRIMITIVES roster "
+                "(or a stale/undocumented roster entry)",
 }
 
 #: modules owning the cancellation waiter protocol itself: their naked
@@ -239,7 +253,8 @@ class _FileLinter(ast.NodeVisitor):
                  known_buckets: Optional[Set[str]] = None,
                  pallas_modules: Optional[Set[str]] = None,
                  known_states: Optional[Set[str]] = None,
-                 known_series: Optional[Set[str]] = None):
+                 known_series: Optional[Set[str]] = None,
+                 kernel_modules: Optional[Set[str]] = None):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
@@ -248,6 +263,7 @@ class _FileLinter(ast.NodeVisitor):
         self.known_buckets = known_buckets
         self.known_states = known_states
         self.known_series = known_series
+        self.kernel_modules = kernel_modules
         self.violations: List[Violation] = []
         # stack of (lock_keys, with_lineno) for held-lock regions
         self._lock_stack: List[Tuple[Set[str], int]] = []
@@ -412,6 +428,7 @@ class _FileLinter(ast.NodeVisitor):
         self._check_attr_bucket(node)
         self._check_live_obs_names(node)
         self._check_compile_entry(node)
+        self._check_kernel_roster(node)
         self._check_unbounded_wait(node)
         self.generic_visit(node)
 
@@ -635,12 +652,17 @@ class _FileLinter(ast.NodeVisitor):
 
     #: receiver names under which .jit/.pjit is the jax compiler
     _JAX_BASES = {"jax", "_jax"}
+    #: receiver names under which .jit is the sanctioned compile-cache
+    #: wrapper (TPU-L013: such a site makes the module kernel-emitting)
+    _CC_BASES = {"compile_cache", "_cc", "cc"}
 
     def _check_jit_decorators(self, node: ast.FunctionDef) -> None:
         """Bare `@jax.jit` decorators are Attribute nodes, not Calls —
         the Call visitor never sees them (`@partial(jax.jit, ...)` and
         `@jax.jit(...)` are Calls and route through
-        _check_compile_entry)."""
+        _check_compile_entry). Bare `@compile_cache.jit` decorators are
+        likewise Attributes and mark the module kernel-emitting for
+        TPU-L013."""
         if self._in_compile_cache:
             return
         for dec in node.decorator_list:
@@ -651,6 +673,9 @@ class _FileLinter(ast.NodeVisitor):
                            "raw @jax.jit decorator — use "
                            "@compile_cache.jit so the sanctioned choke "
                            "point audits the compile entry")
+            elif isinstance(dec, ast.Attribute) and dec.attr == "jit" \
+                    and (_base_name(dec) or "").lower() in self._CC_BASES:
+                self._kernel_site(dec)
 
     def _check_compile_entry(self, node: ast.Call) -> None:
         if self._in_compile_cache:
@@ -683,6 +708,33 @@ class _FileLinter(ast.NodeVisitor):
                        "entries, jit for module-level kernels) so the "
                        "warm-trace cache, compile counters, attribution "
                        "and AOT warmup see the compile")
+
+    # -- TPU-L013 ----------------------------------------------------------
+
+    def _kernel_site(self, node: ast.AST) -> None:
+        """A kernel-emitting site (compile_cache.jit or pallas_call):
+        the containing module must be in the kernel cost auditor's
+        KERNEL_PRIMITIVES roster."""
+        if self.kernel_modules is None or self._in_compile_cache \
+                or self._in_analysis:
+            return
+        if self.relpath in self.kernel_modules:
+            return
+        self._emit("TPU-L013", node,
+                   f"kernel-emitting module {self.relpath!r} is not "
+                   f"registered in the analysis/kernel_audit.py "
+                   f"KERNEL_PRIMITIVES roster — register it so the "
+                   f"audit's coverage statement stays true and the "
+                   f"golden cost-signature artifact tracks it")
+
+    def _check_kernel_roster(self, node: ast.Call) -> None:
+        term = _terminal(node.func)
+        if term == "pallas_call":
+            self._kernel_site(node)
+            return
+        if term == "jit" \
+                and (_base_name(node.func) or "").lower() in self._CC_BASES:
+            self._kernel_site(node)
 
 
 # ---------------------------------------------------------------------------
@@ -795,6 +847,32 @@ def known_sampler_series(pkg_root: str) -> Set[str]:
         os.path.join(pkg_root, "runtime", "obs", "sampler.py"), "SERIES")
 
 
+def known_kernel_primitives(pkg_root: str) -> Set[str]:
+    """Registered kernel-emitting modules: the keys of the
+    KERNEL_PRIMITIVES dict literal in analysis/kernel_audit.py."""
+    return _dict_literal_keys(
+        os.path.join(pkg_root, "analysis", "kernel_audit.py"),
+        "KERNEL_PRIMITIVES")
+
+
+def module_emits_kernels(path: str) -> bool:
+    """Does a module contain a kernel-emitting site (compile_cache.jit
+    decoration/call or pallas_call)? Used for the stale-roster half of
+    TPU-L013."""
+    if not os.path.exists(path):
+        return False
+    tree = ast.parse(open(path).read(), path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "jit" \
+                and (_terminal(node.value) or "").lower() in \
+                _FileLinter._CC_BASES:
+            return True
+        if isinstance(node, ast.Call) \
+                and _terminal(node.func) == "pallas_call":
+            return True
+    return False
+
+
 def known_pallas_modules(pkg_root: str) -> Set[str]:
     """Modules allowed to contain raw pallas_call sites: the
     SANCTIONED_PALLAS_MODULES tuple in runtime/compile_cache.py
@@ -826,7 +904,10 @@ def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
     if not os.path.exists(path):
         return None
     found = set()
-    for m in re.finditer(r"`([A-Za-z][A-Za-z0-9_.]*)`", open(path).read()):
+    # path-like tokens (ops/kernels.py) are roster entries for the
+    # TPU-L013 docs-presence half, hence the "/" in the class
+    for m in re.finditer(r"`([A-Za-z][A-Za-z0-9_./]*)`",
+                         open(path).read()):
         found.add(m.group(1))
     return found
 
@@ -841,7 +922,8 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                 known_buckets: Optional[Set[str]] = None,
                 pallas_modules: Optional[Set[str]] = None,
                 known_states: Optional[Set[str]] = None,
-                known_series: Optional[Set[str]] = None
+                known_series: Optional[Set[str]] = None,
+                kernel_modules: Optional[Set[str]] = None
                 ) -> List[Violation]:
     tree = ast.parse(source, path)
     linter = _FileLinter(path, source, known_metrics,
@@ -850,7 +932,8 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                          known_buckets=known_buckets,
                          pallas_modules=pallas_modules,
                          known_states=known_states,
-                         known_series=known_series)
+                         known_series=known_series,
+                         kernel_modules=kernel_modules)
     linter.visit(tree)
     return linter.violations
 
@@ -866,6 +949,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
     pallas_mods = known_pallas_modules(pkg_root)
     states = known_query_states(pkg_root)
     series = known_sampler_series(pkg_root)
+    kernel_mods = known_kernel_primitives(pkg_root)
     violations: List[Violation] = []
     n_files = 0
     for dirpath, dirnames, filenames in os.walk(pkg_root):
@@ -880,7 +964,24 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 open(path).read(), path, known, relpath=rel,
                 known_sites=sites, known_buckets=buckets,
                 pallas_modules=pallas_mods,
-                known_states=states, known_series=series))
+                known_states=states, known_series=series,
+                kernel_modules=kernel_mods))
+    # the stale half of TPU-L013: a roster entry whose module no longer
+    # exists or no longer emits kernels claims audit coverage that
+    # isn't there
+    kapath = os.path.join(pkg_root, "analysis", "kernel_audit.py")
+    for mod in sorted(kernel_mods):
+        mpath2 = os.path.join(pkg_root, mod.replace("/", os.sep))
+        if not os.path.exists(mpath2):
+            violations.append(Violation(
+                "TPU-L013", kapath, 1,
+                f"KERNEL_PRIMITIVES roster entry {mod!r} names a "
+                f"module that does not exist"))
+        elif not module_emits_kernels(mpath2):
+            violations.append(Violation(
+                "TPU-L013", kapath, 1,
+                f"KERNEL_PRIMITIVES roster entry {mod!r} has no "
+                f"compile_cache.jit / pallas_call site — stale entry"))
     documented = docs_metric_names(repo_root)
     mpath = os.path.join(pkg_root, "runtime", "metrics.py")
     if documented is None:
@@ -913,6 +1014,12 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 "TPU-L011", spath, 1,
                 f"sampler series {name!r} absent from docs/metrics.md "
                 f"— regenerate with 'python tools/gen_docs.py'"))
+        for mod in sorted(kernel_mods - documented):
+            violations.append(Violation(
+                "TPU-L013", kapath, 1,
+                f"kernel-primitive module {mod!r} absent from "
+                f"docs/metrics.md — regenerate with "
+                f"'python tools/gen_docs.py'"))
     stats = {
         "files": n_files,
         "violations": sum(1 for v in violations if not v.suppressed),

@@ -338,6 +338,44 @@ OBS_SAMPLER_RING = conf_int(
     "kept — the flight-recorder ring discipline). At the default "
     "200ms interval, 512 samples cover the last ~102 seconds.")
 
+OBS_AUDIT_ENABLED = conf_bool(
+    "spark.rapids.obs.audit.enabled", False,
+    "Arm the kernel cost auditor (analysis/kernel_audit.py): every "
+    "computation resolved through the compile-cache choke point is "
+    "audited AT TRACE TIME for XLA flops, bytes accessed, input/output "
+    "plane bytes and shape-bucket padding exposure, deduped per "
+    "(entry, shape signature) so steady-state dispatches add zero "
+    "work. Joined with dispatch tallies and attribution device "
+    "seconds into per-query roofline attribution: achieved GB/s and "
+    "FLOP/s, % of the configured rooflines, memory/compute/"
+    "dispatch-overhead boundedness — surfaced in "
+    "explain(mode='analyze'), history records, rapids_roofline_* "
+    "gauges, /console, and tools/roofline_report.py. Off by default: "
+    "audited runs pay one extra lower+compile per traced shape at "
+    "resolution time (CI's audit_smoke and the golden cost-signature "
+    "generator run with it on).")
+
+OBS_AUDIT_PEAK_GBPS = conf_float(
+    "spark.rapids.obs.audit.peakGbps", 819.0,
+    "Memory-bandwidth roofline in GB/s for roofline attribution "
+    "(819 = one v5e chip's HBM bandwidth). Achieved GB/s is audited "
+    "bytes over measured device seconds; roofline_pct_bw is its share "
+    "of this peak.")
+
+OBS_AUDIT_PEAK_GFLOPS = conf_float(
+    "spark.rapids.obs.audit.peakGflops", 197000.0,
+    "Compute roofline in GFLOP/s for roofline attribution (197000 = "
+    "one v5e chip's bf16 peak). Drives roofline_pct_flops and the "
+    "memory-vs-compute boundedness verdict.")
+
+OBS_AUDIT_OVERHEAD_FACTOR = conf_float(
+    "spark.rapids.obs.audit.overheadBoundFactor", 10.0,
+    "A kernel group whose measured device seconds exceed this multiple "
+    "of its best-case roofline time (max of bytes/peakGbps and "
+    "flops/peakGflops) classifies as dispatch_overhead-bound: the "
+    "device is waiting on per-dispatch latency, not moving data or "
+    "computing.")
+
 LORE_DUMP_DIR = conf_str(
     "spark.rapids.sql.lore.dumpPath", "",
     "When set, every exec's input batches dump as parquet under "

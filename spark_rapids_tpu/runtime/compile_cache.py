@@ -89,6 +89,19 @@ _TLS = threading.local()
 _MONITORING_INSTALLED = False
 _PERSISTENT_DIR: Optional[str] = None
 
+#: the kernel cost auditor (analysis/kernel_audit.py) when armed, else
+#: None: get() notes every keyed resolution (one call per dispatch) and
+#: wraps fresh traced bodies so (entry, shape) costs are audited at
+#: trace time. Disabled cost: this one module-global None check — the
+#: fuse._DISPATCH_HOOK pattern.
+_AUDITOR = None
+
+
+def set_auditor(mod) -> None:
+    """Arm/disarm the kernel cost auditor (kernel_audit.configure)."""
+    global _AUDITOR
+    _AUDITOR = mod
+
 
 #: fingerprint of the most recently ACTIVATED session conf: the
 #: fallback for threads that never had a conf bound thread-locally.
@@ -145,12 +158,29 @@ def get(exec_class: str, key: Tuple, builder: Callable[[], Callable]
     building it from `builder` on a miss. The first call of a fresh
     entry is timed into the attribution 'compile' bucket and the
     entry's raw jitted function then swaps into the cache."""
-    full_key = (exec_class, key, _conf_fingerprint())
+    fp = _conf_fingerprint()
+    full_key = (exec_class, key, fp)
+    # ONE read of the auditor global per call (the fuse._DISPATCH_HOOK
+    # pattern): a concurrent disarm (another session's configure) must
+    # not crash a dispatch between the None check and the note
+    auditor = _AUDITOR
     fn = _CACHE.get(full_key)
     if fn is not None:
         _STATS["hits"] += 1
+        if auditor is not None:
+            auditor.note(full_key)
         return fn
-    jfn = jax.jit(builder())  # the ONE sanctioned keyed jit site
+    body = builder()
+    bind = None
+    if auditor is not None:
+        # trace-time cost audit: jax executes the wrapped Python body
+        # only while tracing (once per shape signature, re-traces
+        # included), so steady-state dispatches never touch it
+        body, bind = auditor.wrap_traced(exec_class, key, fp, body)
+    jfn = jax.jit(body)  # the ONE sanctioned keyed jit site
+    if bind is not None:
+        bind(jfn)
+        auditor.note(full_key)  # the build's first call is a dispatch
     wrapped = _timed_first_call(full_key, jfn)
     with _LOCK:
         fn = _CACHE.get(full_key)
@@ -232,10 +262,21 @@ def jit(fn: Optional[Callable] = None, **jit_kwargs) -> Callable:
     jax.jit directly — jax's own signature cache keys the executable by
     (bucketed shapes, dtypes, statics), and the process-wide monitoring
     listener accounts any compile it triggers — so calls cost exactly
-    what a raw jax.jit call would."""
+    what a raw jax.jit call would.
+
+    The kernel cost auditor's wrapper rides INSIDE the traced body
+    (installed unconditionally here because decoration happens at
+    import, before any conf exists): it runs only while jax traces and
+    checks the armed flag then, so per-call cost stays exactly one
+    PjitFunction invocation. functools.wraps preserves the kernel's
+    signature for static_argnames resolution."""
     if fn is None:
         return lambda f: jit(f, **jit_kwargs)
-    return jax.jit(fn, **jit_kwargs)  # the ONE sanctioned raw-jit site
+    from spark_rapids_tpu.analysis import kernel_audit as _ka
+    body, bind = _ka.wrap_kernel(fn)
+    jfn = jax.jit(body, **jit_kwargs)  # the ONE sanctioned raw-jit site
+    bind(jfn)
+    return jfn
 
 
 # ---------------------------------------------------------------------------

@@ -90,6 +90,9 @@ class ObsState:
         #: the most recent SLO breach: digest, breach doc, attribution
         #: summary, flight-dump path (the /healthz slow-query surface)
         self.last_slow: Optional[dict] = None
+        #: the most recent audited query's roofline doc (analysis/
+        #: kernel_audit.py) — the /console roofline table reads this
+        self.last_roofline: Optional[dict] = None
 
 
 #: per-thread collect depth: a re-entrant collect on the SAME thread is
@@ -183,6 +186,27 @@ def _preregister(reg: MetricsRegistry) -> None:
             "Per-query wall time attributed to each phase bucket "
             "(seconds; runtime/obs/attribution.py)",
             labels={"phase": phase})
+    # roofline attribution of the most recent AUDITED query (analysis/
+    # kernel_audit.py; spark.rapids.obs.audit.enabled): set once per
+    # query end, zero when no audited query has completed yet
+    for group in ("device_compute", "shuffle", "total"):
+        reg.gauge("rapids_roofline_achieved_gbps",
+                  "Achieved device bandwidth of the most recent "
+                  "audited query (audited bytes / measured device "
+                  "seconds)", labels={"group": group})
+        reg.gauge("rapids_roofline_pct",
+                  "Share of the configured bandwidth roofline "
+                  "(spark.rapids.obs.audit.peakGbps) the most recent "
+                  "audited query achieved", labels={"group": group})
+    for group in ("device_compute", "shuffle"):
+        reg.gauge("rapids_roofline_achieved_gflops",
+                  "Achieved device FLOP rate of the most recent "
+                  "audited query", labels={"group": group})
+        reg.gauge("rapids_roofline_padding_waste_ratio",
+                  "Worst-case shape-bucket padding share of the most "
+                  "recent audited query's input plane bytes "
+                  "(runtime/shapes.py ladder exposure)",
+                  labels={"group": group})
     reg.histogram("rapids_query_wall_time_ms",
                   "Per-query wall time (ms)")
     reg.histogram("rapids_task_duration_ms", "Per-task duration (ms)")
@@ -449,6 +473,7 @@ def on_query_end(token, *, session, plan, status: str,
                  last_metrics: Optional[Dict[str, dict]] = None,
                  degraded_reason: Optional[str] = None,
                  attribution_doc: Optional[dict] = None,
+                 roofline_doc: Optional[dict] = None,
                  flight_dump: Optional[str] = None
                  ) -> Optional[dict]:
     """Publish one finished top-level action: registry rollups, the SLO
@@ -478,6 +503,40 @@ def on_query_end(token, *, session, plan, status: str,
                 if secs:
                     reg.float_counter("rapids_query_seconds_bucket",
                                       labels={"phase": phase}).inc(secs)
+        if roofline_doc:
+            st.last_roofline = roofline_doc
+            # last-audited-query roofline gauges (the console and any
+            # scraper read these; per-query history carries the full
+            # doc). Zero the whole group roster FIRST: a query whose
+            # doc omits a group (no exchange dispatched) must not leave
+            # a PREVIOUS query's number labelled as this one's.
+            for group in ("device_compute", "shuffle", "total"):
+                lbl = {"group": group}
+                reg.gauge("rapids_roofline_achieved_gbps",
+                          labels=lbl).set(0.0)
+                reg.gauge("rapids_roofline_pct", labels=lbl).set(0.0)
+                if group != "total":
+                    reg.gauge("rapids_roofline_achieved_gflops",
+                              labels=lbl).set(0.0)
+                    reg.gauge("rapids_roofline_padding_waste_ratio",
+                              labels=lbl).set(0.0)
+            for group, g in roofline_doc.get("groups", {}).items():
+                lbl = {"group": group}
+                reg.gauge("rapids_roofline_achieved_gbps", labels=lbl
+                          ).set(g.get("achieved_gbps") or 0.0)
+                reg.gauge("rapids_roofline_pct", labels=lbl
+                          ).set(g.get("roofline_pct_bw") or 0.0)
+                reg.gauge("rapids_roofline_achieved_gflops", labels=lbl
+                          ).set(g.get("achieved_gflops") or 0.0)
+                reg.gauge("rapids_roofline_padding_waste_ratio",
+                          labels=lbl
+                          ).set(g.get("padding_waste_ratio") or 0.0)
+            tot = roofline_doc.get("total") or {}
+            reg.gauge("rapids_roofline_achieved_gbps",
+                      labels={"group": "total"}
+                      ).set(tot.get("achieved_gbps") or 0.0)
+            reg.gauge("rapids_roofline_pct", labels={"group": "total"}
+                      ).set(tot.get("roofline_pct_bw") or 0.0)
         digest = None
         try:
             digest = plan_digest(plan)
@@ -536,7 +595,8 @@ def on_query_end(token, *, session, plan, status: str,
                 duration_ns=duration_ns, status=status, error=error,
                 plan=plan, session=session, trace_paths=trace_paths,
                 snaps=snaps, degraded_reason=degraded_reason,
-                attribution=attribution_doc, slo_breach=breach,
+                attribution=attribution_doc, roofline=roofline_doc,
+                slo_breach=breach,
                 flight_dump=flight_dump, digest=digest)
             st.history.append(rec)
         st.last_query = {

@@ -201,6 +201,64 @@ def fold_task(metrics: Dict[str, object]) -> None:
 # the fold
 # ---------------------------------------------------------------------------
 
+def classify_exec_times(snaps: Optional[Dict[str, dict]]
+                        ) -> Dict[str, Dict[str, int]]:
+    """Per-exec-CLASS bucket decomposition of a last_metrics()-shaped
+    snapshot: {exec_class: {bucket: ns}} under exactly the rules
+    attribute() folds into its query totals. This is the snapshot half
+    of attribute() factored out so the kernel cost auditor's roofline
+    join (analysis/kernel_audit.py) reads per-class device seconds from
+    the SAME classification — its device_compute reconciles with the
+    attribution bucket by construction, not by a parallel copy of the
+    rules."""
+    per_cls: Dict[str, Dict[str, int]] = {}
+    for exec_key, snap in (snaps or {}).items():
+        cls = exec_key.split("#", 1)[0]
+        shuffle_cls = any(s in cls for s in _SHUFFLE_CLASSES)
+        dst = per_cls.setdefault(cls, {})
+        for mname, v in snap.items():
+            if not mname.endswith("Time") or mname in _EXCLUDED_METRICS:
+                continue
+            try:
+                v = int(v)
+            except Exception:  # noqa: BLE001 - non-numeric snapshot entry
+                continue
+            if v <= 0:
+                continue
+            b = METRIC_BUCKETS.get(mname)
+            if b is None:
+                b = "shuffle" if shuffle_cls else "device_compute"
+            dst[b] = dst.get(b, 0) + v
+    return per_cls
+
+
+#: the compile-correction cascade order: a compile-laden first dispatch
+#: also ran under its exec's span, so its wall sits in one of these
+#: buckets too — subtraction walks them in THIS order. attribute() and
+#: the kernel auditor's roofline join (analysis/kernel_audit.py) both
+#: call subtract_compile, so the 'reconciles by construction' guarantee
+#: rests on one cascade, not two hand-synchronized copies.
+_COMPILE_CASCADE = ("device_compute", "shuffle", "host_decode")
+
+
+def subtract_compile(totals: Dict[str, int], compile_ns: int) -> None:
+    """Subtract a query's direct-recorded compile ns from the buckets
+    its first dispatches double-counted into, in cascade order,
+    mutating `totals` in place. Buckets absent from `totals` are
+    skipped (the roofline join passes only its device groups)."""
+    rem = int(compile_ns)
+    if rem <= 0:
+        return
+    for b in _COMPILE_CASCADE:
+        if b not in totals:
+            continue
+        shift = min(rem, totals[b])
+        totals[b] -= shift
+        rem -= shift
+        if not rem:
+            break
+
+
 def attribute(snaps: Optional[Dict[str, dict]], duration_ns: int,
               extra: Optional[Dict[str, int]] = None) -> Optional[dict]:
     """Decompose one query's wall time into the bucket roster.
@@ -214,21 +272,8 @@ def attribute(snaps: Optional[Dict[str, dict]], duration_ns: int,
     if wall_ns <= 0:
         return None
     totals = {b: 0 for b in BUCKETS}
-    for exec_key, snap in (snaps or {}).items():
-        cls = exec_key.split("#", 1)[0]
-        shuffle_cls = any(s in cls for s in _SHUFFLE_CLASSES)
-        for mname, v in snap.items():
-            if not mname.endswith("Time") or mname in _EXCLUDED_METRICS:
-                continue
-            try:
-                v = int(v)
-            except Exception:  # noqa: BLE001 - non-numeric snapshot entry
-                continue
-            if v <= 0:
-                continue
-            b = METRIC_BUCKETS.get(mname)
-            if b is None:
-                b = "shuffle" if shuffle_cls else "device_compute"
+    for per_bucket in classify_exec_times(snaps).values():
+        for b, v in per_bucket.items():
             totals[b] += v
     for b, v in (extra or {}).items():
         if b in totals:
@@ -240,14 +285,7 @@ def attribute(snaps: Optional[Dict[str, dict]], duration_ns: int,
     # 'host_decode'. Cascade the subtraction so compile stays disjoint
     # from all three instead of double-counting (which would inflate
     # measured_seconds past wall and fake a concurrency factor).
-    if totals["compile"]:
-        rem = totals["compile"]
-        for b in ("device_compute", "shuffle", "host_decode"):
-            shift = min(rem, totals[b])
-            totals[b] -= shift
-            rem -= shift
-            if not rem:
-                break
+    subtract_compile(totals, totals["compile"])
     measured = sum(totals.values())
     if measured > wall_ns:
         # concurrent tasks: summed time exceeds wall — report

@@ -120,6 +120,7 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
                        snaps: Optional[dict] = None,
                        degraded_reason: Optional[str] = None,
                        attribution: Optional[dict] = None,
+                       roofline: Optional[dict] = None,
                        slo_breach: Optional[dict] = None,
                        flight_dump: Optional[str] = None,
                        digest: Optional[str] = None) -> dict:
@@ -145,6 +146,12 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
         # the per-query wall-time decomposition (obs/attribution.py);
         # tools/history_server.py renders it as the breakdown bar
         rec["attribution"] = attribution
+    if roofline is not None:
+        # the kernel cost audit's roofline attribution (analysis/
+        # kernel_audit.py): achieved GB/s + FLOP/s vs the configured
+        # peaks, boundedness, and padding waste per kernel group —
+        # tools/roofline_report.py aggregates these across the store
+        rec["roofline"] = roofline
     if slo_breach is not None:
         rec["slo_breach"] = slo_breach
     if flight_dump is not None:

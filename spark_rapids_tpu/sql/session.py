@@ -102,6 +102,12 @@ class TpuSession:
         # warmup arms now and launches replays as tables register
         from spark_rapids_tpu.runtime import compile_cache, warmup
         compile_cache.configure(self.conf)
+        # arm the kernel cost auditor NOW, not first at
+        # prepare_execution: the audit's per-query tally opens at
+        # collect entry, before the plan converts — a session's first
+        # query must already be audited
+        from spark_rapids_tpu.analysis import kernel_audit
+        kernel_audit.configure(self.conf)
         warmup.maybe_arm(self)
 
     def _activate(self):
@@ -216,7 +222,7 @@ class TpuSession:
         injection (general sites + the legacy OOM injector), sync the
         retry backoff and the dispatch watchdog/breaker, convert the
         plan. Returns (exec_root, meta)."""
-        from spark_rapids_tpu.analysis import sanitizer
+        from spark_rapids_tpu.analysis import kernel_audit, sanitizer
         from spark_rapids_tpu.config import set_session_conf
         from spark_rapids_tpu.plan.overrides import convert_plan
         from spark_rapids_tpu.runtime import faults, watchdog
@@ -226,6 +232,7 @@ class TpuSession:
         )
         set_session_conf(self.conf)
         sanitizer.maybe_install(self.conf)
+        kernel_audit.configure(self.conf)
         OomInjector.from_conf(self.conf)
         faults.from_conf(self.conf)
         backoff_from_conf(self.conf)
@@ -334,6 +341,11 @@ class TpuSession:
             # explain(mode="analyze") always has a breakdown
             from spark_rapids_tpu.runtime.obs import attribution as ATTR
             ATTR.on_query_start()
+            # and the kernel cost auditor's dispatch tally (one global
+            # read when the audit is off; the conf rides along so a
+            # mid-session enable covers THIS query)
+            from spark_rapids_tpu.analysis import kernel_audit as KA
+            KA.on_query_start(self.conf)
         cpu_gate_failed = False
         try:
             if depth == 0:
@@ -567,6 +579,23 @@ class TpuSession:
                 except Exception:  # noqa: BLE001
                     log.warning("failed to attribute query time",
                                 exc_info=True)
+            # kernel cost audit: close the dispatch tally, resolve any
+            # pending cost analyses (trace-time audits deferred off the
+            # dispatch path), and join with the attribution's device
+            # seconds into the roofline doc. One global read when off.
+            from spark_rapids_tpu.analysis import kernel_audit as KA
+            self._last_audit = None
+            self._last_roofline = None
+            try:
+                self._last_audit = KA.finish_query()
+                if self._last_audit is not None and lm is not None:
+                    self._last_roofline = KA.roofline(
+                        self._last_audit, lm, duration_ns,
+                        extra=self._last_attr_extra)
+            except Exception:  # noqa: BLE001 - the audit must never
+                # fail (or mask the real error of) a query
+                log.warning("failed to compute kernel cost audit",
+                            exc_info=True)
         flight_dump = None
         if top_level and status in ("failed", "degraded", "cancelled"):
             # emit the outcome marker (tracer AND/OR flight ring), then
@@ -637,6 +666,7 @@ class TpuSession:
                     degraded_reason=degraded_reason,
                     attribution_doc=getattr(self, "_last_attribution",
                                             None),
+                    roofline_doc=getattr(self, "_last_roofline", None),
                     flight_dump=flight_dump)
             except Exception:  # noqa: BLE001
                 log.warning("failed to publish query to obs",
@@ -752,6 +782,37 @@ class TpuSession:
             # poisoned lazy count must not fail an explain
             return None
 
+    def last_audit(self) -> Optional[dict]:
+        """Kernel cost audit summary of the most recent top-level action
+        (analysis/kernel_audit.py): per-kernel-family dispatches, FLOPs,
+        bytes accessed, plane bytes and padding exposure. None when
+        spark.rapids.obs.audit.enabled was off for the action."""
+        return getattr(self, "_last_audit", None)
+
+    def last_roofline(self) -> Optional[dict]:
+        """Roofline attribution of the most recent top-level action:
+        audited bytes/FLOPs joined with measured device seconds into
+        achieved GB/s + FLOP/s, roofline %, boundedness, and padding
+        waste. Uses the epilogue's precomputed doc when one exists;
+        otherwise recomputes from the stored audit summary plus a fresh
+        metric snapshot. None when the audit was off."""
+        doc = getattr(self, "_last_roofline", None)
+        if doc is not None:
+            return doc
+        summary = getattr(self, "_last_audit", None)
+        dur = getattr(self, "_last_duration_ns", 0)
+        if not summary or not dur \
+                or getattr(self, "_last_exec", None) is None:
+            return None
+        from spark_rapids_tpu.analysis import kernel_audit as KA
+        try:
+            return KA.roofline(summary, self.last_metrics(), dur,
+                               extra=getattr(self, "_last_attr_extra",
+                                             None))
+        except Exception:  # noqa: BLE001 - the roofline view is
+            # advisory: a poisoned lazy count must not fail an explain
+            return None
+
     def explain_analyze(self) -> str:
         """The physical exec tree of the MOST RECENT action annotated
         with its actual runtime metrics — rows, batches, dispatches, and
@@ -786,4 +847,9 @@ class TpuSession:
             from spark_rapids_tpu.runtime.obs import attribution as ATTR
             lines.append("")
             lines.extend(ATTR.render_text(attr))
+        roof = self.last_roofline()
+        if roof is not None:
+            from spark_rapids_tpu.analysis import kernel_audit as KA
+            lines.append("")
+            lines.extend(KA.render_text(roof))
         return "\n".join(lines)

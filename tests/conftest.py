@@ -24,6 +24,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: full NDS-scale runs excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _reset_runtime():
     yield
@@ -54,6 +60,13 @@ def _reset_runtime():
         if st.slo is not None:
             st.slo.reset_for_tests()
         st.last_slow = None
+        st.last_roofline = None
+    # the kernel cost auditor: disarm + drop the per-query tally and
+    # findings; the (entry, shape) record table deliberately persists —
+    # it mirrors the process-wide warm-trace cache (tests wanting a
+    # cold audit call kernel_audit.clear_for_cold_audit())
+    from spark_rapids_tpu.analysis import kernel_audit
+    kernel_audit.reset_for_tests()
     # a test that armed AOT warmup must not leak its manager (and its
     # captured session) into the next test; the warm-trace cache itself
     # deliberately persists — it is process-global by design and tests

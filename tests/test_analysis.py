@@ -455,6 +455,84 @@ def test_l012_suppression_counts():
     assert _rules(vs, suppressed=True) == ["TPU-L012"]
 
 
+def _lint_kernel(src, relpath="ops/new_kernel.py",
+                 roster=frozenset({"ops/kernels.py"})):
+    return lint.lint_source(textwrap.dedent(src), "/x/" + relpath,
+                            {"opTime"}, relpath=relpath,
+                            pallas_modules={"ops/pallas_kernels.py"},
+                            kernel_modules=set(roster))
+
+
+def test_l013_unrostered_cc_jit_module_flagged():
+    """A compile_cache.jit site (bare decorator, call-form decorator,
+    and plain call) in a module outside KERNEL_PRIMITIVES fails — the
+    audit's coverage statement must track every kernel emitter."""
+    vs = _lint_kernel("""
+        from spark_rapids_tpu.runtime import compile_cache as _cc
+
+        @_cc.jit
+        def k1(x):
+            return x
+
+        @_cc.jit(static_argnums=(1,))
+        def k2(x, n):
+            return x
+
+        def k3(fn):
+            return _cc.jit(fn)
+    """)
+    assert _rules(vs) == ["TPU-L013", "TPU-L013", "TPU-L013"]
+
+
+def test_l013_rostered_module_and_non_kernel_module_pass():
+    src = """
+        from spark_rapids_tpu.runtime import compile_cache as _cc
+
+        @_cc.jit
+        def k(x):
+            return x
+    """
+    assert _rules(_lint_kernel(src, relpath="ops/kernels.py")) == []
+    # a module with no kernel sites owes the roster nothing
+    assert _rules(_lint_kernel("""
+        def plain(x):
+            return x + 1
+    """)) == []
+
+
+def test_l013_pallas_call_outside_roster_flagged():
+    """pallas_call makes a module kernel-emitting too: a sanctioned
+    pallas module (TPU-L010-clean) that is NOT in KERNEL_PRIMITIVES
+    still fails L013 — the two rosters enforce different claims."""
+    src = """
+        import jax.experimental.pallas as pl
+
+        def k(x):
+            return pl.pallas_call(lambda r: r, out_shape=x)(x)
+    """
+    vs = _lint_kernel(src, relpath="ops/pallas_kernels.py")
+    assert _rules(vs) == ["TPU-L013"]
+    vs2 = _lint_kernel(src, relpath="ops/pallas_kernels.py",
+                       roster=frozenset({"ops/pallas_kernels.py"}))
+    assert _rules(vs2) == []
+
+
+def test_l013_roster_extraction_and_staleness():
+    pkg = os.path.join(REPO, "spark_rapids_tpu")
+    mods = lint.known_kernel_primitives(pkg)
+    from spark_rapids_tpu.analysis.kernel_audit import KERNEL_PRIMITIVES
+    assert mods == set(KERNEL_PRIMITIVES)
+    # every rostered module exists and really emits kernels (the stale
+    # half lint_tree enforces on the real tree)
+    for mod in mods:
+        path = os.path.join(pkg, mod.replace("/", os.sep))
+        assert os.path.exists(path), mod
+        assert lint.module_emits_kernels(path), mod
+    # and a kernel-free module is not kernel-emitting
+    assert not lint.module_emits_kernels(
+        os.path.join(pkg, "runtime", "metrics.py"))
+
+
 def test_l011_roster_extraction_matches_live_modules():
     pkg = os.path.join(REPO, "spark_rapids_tpu")
     from spark_rapids_tpu.runtime.obs.live import STATES

@@ -43,18 +43,36 @@ def _leak_sweep():
     its generator frames (frame<->traceback cycles) until the cyclic
     collector runs, and those frames hold task contexts whose
     completion releases permits — pending cyclic garbage is not a
-    leak."""
+    leak.
+
+    The sweep REAPS AND RETRIES before declaring a leak: a cancelled
+    query's thread may still be unwinding when its test returns (the
+    bounded join(15) in the queued-cancel test races the teardown
+    under load — a known tier-1 flake), so a transiently-held permit
+    is re-checked for up to ~15s and only a STABLY held one fails."""
     yield
     import gc
-    gc.collect()
-    sem = peek_semaphore()
-    if sem is not None:
-        assert sem.available == sem.permits, "stranded semaphore permits"
-        assert sem.waiting == 0, "leaked semaphore waiters"
-    assert LC.token_ids() == [], "leaked cancel tokens"
-    gd = LC.gate().doc()
-    assert gd["active"] == 0 and gd["queued"] == 0, \
-        f"leaked admission-gate occupancy: {gd}"
+
+    def _clean():
+        gc.collect()
+        sem = peek_semaphore()
+        if sem is not None:
+            if sem.available != sem.permits or sem.waiting != 0:
+                return f"semaphore: available={sem.available}/" \
+                       f"{sem.permits} waiting={sem.waiting}"
+        if LC.token_ids():
+            return f"cancel tokens: {LC.token_ids()}"
+        gd = LC.gate().doc()
+        if gd["active"] != 0 or gd["queued"] != 0:
+            return f"admission gate: {gd}"
+        return None
+
+    leak = _clean()
+    deadline = time.monotonic() + 15.0
+    while leak is not None and time.monotonic() < deadline:
+        time.sleep(0.1)
+        leak = _clean()
+    assert leak is None, f"stable leak after reap-and-retry: {leak}"
 
 
 def _table(rows=20000, seed=7):

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
 # chaos smoke, obs smoke, flight smoke, pipeline smoke, compile smoke,
-# tier-1 tests.
+# audit smoke, tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
@@ -64,6 +64,17 @@ fi
 
 step "compile smoke (cross-process persistent-cache hits; warm-history AOT warmup drops first-run compile_seconds >=5x; warm choke-point overhead <2%)"
 if ! python tools/compile_smoke.py; then
+    fail=1
+fi
+
+step "audit smoke (kernel cost auditor: audited NDS pass reproduces the golden cost signatures byte-identically; two consecutive generator runs identical; armed steady-state overhead <2%; roofline reconciles with attribution device_compute <1%)"
+# --fast replays a sorted prefix against the golden instead of the full
+# ~340-490s audited 98-query pass (which stays on the default path)
+audit_args=""
+if [[ "${1:-}" == "--fast" ]]; then
+    audit_args="--quick"
+fi
+if ! python tools/audit_smoke.py $audit_args; then
     fail=1
 fi
 
