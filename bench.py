@@ -582,6 +582,17 @@ def main():
         }
         if compile_s is not None:
             detail[name]["tpu_compile_s"] = round(compile_s, 4)
+        try:
+            # adaptive decisions from the last (warm) timed rep: which
+            # replans fired and how many device dispatches they dropped,
+            # read beside measured_eff_gbps (BENCH_r06+ columns)
+            aqe = sess.last_aqe()
+        except Exception:  # noqa: BLE001 - decision doc is advisory
+            aqe = None
+        if aqe:
+            detail[name]["aqe_decisions"] = aqe.get("counts", {})
+            detail[name]["dispatches_saved"] = aqe.get(
+                "dispatches_saved", 0)
 
     audit_pass(sess, tpu, detail, t_start)
 

@@ -563,9 +563,51 @@ SHUFFLE_HOST_BUDGET = conf_int(
 
 ADAPTIVE_ENABLED = conf_bool(
     "spark.rapids.sql.adaptive.enabled", True,
-    "Pick the join strategy at RUNTIME from measured build-side size when "
-    "the planner has no estimate (the AQE role: reference "
-    "GpuCustomShuffleReaderExec / per-stage re-planning).")
+    "Adaptive query execution (the AQE role: reference "
+    "GpuCustomShuffleReaderExec / per-stage re-planning): pick the join "
+    "strategy at RUNTIME from the measured build side, convert a shuffled "
+    "hash join to broadcast when the materialized build side lands under "
+    "the byte threshold, split skewed post-shuffle partitions, reuse "
+    "materialized broadcast builds across queries, and let the measured "
+    "cost pass (plan/cost.py) replan from audited history. Master switch "
+    "for every spark.rapids.sql.adaptive.* feature below.")
+
+ADAPTIVE_BROADCAST_BYTES = conf_int(
+    "spark.rapids.sql.adaptive.broadcastThresholdBytes", 64 << 20,
+    "Runtime shuffle-hash -> broadcast conversion threshold: the build "
+    "side of a shuffled hash join materializes its exchange FIRST, and "
+    "when its MEASURED device bytes (actual row counts from the compact "
+    "offsets fetch - no extra sync) land at or under this many bytes, the "
+    "probe-side exchange is never dispatched - the join replans as a "
+    "broadcast hash join over the raw probe partitions (reference "
+    "spark.sql.adaptive.autoBroadcastJoinThreshold + "
+    "GpuBroadcastJoinMeta). <= 0 disables the conversion.")
+
+ADAPTIVE_SKEW_FACTOR = conf_float(
+    "spark.rapids.sql.adaptive.skewFactor", 4.0,
+    "Skewed-partition split: a post-shuffle partition whose row count "
+    "(free host ints from the compact offsets fetch) exceeds this factor "
+    "times the median partition is split into median-sized sub-batches "
+    "that rejoin under the existing batch semantics, bounding per-"
+    "dispatch capacity (reference spark.sql.adaptive.skewJoin."
+    "skewedPartitionFactor / GpuSkewJoin). <= 0 disables splitting.")
+
+ADAPTIVE_BUILD_REUSE = conf_bool(
+    "spark.rapids.sql.adaptive.buildReuse.enabled", True,
+    "Cache materialized broadcast build sides ACROSS queries, keyed by "
+    "build-plan digest + table registration version next to the compile "
+    "cache, so a repeated join skips the build entirely (reference "
+    "ReusedExchangeExec across AQE stages). Entries invalidate when any "
+    "temp view is re-registered and are capped at 8.")
+
+ADAPTIVE_MEASURED_COST = conf_bool(
+    "spark.rapids.sql.adaptive.measuredCost.enabled", True,
+    "Measured cost pass: before converting a plan, consult the query "
+    "history store's roofline verdicts for the SAME plan digest and pick "
+    "exchange partition counts, aggregate fusion boundaries, and the "
+    "coalesceTinyRows threshold from what was MEASURED instead of static "
+    "defaults (needs spark.rapids.obs.historyDir; a digest with no "
+    "audited history keeps the static plan).")
 
 PALLAS_ENABLED = conf_bool(
     "spark.rapids.sql.pallas.enabled", True,

@@ -1,0 +1,363 @@
+"""Adaptive query execution on the MEASURED cost model (the AQE layer).
+
+Reference parity: GpuQueryStagePrepOverrides / the AQE shims +
+CostBasedOptimizer — Spark replans between stages from runtime
+statistics. Here the statistics are better than Spark's: the compact
+exchange already fetches exact per-partition row counts (one offsets
+D2H per batch), and the kernel cost auditor (analysis/kernel_audit.py)
+writes per-digest roofline verdicts into the query history store. This
+module turns both into runtime decisions:
+
+- ``AdaptiveShuffledHashJoinExec``: materialize the build-side exchange
+  FIRST; when its measured bytes land under
+  ``spark.rapids.sql.adaptive.broadcastThresholdBytes``, the probe-side
+  exchange is never dispatched — the join replans as a broadcast hash
+  join over the raw probe partitions (shuffle-hash -> broadcast
+  conversion, the dispatch-storm killer).
+- skew accounting for ``ExchangeExec``: partitions whose row count
+  exceeds ``skewFactor`` x median split into bounded sub-dispatches
+  (the split itself lives in tpu_nodes; the policy math is here).
+- a cross-query broadcast-build cache keyed by build-plan digest +
+  table registration version, next to the compile cache in spirit:
+  entries die on any temp-view re-registration and never outlive the
+  anchor relation's materialization.
+- the decision RECORDER: every decision emits an ``aqeDecision`` trace
+  instant, a ``rapids_aqe_decisions_total{kind}`` counter, an EXPLAIN
+  ANALYZE "adaptive" section and an ``aqe`` field in the history
+  record. A replan that cannot be seen did not happen.
+
+The measured cost PASS (pick partition counts / fusion boundaries /
+coalesce thresholds from per-digest history) lives in plan/cost.py;
+its decisions are recorded through this module so all four pieces
+share one observable surface.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec import tpu_nodes as X
+from spark_rapids_tpu.runtime import metrics as M
+from spark_rapids_tpu.runtime import trace as TR
+
+# ---------------------------------------------------------------------------
+# decision recorder (the observable surface every AQE piece reports to)
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+#: the open query's decision list (collect depth 0 opens it; None
+#: between queries — decisions made with no open query still trace and
+#: count, they just have no history record to land in)
+_CUR: Optional[List[dict]] = None
+
+#: decision kinds (the rapids_aqe_decisions_total label values)
+BROADCAST_CONVERSION = "broadcast_conversion"
+SKEW_SPLIT = "skew_split"
+BUILD_REUSE = "build_reuse"
+MEASURED_COST = "measured_cost"
+
+
+def enabled(conf) -> bool:
+    return bool(conf.get(C.ADAPTIVE_ENABLED))
+
+
+def on_query_start(conf=None) -> None:
+    """Open the active query's decision list (collect depth 0). Cheap
+    enough to run unconditionally: the disabled path pays one lock and
+    one list allocation per ACTION, not per batch."""
+    global _CUR
+    with _LOCK:
+        _CUR = []
+
+
+def record(kind: str, *, dispatches_saved: int = 0, **detail: Any) -> None:
+    """One adaptive decision, made first-class: appended to the open
+    query's list (-> EXPLAIN ANALYZE + history), traced as an
+    ``aqeDecision`` instant, and counted in the process registry."""
+    d: Dict[str, Any] = {"kind": kind}
+    d.update(detail)
+    if dispatches_saved:
+        d["dispatches_saved"] = int(dispatches_saved)
+    with _LOCK:
+        if _CUR is not None:
+            _CUR.append(d)
+    try:
+        TR.instant("aqeDecision", cat="adaptive", args=d,
+                   level=TR.ESSENTIAL)
+    except Exception:  # noqa: BLE001 - a marker failure must not fail
+        pass  # the query the decision just sped up
+    try:
+        from spark_rapids_tpu.runtime import obs as OBS
+        st = OBS.state()
+        if st is not None:
+            st.registry.counter(
+                "rapids_aqe_decisions_total",
+                "Adaptive execution decisions by kind (aqeDecision "
+                "instants; spark.rapids.sql.adaptive.*).",
+                labels={"kind": kind}).inc()
+            if dispatches_saved:
+                st.registry.counter(
+                    "rapids_aqe_dispatches_saved_total",
+                    "Device dispatches adaptive execution avoided "
+                    "(broadcast conversions skipping probe-side "
+                    "exchanges, reused broadcast builds).").inc(
+                        int(dispatches_saved))
+    except Exception:  # noqa: BLE001 - observability never fails a query
+        pass
+
+
+def finish_query() -> Optional[dict]:
+    """Close the active query's decision list into the ``aqe`` doc the
+    session threads into EXPLAIN ANALYZE and the history record. None
+    when the query made no adaptive decision."""
+    global _CUR
+    with _LOCK:
+        cur, _CUR = _CUR, None
+    if not cur:
+        return None
+    counts: Dict[str, int] = {}
+    saved = 0
+    for d in cur:
+        counts[d["kind"]] = counts.get(d["kind"], 0) + 1
+        saved += int(d.get("dispatches_saved", 0))
+    return {"decisions": cur, "counts": counts, "dispatches_saved": saved}
+
+
+def render_text(doc: Optional[dict]) -> List[str]:
+    """EXPLAIN ANALYZE "adaptive" section (the render_text pattern of
+    attribution / kernel_audit)."""
+    if not doc:
+        return []
+    n = sum(doc.get("counts", {}).values())
+    lines = [f"-- adaptive ({n} decision{'s' if n != 1 else ''}, "
+             f"{doc.get('dispatches_saved', 0)} dispatches saved) --"]
+    for d in doc.get("decisions", []):
+        detail = ", ".join(f"{k}={v}" for k, v in d.items()
+                           if k != "kind")
+        lines.append(f"  {d['kind']}" + (f": {detail}" if detail else ""))
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# cross-query broadcast-build cache (digest + table version keyed)
+# ---------------------------------------------------------------------------
+
+#: bumped by every temp-view (re-)registration: a key minted under an
+#: older epoch can never hit again, so a re-registered table invalidates
+#: every cached build that might have read the replaced data
+_TABLE_EPOCH = 0
+_BUILD_CACHE: "OrderedDict[tuple, dict]" = OrderedDict()
+_BUILD_CACHE_CAP = 8
+
+
+def table_epoch() -> int:
+    with _LOCK:
+        return _TABLE_EPOCH
+
+
+def bump_table_version() -> None:
+    """A temp view was (re-)registered: invalidate the whole digest
+    cache. Coarse on purpose — the digest cannot tell which relation a
+    name now resolves to, and stale entries would pin replaced HBM."""
+    global _TABLE_EPOCH
+    with _LOCK:
+        _TABLE_EPOCH += 1
+        _BUILD_CACHE.clear()
+
+
+def _build_cache_key(build_plan, skey) -> Optional[tuple]:
+    try:
+        from spark_rapids_tpu.runtime.obs.history import plan_digest
+        digest = plan_digest(build_plan)
+    except Exception:  # noqa: BLE001 - an undigestable build just
+        return None  # doesn't participate in cross-query reuse
+    with _LOCK:
+        epoch = _TABLE_EPOCH
+    return (digest, skey, epoch)
+
+
+def build_cache_get(conf, build_plan, skey, anchor) -> Optional[dict]:
+    """Look up a materialized broadcast build for this build-plan digest.
+    The digest normalizes CachedRelation state out (two same-shaped
+    relations collide), so a hit is only trusted when the entry's anchor
+    AND its materialization are identity-identical to the live ones."""
+    if anchor is None or not enabled(conf) \
+            or not conf.get(C.ADAPTIVE_BUILD_REUSE):
+        return None
+    key = _build_cache_key(build_plan, skey)
+    if key is None:
+        return None
+    with _LOCK:
+        entry = _BUILD_CACHE.get(key)
+        if entry is None:
+            return None
+        if entry.get("anchor") is not anchor \
+                or entry["mat"] is not anchor.materialized:
+            del _BUILD_CACHE[key]  # stale: stop pinning old batches
+            return None
+        _BUILD_CACHE.move_to_end(key)
+    return entry
+
+
+def build_cache_put(conf, build_plan, skey, anchor, entry: dict) -> None:
+    if anchor is None or not enabled(conf) \
+            or not conf.get(C.ADAPTIVE_BUILD_REUSE):
+        return
+    key = _build_cache_key(build_plan, skey)
+    if key is None:
+        return
+    e = dict(entry)
+    e["anchor"] = anchor
+    with _LOCK:
+        while len(_BUILD_CACHE) >= _BUILD_CACHE_CAP:
+            _BUILD_CACHE.popitem(last=False)
+        _BUILD_CACHE[key] = e
+
+
+# ---------------------------------------------------------------------------
+# skew policy (the split mechanics live on ExchangeExec)
+# ---------------------------------------------------------------------------
+
+def skew_threshold(conf, totals: List[Optional[int]]
+                   ) -> Optional[Tuple[int, int]]:
+    """(threshold_rows, median_rows) for a materialized exchange's
+    per-partition row totals, or None when splitting must not engage:
+    adaptive off, factor <= 0, fewer than 2 partitions with known
+    counts, or nothing exceeds the threshold anyway. ``None`` totals
+    (lazy/masked counts that would sync) are excluded from the median
+    and their partitions never split."""
+    if not enabled(conf):
+        return None
+    factor = float(conf.get(C.ADAPTIVE_SKEW_FACTOR))
+    if factor <= 0:
+        return None
+    known = sorted(t for t in totals if t is not None)
+    if len(known) < 2:
+        return None
+    mid = len(known) // 2
+    median = known[mid] if len(known) % 2 else (
+        (known[mid - 1] + known[mid]) // 2)
+    threshold = int(factor * max(median, 1))
+    if known[-1] <= threshold:
+        return None
+    return threshold, max(int(median), 1)
+
+
+# ---------------------------------------------------------------------------
+# shuffle-hash -> broadcast conversion
+# ---------------------------------------------------------------------------
+
+class AdaptiveShuffledHashJoinExec(X.TpuExec):
+    """The planned-as-shuffled join that measures before dispatching
+    (tentpole piece (a); reference GpuCustomShuffleReaderExec reading a
+    materialized stage + the AQE broadcast demotion): the BUILD side's
+    exchange materializes first — its per-partition row counts are
+    already exact host ints from the compact offsets fetch — and when
+    the measured device bytes land at or under
+    spark.rapids.sql.adaptive.broadcastThresholdBytes the probe-side
+    exchange is never built: the join replans as a broadcast hash join
+    over the RAW probe partitions, eliminating the probe partitioning
+    kernels, their offsets fetches, and the per-sub-batch dispatch
+    storm downstream. Over the threshold (or when measuring would sync
+    a lazy count) the already-materialized exchange feeds the shuffled
+    join unchanged — the measurement is never wasted work.
+
+    Differs from AdaptiveJoinExec (the est-unknown planner fallback):
+    this node exists where the planner DID estimate the build side as
+    big; the conversion catches estimates that were wrong at runtime,
+    and the build side is measured THROUGH its exchange so the shuffled
+    path never re-executes the child."""
+
+    def __init__(self, plan, children, conf, part_keys):
+        super().__init__(plan, children, conf)
+        self.part_keys = part_keys
+        self._lock = threading.Lock()
+        self._chosen: Optional[X.TpuExec] = None
+
+    @property
+    def num_partitions(self):
+        return self.children[0].num_partitions
+
+    @staticmethod
+    def _measure(parts) -> Optional[Tuple[int, int, int]]:
+        """(device_bytes, rows, batches) across a materialized
+        exchange's output, or None when any count would sync (masked
+        sub-batches, lazily-deserialized shuffle blobs) — the decision
+        must stay free, exactly like _coalesce_tiny's."""
+        nbytes = nrows = nbatches = 0
+        for part in parts:
+            for b in part:
+                if not isinstance(b, ColumnarBatch) \
+                        or b.row_mask is not None \
+                        or not isinstance(b.num_rows, int):
+                    return None
+                nrows += b.num_rows
+                nbytes += int(b.device_memory_size())
+                nbatches += 1
+        return nbytes, nrows, nbatches
+
+    def _choose(self) -> X.TpuExec:
+        with self._lock:
+            if self._chosen is not None:
+                return self._chosen
+            left, right = self.children
+            lkeys, rkeys = self.part_keys
+            n_out = left.num_partitions
+            rex = X.ShuffleExchangeExec(self.plan, [right], self.conf,
+                                        rkeys, n_out)
+            threshold = int(self.conf.get(C.ADAPTIVE_BROADCAST_BYTES))
+            measured = None
+            if threshold > 0 and enabled(self.conf) \
+                    and self.plan.how not in ("right", "full"):
+                # right/full track probe-side matches across the whole
+                # build: they need the single-probe-partition collect
+                # plan, so they keep the shuffled path here
+                parts = rex._materialize()
+                measured = self._measure(parts)
+            if measured is not None and measured[0] <= threshold:
+                nbytes, nrows, nbatches = measured
+                batches = [b for part in parts for b in part]
+                src = X._MaterializedExec(self.plan.children[1], batches,
+                                          self.conf)
+                self._chosen = X.BroadcastHashJoinExec(
+                    self.plan, [left, src], self.conf)
+                # the avoided work: the probe-side partitioning kernels
+                # + offsets fetches the exchange we never built would
+                # have dispatched. The build side's own tally is the
+                # best same-shaped estimate available without running
+                # the probe.
+                saved = int(
+                    rex.metrics.metric(M.PARTITION_DISPATCHES).value
+                    + rex.metrics.metric(M.PARTITION_HOST_FETCHES).value)
+                record(BROADCAST_CONVERSION, build_bytes=nbytes,
+                       build_rows=nrows, build_batches=nbatches,
+                       threshold_bytes=threshold, n_out=n_out,
+                       dispatches_saved=max(saved, 1))
+            else:
+                lex = X.ShuffleExchangeExec(self.plan, [left], self.conf,
+                                            lkeys, n_out)
+                self._chosen = X.ShuffledHashJoinExec(
+                    self.plan, [lex, rex], self.conf,
+                    part_keys=self.part_keys)
+            return self._chosen
+
+    def execute_partition(self, ctx, pidx):
+        yield from self._choose().execute_partition(ctx, pidx)
+
+
+# ---------------------------------------------------------------------------
+# test hook
+# ---------------------------------------------------------------------------
+
+def reset_for_tests() -> None:
+    """Drop all process-global adaptive state (tests/conftest.py's
+    _reset_runtime): the open decision list, the build cache, and the
+    table epoch."""
+    global _CUR, _TABLE_EPOCH
+    with _LOCK:
+        _CUR = None
+        _TABLE_EPOCH = 0
+        _BUILD_CACHE.clear()

@@ -390,7 +390,16 @@ def _rewrite(node, conf, counter):
 
     if _fusable(node):
         chain, input_exec = _collect_chain(node)
-        if sum(1 for m in chain if _dispatching(m)) >= 2:
+        # the fusion boundary is 2 dispatching members (any fewer is
+        # illegal — plan_verify PV-FUSE); the measured cost pass may
+        # RAISE it for this plan when history shows fusion's retrace
+        # cost outweighs the dispatch savings
+        min_members = 2
+        from spark_rapids_tpu.plan import cost as _cost
+        h = _cost.current_hints()
+        if h is not None and h.fusion_min_members is not None:
+            min_members = max(2, int(h.fusion_min_members))
+        if sum(1 for m in chain if _dispatching(m)) >= min_members:
             counter[0] += 1
             members = list(reversed(chain))  # child-most first
             cls = fused_stage_cls()

@@ -2799,6 +2799,13 @@ class ExchangeExec(TpuExec):
         #: serialized writer hooks it so serde/spill of batch i overlaps
         #: the device partitioning of batch i+1
         self._emit_sink = None
+        #: measured cost pass override of coalesceTinyRows, snapshotted
+        #: at convert time (the thread-local hints are gone by execute):
+        #: history said this plan is dispatch-bound, so coalesce harder
+        from spark_rapids_tpu.plan import cost as COST
+        h = COST.current_hints()
+        self._tiny_override: Optional[int] = (
+            h.coalesce_tiny_rows if h is not None else None)
 
     @property
     def schema(self):
@@ -3038,7 +3045,94 @@ class ExchangeExec(TpuExec):
 
     def execute_partition(self, ctx, pidx):
         out = self._materialize()
-        yield from self._coalesce_tiny(out[pidx])
+        # coalesce first, then split: the two repair opposite tails (dust
+        # -> fewer dispatches, giants -> bounded dispatches) and a split
+        # slice must never be re-merged back into the giant it came from
+        yield from self._split_skewed(self._coalesce_tiny(out[pidx]), pidx)
+
+    def _item_rows(self, item, pidx) -> Optional[int]:
+        """Free (host-int) row count of one materialized item, or None
+        when counting would sync — the skew detector's unit of account."""
+        if isinstance(item, ColumnarBatch) and item.row_mask is None \
+                and isinstance(item.num_rows, int):
+            return item.num_rows
+        return None
+
+    def _skew_plan(self):
+        """(threshold_rows, target_rows, totals) once per exchange, or
+        None when no partition qualifies for splitting. Computed from
+        the already-materialized output's host-int counts only — the
+        decision never syncs (partitions with any lazy count are
+        excluded and never split)."""
+        with self._lock:
+            sp = getattr(self, "_skew_decision", None)
+            if sp is None:
+                from spark_rapids_tpu.exec import adaptive as AQ
+                totals: List[Optional[int]] = []
+                for p, part in enumerate(self._out or []):
+                    n: Optional[int] = 0
+                    for item in part:
+                        r = self._item_rows(item, p)
+                        if r is None:
+                            n = None
+                            break
+                        n += r
+                    totals.append(n)
+                t = AQ.skew_threshold(self.conf, totals)
+                sp = self._skew_decision = (
+                    False if t is None else (t[0], t[1], totals))
+        return sp or None
+
+    def _split_skewed(self, batches, pidx):
+        """Skewed-partition split (spark.rapids.sql.adaptive.skewFactor;
+        reference GpuSkewJoin / skewedPartitionFactor): a partition whose
+        row total exceeds factor x median splits its oversized batches
+        into ~median-row contiguous slices (bounded fan-out), so one hot
+        key range stops serializing the whole downstream stage behind a
+        single giant dispatch. In-order slices — every downstream result
+        is byte-identical, sub-batches just rejoin under the existing
+        batch semantics."""
+        if getattr(self, "n_out", 1) <= 1:
+            return batches
+        from spark_rapids_tpu.exec import adaptive as AQ
+        if not AQ.enabled(self.conf) \
+                or float(self.conf.get(C.ADAPTIVE_SKEW_FACTOR)) <= 0:
+            return batches
+        sp = self._skew_plan()
+        if sp is None:
+            return batches
+        threshold, target, totals = sp
+        total = totals[pidx] if pidx < len(totals) else None
+        if total is None or total <= threshold:
+            return batches
+        return self._split_stream(batches, pidx, total, threshold, target)
+
+    def _split_stream(self, batches, pidx, total, threshold, target):
+        from spark_rapids_tpu.exec import adaptive as AQ
+        nsplits = 0
+        for b in batches:
+            n = self._item_rows(b, pidx)
+            if n is None or n <= 2 * target:
+                yield b
+                continue
+            # bounded fan-out: at most 8 sub-dispatches per batch, each
+            # a contiguous in-order slice sharing the compact exchange's
+            # capacity buckets (ops/repartition.py slice_rows)
+            step = max(target, -(-n // 8))
+            start = 0
+            while start < n:
+                ln = min(step, n - start)
+                sub = RP.slice_rows(b, start, ln)
+                for ic, oc in zip(b.columns, sub.columns):
+                    oc.bounds = ic.bounds
+                sub.coalesced = getattr(b, "coalesced", False)
+                nsplits += 1
+                yield sub
+                start += ln
+        if nsplits:
+            AQ.record(AQ.SKEW_SPLIT, partition=pidx, rows=int(total),
+                      median=int(target), threshold_rows=int(threshold),
+                      splits=nsplits)
 
     def _coalesce_tiny(self, batches):
         """Post-shuffle tiny-partition coalescing (spark.rapids.shuffle.
@@ -3052,7 +3146,9 @@ class ExchangeExec(TpuExec):
         count is still on device pass through untouched, as do masked
         batches and lazily-deserialized shuffle blobs). Merges count
         into shuffleCoalescedBatches — visible in EXPLAIN ANALYZE."""
-        tiny = int(self.conf.get(C.SHUFFLE_COALESCE_TINY_ROWS))
+        override = getattr(self, "_tiny_override", None)
+        tiny = int(override) if override is not None \
+            else int(self.conf.get(C.SHUFFLE_COALESCE_TINY_ROWS))
         if tiny <= 0 or getattr(self, "n_out", 1) <= 1:
             yield from batches
             return
@@ -3138,6 +3234,16 @@ class ShuffleExchangeExec(ExchangeExec):
         # results twice — a live stream cannot be replayed
         return self.conf.get(C.SHUFFLE_MODE).upper() != "ICI"
 
+    def _item_rows(self, item, pidx):
+        if isinstance(item, _LazyShuffleBlobs):
+            # serialized partitions are sized by the writer-side tally —
+            # decoding blobs just to count them would defeat the free-
+            # decision contract
+            store = getattr(self, "_store", None)
+            n = store.partition_rows(pidx) if store is not None else 0
+            return n if n > 0 else None
+        return super()._item_rows(item, pidx)
+
     def _repartition(self, child_results):
         mode = self.conf.get(C.SHUFFLE_MODE).upper()
         if mode == "ICI":
@@ -3207,12 +3313,15 @@ class ShuffleExchangeExec(ExchangeExec):
         def ser(item):
             # the compact partitioning path hands over already-contiguous
             # right-sized slices; serialize_batch compacts the masked
-            # path's sub-batches itself
+            # path's sub-batches itself. The row count rides along into
+            # the store's per-partition tally (skew detection reads it
+            # without decoding blobs).
             p, b = item
-            if rows_int(b.num_rows) == 0:
-                return p, None  # empty sub-batches never ship
+            n = rows_int(b.num_rows)
+            if n == 0:
+                return p, None, 0  # empty sub-batches never ship
             return p, FLT.site_bytes("shuffle.write",
-                                     serde.serialize_batch(b, codec))
+                                     serde.serialize_batch(b, codec)), n
 
         if pipeline_conf(self.conf) > 0 and nthreads > 1:
             self._serialize_streaming(child_results, store, ser, nthreads,
@@ -3225,15 +3334,15 @@ class ShuffleExchangeExec(ExchangeExec):
                     from spark_rapids_tpu.runtime.host_pool import (
                         get_host_pool,
                     )
-                    for p, blob in get_host_pool(self.conf).map_ordered(
+                    for p, blob, n in get_host_pool(self.conf).map_ordered(
                             ser, work, max_concurrency=nthreads):
                         if blob is not None:
-                            store.add(p, blob)
+                            store.add(p, blob, rows=n)
                 else:
                     for item in work:
-                        p, blob = ser(item)
+                        p, blob, n = ser(item)
                         if blob is not None:
-                            store.add(p, blob)
+                            store.add(p, blob, rows=n)
         self._store = store
         tot = store.totals()
         self.metrics.metric(M.SHUFFLE_BYTES_WRITTEN).add(
@@ -3273,9 +3382,9 @@ class ShuffleExchangeExec(ExchangeExec):
 
         def drain(block: bool) -> None:
             while futures and (block or futures[0].done()):
-                p, blob = futures.popleft().result()
+                p, blob, n = futures.popleft().result()
                 if blob is not None:
-                    store.add(p, blob)
+                    store.add(p, blob, rows=n)
 
         def sink(p, b):
             futures.append(ex.submit(b.device_memory_size(), ser, (p, b)))
@@ -3312,8 +3421,10 @@ class ShuffleExchangeExec(ExchangeExec):
                     yield item
 
         # deserialized blobs coalesce exactly like device sub-batches:
-        # the serialized path chops partitions even finer
-        yield from self._coalesce_tiny(decoded())
+        # the serialized path chops partitions even finer. Skew split
+        # applies after (the store's writer-side row tally sizes lazy
+        # partitions without decoding them).
+        yield from self._split_skewed(self._coalesce_tiny(decoded()), pidx)
 
     def _ici_eligible(self, child_results):
         import jax as _jax
@@ -4145,12 +4256,35 @@ class BroadcastHashJoinExec(_HashJoinBase):
                             and entry["mat"] is not anchor.materialized:
                         del store[skey]  # stale: stop pinning old batches
                         entry = None
+                    from spark_rapids_tpu.exec import adaptive as AQ
+                    src = "anchor"
+                    if entry is None:
+                        # second chance: the digest-keyed cross-query
+                        # cache (exec/adaptive.py) — a DIFFERENT plan
+                        # tree joining the same cached relation through
+                        # the same build shape reuses the materialized
+                        # broadcast; the hit re-warms the anchor store
+                        entry = AQ.build_cache_get(
+                            self.conf, self.plan.children[1], skey, anchor)
+                        src = "digest"
+                        if entry is not None:
+                            if len(store) >= 8:
+                                store.pop(next(iter(store)))
+                            store[skey] = entry
+                            if getattr(anchor, "_bcast_reuse",
+                                       None) is None:
+                                anchor._bcast_reuse = store
                     if entry is not None:
                         self._build = entry["build"]
                         self._build_keys = entry["keys"]
                         self.plan._bcast_cache = (self._build,
                                                   self._build_keys)
                         self.plan._bcast_session_entry = entry
+                        if AQ.enabled(self.conf):
+                            AQ.record(
+                                AQ.BUILD_REUSE, source=src,
+                                dispatches_saved=int(
+                                    entry.get("build_batches", 0)) or 1)
                         return self._build
                 build_t = self.metrics.metric(M.BUILD_TIME)
                 right = self.children[1]
@@ -4168,7 +4302,8 @@ class BroadcastHashJoinExec(_HashJoinBase):
                         self.plan.right_keys, self._build)
                 if anchor is not None and anchor.materialized is not None:
                     entry = {"build": self._build, "keys": self._build_keys,
-                             "dense": {}, "mat": anchor.materialized}
+                             "dense": {}, "mat": anchor.materialized,
+                             "build_batches": len(batches)}
                     store = getattr(anchor, "_bcast_reuse", None)
                     if store is None:
                         store = anchor._bcast_reuse = {}
@@ -4177,6 +4312,9 @@ class BroadcastHashJoinExec(_HashJoinBase):
                     store[skey] = entry
                     self.plan._bcast_session_entry = entry
                     self.plan._bcast_cache = (self._build, self._build_keys)
+                    from spark_rapids_tpu.exec import adaptive as AQ
+                    AQ.build_cache_put(self.conf, self.plan.children[1],
+                                       skey, anchor, entry)
         return self._build
 
     def execute_partition(self, ctx, pidx):
@@ -4231,6 +4369,12 @@ class AdaptiveJoinExec(TpuExec):
                                                   batches, self.conf)
                     self._chosen = BroadcastHashJoinExec(
                         self.plan, [left, right_src], self.conf)
+                    from spark_rapids_tpu.exec import adaptive as AQ
+                    AQ.record(AQ.BROADCAST_CONVERSION, source="row_probe",
+                              build_rows=rows, threshold_rows=threshold,
+                              # both sides' exchanges (partition kernel +
+                              # offsets fetch per input batch) never run
+                              dispatches_saved=2 * max(len(batches), 1))
                 else:
                     del batches  # release; the exchange re-executes right
                     lkeys, rkeys = self.part_keys

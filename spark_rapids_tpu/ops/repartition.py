@@ -85,6 +85,21 @@ def _slice_kernel(batch, start, length, out_cap: int):
     return K.gather_batch(batch, idx, length)
 
 
+def slice_rows(batch: ColumnarBatch, start: int, length: int
+               ) -> ColumnarBatch:
+    """Contiguous row-range slice [start, start+length) of a compact
+    batch as a right-sized sub-batch with a plain host-int row count —
+    the skew-split primitive (exec/adaptive.py): one gather dispatch per
+    slice, capacity bucketed by ``round_capacity(length)`` so the
+    sub-dispatches of a split partition share executables with the
+    compact exchange's own slices. The caller guarantees the batch is
+    unmasked (row_mask None) with a host-int row count."""
+    sub = _slice_kernel(batch, jnp.int32(int(start)),
+                        jnp.int32(int(length)),
+                        round_capacity(int(length)))
+    return ColumnarBatch(sub.columns, int(length))
+
+
 def compact_slices(sorted_batch: ColumnarBatch, offsets: np.ndarray,
                    n_out: int) -> List[Optional[ColumnarBatch]]:
     """Host-side assembly after the single offsets fetch: contiguous

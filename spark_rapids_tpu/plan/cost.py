@@ -1,19 +1,34 @@
-"""Cost-based optimizer: revert TPU subtrees not worth the transfer.
+"""Cost model: the static revert pass + the MEASURED cost pass (AQE).
 
-Reference parity: CostBasedOptimizer.scala (:54 — optional, off by
-default; CpuCostModel :284 / GpuCostModel :334 estimate per-operator cost
-and revert subtrees where the accelerated plan plus its transfer overhead
-loses to staying on CPU). Here the dominant term is the host->device
-boundary: a tiny scan feeding one cheap operator is faster on the CPU
-backend than paying upload + dispatch round trips.
+Two passes share this module:
 
-Enabled by spark.rapids.sql.optimizer.enabled. The model is deliberately
-coarse (row estimates x per-op scores, like the reference's
-operatorsScore.csv); it only ever REVERTS, never forces, so correctness
-is unaffected.
+1. The static cost-based optimizer (reference CostBasedOptimizer.scala
+   :54 — optional, off by default; CpuCostModel :284 / GpuCostModel
+   :334): estimate per-operator cost from row statistics and revert TPU
+   subtrees where the accelerated plan plus its transfer overhead loses
+   to staying on CPU. The dominant term is the host->device boundary: a
+   tiny scan feeding one cheap operator is faster on the CPU backend
+   than paying upload + dispatch round trips. Enabled by
+   spark.rapids.sql.optimizer.enabled; deliberately coarse (row
+   estimates x per-op scores, like the reference's operatorsScore.csv);
+   it only ever REVERTS, never forces, so correctness is unaffected.
+
+2. The measured cost pass (spark.rapids.sql.adaptive.measuredCost.
+   enabled): before a plan converts, consult the query history store's
+   roofline verdicts (analysis/kernel_audit.py writes them per plan
+   digest) and derive MeasuredHints — partition counts, fusion
+   boundaries, and the coalesceTinyRows threshold picked from what was
+   MEASURED for this exact digest instead of static defaults. Hints
+   install thread-locally around convert_plan (sql/session.py
+   prepare_execution); plan/overrides.py and exec/stage_fusion.py read
+   them through current_hints(). A digest with no audited history (or
+   no history store at all) yields no hints and the static plan stands
+   — the pass is deterministic for a fixed digest + history file, so
+   golden plans regenerate reproducibly.
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from spark_rapids_tpu import config as C
@@ -32,6 +47,10 @@ OP_SCORES = {
 TRANSFER_PER_ROW = 0.5
 FIXED_DISPATCH = 50_000.0  # ~round-trip latency expressed in row-costs
 
+
+# ---------------------------------------------------------------------------
+# static revert pass (unchanged semantics)
+# ---------------------------------------------------------------------------
 
 def _plan_costs(plan: P.PlanNode, inherited_rows: int) -> tuple:
     """Returns (cpu_cost, device_cost) where device_cost covers compute +
@@ -85,3 +104,166 @@ def _revert_all(meta, reason: str) -> None:
     meta.reasons.append(reason)
     for c in meta.children:
         _revert_all(c, reason)
+
+
+# ---------------------------------------------------------------------------
+# measured cost pass (the history-fed half of adaptive execution)
+# ---------------------------------------------------------------------------
+
+class MeasuredHints:
+    """Per-plan conversion hints derived from audited history. All
+    fields are None when the measurement prescribes no change; the
+    static plan is always the fallback."""
+
+    __slots__ = ("digest", "basis", "exchange_parts",
+                 "coalesce_tiny_rows", "fusion_min_members")
+
+    def __init__(self, digest: str, basis: str,
+                 exchange_parts: Optional[int] = None,
+                 coalesce_tiny_rows: Optional[int] = None,
+                 fusion_min_members: Optional[int] = None):
+        self.digest = digest
+        #: what measurement produced these hints (the decision detail)
+        self.basis = basis
+        #: n_out override for group-key aggregate exchanges; 1 collapses
+        #: the hash exchange to a collect (the single-partitioning
+        #: shuffle-elimination AQE move)
+        self.exchange_parts = exchange_parts
+        #: spark.rapids.shuffle.coalesceTinyRows override for this plan's
+        #: exchanges
+        self.coalesce_tiny_rows = coalesce_tiny_rows
+        #: minimum dispatching members for stage fusion (>= 2: a fused
+        #: stage under 2 dispatches is illegal — plan_verify PV-FUSE)
+        self.fusion_min_members = fusion_min_members
+
+    def any(self) -> bool:
+        return (self.exchange_parts is not None
+                or self.coalesce_tiny_rows is not None
+                or self.fusion_min_members is not None)
+
+    def detail(self) -> dict:
+        d = {"digest": self.digest, "basis": self.basis}
+        if self.exchange_parts is not None:
+            d["exchange_parts"] = self.exchange_parts
+        if self.coalesce_tiny_rows is not None:
+            d["coalesce_tiny_rows"] = self.coalesce_tiny_rows
+        if self.fusion_min_members is not None:
+            d["fusion_min_members"] = self.fusion_min_members
+        return d
+
+
+_TLS = threading.local()
+
+#: per-process memo of (history file signature, digest) -> hints; the
+#: history file only ever appends, so a changed (size, mtime_ns) is a
+#: sufficient invalidation signal
+_HINT_CACHE: dict = {}
+_HINT_CACHE_CAP = 256
+
+
+def install_hints(hints: Optional[MeasuredHints]) -> None:
+    """Bind hints to THIS thread for the duration of one convert_plan
+    (prepare_execution wraps the call in install/clear try/finally)."""
+    _TLS.hints = hints
+
+
+def clear_hints() -> None:
+    _TLS.hints = None
+
+
+def current_hints() -> Optional[MeasuredHints]:
+    return getattr(_TLS, "hints", None)
+
+
+def _history_store():
+    from spark_rapids_tpu.runtime import obs as OBS
+    st = OBS.state()
+    return st.history if st is not None else None
+
+
+def _file_sig(path: str):
+    import os
+    try:
+        s = os.stat(path)
+        return (s.st_size, s.st_mtime_ns)
+    except OSError:
+        return None
+
+
+def measured_hints(plan, conf) -> Optional[MeasuredHints]:
+    """Derive conversion hints for this plan from its own audited
+    history: the latest successful record for the SAME digest that
+    carries a roofline doc decides. The rules are deliberately few and
+    verdict-driven:
+
+    - shuffle group dispatch_overhead-bound -> the exchange is pure
+      per-partition launch tax: collapse group-key aggregate exchanges
+      to a single partition (exchange_parts=1) and coalesce harder
+      (4x coalesceTinyRows), unless the ICI interconnect carries the
+      exchange (collapsing would serialize real cross-chip bandwidth).
+    - device_compute group dispatch_overhead-bound -> downstream
+      dispatches dominate: coalesce harder, and pin stage fusion at its
+      most aggressive legal boundary (fusion_min_members=2).
+
+    Returns None (static plan) when adaptive/measured-cost is off, no
+    history store is configured, the digest has no audited record, or
+    the verdicts prescribe nothing."""
+    if not conf.get(C.ADAPTIVE_ENABLED) \
+            or not conf.get(C.ADAPTIVE_MEASURED_COST):
+        return None
+    store = _history_store()
+    if store is None:
+        return None
+    from spark_rapids_tpu.runtime.obs.history import plan_digest
+    try:
+        digest = plan_digest(plan)
+    except Exception:  # noqa: BLE001 - an undigestable plan has no
+        return None  # history to measure against
+    sig = _file_sig(store.path)
+    if sig is None:
+        return None
+    cached = _HINT_CACHE.get(digest)
+    if cached is not None and cached[0] == sig:
+        return cached[1]
+    roof = None
+    try:
+        for rec in reversed(store.by_digest(digest)):
+            if rec.get("status") == "ok" and rec.get("roofline"):
+                roof = rec["roofline"]
+                break
+    except Exception:  # noqa: BLE001 - a torn/corrupt history file must
+        return None  # never fail planning
+    hints = _derive(digest, roof, conf) if roof is not None else None
+    if hints is not None and not hints.any():
+        hints = None
+    if len(_HINT_CACHE) >= _HINT_CACHE_CAP:
+        _HINT_CACHE.clear()
+    _HINT_CACHE[digest] = (sig, hints)
+    return hints
+
+
+def _derive(digest: str, roof: dict, conf) -> Optional[MeasuredHints]:
+    groups = roof.get("groups") or {}
+    shuffle_bound = (groups.get("shuffle") or {}).get("bound")
+    compute_bound = (groups.get("device_compute") or {}).get("bound")
+    exchange_parts = None
+    coalesce = None
+    fusion_min = None
+    if shuffle_bound == "dispatch_overhead" \
+            and conf.get(C.SHUFFLE_MODE).upper() != "ICI":
+        exchange_parts = 1
+        coalesce = 4 * int(conf.get(C.SHUFFLE_COALESCE_TINY_ROWS))
+    if compute_bound == "dispatch_overhead":
+        if coalesce is None:
+            coalesce = 4 * int(conf.get(C.SHUFFLE_COALESCE_TINY_ROWS))
+        fusion_min = 2
+    basis = (f"shuffle={shuffle_bound or 'n/a'},"
+             f"device_compute={compute_bound or 'n/a'}")
+    return MeasuredHints(digest, basis, exchange_parts=exchange_parts,
+                         coalesce_tiny_rows=coalesce,
+                         fusion_min_members=fusion_min)
+
+
+def reset_for_tests() -> None:
+    _HINT_CACHE.clear()
+    clear_hints()

@@ -762,6 +762,16 @@ def tag_agg(fn: A.AggFunction, conf, reasons: List[str], where: str) -> None:
             reasons.append(f"{where}: {rule.name} input {r}")
 
 
+def _measured_collapse() -> bool:
+    """True when the measured cost pass (plan/cost.py measured_hints)
+    prescribed collapsing group-key aggregate exchanges to one partition
+    for the plan currently converting on this thread — the history said
+    the shuffle group was dispatch_overhead-bound."""
+    from spark_rapids_tpu.plan import cost as COST
+    h = COST.current_hints()
+    return h is not None and h.exchange_parts == 1
+
+
 # ---------------------------------------------------------------------------
 # Plan metas
 # ---------------------------------------------------------------------------
@@ -1050,7 +1060,7 @@ class SparkPlanMeta:
             # aggregate each partition completely (reference: these aggs
             # carry whole-collection buffers between stages; shuffling rows
             # first is the TPU-shaped equivalent)
-            if p.group_exprs:
+            if p.group_exprs and not _measured_collapse():
                 exch = X.ShuffleExchangeExec(p, [child], conf, p.group_exprs,
                                              n_out=child.num_partitions)
             else:
@@ -1074,7 +1084,7 @@ class SparkPlanMeta:
                                            pre_filter=pre_filter)
         partial = X.HashAggregateExec(p, [child], conf, mode="partial",
                                       pre_filter=pre_filter)
-        if nkeys and not single_device:
+        if nkeys and not single_device and not _measured_collapse():
             keys = [E.BoundRef(i, e.data_type(), n) for i, (e, n) in
                     enumerate(zip(p.group_exprs, p.group_names))]
             exch = X.ShuffleExchangeExec(p, [partial], conf, keys,
@@ -1124,6 +1134,17 @@ class SparkPlanMeta:
                 lkeys.append(lk if lk.data_type() == ct else E.Cast(lk, ct))
                 rkeys.append(rk if rk.data_type() == ct else E.Cast(rk, ct))
             n_out = left.num_partitions
+            if conf.get(C.ADAPTIVE_ENABLED) \
+                    and conf.get(C.ADAPTIVE_BROADCAST_BYTES) > 0:
+                # planned-as-shuffled, measured at runtime: the build
+                # side's exchange materializes first and a small MEASURED
+                # result demotes to broadcast before the probe exchange
+                # ever dispatches (exec/adaptive.py)
+                from spark_rapids_tpu.exec.adaptive import (
+                    AdaptiveShuffledHashJoinExec,
+                )
+                return AdaptiveShuffledHashJoinExec(
+                    p, [left, right], conf, part_keys=(lkeys, rkeys))
             left = X.ShuffleExchangeExec(p, [left], conf, lkeys, n_out)
             right = X.ShuffleExchangeExec(p, [right], conf, rkeys, n_out)
             return X.ShuffledHashJoinExec(p, [left, right], conf,

@@ -474,6 +474,7 @@ def on_query_end(token, *, session, plan, status: str,
                  degraded_reason: Optional[str] = None,
                  attribution_doc: Optional[dict] = None,
                  roofline_doc: Optional[dict] = None,
+                 aqe_doc: Optional[dict] = None,
                  flight_dump: Optional[str] = None
                  ) -> Optional[dict]:
     """Publish one finished top-level action: registry rollups, the SLO
@@ -596,7 +597,7 @@ def on_query_end(token, *, session, plan, status: str,
                 plan=plan, session=session, trace_paths=trace_paths,
                 snaps=snaps, degraded_reason=degraded_reason,
                 attribution=attribution_doc, roofline=roofline_doc,
-                slo_breach=breach,
+                aqe=aqe_doc, slo_breach=breach,
                 flight_dump=flight_dump, digest=digest)
             st.history.append(rec)
         st.last_query = {

@@ -121,6 +121,7 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
                        degraded_reason: Optional[str] = None,
                        attribution: Optional[dict] = None,
                        roofline: Optional[dict] = None,
+                       aqe: Optional[dict] = None,
                        slo_breach: Optional[dict] = None,
                        flight_dump: Optional[str] = None,
                        digest: Optional[str] = None) -> dict:
@@ -152,6 +153,11 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
         # peaks, boundedness, and padding waste per kernel group —
         # tools/roofline_report.py aggregates these across the store
         rec["roofline"] = roofline
+    if aqe is not None:
+        # the adaptive execution decision doc (exec/adaptive.py):
+        # decisions taken, per-kind counts and dispatches saved —
+        # tools/roofline_report.py surfaces them next to the verdicts
+        rec["aqe"] = aqe
     if slo_breach is not None:
         rec["slo_breach"] = slo_breach
     if flight_dump is not None:

@@ -45,6 +45,11 @@ class ShuffleStore:
         self._lock = _san.lock("shuffle.store")
         #: partition -> ordered blob list; bytes = resident, _DiskSeg = spilled
         self._parts: List[List[object]] = [[] for _ in range(n_partitions)]
+        #: per-partition row tally (writer-supplied host ints): the skew
+        #: detector (exec/adaptive.py) sizes serialized partitions from
+        #: this instead of decoding blobs — same free-decision contract
+        #: as the compact path's offsets vector
+        self._rows: List[int] = [0] * n_partitions
         self._resident = 0
         self.bytes_written = 0
         self.bytes_spilled = 0
@@ -65,13 +70,20 @@ class ShuffleStore:
                 self, shutil.rmtree, self._dir, True)
         return os.path.join(self._dir, f"part_{p}.bin")
 
-    def add(self, partition: int, blob: bytes) -> None:
+    def add(self, partition: int, blob: bytes, rows: int = 0) -> None:
         with self._lock:
             assert not self._closed
             self._parts[partition].append(blob)
+            self._rows[partition] += int(rows)
             self._resident += len(blob)
             self.bytes_written += len(blob)
         self._enforce_budget()
+
+    def partition_rows(self, partition: int) -> int:
+        """Writer-tallied row count for one partition (0 when the writer
+        predates the tally or the partition is empty)."""
+        with self._lock:
+            return self._rows[partition]
 
     def _enforce_budget(self) -> None:
         # flush the partitions holding the most resident bytes first

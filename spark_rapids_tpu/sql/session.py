@@ -120,6 +120,11 @@ class TpuSession:
     def create_or_replace_temp_view(self, name: str, df) -> None:
         """Register a DataFrame for session.sql() FROM resolution."""
         self._views[name.lower()] = df
+        # re-registering a view is the one way table data changes under a
+        # stable plan digest: advance the table epoch so the adaptive
+        # build-reuse cache (exec/adaptive.py) drops every cached build
+        from spark_rapids_tpu.exec import adaptive as AQ
+        AQ.bump_table_version()
         # a new table may unblock pending AOT warmup replays (one
         # module-global read when warmup is unarmed)
         from spark_rapids_tpu.runtime import warmup
@@ -238,7 +243,20 @@ class TpuSession:
         backoff_from_conf(self.conf)
         watchdog.maybe_install(self.conf)
         get_spill_framework(self.conf)  # sync budgets to this session
-        exec_root, meta = convert_plan(plan, self.conf)
+        # measured cost pass: audited history for this plan's digest may
+        # override partition counts / coalescing / fusion boundaries
+        # during conversion (thread-local — concurrent sessions convert
+        # under their own hints)
+        from spark_rapids_tpu.exec import adaptive as AQ
+        from spark_rapids_tpu.plan import cost as COST
+        hints = COST.measured_hints(plan, self.conf)
+        COST.install_hints(hints)
+        try:
+            exec_root, meta = convert_plan(plan, self.conf)
+        finally:
+            COST.clear_hints()
+        if hints is not None and AQ.enabled(self.conf):
+            AQ.record(AQ.MEASURED_COST, **hints.detail())
         self._last_meta = meta
         self._last_exec = exec_root
         # attach the converted tree to THIS query's live context (the
@@ -346,6 +364,11 @@ class TpuSession:
             # mid-session enable covers THIS query)
             from spark_rapids_tpu.analysis import kernel_audit as KA
             KA.on_query_start(self.conf)
+            # and the adaptive decision recorder: every AQE decision this
+            # query makes (conversion, skew split, build reuse, measured
+            # cost) lands in one per-query doc
+            from spark_rapids_tpu.exec import adaptive as AQ
+            AQ.on_query_start(self.conf)
         cpu_gate_failed = False
         try:
             if depth == 0:
@@ -596,6 +619,16 @@ class TpuSession:
                 # fail (or mask the real error of) a query
                 log.warning("failed to compute kernel cost audit",
                             exc_info=True)
+            # close the adaptive decision recorder: the per-query doc
+            # feeds last_aqe(), EXPLAIN ANALYZE and the history record
+            from spark_rapids_tpu.exec import adaptive as AQ
+            self._last_aqe = None
+            try:
+                self._last_aqe = AQ.finish_query()
+            except Exception:  # noqa: BLE001 - decision bookkeeping
+                # must never fail (or mask the real error of) a query
+                log.warning("failed to close adaptive decisions",
+                            exc_info=True)
         flight_dump = None
         if top_level and status in ("failed", "degraded", "cancelled"):
             # emit the outcome marker (tracer AND/OR flight ring), then
@@ -667,6 +700,7 @@ class TpuSession:
                     attribution_doc=getattr(self, "_last_attribution",
                                             None),
                     roofline_doc=getattr(self, "_last_roofline", None),
+                    aqe_doc=getattr(self, "_last_aqe", None),
                     flight_dump=flight_dump)
             except Exception:  # noqa: BLE001
                 log.warning("failed to publish query to obs",
@@ -813,6 +847,13 @@ class TpuSession:
             # advisory: a poisoned lazy count must not fail an explain
             return None
 
+    def last_aqe(self) -> Optional[dict]:
+        """Adaptive execution decisions of the most recent top-level
+        action (exec/adaptive.py): the decision list plus per-kind
+        counts and total dispatches saved. None when adaptive execution
+        was off for the action or it made no decisions."""
+        return getattr(self, "_last_aqe", None)
+
     def explain_analyze(self) -> str:
         """The physical exec tree of the MOST RECENT action annotated
         with its actual runtime metrics — rows, batches, dispatches, and
@@ -852,4 +893,9 @@ class TpuSession:
             from spark_rapids_tpu.analysis import kernel_audit as KA
             lines.append("")
             lines.extend(KA.render_text(roof))
+        aqe = self.last_aqe()
+        if aqe is not None:
+            from spark_rapids_tpu.exec import adaptive as AQ
+            lines.append("")
+            lines.extend(AQ.render_text(aqe))
         return "\n".join(lines)

@@ -79,3 +79,10 @@ def _reset_runtime():
     # a cancelled or queued query must not leak into the next test
     from spark_rapids_tpu.runtime import lifecycle
     lifecycle.reset_for_tests()
+    # adaptive execution: the decision recorder, build-reuse cache and
+    # table epoch are process-global, as is the measured-hints memo —
+    # one test's cached broadcast build or hint must not leak forward
+    from spark_rapids_tpu.exec import adaptive
+    adaptive.reset_for_tests()
+    from spark_rapids_tpu.plan import cost
+    cost.reset_for_tests()

@@ -68,10 +68,15 @@ def _leak_sweep():
         return None
 
     leak = _clean()
-    deadline = time.monotonic() + 15.0
+    deadline = time.monotonic() + 45.0
     while leak is not None and time.monotonic() < deadline:
         time.sleep(0.1)
         leak = _clean()
+    if leak is not None:
+        # name the holder before failing: the stack of whichever thread
+        # still pins the permit is the whole diagnosis
+        import faulthandler
+        faulthandler.dump_traceback()
     assert leak is None, f"stable leak after reap-and-retry: {leak}"
 
 

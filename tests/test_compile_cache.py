@@ -377,6 +377,10 @@ def test_coalesce_merges_tiny_sub_batches():
     assert got == want
 
 
+# Heaviest single test in the suite (~60-130s: the disabled path recompiles
+# every tiny sub-batch shape); the coalesce-on representatives above keep the
+# feature covered in tier-1, the off-switch runs under the full @slow/CI pass.
+@pytest.mark.slow
 def test_coalesce_disabled_by_conf():
     sess = TpuSession({"spark.rapids.sql.reader.batchSizeRows": "512",
                        "spark.rapids.shuffle.coalesceTinyRows": "0"})

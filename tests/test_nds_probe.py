@@ -23,7 +23,18 @@ def dfs():
     return sess, out
 
 
-@pytest.mark.parametrize("qn", sorted(nds.QUERIES))
+# The 98-query sweep is the suite's single heaviest parametrization (~7-8min
+# on the CPU sim). Tier-1 keeps a representative spread — the bench/probe
+# anchors q1/q3/q6/q67/q72 plus every 7th query — and the rest run under the
+# full @slow/CI pass; audit_smoke's golden cost-signature replay in ci_check
+# still executes all 98 against byte-identical goldens.
+_ALL_QN = sorted(nds.QUERIES)
+_TIER1_QN = set(_ALL_QN[::7]) | ({1, 3, 6, 67, 72} & set(_ALL_QN))
+
+
+@pytest.mark.parametrize(
+    "qn", [q if q in _TIER1_QN else pytest.param(q, marks=pytest.mark.slow)
+           for q in _ALL_QN])
 def test_nds_query(dfs, qn):
     sess, d = dfs
     df = nds.QUERIES[qn](sess, d)

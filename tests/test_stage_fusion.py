@@ -206,8 +206,14 @@ def _run_query(build, conf):
     return build(s).collect().to_pylist()
 
 
-@pytest.mark.parametrize("masked", [False, True])
-@pytest.mark.parametrize("ansi", ["false", "true"])
+# Tier-1 keeps the richest corner (masked input + ANSI both on); the other
+# three combos of each parity grid run under the full @slow/CI pass.
+_PARITY_MASKED = [pytest.param(False, marks=pytest.mark.slow), True]
+_PARITY_ANSI = [pytest.param("false", marks=pytest.mark.slow), "true"]
+
+
+@pytest.mark.parametrize("masked", _PARITY_MASKED)
+@pytest.mark.parametrize("ansi", _PARITY_ANSI)
 def test_chain_parity_fused_vs_unfused(ansi, masked):
     res = {}
     for flag in ("true", "false"):
@@ -218,8 +224,8 @@ def test_chain_parity_fused_vs_unfused(ansi, masked):
     assert _eq(res["true"], res["false"])
 
 
-@pytest.mark.parametrize("masked", [False, True])
-@pytest.mark.parametrize("ansi", ["false", "true"])
+@pytest.mark.parametrize("masked", _PARITY_MASKED)
+@pytest.mark.parametrize("ansi", _PARITY_ANSI)
 def test_agg_chain_parity_fused_vs_unfused(ansi, masked):
     def build(s):
         df = gen_df(s, _SPEC, length=2200, seed=23, num_partitions=3)

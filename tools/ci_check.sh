@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
 # chaos smoke, obs smoke, flight smoke, pipeline smoke, compile smoke,
-# audit smoke, tier-1 tests.
+# audit smoke, aqe smoke, tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
@@ -75,6 +75,11 @@ if [[ "${1:-}" == "--fast" ]]; then
     audit_args="--quick"
 fi
 if ! python tools/audit_smoke.py $audit_args; then
+    fail=1
+fi
+
+step "aqe smoke (q3join/q72shfl probes cold then history-warm: broadcast conversion + warm measured-cost collapse fire, results byte-identical to AQE-off, disabled hook sites <2% by count x delta)"
+if ! python tools/aqe_smoke.py; then
     fail=1
 fi
 

@@ -66,6 +66,13 @@ os.environ["XLA_FLAGS"] = _flags
 SF = 0.002
 SEED = 7
 
+#: pinned EXPLICITLY (not left to the conf default): adaptive execution
+#: changes plan shape (AdaptiveShuffledHashJoinExec in the census, the
+#: measured cost pass replanning exchanges from history), so a golden
+#: generated under a drifted default would silently pin different plans
+#: than CI converts. Recorded in both artifact headers.
+ADAPTIVE = "true"
+
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                    "tests", "golden_plans", "dispatch_budgets.json")
 OUT_SIG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
@@ -89,7 +96,7 @@ def build_budgets():
     from spark_rapids_tpu.sql.session import TpuSession
 
     nds = _load_nds()
-    sess = TpuSession()
+    sess = TpuSession({"spark.rapids.sql.adaptive.enabled": ADAPTIVE})
     tables = nds.gen_tables(SF, seed=SEED)
     d = {name: sess.create_dataframe(t).cache()
          for name, t in tables.items()}
@@ -117,7 +124,8 @@ def build_cost_signatures(limit=None, queries=None):
     # a fresh session AND fresh tables: the budgets pass (or any prior
     # work in this process) must not decide which query first-traces a
     # shared entry
-    sess = TpuSession({"spark.rapids.obs.audit.enabled": "true"})
+    sess = TpuSession({"spark.rapids.obs.audit.enabled": "true",
+                       "spark.rapids.sql.adaptive.enabled": ADAPTIVE})
     tables = nds.gen_tables(SF, seed=SEED)
     d = {name: sess.create_dataframe(t).cache()
          for name, t in tables.items()}
@@ -145,7 +153,7 @@ def build_cost_signatures(limit=None, queries=None):
 def signature_doc(sigs) -> dict:
     from spark_rapids_tpu.analysis.kernel_audit import KERNEL_PRIMITIVES
     return {"_generator": "tools/gen_dispatch_budgets.py",
-            "_sf": SF, "_seed": SEED,
+            "_sf": SF, "_seed": SEED, "_adaptive": ADAPTIVE,
             "kernel_primitives": sorted(KERNEL_PRIMITIVES),
             "cost_signatures": sigs}
 
@@ -177,7 +185,8 @@ def main(argv=None) -> int:
     if not sig_only:
         budgets = build_budgets()
         doc = {"_generator": "tools/gen_dispatch_budgets.py",
-               "_sf": SF, "_seed": SEED, "budgets": budgets}
+               "_sf": SF, "_seed": SEED, "_adaptive": ADAPTIVE,
+               "budgets": budgets}
         with open(OUT, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True)
             f.write("\n")

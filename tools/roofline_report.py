@@ -11,7 +11,11 @@ the shape-bucket ladder exposes as padding. The answer to "we are at
     python tools/roofline_report.py --history <dir> [--json] [--top N]
 
 Reads `query_history.jsonl` (runtime/obs/history.py); only records
-carrying a `roofline` doc (audited queries) contribute.
+carrying a `roofline` doc (audited queries) contribute. Records that
+also carry an `aqe` doc (exec/adaptive.py decisions) get an "adaptive"
+column — decision kinds × counts and the dispatches those decisions
+saved — so a verdict flip (dispatch_overhead -> memory) can be read
+next to the replan that caused it.
 """
 from __future__ import annotations
 
@@ -57,6 +61,12 @@ def summarize(records):
                          if g.get("bound")})
         waste = max([g.get("padding_waste_ratio") or 0.0
                      for g in groups.values()] or [0.0])
+        aqe = rec.get("aqe") or {}
+        counts = aqe.get("counts") or {}
+        adaptive = ",".join(f"{k}x{n}" for k, n in sorted(counts.items()))
+        saved = aqe.get("dispatches_saved", 0)
+        if adaptive and saved:
+            adaptive += f"(-{saved}d)"
         rows.append({
             "query_id": rec.get("query_id"),
             "digest": rec.get("plan_digest"),
@@ -68,6 +78,8 @@ def summarize(records):
             "roofline_pct": tot.get("roofline_pct_bw", 0.0),
             "bound": "+".join(bounds) or "?",
             "padding_waste_max": round(waste, 3),
+            "adaptive": adaptive or "-",
+            "dispatches_saved": saved,
             "top_kernel": top_kernel,
         })
     rows.sort(key=lambda r: r["roofline_pct"])
@@ -79,14 +91,14 @@ def render(rows, top: int) -> str:
              f"(lowest roofline share first)",
              f"{'query':>6} {'wall s':>8} {'dev s':>8} {'GB':>8} "
              f"{'GB/s':>8} {'%roof':>7} {'waste<=':>8} "
-             f"{'bound':<18} top kernel"]
+             f"{'bound':<18} {'adaptive':<28} top kernel"]
     for r in rows[:top]:
         lines.append(
             f"{str(r['query_id']):>6} {r['wall_s']:>8.3f} "
             f"{r['device_s']:>8.3f} {r['gb_moved']:>8.3f} "
             f"{r['achieved_gbps']:>8.2f} {r['roofline_pct']:>7.3f} "
             f"{r['padding_waste_max'] * 100:>7.0f}% "
-            f"{r['bound']:<18} {r['top_kernel']}")
+            f"{r['bound']:<18} {r['adaptive']:<28} {r['top_kernel']}")
     if rows:
         import math
         pcts = [r["roofline_pct"] for r in rows if r["roofline_pct"] > 0]
