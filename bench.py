@@ -484,6 +484,107 @@ def audit_pass(sess, tpu, detail, t_start) -> None:
             pass
 
 
+#: rows for the device-decode scan pass (bounded separately: it writes a
+#: real parquet file, so the working set is disk + upload, not HBM)
+DECODE_ROWS = min(ROWS, int(os.environ.get("BENCH_DECODE_ROWS", 2_000_000)))
+
+
+def decode_pass(t, detail, t_start) -> None:
+    """Device-decode scan bench (round 16): write a lineitem slice as a
+    REAL parquet file (snappy + dictionary, data-page v1) and run the
+    q6-shaped scan over it three ways — decode_path device (all columns
+    device-decodable), mixed (a string column rides along and host-falls
+    back per column), host (device decode disabled) — recording wall
+    time plus the encoded-vs-decoded scanned-bytes split the device path
+    exists to win: what crosses PCIe/the tunnel is encodedBytes, what
+    the fused kernel materializes in HBM is decodedBytes."""
+    import shutil
+    import tempfile
+    import pyarrow.parquet as pq
+    from spark_rapids_tpu.sql.session import TpuSession
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.expr.core import col, lit
+
+    tdir = tempfile.mkdtemp(prefix="bench_decode_")
+    try:
+        ts = t.slice(0, DECODE_ROWS)
+        path = os.path.join(tdir, "lineitem.parquet")
+        # dictionary only where cardinality warrants it: pyarrow switches
+        # a chunk's remaining pages to PLAIN when the dict overflows, and
+        # mixed-encoding chunks host-fall-back per column (supported
+        # matrix) — high-entropy columns are written PLAIN outright
+        pq.write_table(ts, path, row_group_size=1 << 20,
+                       use_dictionary=["l_shipdate", "l_quantity",
+                                       "l_returnflag", "l_linestatus"],
+                       compression="snappy", data_page_version="1.0")
+        num_cols = ["l_shipdate", "l_discount", "l_quantity",
+                    "l_extendedprice"]
+
+        def q6(sess, cols):
+            df = sess.read_parquet(path, columns=cols)
+            cond = ((col("l_shipdate") >= lit(LO))
+                    & (col("l_shipdate") < lit(HI))
+                    & (col("l_discount") >= lit(0.05))
+                    & (col("l_discount") <= lit(0.07))
+                    & (col("l_quantity") < lit(24.0)))
+            out = (df.filter(cond)
+                   .agg(F.sum(col("l_extendedprice") * col("l_discount"))))
+            return list(out.to_pydict().values())[0][0]
+
+        paths = {
+            # all referenced columns device-decode
+            "device": ({"spark.rapids.sql.decode.device.enabled": "true"},
+                       num_cols),
+            # string column rides along: per-column host fallback mixes
+            # into the same encoded batch
+            "mixed": ({"spark.rapids.sql.decode.device.enabled": "true"},
+                      num_cols + ["l_returnflag"]),
+            # the pre-round-16 host decode path, same columns as device
+            "host": ({"spark.rapids.sql.decode.device.enabled": "false"},
+                     num_cols),
+        }
+        out = {"rows": DECODE_ROWS,
+               "file_gb": round(os.path.getsize(path) / 1e9, 4)}
+        vals = {}
+        for name, (conf, cols) in paths.items():
+            if time.perf_counter() - t_start > TIME_BUDGET_S:
+                out[name] = {"skipped": "time budget exhausted"}
+                continue
+            print(f"[bench] decode_path={name}...", file=sys.stderr,
+                  flush=True)
+            sess = TpuSession(dict(conf))
+            cold, best, vals[name] = timeit(lambda: q6(sess, cols))
+            rec = {"tpu_s": round(best, 4), "tpu_cold_s": round(cold, 4)}
+            try:
+                snaps = sess.last_metrics()
+                enc = sum(v.get("encodedBytes", 0) for v in snaps.values())
+                dec = sum(v.get("decodedBytes", 0) for v in snaps.values())
+                rb = sum(v.get("readBytes", 0) for v in snaps.values())
+                fb = sum(v.get("numDecodeFallbackColumns", 0)
+                         for v in snaps.values())
+                rec["encoded_gb"] = round(enc / 1e9, 4)
+                rec["decoded_gb"] = round(dec / 1e9, 4)
+                rec["read_gb"] = round(rb / 1e9, 4)
+                if fb:
+                    rec["fallback_columns"] = int(fb)
+                if enc and best:
+                    rec["eff_gbps_encoded"] = round(enc / best / 1e9, 3)
+                if dec and best:
+                    rec["eff_gbps_decoded"] = round(dec / best / 1e9, 3)
+            except Exception:  # noqa: BLE001 - byte columns are advisory
+                pass
+            out[name] = rec
+        got = [v for v in vals.values() if v is not None]
+        if len(got) > 1:
+            out["match"] = all(_close(a, got[0]) for a in got[1:])
+        detail["decode"] = out
+    except Exception as e:  # noqa: BLE001 - the decode pass must not
+        # take down the 5-query record
+        detail["decode"] = {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+
 def cpu_only_detail(t, orders, t_start) -> dict:
     """Per-query CPU-baseline detail for rounds where the engine backend
     is unusable: the trajectory then carries real per-query numbers and
@@ -595,6 +696,7 @@ def main():
                 "dispatches_saved", 0)
 
     audit_pass(sess, tpu, detail, t_start)
+    decode_pass(t, detail, t_start)
 
     if not speedups:
         emit_error("time budget exhausted before any query ran",

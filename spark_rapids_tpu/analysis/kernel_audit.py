@@ -99,6 +99,9 @@ KERNEL_PRIMITIVES: Dict[str, str] = {
     "ops/join.py": "dense-table hash-join build/probe kernels",
     "ops/repartition.py": "single-dispatch counting-sort shuffle "
                           "partitioning kernel",
+    "ops/pallas_decode.py": "pallas parquet-decode bit-slice kernel "
+                            "(dictionary/RLE unpack) — sanctioned "
+                            "pallas module",
     "ops/pallas_kernels.py": "hand-tiled pallas kernels (murmur3, "
                              "sort tiles) — sanctioned pallas module",
     "ops/pallas_segsum.py": "pallas segmented-sum kernel — sanctioned "

@@ -66,8 +66,8 @@ _SCHEMA_PRESERVING = {
 
 #: the only nodes insert_pipelines may wrap (its scan_types tuple)
 _PIPELINE_WRAPPABLE = {
-    "ParquetScanExec", "TextScanExec", "InMemoryScanExec",
-    "ShuffleFileScanExec",
+    "ParquetScanExec", "EncodedParquetSourceExec", "TextScanExec",
+    "InMemoryScanExec", "ShuffleFileScanExec",
 }
 
 

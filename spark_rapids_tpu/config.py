@@ -626,6 +626,29 @@ MULTIFILE_READER_THREADS = conf_int(
     "Host threads for multi-file read scheduling "
     "(reference GpuMultiFileReader).")
 
+DEVICE_DECODE_ENABLED = conf_bool(
+    "spark.rapids.sql.decode.device.enabled", True,
+    "Decode Parquet column chunks ON DEVICE: the scan uploads raw "
+    "dictionary/RLE/bit-packed/delta chunk bytes and Pallas/XLA kernels "
+    "expand them inside the fused stage body (the cuDF GPU-reader "
+    "analog; io/encoded.py + ops/pallas_decode.py). Columns with "
+    "unsupported types/encodings/codecs fall back per column to the "
+    "host pyarrow path, with the reason surfaced in explain/history. "
+    "Off = the classic host-decode scan.")
+
+DEVICE_DECODE_DELTA = conf_bool(
+    "spark.rapids.sql.decode.device.delta.enabled", True,
+    "Allow DELTA_BINARY_PACKED columns on the device-decode path "
+    "(decoded as a cumulative sum with per-page restarts). Off falls "
+    "such columns back to host decode.")
+
+DEVICE_DECODE_MAX_BITS = conf_int(
+    "spark.rapids.sql.decode.device.maxBits", 32,
+    "Widest dictionary/delta packed bit width decoded on device (the "
+    "bit-slice kernel extracts from 32-bit word pairs). Columns packed "
+    "wider fall back per column to host decode; values above 32 are "
+    "capped at 32.")
+
 ASYNC_WRITE_MAX_INFLIGHT = conf_int(
     "spark.rapids.sql.asyncWrite.maxInFlightHostMemoryBytes", 2 << 30,
     "Throttle for async output writes "

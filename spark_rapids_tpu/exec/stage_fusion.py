@@ -277,7 +277,8 @@ def _fusable(node) -> bool:
     free (pure column re-listing inside the trace) but never justify one
     — see _dispatching."""
     X = _exec_base()
-    if isinstance(node, (X.ProjectExec, X.FilterExec, X.LimitExec)):
+    if isinstance(node, (X.ProjectExec, X.FilterExec, X.LimitExec,
+                         X.DeviceDecodeScanExec)):
         return len(node.children) == 1
     if isinstance(node, X.ExpandExec):
         if len(node.children) != 1:
@@ -300,7 +301,8 @@ def _dispatching(node) -> bool:
     X = _exec_base()
     if isinstance(node, X.ProjectExec):
         return node._trivial_indices() is None
-    return isinstance(node, (X.FilterExec, X.ExpandExec))
+    return isinstance(node, (X.FilterExec, X.ExpandExec,
+                             X.DeviceDecodeScanExec))
 
 
 def _collect_chain(node):
@@ -379,7 +381,12 @@ def _rewrite(node, conf, counter):
     if _agg_absorbable(node):
         chain, input_exec = _collect_chain(node.children[0])
         bodies = [m.stage_body() for m in reversed(chain)]
-        if chain and all(not b.has_carry for b in bodies) \
+        # forceSinglePass concatenates the RAW child batches host-side
+        # before one update — impossible over still-encoded batches, so
+        # a chain rooted at a device-decode scan must not absorb there
+        concat_ok = not (conf.get(C.AGG_FORCE_SINGLE_PASS) and any(
+            isinstance(m, X.DeviceDecodeScanExec) for m in chain))
+        if chain and concat_ok and all(not b.has_carry for b in bodies) \
                 and any(_dispatching(m) for m in chain):
             counter[0] += 1
             node.pre_chain = bodies

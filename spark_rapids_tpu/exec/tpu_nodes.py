@@ -257,6 +257,237 @@ def _prefetched(items, load_fn, n_threads: int, conf=None):
                                                max_concurrency=n_threads)
 
 
+def device_decode_stage_body() -> fuse.StageBody:
+    """Decode-on-device as a fusable stage body: the fused trace's INPUT
+    is the EncodedBatch pytree (raw chunk planes) and its first stage is
+    the pallas_decode expansion, so downstream bodies (Filter, partial
+    agg) compose after it and Scan→Filter→partial-agg stays ONE dispatch
+    per batch over encoded bytes. The builder captures no exec state;
+    already-decoded batches (replay/fallback paths) pass through — a
+    trace-time structure distinction, not a runtime branch."""
+    def build():
+        from spark_rapids_tpu.ops import pallas_decode as PD
+
+        def fn(batch, pid, carry):
+            if isinstance(batch, ColumnarBatch):
+                return batch, {}, carry
+            return PD.decode_batch(batch), {}, carry
+        return fn
+
+    return fuse.StageBody(("device_decode",), build,
+                          bounds_map=lambda bs: list(bs),
+                          name="DeviceDecode")
+
+
+class EncodedParquetSourceExec(TpuExec):
+    """Leaf half of the device-decode scan pair: footer read + partition
+    -file and row-group pruning exactly as ParquetScanExec, but instead
+    of host-decoding through pyarrow it extracts the still-ENCODED
+    column chunk bytes (io/encoded.py) and uploads them as EncodedBatch
+    planes — what crosses the host->device link is the compressed
+    encoding, not decoded plates. Columns outside the supported matrix
+    host-decode HERE (the per-column fallback) and ride inside the
+    EncodedBatch as ready ColumnVectors; reasons accumulate in
+    `fallback_columns` for explain/history. DeviceDecodeScanExec is the
+    paired unary exec expanding the planes inside the fused stage body
+    (reference: the host half of libcudf's GPU Parquet reader —
+    gpu::DecodePageHeaders feeding gpuDecodePages)."""
+
+    def __init__(self, plan, children, conf):
+        super().__init__(plan, children, conf)
+        from spark_rapids_tpu.io.parquet_pruning import prune_partition_file
+        pv = plan.partition_values
+        paths = list(plan.paths)
+        self._pushed = list(plan.pushed_filters)
+        if pv and self._pushed:
+            kept = [i for i in range(len(paths)) if prune_partition_file(
+                pv[i], plan.schema, self._pushed)]
+        else:
+            kept = list(range(len(paths)))
+        self._kept_files = kept
+        #: column -> fallback reason (plan-time probe + execute-time
+        #: page surprises): the explain/history surface
+        self.fallback_columns: dict = {}
+        if kept:
+            # static footer probe of the first kept file: fallback
+            # reasons are visible in explain BEFORE the query runs
+            # (page-level surprises still merge in at execute time)
+            from spark_rapids_tpu.io import encoded as ENC
+            try:
+                self.fallback_columns.update(ENC.probe_support(
+                    paths[kept[0]], self._file_fields()))
+            except Exception:  # noqa: BLE001 - probe is advisory only
+                pass
+
+    def tree_string(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        note = ""
+        if self.fallback_columns:
+            note = " host-fallback{" + ", ".join(
+                f"{k}: {v}" for k, v in
+                sorted(self.fallback_columns.items())) + "}"
+        lines = [f"{pad}{self.name()}{note} <- {self.plan.describe()}"]
+        for c in self.children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    @property
+    def num_partitions(self):
+        return max(1, len(self._kept_files))
+
+    def _file_fields(self):
+        n_part = len(self.plan.partition_fields())
+        fields = list(self.plan.schema.fields)
+        return fields[: len(fields) - n_part] if n_part else fields
+
+    def _partition_columns(self, fidx, n, cap):
+        """Constant partition-value columns as ready (decoded) planes —
+        the same arrays with_partition_cols + from_arrow would build."""
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar.batch import column_from_arrow
+        from spark_rapids_tpu.io import encoded as ENC
+        out = []
+        if not self.plan.partition_values:
+            return out
+        vals = self.plan.partition_values[fidx]
+        for f in self.plan.partition_fields():
+            v = vals.get(f.name)
+            if v is not None and f.dtype == T.INT64:
+                v = int(v)
+            arr = pa.array([v] * n, type=T.to_arrow(f.dtype))
+            cv = column_from_arrow(arr, f.dtype, cap)
+            out.append(ENC.EncodedColumn("decoded", f.dtype, {}, (),
+                                         cv=cv, bounds=cv.bounds))
+        return out
+
+    def execute_partition(self, ctx, pidx):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from spark_rapids_tpu.columnar.batch import column_from_arrow
+        from spark_rapids_tpu.io import encoded as ENC
+        from spark_rapids_tpu.io.parquet_pruning import prune_row_groups
+        if not self._kept_files:
+            return
+        fidx = self._kept_files[pidx]
+        path = self.plan.paths[fidx]
+        decode_t = self.metrics.metric(M.DECODE_TIME)
+        copy_t = self.metrics.metric(M.COPY_TO_DEVICE_TIME)
+        out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        out_batches = self.metrics.metric(M.NUM_OUTPUT_BATCHES)
+        rg_total = self.metrics.metric(M.NUM_ROW_GROUPS)
+        rg_pruned = self.metrics.metric(M.NUM_ROW_GROUPS_PRUNED)
+        read_bytes = self.metrics.metric(M.READ_BYTES)
+        enc_bytes = self.metrics.metric(M.ENCODED_BYTES)
+        dec_bytes = self.metrics.metric(M.DECODED_BYTES)
+        fb_cols = self.metrics.metric(M.NUM_DECODE_FALLBACK_COLUMNS)
+        fields = self._file_fields()
+
+        pf = pq.ParquetFile(path)
+        metadata = pf.metadata
+        groups, total = prune_row_groups(metadata, self._pushed)
+        rg_total.add(total)
+        rg_pruned.add(total - len(groups))
+        for g in groups:
+            read_bytes.add(metadata.row_group(g).total_byte_size)
+        if not groups:
+            if total:
+                return  # every row group statically refuted: nothing
+                # read, nothing uploaded (pruning composes)
+            # row-group-less / empty file: host read, all-decoded batch
+            FLT.site("scan.decode")
+            with self.span(decode_t):
+                tbl = pf.read(columns=[f.name for f in fields] or None)
+            tbl = self.plan.with_partition_cols(tbl, fidx)
+            self._acquire(ctx)
+            with self.span(copy_t):
+                b = from_arrow(tbl)
+            cols = [ENC.EncodedColumn("decoded", c.dtype, {}, (), cv=c,
+                                      bounds=c.bounds) for c in b.columns]
+            yield ENC.EncodedBatch(cols, rows_int(b.num_rows), b.capacity)
+            out_rows.add(rows_int(b.num_rows))
+            out_batches.add(1)
+            return
+
+        batch_rows = self.conf.get(C.MAX_READER_BATCH_SIZE_ROWS)
+        max_bits = min(32, int(self.conf.get(C.DEVICE_DECODE_MAX_BITS)))
+        delta_ok = bool(self.conf.get(C.DEVICE_DECODE_DELTA))
+        hbs = ENC.read_encoded_batches(path, metadata, groups, fields,
+                                       batch_rows, max_bits, delta_ok)
+        while True:
+            FLT.site("scan.decode")
+            with self.span(decode_t):
+                hb = next(hbs, None)
+            if hb is None:
+                return
+            self.fallback_columns.update(hb.fallback)
+            decoded = {}
+            fb_idx = [i for i, c in enumerate(hb.columns) if c is None]
+            if fb_idx:
+                fb_cols.add(len(fb_idx))
+                names = [fields[i].name for i in fb_idx]
+                with self.span(decode_t):
+                    parts = [pf.read_row_group(g, columns=names)
+                             for g in hb.groups]
+                    tbl = (pa.concat_tables(parts) if len(parts) > 1
+                           else parts[0]).combine_chunks()
+            self._acquire(ctx)
+            with self.span(copy_t):
+                for j, i in enumerate(fb_idx):
+                    col = tbl.column(j)
+                    arr = col.chunk(0) if col.num_chunks \
+                        else col.combine_chunks()
+                    decoded[i] = column_from_arrow(arr, fields[i].dtype,
+                                                   hb.cap)
+                eb = ENC.upload(hb, decoded)
+            eb.columns.extend(
+                self._partition_columns(fidx, hb.num_rows, hb.cap))
+            enc_bytes.add(hb.encoded_bytes)
+            # decoded footprint is static (cap x itemsize): recorded HERE
+            # because on the fused path the decode body runs inside
+            # FusedStageExec's dispatch, not DeviceDecodeScanExec's
+            dec_bytes.add(eb.decoded_size())
+            out_rows.add(hb.num_rows)
+            out_batches.add(1)
+            yield eb
+
+
+class DeviceDecodeScanExec(TpuExec):
+    """Unary half of the device-decode scan pair (the PR's tentpole):
+    expands the child's EncodedBatches into decoded ColumnarBatches ON
+    DEVICE via a fuse.StageBody, so stage_fusion composes Filter /
+    partial-agg bodies behind the decode into one dispatch per batch
+    over encoded bytes (the cuDF gpuDecodePages analog). The kernel
+    cost auditor sees the encoded planes as the dispatch inputs, so the
+    roofline credits encoded-input bytes and decode time lands in
+    opTime -> device_compute: the host_decode bucket collapses
+    structurally for device-decoded scans."""
+
+    def stage_body(self) -> fuse.StageBody:
+        return device_decode_stage_body()
+
+    def execute_partition(self, ctx, pidx):
+        op_t = self.metrics.metric(M.OP_TIME)
+        out_rows = self.metrics.metric(M.NUM_OUTPUT_ROWS)
+        out_batches = self.metrics.metric(M.NUM_OUTPUT_BATCHES)
+        body = self.stage_body()
+        fn = fuse.fused(body.key, body.builder)
+        carry = body.init_carry()
+        pid = jnp.int32(pidx)
+        for batch in self.children[0].execute_partition(ctx, pidx):
+            self._acquire(ctx)
+            n = batch.num_rows  # host int on the encoded source path
+            with self.span(op_t):
+                out, errs, carry = fn(batch, pid, carry)
+            compiled.raise_errors(errs)
+            if isinstance(n, int):
+                # keep the row count host-side: the source knew it
+                # exactly, so no device sync is ever needed for it
+                out = ColumnarBatch(out.columns, n, out.row_mask)
+            out_rows.add(n if isinstance(n, int) else out.num_rows)
+            out_batches.add(1)
+            yield out
+
+
 class TextScanExec(TpuExec):
     """CSV/JSON/ORC scan: prefetched host parse, chunked device upload
     (reference GpuCSVScan / GpuJsonScan / GpuOrcScan MULTITHREADED)."""

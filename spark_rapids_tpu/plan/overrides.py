@@ -954,6 +954,14 @@ class SparkPlanMeta:
         if isinstance(p, P.InMemorySource):
             return X.InMemoryScanExec(p, [], conf)
         if isinstance(p, P.ParquetScan):
+            if conf.get(C.DEVICE_DECODE_ENABLED):
+                # device-side decode (cuDF GPU-reader analog): the source
+                # coalesces row groups itself up to the reader batch size
+                # (no CoalesceBatchesExec — encoded batches are not
+                # concatenable, and don't need to be), and the decode
+                # exec's stage body fuses with downstream Filter/agg.
+                return X.DeviceDecodeScanExec(
+                    p, [X.EncodedParquetSourceExec(p, [], conf)], conf)
             # insertCoalesce analog (GpuTransitionOverrides.scala): file
             # scans emit one batch per row group / file split; coalesce to
             # the target size so downstream fused stages see few big

@@ -60,6 +60,7 @@ from spark_rapids_tpu.runtime.obs import attribution as _attr
 #: whose public entries are invoked beneath computations that DID route
 #: through this cache.
 SANCTIONED_PALLAS_MODULES = (
+    "ops/pallas_decode.py",
     "ops/pallas_kernels.py",
     "ops/pallas_segsum.py",
 )

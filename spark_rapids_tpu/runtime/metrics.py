@@ -23,6 +23,16 @@ NUM_INPUT_BATCHES = "numInputBatches"
 NUM_ROW_GROUPS = "numRowGroups"
 NUM_ROW_GROUPS_PRUNED = "numRowGroupsPruned"
 READ_BYTES = "readBytes"
+#: raw ENCODED Parquet bytes a device-decode scan uploaded — the bytes
+#: that actually crossed the host->device link (compare decodedBytes:
+#: the ratio is the link traffic the device decoder saved)
+ENCODED_BYTES = "encodedBytes"
+#: decoded plane bytes a device-decode scan produced on device — what
+#: the host path would have uploaded instead
+DECODED_BYTES = "decodedBytes"
+#: columns a device-decode scan host-decoded instead (unsupported
+#: type/encoding/codec; per-column reasons in explain/history)
+NUM_DECODE_FALLBACK_COLUMNS = "numDecodeFallbackColumns"
 OP_TIME = "opTime"
 SORT_TIME = "sortTime"
 AGG_TIME = "aggTime"

@@ -434,7 +434,8 @@ def insert_pipelines(exec_root, conf):
     if depth <= 0:
         return exec_root
     from spark_rapids_tpu.exec import tpu_nodes as X
-    scan_types = (X.ParquetScanExec, X.TextScanExec, X.InMemoryScanExec,
+    scan_types = (X.ParquetScanExec, X.EncodedParquetSourceExec,
+                  X.TextScanExec, X.InMemoryScanExec,
                   X.ShuffleFileScanExec)
     cls = pipeline_exec_cls()
 

@@ -105,6 +105,18 @@ def bucket_rows(n: int, minimum: int, itemsize: Optional[int] = None
     return cap
 
 
+def bucket_pool_bytes(nbytes: int, slack: int = 8) -> int:
+    """Capacity for a raw byte pool (encoded Parquet bit pools,
+    io/encoded.py): bucket on the 1-byte ladder with `slack` guard bytes
+    so 32-bit word pairs gathered at the last bit offset stay in bounds,
+    rounded to whole u32 words so the pool reinterprets as a word plane
+    without a tail copy. Pools use minimum=32 — they are auxiliary
+    planes, not row planes, so the session MIN_CAPACITY floor does not
+    apply."""
+    cap = bucket_rows(int(nbytes) + int(slack), 32, 1)
+    return ((cap + 3) // 4) * 4
+
+
 def is_bucketed(capacity: int, minimum: int,
                 itemsize: Optional[int] = None) -> bool:
     """Is `capacity` already a policy bucket (the fixpoint check the

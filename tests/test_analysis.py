@@ -517,6 +517,35 @@ def test_l013_pallas_call_outside_roster_flagged():
     assert _rules(vs2) == []
 
 
+def test_decode_module_in_both_rosters_and_clean():
+    """Round 16 fixture: ops/pallas_decode.py (the parquet-decode pallas
+    kernel home) must be sanctioned in BOTH rosters — TPU-L010's
+    SANCTIONED_PALLAS_MODULES and TPU-L013's KERNEL_PRIMITIVES — and its
+    real source must lint clean under them."""
+    pkg = os.path.join(REPO, "spark_rapids_tpu")
+    pallas_mods = lint.known_pallas_modules(pkg)
+    kernel_mods = lint.known_kernel_primitives(pkg)
+    assert "ops/pallas_decode.py" in pallas_mods
+    assert "ops/pallas_decode.py" in kernel_mods
+    path = os.path.join(pkg, "ops", "pallas_decode.py")
+    with open(path) as f:
+        src = f.read()
+    vs = lint.lint_source(src, path, {"opTime"},
+                          relpath="ops/pallas_decode.py",
+                          pallas_modules=pallas_mods,
+                          kernel_modules=kernel_mods)
+    assert [r for r in _rules(vs) if r in ("TPU-L010", "TPU-L013")] == []
+    # and OUTSIDE the rosters the same source is flagged: the fixture
+    # proves the roster entries are load-bearing, not decorative
+    vs2 = lint.lint_source(src, path, {"opTime"},
+                           relpath="ops/pallas_decode.py",
+                           pallas_modules=pallas_mods
+                           - {"ops/pallas_decode.py"},
+                           kernel_modules=kernel_mods
+                           - {"ops/pallas_decode.py"})
+    assert "TPU-L010" in _rules(vs2) and "TPU-L013" in _rules(vs2)
+
+
 def test_l013_roster_extraction_and_staleness():
     pkg = os.path.join(REPO, "spark_rapids_tpu")
     mods = lint.known_kernel_primitives(pkg)

@@ -181,9 +181,12 @@ def test_scan_coalesces_small_row_groups(session, tmp_path):
     path = str(tmp_path / "rg.parquet")
     pq.write_table(t, path, row_group_size=10)
     # PERFILE: no host-side coalescing, so the device coalesce node is
-    # what merges the 10 per-row-group batches
+    # what merges the 10 per-row-group batches (this test pins the HOST
+    # decode path — the device-decode source coalesces row groups itself
+    # and never gets a CoalesceBatchesExec)
     session = TpuSession(
-        {"spark.rapids.sql.format.parquet.reader.type": "PERFILE"})
+        {"spark.rapids.sql.format.parquet.reader.type": "PERFILE",
+         "spark.rapids.sql.decode.device.enabled": "false"})
     df = session.read_parquet(path)
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: s.read_parquet(path).filter(col("i") > lit(0)),
@@ -215,8 +218,12 @@ def test_scan_coalesces_small_row_groups(session, tmp_path):
 
 
 def _rg_metrics(session):
+    # footer pruning runs identically on the host scan and the
+    # device-decode encoded source — accept whichever the conf picked
     m = session.last_metrics()
-    scan = next(v for k, v in m.items() if k.startswith("ParquetScanExec"))
+    scan = next(v for k, v in m.items()
+                if k.startswith(("ParquetScanExec",
+                                 "EncodedParquetSourceExec")))
     return scan.get("numRowGroups", 0), scan.get("numRowGroupsPruned", 0)
 
 
@@ -335,7 +342,8 @@ def test_parquet_partition_file_pruning(session, tmp_path):
     from spark_rapids_tpu.exec import tpu_nodes as X
     root, _ = convert_plan(df.plan, session.conf)
     def find(e):
-        if isinstance(e, X.ParquetScanExec):
+        # both scan flavors prune partition files in their ctor
+        if isinstance(e, (X.ParquetScanExec, X.EncodedParquetSourceExec)):
             return e
         for c in e.children:
             r = find(c)

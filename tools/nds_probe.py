@@ -2632,6 +2632,28 @@ def run_one(sess, dfs, qn: int, history_dir: str = "",
         rec["attr_stall_seconds"] = round(
             b.get("semaphore_wait", 0.0) + b.get("pipeline_stall", 0.0)
             + b.get("retry_backoff", 0.0), 4)
+    try:
+        # round-16 decode columns — only when a parquet scan actually ran
+        # (the probe's default tables are in-memory cached): which decode
+        # path served the scan and the encoded-vs-decoded bytes split
+        snaps = sess.last_metrics()
+        enc_execs = [v for k, v in snaps.items()
+                     if k.startswith("EncodedParquetSourceExec")]
+        host_scan = any(k.startswith("ParquetScanExec") for k in snaps)
+        if enc_execs:
+            fbc = sum(v.get("numDecodeFallbackColumns", 0)
+                      for v in enc_execs)
+            rec["decode_path"] = "mixed" if fbc else "device"
+            rec["encoded_gb"] = round(sum(
+                v.get("encodedBytes", 0) for v in enc_execs) / 1e9, 4)
+            rec["decoded_gb"] = round(sum(
+                v.get("decodedBytes", 0) for v in snaps.values()) / 1e9, 4)
+            if fbc:
+                rec["decode_fallback_columns"] = int(fbc)
+        elif host_scan:
+            rec["decode_path"] = "host"
+    except Exception:  # noqa: BLE001 - decode columns are advisory
+        pass
     if history_dir:
         append_scorecard(history_dir, qn, rec, df.plan, wall0, sf=sf)
     return rec
