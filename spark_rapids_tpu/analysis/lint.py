@@ -88,6 +88,14 @@ TPU-L013  every kernel-emitting module — one containing a
           compiled computation routes through an audited entry point" —
           holds only while the roster tracks reality (the L007-L012
           roster pattern).
+TPU-L014  every HTTP route literal the obs endpoint's handlers compare
+          ``path`` against must be registered in the ``ROUTES`` roster
+          of ``runtime/obs/endpoint.py`` (and every roster entry must
+          appear in generated docs/metrics.md and still match a handler
+          literal — stale entries are flagged). The endpoint now
+          carries mutating routes (POST /sql, POST
+          /queries/<id>/cancel), so an undocumented or drifted route is
+          an invisible API surface (the L007-L013 roster pattern).
 
 Suppression
 -----------
@@ -137,6 +145,9 @@ RULES: Dict[str, str] = {
                 "pallas_call site) not registered in the "
                 "analysis/kernel_audit.py KERNEL_PRIMITIVES roster "
                 "(or a stale/undocumented roster entry)",
+    "TPU-L014": "HTTP route literal not registered in the "
+                "runtime/obs/endpoint.py ROUTES roster (or a "
+                "stale/undocumented roster entry)",
 }
 
 #: modules owning the cancellation waiter protocol itself: their naked
@@ -254,7 +265,8 @@ class _FileLinter(ast.NodeVisitor):
                  pallas_modules: Optional[Set[str]] = None,
                  known_states: Optional[Set[str]] = None,
                  known_series: Optional[Set[str]] = None,
-                 kernel_modules: Optional[Set[str]] = None):
+                 kernel_modules: Optional[Set[str]] = None,
+                 known_routes: Optional[Set[str]] = None):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
@@ -264,6 +276,7 @@ class _FileLinter(ast.NodeVisitor):
         self.known_states = known_states
         self.known_series = known_series
         self.kernel_modules = kernel_modules
+        self.known_routes = known_routes
         self.violations: List[Violation] = []
         # stack of (lock_keys, with_lineno) for held-lock regions
         self._lock_stack: List[Tuple[Set[str], int]] = []
@@ -435,6 +448,40 @@ class _FileLinter(ast.NodeVisitor):
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
         self._check_swallowed(node)
         self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        self._check_route_literal(node)
+        self.generic_visit(node)
+
+    # -- TPU-L014 ----------------------------------------------------------
+
+    def _check_route_literal(self, node: ast.Compare) -> None:
+        """A handler dispatching on ``path == "/literal"`` (or ``path in
+        ("/a", "/b")``) serves a route: the literal must be in the
+        endpoint's ROUTES roster or it is an invisible, undocumented API
+        surface. The variable must terminate in exactly ``path`` (the
+        BaseHTTPRequestHandler convention) — ``opname == "/"`` in the
+        UDF compiler never matches."""
+        if self.known_routes is None:
+            return
+        operands = [node.left] + list(node.comparators)
+        if not any(_terminal(o) == "path" for o in operands):
+            return
+        literals: List[ast.Constant] = []
+        for o in operands:
+            if isinstance(o, ast.Constant):
+                literals.append(o)
+            elif isinstance(o, (ast.Tuple, ast.List, ast.Set)):
+                literals.extend(el for el in o.elts
+                                if isinstance(el, ast.Constant))
+        for lit in literals:
+            if isinstance(lit.value, str) and lit.value.startswith("/") \
+                    and lit.value not in self.known_routes:
+                self._emit("TPU-L014", node,
+                           f"HTTP route {lit.value!r} is not registered "
+                           f"in the runtime/obs/endpoint.py ROUTES "
+                           f"roster — register it so the endpoint index "
+                           f"and generated docs stay complete")
 
     # -- TPU-L002 ----------------------------------------------------------
 
@@ -847,6 +894,41 @@ def known_sampler_series(pkg_root: str) -> Set[str]:
         os.path.join(pkg_root, "runtime", "obs", "sampler.py"), "SERIES")
 
 
+def known_http_routes(pkg_root: str) -> Set[str]:
+    """Registered HTTP routes: the keys of the ROUTES dict literal in
+    runtime/obs/endpoint.py."""
+    return _dict_literal_keys(
+        os.path.join(pkg_root, "runtime", "obs", "endpoint.py"), "ROUTES")
+
+
+def endpoint_served_routes(path: str) -> Set[str]:
+    """Route literals a handler actually dispatches on: string constants
+    compared against a ``path`` variable (the visit_Compare shape).
+    Used for the stale-roster half of TPU-L014 — the ROUTES dict itself
+    contains every literal, so a plain substring scan would be
+    vacuous."""
+    served: Set[str] = set()
+    if not os.path.exists(path):
+        return served
+    tree = ast.parse(open(path).read(), path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        operands = [node.left] + list(node.comparators)
+        if not any(_terminal(o) == "path" for o in operands):
+            continue
+        for o in operands:
+            lits = [o] if isinstance(o, ast.Constant) else (
+                list(o.elts) if isinstance(o, (ast.Tuple, ast.List,
+                                               ast.Set)) else [])
+            for lit in lits:
+                if isinstance(lit, ast.Constant) \
+                        and isinstance(lit.value, str) \
+                        and lit.value.startswith("/"):
+                    served.add(lit.value)
+    return served
+
+
 def known_kernel_primitives(pkg_root: str) -> Set[str]:
     """Registered kernel-emitting modules: the keys of the
     KERNEL_PRIMITIVES dict literal in analysis/kernel_audit.py."""
@@ -912,6 +994,17 @@ def docs_metric_names(repo_root: str) -> Optional[Set[str]]:
     return found
 
 
+def docs_route_names(repo_root: str) -> Optional[Set[str]]:
+    """HTTP routes documented in docs/metrics.md (backtick tokens
+    starting with '/' — docs_metric_names' leading-letter class cannot
+    match them). None when the file is missing."""
+    path = os.path.join(repo_root, "docs", "metrics.md")
+    if not os.path.exists(path):
+        return None
+    return {m.group(1) for m in
+            re.finditer(r"`(/[A-Za-z0-9_./<>-]*)`", open(path).read())}
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
@@ -923,7 +1016,8 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                 pallas_modules: Optional[Set[str]] = None,
                 known_states: Optional[Set[str]] = None,
                 known_series: Optional[Set[str]] = None,
-                kernel_modules: Optional[Set[str]] = None
+                kernel_modules: Optional[Set[str]] = None,
+                known_routes: Optional[Set[str]] = None
                 ) -> List[Violation]:
     tree = ast.parse(source, path)
     linter = _FileLinter(path, source, known_metrics,
@@ -933,7 +1027,8 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                          pallas_modules=pallas_modules,
                          known_states=known_states,
                          known_series=known_series,
-                         kernel_modules=kernel_modules)
+                         kernel_modules=kernel_modules,
+                         known_routes=known_routes)
     linter.visit(tree)
     return linter.violations
 
@@ -950,6 +1045,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
     states = known_query_states(pkg_root)
     series = known_sampler_series(pkg_root)
     kernel_mods = known_kernel_primitives(pkg_root)
+    routes = known_http_routes(pkg_root)
     violations: List[Violation] = []
     n_files = 0
     for dirpath, dirnames, filenames in os.walk(pkg_root):
@@ -965,7 +1061,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 known_sites=sites, known_buckets=buckets,
                 pallas_modules=pallas_mods,
                 known_states=states, known_series=series,
-                kernel_modules=kernel_mods))
+                kernel_modules=kernel_mods, known_routes=routes))
     # the stale half of TPU-L013: a roster entry whose module no longer
     # exists or no longer emits kernels claims audit coverage that
     # isn't there
@@ -982,6 +1078,21 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 "TPU-L013", kapath, 1,
                 f"KERNEL_PRIMITIVES roster entry {mod!r} has no "
                 f"compile_cache.jit / pallas_call site — stale entry"))
+    # the stale half of TPU-L014: a ROUTES entry no handler dispatch
+    # literal serves claims an API surface that isn't there. Templated
+    # routes (<id> segments) dispatch through a regex, not a literal —
+    # skip them.
+    eppath = os.path.join(pkg_root, "runtime", "obs", "endpoint.py")
+    served = endpoint_served_routes(eppath)
+    for route in sorted(routes):
+        if "<" in route:
+            continue
+        if route not in served:
+            violations.append(Violation(
+                "TPU-L014", eppath, 1,
+                f"ROUTES roster entry {route!r} matches no handler "
+                f"path comparison in runtime/obs/endpoint.py — stale "
+                f"entry"))
     documented = docs_metric_names(repo_root)
     mpath = os.path.join(pkg_root, "runtime", "metrics.py")
     if documented is None:
@@ -1020,6 +1131,12 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 f"kernel-primitive module {mod!r} absent from "
                 f"docs/metrics.md — regenerate with "
                 f"'python tools/gen_docs.py'"))
+        documented_routes = docs_route_names(repo_root) or set()
+        for route in sorted(routes - documented_routes):
+            violations.append(Violation(
+                "TPU-L014", eppath, 1,
+                f"HTTP route {route!r} absent from docs/metrics.md — "
+                f"regenerate with 'python tools/gen_docs.py'"))
     stats = {
         "files": n_files,
         "violations": sum(1 for v in violations if not v.suppressed),

@@ -876,6 +876,81 @@ QUERY_DEVICE_BUDGET = conf_int(
     "(the isolation primitive concurrent serving requires; composes "
     "with the process-wide spark.rapids.memory.tpu.budgetBytes).")
 
+SERVING_ENABLED = conf_bool(
+    "spark.rapids.serving.enabled", False,
+    "Attach the query-serving layer to the obs HTTP endpoint: POST /sql "
+    "accepts {sql, session?, conf?, timeout_seconds?} documents, runs "
+    "each request as a top-level action through the admission gate / "
+    "per-query device quotas / deadlines / cancellation, and returns the "
+    "result as Arrow IPC bytes plus the wall-time attribution breakdown. "
+    "Requires spark.rapids.obs.enabled with a bindable port. The long-"
+    "lived-driver serving model of the reference (one plugin process, "
+    "many sessions, concurrentGpuTasks bounding device work) lifted to "
+    "an HTTP surface.", commonly_used=True)
+
+SERVING_MAX_SESSIONS = conf_int(
+    "spark.rapids.serving.maxSessions", 16,
+    "Bound on named client sessions the server materializes (each is a "
+    "conf-overlay session sharing the root session's temp views). A "
+    "request naming a session past the bound is refused with HTTP 429 "
+    "and a typed error doc rather than growing without limit.")
+
+SERVING_MAX_INFLIGHT = conf_int(
+    "spark.rapids.serving.maxInflight", 32,
+    "Bound on HTTP /sql requests concurrently inside the server (admitted "
+    "OR parked in the admission queue). A request arriving past it is "
+    "refused immediately with HTTP 429 — the serving layer rejects "
+    "rather than piles up, mirroring spark.rapids.query.maxQueued one "
+    "level out.")
+
+SERVING_RESULT_CACHE_ENABLED = conf_bool(
+    "spark.rapids.serving.resultCache.enabled", True,
+    "Plan-digest-keyed result cache for the serving layer: a hit returns "
+    "the byte-identical Arrow IPC stream of a prior execution with the "
+    "same (plan digest, table-version epoch, compile fingerprint) key. "
+    "Invalidated by the table-version epoch the broadcast-reuse cache "
+    "established (any create_or_replace_temp_view bumps it). Plans "
+    "containing non-deterministic expressions (rand) bypass the cache; "
+    "ANSI-divergent plans never share entries (the compile fingerprint "
+    "is in the key).")
+
+SERVING_RESULT_CACHE_MAX_BYTES = conf_int(
+    "spark.rapids.serving.resultCache.maxBytes", 256 << 20,
+    "Byte bound on cached result payloads (Arrow IPC stream bytes, "
+    "exact len() accounting). Least-recently-used entries evict to "
+    "admit new ones; every eviction is a counter.")
+
+SERVING_RESULT_CACHE_MAX_ENTRIES = conf_int(
+    "spark.rapids.serving.resultCache.maxEntries", 64,
+    "Entry bound on the result cache (LRU eviction, counted), "
+    "independent of the byte bound — many tiny results must not grow "
+    "the key set without limit.")
+
+SERVING_WARM_BOOT_ENABLED = conf_bool(
+    "spark.rapids.serving.warmBoot.enabled", True,
+    "Block server start on the compile-warmup replay when warmup is "
+    "armed (spark.rapids.compile.warmup.enabled + obs.historyDir): a "
+    "fresh replica pointed at a shared historyDir and persistent "
+    "compile cache then serves its first hot-digest query with zero "
+    "backend compiles — PR 10's session-construction warmup "
+    "generalized to server boot, gated by rapids_xla_compiles_total.")
+
+SERVING_WARM_BOOT_TIMEOUT_S = conf_float(
+    "spark.rapids.serving.warmBoot.timeoutSeconds", 60.0,
+    "Longest server start waits for the warmup replay before serving "
+    "anyway (0 = don't wait). A timeout degrades to cold serving, it "
+    "never fails the boot.")
+
+SERVING_REQUEST_NICE = conf_int(
+    "spark.rapids.serving.requestNice", 0,
+    "OS niceness (0-19) applied to the handler thread for the duration "
+    "of each request on this session — the serving QoS tier. A batch "
+    "session sets this in its conf overlay to declare itself "
+    "background: its host-side work (and on the CPU sim, its device "
+    "compute, which runs on the dispatching thread) then yields to "
+    "latency-tier requests under CPU contention. Best-effort: applied "
+    "per-thread via setpriority, silently skipped where unsupported.")
+
 STAGE_FUSION_ENABLED = conf_bool(
     "spark.rapids.sql.stageFusion.enabled", True,
     "Collapse maximal linear chains of narrow operators (project, filter, "

@@ -171,6 +171,15 @@ def get(exec_class: str, key: Tuple, builder: Callable[[], Callable]
         if auditor is not None:
             auditor.note(full_key)
         return fn
+    # the compile choke point is the last cooperative checkpoint before
+    # an UNINTERRUPTIBLE stretch: a fresh build's first call parks in
+    # the XLA compiler for seconds, where no cancel token can reach.
+    # Check before building so a cancelled query's task thread never
+    # enters a compile it cannot leave (the test_cancel leak-sweep
+    # flake: reaping waited out exactly these parked threads). The hit
+    # path above stays checkpoint-free — it is the per-dispatch path.
+    from spark_rapids_tpu.runtime import lifecycle as _lc
+    _lc.check_current()
     body = builder()
     bind = None
     if auditor is not None:
@@ -202,6 +211,13 @@ def _timed_first_call(full_key: Tuple, jfn: Callable) -> Callable:
     done = [False]
 
     def first(*args, **kwargs):
+        # last checkpoint before the backend compile itself: get()'s
+        # check covered the build, but the entry may have been built by
+        # an earlier (cancelled) call and left unexecuted — raising
+        # here leaves done[0] unconsumed, so an uncancelled retry still
+        # records the compile and swaps in the raw fn
+        from spark_rapids_tpu.runtime import lifecycle as _lc
+        _lc.check_current()
         _TLS.in_first_call = getattr(_TLS, "in_first_call", 0) + 1
         t0 = time.perf_counter_ns()
         try:

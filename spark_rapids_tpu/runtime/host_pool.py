@@ -36,6 +36,88 @@ _LOCK = _san.lock("hostPool.registry")
 _POOL: "Optional[HostTaskPool]" = None
 
 
+# ---------------------------------------------------------------------------
+# serving QoS tier (spark.rapids.serving.requestNice)
+# ---------------------------------------------------------------------------
+#
+# A background-tier request runs its host work at raised OS niceness so
+# latency-tier requests win CPU contention. The tier is thread-local and
+# propagates to wave threads and pool workers the same way the session
+# conf fingerprint and query-id binding do: captured at submit time,
+# applied (and restored) around the task on the worker.
+
+_QOS = threading.local()
+_NICE_RESTORABLE: Optional[bool] = None
+
+
+def qos_nice() -> int:
+    """This thread's background-tier niceness (0 = latency tier)."""
+    return getattr(_QOS, "nice", 0)
+
+
+def run_at_nice(nice: int, fn: Callable, *args):
+    """Run fn on the current thread at the given niceness (thread-local
+    tier set for nested submissions), restoring both afterwards."""
+    if nice <= 0:
+        return fn(*args)
+    prev = getattr(_QOS, "nice", 0)
+    _QOS.nice = nice
+    restore = _raise_nice(nice)
+    try:
+        return fn(*args)
+    finally:
+        _QOS.nice = prev
+        if restore is not None:
+            restore()
+
+
+def _nice_restorable() -> bool:
+    """One-time probe: can this process LOWER a thread's niceness back
+    down (CAP_SYS_NICE / RLIMIT_NICE)? If not, never raise it on any
+    thread — a shared pool worker stuck at 19 would slow every query
+    that lands on it afterwards. QoS degrades to a no-op."""
+    global _NICE_RESTORABLE
+    if _NICE_RESTORABLE is None:
+        import os
+        ok = False
+        if hasattr(os, "setpriority"):
+            try:
+                tid = threading.get_native_id()
+                before = os.getpriority(os.PRIO_PROCESS, tid)
+                if before < 19:
+                    os.setpriority(os.PRIO_PROCESS, tid, before + 1)
+                    os.setpriority(os.PRIO_PROCESS, tid, before)
+                    ok = True
+            except OSError:
+                ok = False
+        _NICE_RESTORABLE = ok
+    return _NICE_RESTORABLE
+
+
+def _raise_nice(nice: int):
+    """Raise the current thread's niceness; returns a restore callable,
+    or None when nothing was changed (already that nice, or the probe
+    says restoring would fail)."""
+    import os
+    if not _nice_restorable():
+        return None
+    try:
+        tid = threading.get_native_id()
+        before = os.getpriority(os.PRIO_PROCESS, tid)
+        if before >= nice:
+            return None
+        os.setpriority(os.PRIO_PROCESS, tid, min(int(nice), 19))
+    except OSError:
+        return None
+
+    def restore():
+        try:
+            os.setpriority(os.PRIO_PROCESS, tid, before)
+        except OSError:
+            pass
+    return restore
+
+
 def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
     """Run one action's top-level partition tasks (the Spark task-set
     role) and return [fn(item)] in input order.
@@ -70,6 +152,7 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
     # way the conf fingerprint does: a task constructed on a wave thread
     # must attribute to the query that fanned it out
     qid = _live.current_query_id()
+    nice = qos_nice()
 
     def bound(item):
         if conf is not None:
@@ -82,6 +165,8 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
             # wave-start cooperative checkpoint: partitions of an
             # already-cancelled query unwind before doing any work
             _lc.check_current()
+            if nice:
+                return run_at_nice(nice, fn, item)
             return fn(item)
         finally:
             if qid is not None:
@@ -156,6 +241,15 @@ class HostTaskPool:
 
             def fn(*a):  # noqa: F811 - bound wrapper replaces fn
                 return _live.run_bound(qid, inner_fn, *a)
+        # the submitter's QoS tier rides along the same way: background
+        # requests keep their raised niceness on whichever worker runs
+        # the task (restored after, so shared workers aren't poisoned)
+        nice = qos_nice()
+        if nice:
+            tier_fn = fn
+
+            def fn(*a):  # noqa: F811 - QoS wrapper replaces fn
+                return run_at_nice(nice, tier_fn, *a)
         if depth == 0:
             return self._tier0.submit(fn, *args)
         if depth == 1:

@@ -180,6 +180,28 @@ def _preregister(reg: MetricsRegistry) -> None:
     reg.counter("rapids_flight_dumps_total",
                 "Flight-recorder dumps written, by trigger",
                 labels={"reason": "query_failed"})
+    # serving layer (runtime/serving/): request intake and the
+    # plan-digest-keyed result cache
+    reg.counter("rapids_serving_requests_total",
+                "POST /sql requests accepted into the serving "
+                "layer (past the maxInflight bound).")
+    reg.counter("rapids_serving_rejected_total",
+                "POST /sql requests refused with HTTP 429 "
+                "(maxInflight, maxSessions, or admission-gate "
+                "rejection).")
+    reg.counter("rapids_result_cache_hits_total",
+                "Serving result-cache hits (byte-identical replay of "
+                "a prior execution with the same plan digest, table "
+                "epoch, and compile fingerprint).")
+    reg.counter("rapids_result_cache_misses_total",
+                "Serving result-cache misses (the request executed and "
+                "its serialized result was inserted).")
+    reg.counter("rapids_result_cache_evictions_total",
+                "Serving result-cache LRU evictions (byte or entry "
+                "bound exceeded).")
+    reg.counter("rapids_result_cache_bypasses_total",
+                "Serving requests that bypassed the result cache "
+                "(non-deterministic plan or cache=false).")
     for phase in attribution.BUCKETS:
         reg.float_counter(
             "rapids_query_seconds_bucket",
@@ -331,7 +353,9 @@ def install(conf) -> "Optional[ObsState]":
                                        console=render_live,
                                        cors_origin=conf.get(
                                            Cf.OBS_CORS_ORIGIN),
-                                       cancel=_cancel_query)
+                                       cancel=_cancel_query,
+                                       sql=_serving_sql,
+                                       serving=_serving_doc)
                 server.start()
                 st.server = server
             except Exception:  # noqa: BLE001 - a bind failure (port in
@@ -692,6 +716,23 @@ def _cancel_query(query_id) -> bool:
     return LC.cancel(query_id, reason="http")
 
 
+def _serving_sql(payload: dict):
+    """The POST /sql handler target (lazy: the serving layer may install
+    after the endpoint starts, or never)."""
+    from spark_rapids_tpu.runtime import serving as SRV
+    return SRV.handle_sql(payload)
+
+
+def _serving_doc():
+    """The GET /serving + healthz['serving'] document (None when the
+    serving layer is not installed)."""
+    try:
+        from spark_rapids_tpu.runtime import serving as SRV
+        return SRV.server_doc()
+    except Exception:  # noqa: BLE001 - health must always render
+        return None
+
+
 def suppressed_actions():
     """Context manager making every collect on the CURRENT thread look
     nested to the live layer (on_query_start returns NESTED: no history
@@ -811,4 +852,7 @@ def healthz() -> dict:
         # query lifecycle control (runtime/lifecycle.py): live cancel
         # tokens, admission-gate occupancy, reject/cancel totals
         "lifecycle": _lifecycle_doc(),
+        # the serving layer (runtime/serving/): intake bounds, overlay
+        # sessions, result-cache traffic (None when serving is off)
+        "serving": _serving_doc(),
     }

@@ -32,6 +32,25 @@ from typing import Callable, Optional
 #: of obs-disabled engines; the endpoint accepts both)
 _CANCEL_RE = re.compile(r"^/queries/(-?\d+)/cancel$")
 
+#: Every route this endpoint serves, with its method — the tpulint
+#: TPU-L014 roster: a handler comparing `path` to a literal absent here
+#: (or a roster entry absent from the generated docs) is lint-visible
+#: drift. `<id>` marks the one templated segment (_CANCEL_RE).
+ROUTES = {
+    "/": "GET: plain-text index of the routes below.",
+    "/metrics": "GET: Prometheus text exposition of the registry.",
+    "/healthz": "GET: health JSON; 200 ok / 503 degraded.",
+    "/queries": "GET: live query registry (in-flight progress docs).",
+    "/console": "GET: auto-refreshing HTML console.",
+    "/serving": "GET: serving-layer doc (sessions, queue, result "
+                "cache); 404 when spark.rapids.serving.enabled is off.",
+    "/sql": "POST: execute {sql, session?, conf?, timeout_seconds?, "
+            "cache?} as a top-level action; 200 ok / 400 bad request / "
+            "429 rejected / 499 cancelled / 500 failed.",
+    "/queries/<id>/cancel": "POST: fire the query's cancel token; 200 "
+                            "cancelled / 404 not in flight.",
+}
+
 
 def default_device_probe() -> bool:
     """One trivial dispatch + fetch: the cheapest end-to-end proof the
@@ -113,12 +132,16 @@ class ObsHttpServer:
                  queries: Optional[Callable[[], dict]] = None,
                  console: Optional[Callable[[], str]] = None,
                  cors_origin: str = "",
-                 cancel: Optional[Callable[[int], bool]] = None):
+                 cancel: Optional[Callable[[int], bool]] = None,
+                 sql: Optional[Callable[[dict], tuple]] = None,
+                 serving: Optional[Callable[[], Optional[dict]]] = None):
         self._render_metrics = render_metrics
         self._healthz = healthz
         self._queries = queries
         self._console = console
         self._cancel = cancel
+        self._sql = sql
+        self._serving = serving
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -155,10 +178,20 @@ class ObsHttpServer:
                     elif path == "/console" and outer._console is not None:
                         self._send(200, outer._console().encode(),
                                    "text/html; charset=utf-8")
+                    elif path == "/serving" and outer._serving is not None:
+                        doc = outer._serving()
+                        if doc is None:  # serving layer not installed
+                            self._send(404, b"serving disabled\n",
+                                       "text/plain")
+                        else:
+                            self._send(200, json.dumps(doc,
+                                                       indent=1).encode(),
+                                       "application/json")
                     elif path == "/":
                         self._send(200, b"spark-rapids-tpu obs endpoint: "
                                    b"/metrics /healthz /queries "
-                                   b"/console; POST /queries/<id>/cancel"
+                                   b"/console /serving; POST /sql, "
+                                   b"POST /queries/<id>/cancel"
                                    b"\n", "text/plain")
                     else:
                         self._send(404, b"not found\n", "text/plain")
@@ -167,6 +200,32 @@ class ObsHttpServer:
 
             def do_POST(self):
                 path = self.path.split("?", 1)[0]
+                if path == "/sql" and outer._sql is not None:
+                    # the serving layer: the request executes as a
+                    # top-level action ON THIS handler thread (the
+                    # ThreadingHTTPServer gives each request its own
+                    # daemon thread), so admission/quotas/deadlines/
+                    # cancellation apply with no extra pool
+                    try:
+                        n = int(self.headers.get("Content-Length") or 0)
+                        raw = self.rfile.read(n) if n else b"{}"
+                        try:
+                            payload = json.loads(raw.decode() or "{}")
+                        except Exception:  # noqa: BLE001 - typed 400
+                            payload = None
+                        if not isinstance(payload, dict):
+                            code, doc = 400, {
+                                "status": "bad_request",
+                                "error_type": "ValueError",
+                                "message": "body must be a JSON object"}
+                        else:
+                            code, doc = outer._sql(payload)
+                        self._send(code, json.dumps(doc).encode(),
+                                   "application/json")
+                    except Exception as e:  # noqa: BLE001 - must answer
+                        self._send(500, f"error: {e}\n".encode(),
+                                   "text/plain")
+                    return
                 m = _CANCEL_RE.match(path)
                 try:
                     if m is None or outer._cancel is None:

@@ -66,6 +66,12 @@ SERIES: Dict[str, str] = {
     "process_rss_bytes": "process resident set size (/proc/self/statm)",
     "running_queries": "top-level queries currently in flight "
                        "(runtime/obs/live.py registry)",
+    "serving_active_requests": "POST /sql requests inside the serving "
+                               "layer (runtime/serving/; 0 when off)",
+    "serving_queue_depth": "queries parked in the admission queue "
+                           "behind spark.rapids.query.maxConcurrent",
+    "serving_cache_hit_ratio": "serving result-cache hits / lookups "
+                               "(0 until the first lookup)",
 }
 
 
@@ -160,6 +166,28 @@ def _collect_running_queries() -> float:
     return float(live.running_count())
 
 
+def _collect_serving_active() -> float:
+    from spark_rapids_tpu.runtime import serving as SRV
+    srv = SRV.server()
+    return float(srv._active) if srv is not None else 0.0
+
+
+def _collect_serving_queue() -> float:
+    from spark_rapids_tpu.runtime import serving as SRV
+    if SRV.server() is None:
+        return 0.0
+    from spark_rapids_tpu.runtime import lifecycle as LC
+    return float(LC.doc().get("queued", 0))
+
+
+def _collect_serving_hit_ratio() -> float:
+    from spark_rapids_tpu.runtime import serving as SRV
+    srv = SRV.server()
+    if srv is None or srv.cache is None:
+        return 0.0
+    return float(srv.cache.stats()["hit_ratio"])
+
+
 _COLLECTORS: Dict[str, Callable[[], float]] = {
     "device_bytes_held": _collect_device_bytes,
     "host_spill_bytes_held": _collect_host_spill_bytes,
@@ -171,6 +199,9 @@ _COLLECTORS: Dict[str, Callable[[], float]] = {
     "breaker_state": _collect_breaker_state,
     "process_rss_bytes": _collect_rss,
     "running_queries": _collect_running_queries,
+    "serving_active_requests": _collect_serving_active,
+    "serving_queue_depth": _collect_serving_queue,
+    "serving_cache_hit_ratio": _collect_serving_hit_ratio,
 }
 
 # every roster series has exactly one collector (and nothing samples

@@ -1,0 +1,73 @@
+"""Serving-layer lifecycle (spark.rapids.serving.*).
+
+Installation follows the obs/warmup first-wins discipline: the FIRST
+session constructed with serving.enabled=true becomes the root of the
+process-wide QueryServer; later sessions (including the server's own
+overlay sessions) see it installed and do nothing. The server itself is
+transport-free — runtime/obs/endpoint.py calls `handle_sql()` /
+`server_doc()` through the callbacks obs.install wires in, so when
+serving is off those routes answer 404 and the only cost an ordinary
+query ever pays is the one `installed()` module-global read.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from spark_rapids_tpu.runtime.serving.server import QueryServer
+
+_LOCK = threading.Lock()
+_SERVER: Optional[QueryServer] = None
+
+
+def maybe_install(session) -> None:
+    """Install the process-wide query server for this session when
+    spark.rapids.serving.enabled is set (first session wins)."""
+    from spark_rapids_tpu import config as C
+    global _SERVER
+    if _SERVER is not None:  # one global read on the common path
+        return
+    if not session.conf.get(C.SERVING_ENABLED):
+        return
+    with _LOCK:
+        if _SERVER is not None:
+            return
+        srv = QueryServer(session)
+        _SERVER = srv
+    # warm-boot wait OUTSIDE the lock (it can block for seconds)
+    srv.start()
+
+
+def installed() -> bool:
+    return _SERVER is not None
+
+
+def server() -> Optional[QueryServer]:
+    return _SERVER
+
+
+def handle_sql(payload: dict) -> Tuple[int, dict]:
+    """POST /sql entry point (called by the obs endpoint handler)."""
+    srv = _SERVER
+    if srv is None:
+        return 404, {"status": "failed", "error_type": "RuntimeError",
+                     "message": "serving layer not installed "
+                                "(spark.rapids.serving.enabled)"}
+    return srv.handle(payload)
+
+
+def server_doc() -> Optional[dict]:
+    """GET /serving + /healthz['serving'] document (None when off)."""
+    srv = _SERVER
+    if srv is None:
+        return None
+    try:
+        return srv.doc()
+    except Exception:  # noqa: BLE001 - introspection never breaks obs
+        return None
+
+
+def reset_for_tests() -> None:
+    global _SERVER
+    with _LOCK:
+        _SERVER = None

@@ -109,6 +109,11 @@ class TpuSession:
         from spark_rapids_tpu.analysis import kernel_audit
         kernel_audit.configure(self.conf)
         warmup.maybe_arm(self)
+        # the serving layer (spark.rapids.serving.*): POST /sql on the
+        # obs endpoint, result cache, warm-boot wait. Installs AFTER
+        # warmup arms so a warm-boot server can block on the replay
+        from spark_rapids_tpu.runtime import serving
+        serving.maybe_install(self)
 
     def _activate(self):
         # name binding (case sensitivity) consults the active session conf

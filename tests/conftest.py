@@ -79,6 +79,11 @@ def _reset_runtime():
     # a cancelled or queued query must not leak into the next test
     from spark_rapids_tpu.runtime import lifecycle
     lifecycle.reset_for_tests()
+    # the serving layer installs a process-global query server (and its
+    # result cache) on the first serving-enabled session; drop it so one
+    # test's server, sessions and cached results don't leak forward
+    from spark_rapids_tpu.runtime import serving
+    serving.reset_for_tests()
     # adaptive execution: the decision recorder, build-reuse cache and
     # table epoch are process-global, as is the measured-hints memo —
     # one test's cached broadcast build or hint must not leak forward

@@ -562,6 +562,64 @@ def test_l013_roster_extraction_and_staleness():
         os.path.join(pkg, "runtime", "metrics.py"))
 
 
+def _lint_routes(src, routes=frozenset({"/metrics", "/healthz"})):
+    return lint.lint_source(textwrap.dedent(src), "/x/runtime/obs/x.py",
+                            {"opTime"}, relpath="runtime/obs/x.py",
+                            known_routes=set(routes))
+
+
+def test_l014_off_roster_route_flagged():
+    vs = _lint_routes("""
+        def do_GET(self, path):
+            if path == "/metrics":
+                pass
+            elif path in ("/healthz", "/secret"):
+                pass
+    """)
+    assert _rules(vs) == ["TPU-L014"]
+
+
+def test_l014_non_path_compare_and_suppression():
+    # `opname == "/"` (the UDF-compiler shape) must never match: the
+    # variable has to terminate in exactly `path`
+    assert _rules(_lint_routes("""
+        def compile_op(opname):
+            if opname == "/":
+                return "div"
+    """)) == []
+    vs = _lint_routes("""
+        def do_GET(self, path):
+            if path == "/debug":  # tpulint: disable=TPU-L014 dev route
+                pass
+    """)
+    assert _rules(vs) == []
+    assert _rules(vs, suppressed=True) == ["TPU-L014"]
+
+
+def test_l014_skipped_without_roster():
+    assert _rules(_lint("""
+        def do_GET(self, path):
+            if path == "/unregistered":
+                pass
+    """)) == []
+
+
+def test_l014_roster_extraction_served_and_documented():
+    pkg = os.path.join(REPO, "spark_rapids_tpu")
+    from spark_rapids_tpu.runtime.obs.endpoint import ROUTES
+    routes = lint.known_http_routes(pkg)
+    assert routes == set(ROUTES)
+    assert {"/metrics", "/healthz", "/serving", "/sql"} <= routes
+    # the stale half's input: every non-templated roster entry really is
+    # dispatched by a handler Compare in the endpoint source
+    served = lint.endpoint_served_routes(
+        os.path.join(pkg, "runtime", "obs", "endpoint.py"))
+    assert {r for r in routes if "<" not in r} <= served
+    # and the generated docs carry every roster route
+    documented = lint.docs_route_names(REPO)
+    assert documented is not None and routes <= documented
+
+
 def test_l011_roster_extraction_matches_live_modules():
     pkg = os.path.join(REPO, "spark_rapids_tpu")
     from spark_rapids_tpu.runtime.obs.live import STATES

@@ -276,6 +276,54 @@ def test_cancel_mid_retry_backoff_wakes_immediately():
         set_backoff(10.0, 500.0)
 
 
+def test_cancel_checkpoint_at_compile_choke_point():
+    """The tier-1 leak-sweep flake fix: a cancelled query's task thread
+    used to enter a fresh XLA compile (uninterruptible for seconds) and
+    the sweep waited out exactly those parked threads. The compile-cache
+    choke points must raise BEFORE the build and BEFORE the backend
+    compile — and an uncancelled retry must still build/record it."""
+    from spark_rapids_tpu.runtime import compile_cache as CC
+    import jax.numpy as jnp
+    built = []
+
+    def builder():
+        built.append(1)
+        return lambda x: x + 1
+
+    key = ("cancel-choke-regression",)
+    tok = LC.begin_action(None, C.RapidsConf())
+    try:
+        tok.cancel("user")
+        with pytest.raises(QueryCancelledError):
+            CC.get("CancelChokeTest", key, builder)
+        assert not built, "builder ran for a cancelled query"
+    finally:
+        LC.finish_action(tok, "cancelled")
+    # an uncancelled action builds the entry; a cancel landing between
+    # the build and the first dispatch raises at the first() checkpoint
+    # and leaves the compile claim unconsumed
+    tok2 = LC.begin_action(None, C.RapidsConf())
+    try:
+        fn = CC.get("CancelChokeTest", key, builder)
+        assert built == [1]
+        tok2.cancel("user")
+        with pytest.raises(QueryCancelledError):
+            fn(jnp.arange(4))
+    finally:
+        LC.finish_action(tok2, "cancelled")
+    # a fresh (uncancelled) retry executes, records the compile, and
+    # swaps the raw jitted fn into the cache
+    tok3 = LC.begin_action(None, C.RapidsConf())
+    try:
+        out = fn(jnp.arange(4))
+        assert list(np.asarray(out)) == [1, 2, 3, 4]
+    finally:
+        LC.finish_action(tok3, "ok")
+    assert CC.get("CancelChokeTest", key, builder) is not fn, \
+        "successful first call did not swap in the raw jitted fn"
+    assert built == [1]
+
+
 # ---------------------------------------------------------------------------
 # the interruptible semaphore
 # ---------------------------------------------------------------------------

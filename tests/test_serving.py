@@ -1,0 +1,321 @@
+"""Serving layer (runtime/serving/): the digest-keyed result cache
+(byte parity, epoch invalidation, bounded churn, single-flight,
+non-determinism bypass, ANSI fingerprint isolation) and the POST /sql
+HTTP surface with its 429/400 typed error docs and the /serving doc.
+
+Reference parity: the plugin's serving posture — one long-lived driver,
+many client sessions, concurrentGpuTasks bounding device work — lifted
+to an HTTP front with result reuse keyed exactly like the warm-trace
+compile cache: (plan digest, table epoch, compile fingerprint).
+"""
+import base64
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.runtime import serving
+from spark_rapids_tpu.runtime.serving.result_cache import ResultCache
+from spark_rapids_tpu.runtime.serving.server import deserialize_table
+from spark_rapids_tpu.sql.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Serving rides the obs endpoint; each test gets a fresh obs
+    singleton (the serving singleton itself is reset by conftest)."""
+    from spark_rapids_tpu.runtime import obs
+    obs.shutdown_for_tests()
+    yield
+    obs.shutdown_for_tests()
+
+
+def _table(n=600, seed=11):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": rng.integers(0, 9, n),
+                     "v": rng.integers(1, 1000, n)})
+
+
+def _serving_session(**extra):
+    conf = {"spark.rapids.serving.enabled": "true"}
+    conf.update(extra)
+    s = TpuSession(conf)
+    s.create_or_replace_temp_view("t", s.create_dataframe(_table()))
+    return s
+
+
+_SQL = "SELECT k, SUM(v) AS sv FROM t GROUP BY k ORDER BY k"
+
+
+# ---------------------------------------------------------------------------
+# the result cache through the server
+# ---------------------------------------------------------------------------
+
+def test_hit_is_byte_identical_and_counted():
+    _serving_session()
+    code1, d1 = serving.handle_sql({"sql": _SQL})
+    code2, d2 = serving.handle_sql({"sql": _SQL})
+    assert (code1, d1["cache"]) == (200, "miss")
+    assert (code2, d2["cache"]) == (200, "hit")
+    # byte parity is structural: the hit returns the stored IPC stream
+    assert d1["result"] == d2["result"]
+    tbl = deserialize_table(base64.b64decode(d2["result"]))
+    assert tbl.num_rows == 9 and tbl.column_names == ["k", "sv"]
+    # the hit skipped execution entirely: no attribution, no compiles
+    assert d2["attribution"] is None and d2["xla_compiles"] == 0
+    st = serving.server().cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    assert 0 < st["bytes"] and st["entries"] == 1
+    assert st["hit_ratio"] == 0.5
+
+
+def test_view_replace_bumps_epoch_and_invalidates():
+    s = _serving_session()
+    _, d1 = serving.handle_sql({"sql": _SQL})
+    # same digest, new data: re-registering the view advances the table
+    # epoch, so the stale entry is silently orphaned — the next request
+    # must execute and see the NEW rows
+    s.create_or_replace_temp_view(
+        "t", s.create_dataframe(_table(seed=99)))
+    code, d2 = serving.handle_sql({"sql": _SQL})
+    assert code == 200 and d2["cache"] == "miss"
+    assert d2["plan_digest"] == d1["plan_digest"]  # digest is stable
+    t1 = deserialize_table(base64.b64decode(d1["result"]))
+    t2 = deserialize_table(base64.b64decode(d2["result"]))
+    assert t1.to_pylist() != t2.to_pylist(), \
+        "epoch invalidation served stale data"
+
+
+def test_explicit_cache_false_and_rand_plan_bypass():
+    s = _serving_session()
+    code, doc = serving.handle_sql({"sql": _SQL, "cache": False})
+    assert code == 200 and doc["cache"] == "bypass"
+    assert doc["plan_digest"] is None
+    # a sampled view is non-deterministic (Rand under the hood): no key,
+    # never cached — two runs may legitimately differ
+    s.create_or_replace_temp_view("samp", s.table("t").sample(0.5, seed=3))
+    code, doc = serving.handle_sql({"sql": "SELECT k FROM samp"})
+    assert code == 200 and doc["cache"] == "bypass"
+    assert serving.server().cache.stats()["bypasses"] == 2
+
+
+def test_ansi_fingerprint_splits_keys():
+    s = _serving_session()
+    cache = serving.server().cache
+    plan = s.sql(_SQL).plan
+    k_plain = cache.key_for(plan, s.conf)
+    k_ansi = cache.key_for(
+        plan, C.RapidsConf({"spark.sql.ansi.enabled": "true"}))
+    assert k_plain is not None and k_ansi is not None
+    assert k_plain[0] == k_ansi[0] and k_plain != k_ansi, \
+        "ANSI-divergent plans must never share a cache entry"
+
+
+def test_named_session_overlay_and_session_limit():
+    _serving_session()
+    code, doc = serving.handle_sql({
+        "sql": _SQL, "session": "alice",
+        "conf": {"spark.sql.ansi.enabled": "true"}})
+    assert code == 200 and doc["session"] == "alice"
+    # the overlay session shares the root's temp views but not its
+    # compile fingerprint: alice's entry is distinct from the root's
+    code, doc = serving.handle_sql({"sql": _SQL})
+    assert code == 200 and doc["cache"] == "miss"
+    # unnamed + overlay is a typed 400
+    code, doc = serving.handle_sql({"sql": _SQL, "conf": {"a": "b"}})
+    assert code == 400 and doc["error_type"] == "ValueError"
+    # past maxSessions: typed 429
+    serving.server().max_sessions = 1
+    code, doc = serving.handle_sql({"sql": _SQL, "session": "bob"})
+    assert code == 429 and doc["error_type"] == "QueryRejectedError"
+    assert "maxSessions" in doc["message"]
+
+
+# ---------------------------------------------------------------------------
+# ResultCache unit behavior (no engine underneath)
+# ---------------------------------------------------------------------------
+
+def test_bounded_churn_evicts_lru_and_accounts_bytes():
+    rc = ResultCache(max_bytes=1 << 20, max_entries=3)
+    for i in range(7):
+        rc.get_or_execute(("k", i), lambda i=i: bytes(100 + i))
+    st = rc.stats()
+    assert st["entries"] == 3 and st["evictions"] == 4
+    assert st["bytes"] == sum(100 + i for i in (4, 5, 6))
+    # LRU order: the oldest surviving entries are 4..6
+    assert rc.lookup(("k", 0)) is None
+    assert rc.lookup(("k", 6)) is not None
+    # a payload larger than the whole cache is never inserted
+    rc2 = ResultCache(max_bytes=64, max_entries=8)
+    rc2.get_or_execute(("big",), lambda: bytes(1000))
+    assert rc2.stats()["entries"] == 0 and rc2.stats()["bytes"] == 0
+
+
+def test_single_flight_one_execution_many_waiters():
+    rc = ResultCache(max_bytes=1 << 20, max_entries=8)
+    executions = []
+    barrier = threading.Barrier(5)
+    results = []
+
+    def execute():
+        executions.append(threading.get_ident())
+        time.sleep(0.15)
+        return b"payload"
+
+    def worker():
+        barrier.wait()
+        results.append(rc.get_or_execute(("hot",), execute))
+
+    threads = [threading.Thread(target=worker) for _ in range(5)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(10)
+    assert len(executions) == 1, "single-flight executed more than once"
+    assert len(results) == 5
+    assert all(p == b"payload" for p, _ in results)
+    assert sorted(o for _, o in results) == \
+        ["hit", "hit", "hit", "hit", "miss"]
+
+
+def test_single_flight_leader_failure_promotes_follower():
+    rc = ResultCache(max_bytes=1 << 20, max_entries=8)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(0.1)
+            raise RuntimeError("leader dies")
+        return b"ok"
+
+    errs, box = [], {}
+
+    def leader():
+        try:
+            rc.get_or_execute(("f",), flaky)
+        except RuntimeError as e:
+            errs.append(e)
+
+    def follower():
+        box["out"] = rc.get_or_execute(("f",), flaky)
+
+    tl = threading.Thread(target=leader)
+    tf = threading.Thread(target=follower)
+    tl.start()
+    while not calls:  # follower must arrive while the leader executes
+        time.sleep(0.005)
+    tf.start()
+    tl.join(10)
+    tf.join(10)
+    # the follower retried as the new leader — a failure is never cached
+    assert len(errs) == 1
+    assert box["out"] == (b"ok", "miss") and len(calls) == 2
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(port, path, payload):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    body = json.dumps(payload).encode()
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    out = resp.status, json.loads(resp.read())
+    conn.close()
+    return out
+
+
+def test_post_sql_roundtrip_429_and_serving_doc():
+    port = _free_port()
+    _serving_session(**{"spark.rapids.obs.port": str(port)})
+    from spark_rapids_tpu.runtime import obs
+    port = obs.state().server.port
+    code, doc = _post(port, "/sql", {"sql": _SQL})
+    assert code == 200 and doc["status"] == "ok"
+    assert deserialize_table(
+        base64.b64decode(doc["result"])).num_rows == 9
+    # malformed body and missing sql are typed 400s
+    code, doc = _post(port, "/sql", {"sql": "SELEC nope"})
+    assert code == 400 and doc["status"] == "bad_request"
+    code, doc = _post(port, "/sql", {})
+    assert code == 400 and doc["error_type"] == "ValueError"
+    # saturated intake: typed 429 (the bounded-queue contract)
+    serving.server().max_inflight = 0
+    code, doc = _post(port, "/sql", {"sql": _SQL})
+    assert code == 429 and doc["error_type"] == "QueryRejectedError"
+    serving.server().max_inflight = 32
+    # the /serving doc + /healthz serving key
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", "/serving")
+    sv = json.loads(conn.getresponse().read())
+    assert sv["enabled"] and sv["requests"] >= 4 and sv["rejected"] >= 1
+    assert sv["result_cache"]["entries"] >= 1
+    conn.request("GET", "/healthz")
+    hz = json.loads(conn.getresponse().read())
+    assert hz["serving"]["enabled"] is True
+    conn.close()
+
+
+def test_serving_off_is_404_and_absent_doc():
+    port = _free_port()
+    TpuSession({"spark.rapids.obs.port": str(port)})
+    from spark_rapids_tpu.runtime import obs
+    port = obs.state().server.port
+    assert not serving.installed() and serving.server_doc() is None
+    code, doc = _post(port, "/sql", {"sql": _SQL})
+    assert code == 404 and "serving" in doc["message"]
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", "/serving")
+    resp = conn.getresponse()
+    assert resp.status == 404
+    resp.read()
+    conn.close()
+
+
+def test_qos_tier_rides_wave_threads_and_restores():
+    """spark.rapids.serving.requestNice: the background tier is
+    thread-local, rides run_task_wave fan-out like the conf fingerprint
+    does, raises OS niceness on the worker for the task's duration, and
+    restores both tier and niceness afterwards (shared pool threads
+    must not stay poisoned at low priority)."""
+    import os
+    from spark_rapids_tpu.runtime import host_pool as HP
+
+    assert HP.qos_nice() == 0
+    tid = threading.get_native_id()
+    base_prio = os.getpriority(os.PRIO_PROCESS, tid)
+    seen = []
+
+    def work(i):
+        wtid = threading.get_native_id()
+        seen.append((HP.qos_nice(),
+                     os.getpriority(os.PRIO_PROCESS, wtid)))
+        return i * 10
+
+    out = HP.run_at_nice(
+        7, lambda: HP.run_task_wave(work, [1, 2, 3]))
+    assert out == [10, 20, 30]
+    assert [n for n, _ in seen] == [7, 7, 7]
+    if HP._nice_restorable():
+        assert all(p >= 7 for _, p in seen), \
+            "worker ran a background-tier task at high priority"
+    # the submitting thread is back at its own tier and priority
+    assert HP.qos_nice() == 0
+    assert os.getpriority(os.PRIO_PROCESS, tid) == base_prio

@@ -1,0 +1,298 @@
+"""Serving load bench (round 17) -> SERVING_r01.json.
+
+Drives the query server over its real HTTP surface and records:
+
+* qps + client-observed p50/p99 under N concurrent clients on a
+  hot/cold request mix;
+* per-bucket p99 attribution for the executed (non-hit) requests — the
+  response docs carry the engine's wall breakdown, so the bench explains
+  its own tail without any server-side profiling;
+* hot-path speedup: cached p50 vs forced re-execution p50 (acceptance:
+  >= 10x);
+* quota isolation as a load test: a hog session looping heavy uncached
+  aggregations under a device-budget quota and the background QoS tier
+  (spark.rapids.serving.requestNice) must move a neighbor tenant's p99
+  — a hot/uncached request mix, so the tail lands on real device work —
+  by <= 1.25x of its solo run.
+
+Usage: python tools/bench_serving.py [--clients 8] [--out SERVING_r01.json]
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HOT_SQL = "SELECT k, SUM(v) AS sv, COUNT(*) AS n FROM t GROUP BY k"
+COLD_SQLS = (
+    "SELECT k, SUM(v) AS sv FROM t WHERE v > 250 GROUP BY k",
+    "SELECT k, MIN(v) AS lo, MAX(v) AS hi FROM t GROUP BY k",
+    "SELECT k, v * 2 AS v2 FROM t WHERE k < 3",
+)
+HOG_SQL = ("SELECT k, SUM(v) AS sv, SUM(v * v) AS sq, COUNT(*) AS n "
+           "FROM big GROUP BY k")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port: int, payload: dict, timeout: float = 300.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/sql", body=json.dumps(payload).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _pct(samples, q):
+    if not samples:
+        return None
+    s = sorted(samples)
+    i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[i]
+
+
+def _timed(port, payload):
+    t0 = time.perf_counter()
+    code, doc = _post(port, payload)
+    return (time.perf_counter() - t0) * 1e3, code, doc
+
+
+def boot(port: int):
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.sql.session import TpuSession
+    rng = np.random.default_rng(2026)
+    sess = TpuSession({
+        "spark.rapids.serving.enabled": "true",
+        "spark.rapids.obs.port": str(port),
+    })
+    n = 150_000
+    sess.create_or_replace_temp_view("t", sess.create_dataframe(
+        pa.table({"k": rng.integers(0, 16, n),
+                  "v": rng.integers(1, 1000, n)})))
+    # the hog table is big enough that a hog request is dominated by
+    # XLA compute (which yields the GIL on the CPU sim, as the device
+    # does on TPU), not by Python-side planning
+    nb = 1_500_000
+    sess.create_or_replace_temp_view("big", sess.create_dataframe(
+        pa.table({"k": rng.integers(0, 24, nb),
+                  "v": rng.integers(1, 1000, nb)})))
+    from spark_rapids_tpu.runtime import obs
+    return sess, obs.state().server.port
+
+
+def hot_vs_uncached(port: int, reps: int) -> dict:
+    # warm the trace cache first so the uncached baseline measures
+    # steady-state execution, not first-run compiles
+    _post(port, {"sql": HOT_SQL, "cache": False})
+    uncached = [_timed(port, {"sql": HOT_SQL, "cache": False})[0]
+                for _ in range(reps)]
+    _post(port, {"sql": HOT_SQL})  # populate the entry
+    hot = [_timed(port, {"sql": HOT_SQL})[0] for _ in range(reps)]
+    p50_u, p50_h = _pct(uncached, 0.5), _pct(hot, 0.5)
+    return {"uncached_p50_ms": round(p50_u, 3),
+            "uncached_p99_ms": round(_pct(uncached, 0.99), 3),
+            "hot_p50_ms": round(p50_h, 3),
+            "hot_p99_ms": round(_pct(hot, 0.99), 3),
+            "hot_speedup_p50": round(p50_u / p50_h, 1)}
+
+
+def mixed_load(port: int, clients: int, per_client: int) -> dict:
+    lat = []
+    docs = []
+    lock = threading.Lock()
+
+    def client(i):
+        for j in range(per_client):
+            if (i + j) % 3 == 0:
+                payload = {"sql": COLD_SQLS[(i + j) % len(COLD_SQLS)],
+                           "cache": False}
+            else:
+                payload = {"sql": HOT_SQL}
+            ms, code, doc = _timed(port, payload)
+            with lock:
+                lat.append(ms)
+                if code == 200:
+                    docs.append(doc)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(600)
+    window = time.perf_counter() - t0
+
+    # per-bucket p99 over the EXECUTED requests: the response docs carry
+    # the attribution breakdown, so the tail explains itself
+    buckets = {}
+    for d in docs:
+        attr = d.get("attribution") or {}
+        for name, secs in (attr.get("buckets") or {}).items():
+            buckets.setdefault(name, []).append(secs * 1e3)
+    hits = sum(1 for d in docs if d["cache"] == "hit")
+    return {
+        "clients": clients,
+        "requests": len(lat),
+        "window_s": round(window, 3),
+        "qps": round(len(lat) / window, 1),
+        "p50_ms": round(_pct(lat, 0.5), 3),
+        "p99_ms": round(_pct(lat, 0.99), 3),
+        "cache_hits": hits,
+        "executed": len(docs) - hits,
+        "attribution_p99_ms": {
+            name: round(_pct(ms, 0.99), 3)
+            for name, ms in sorted(buckets.items())},
+    }
+
+
+def quota_isolation(port: int, samples: int, hogs: int) -> dict:
+    # the neighbor is a realistic tenant: mostly hot-path hits with an
+    # uncached query every 5th request, so its p99 lands on real device
+    # work — the thing the hog's QoS tier must yield to (on one core, a
+    # 2ms cache hit's tail is pure GIL scheduling noise either way; a
+    # 75ms device query measures the isolation the engine provides)
+    uncached = {"sql": COLD_SQLS[0], "cache": False}
+
+    def neighbor_pass():
+        # paced 5ms between requests so the pass samples the window
+        out = []
+        for i in range(samples):
+            payload = uncached if i % 5 == 4 else {"sql": HOT_SQL}
+            ms, code, _doc = _timed(port, payload)
+            if code == 200:
+                out.append(ms)
+            time.sleep(0.005)
+        return out
+
+    # the hog declares itself background tier: a device budget bounds
+    # its memory pressure, small reader batches slice its scan into
+    # short dispatches and pipeline overlap is off (so the in-order
+    # device queue DRAINS between hog batches instead of sitting
+    # behind one long kernel or a prefetched lookahead when a neighbor
+    # dispatch arrives), and requestNice=19 runs its requests — wave
+    # tasks and pool work included, via the host_pool QoS propagation —
+    # at low OS priority so its host phases yield the core too; with
+    # concurrentTpuTasks=2 a single hog never exhausts the device
+    # semaphore, so the neighbor's uncached queries admit immediately
+    hog_payload = {
+        "sql": HOG_SQL, "cache": False, "session": "hog",
+        "conf": {"spark.rapids.query.deviceBudgetBytes": str(192 << 20),
+                 "spark.rapids.sql.reader.batchSizeRows": str(16384),
+                 "spark.rapids.sql.pipeline.enabled": "false",
+                 "spark.rapids.serving.requestNice": "19"}}
+    # warm every measured path out of the windows: first runs pay
+    # Python tracing + XLA compile that steady state never replays
+    _post(port, {"sql": HOT_SQL})
+    _post(port, uncached)
+    _post(port, hog_payload)
+    solo = neighbor_pass()
+
+    stop = threading.Event()
+    hog_counts = [0]
+
+    def hog():
+        while not stop.is_set():
+            code, _ = _post(port, hog_payload)
+            if code == 200:
+                hog_counts[0] += 1
+
+    threads = [threading.Thread(target=hog) for _ in range(hogs)]
+    for th in threads:
+        th.start()
+    time.sleep(1.0)  # hogs properly under way
+    loaded = neighbor_pass()
+    stop.set()
+    for th in threads:
+        th.join(120)
+
+    p99_solo, p99_loaded = _pct(solo, 0.99), _pct(loaded, 0.99)
+    return {"neighbor_samples": samples, "hog_clients": hogs,
+            "hog_requests_completed": hog_counts[0],
+            "neighbor_solo_p50_ms": round(_pct(solo, 0.5), 3),
+            "neighbor_solo_p99_ms": round(p99_solo, 3),
+            "neighbor_loaded_p50_ms": round(_pct(loaded, 0.5), 3),
+            "neighbor_loaded_p99_ms": round(p99_loaded, 3),
+            "neighbor_p99_ratio": round(p99_loaded / p99_solo, 3)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--per-client", type=int, default=12)
+    ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--samples", type=int, default=200)
+    ap.add_argument("--hogs", type=int, default=1)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "SERVING_r01.json"))
+    args = ap.parse_args()
+
+    # serving-process thread fairness: the default 5ms GIL switch
+    # interval lets one executing request stall a concurrent hot-path
+    # request for whole scheduling quanta; a latency-serving process
+    # runs with a tighter interval (recorded in the artifact)
+    sys.setswitchinterval(0.001)
+
+    port = _free_port()
+    _sess, port = boot(port)
+
+    print("[1/3] hot-path vs uncached p50...", flush=True)
+    hot = hot_vs_uncached(port, args.reps)
+    print(f"  {hot}")
+
+    print(f"[2/3] mixed hot/cold load, {args.clients} clients...",
+          flush=True)
+    load = mixed_load(port, args.clients, args.per_client)
+    print(f"  {load}")
+
+    print(f"[3/3] quota isolation ({args.hogs} hogs vs 1 neighbor)...",
+          flush=True)
+    iso = quota_isolation(port, args.samples, args.hogs)
+    print(f"  {iso}")
+
+    from spark_rapids_tpu.runtime import serving
+    result = {
+        "bench": "serving_load",
+        "round": 17,
+        "backend": "cpu-sim",
+        "hot_vs_uncached": hot,
+        "mixed_load": load,
+        "quota_isolation": iso,
+        "server": serving.server_doc(),
+        "acceptance": {
+            "hot_speedup_p50_ge_10x":
+                hot["hot_speedup_p50"] >= 10.0,
+            "neighbor_p99_ratio_le_1_25":
+                iso["neighbor_p99_ratio"] <= 1.25,
+            "clients_ge_8": load["clients"] >= 8,
+        },
+    }
+    out = os.path.abspath(args.out)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {out}")
+    ok = all(result["acceptance"].values())
+    print(f"bench_serving: {'PASS' if ok else 'FAIL'} "
+          f"{result['acceptance']}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
 # chaos smoke, obs smoke, flight smoke, pipeline smoke, compile smoke,
-# audit smoke, aqe smoke, decode smoke, tier-1 tests.
+# audit smoke, aqe smoke, decode smoke, serving smoke, tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
@@ -85,6 +85,11 @@ fi
 
 step "decode smoke (device-side parquet decode: probe-query parity on/off byte-identical, encoded<decoded bytes shift with per-column string fallback, DeviceDecodeScanExec fused into the stage, disabled-path conf gate <2% by count x delta)"
 if ! python tools/decode_smoke.py; then
+    fail=1
+fi
+
+step "serving smoke (query server: 4 concurrent clients byte-identical to solo, saturated intake 429 + HTTP cancel 499, replica warm-boot zero backend compiles on the first hot-digest request, disabled-path install read <2% by count x delta)"
+if ! python tools/serving_smoke.py; then
     fail=1
 fi
 

@@ -304,10 +304,12 @@ def render_live_console(engine_url: str, refresh_seconds: int = 2) -> str:
 <h1>spark-rapids-tpu live console</h1>
 <p><small>engine <code>{html.escape(eng)}</code> · refresh
 {refresh_seconds}s · <a href='{html.escape(eng)}/console'>server-rendered
-view</a> · <a href='index.html'>&larr; history</a></small></p>
+view</a> · <a href='{html.escape(eng)}/serving'>serving doc</a> ·
+<a href='index.html'>&larr; history</a></small></p>
 <p id='err'></p>
 <h2>Running queries</h2><div id='running'>-</div>
 <h2>Last completed</h2><div id='last'>-</div>
+<h2>Serving</h2><div id='serving'>-</div>
 <h2>Resources (latest samples)</h2><div id='sampler'>-</div>
 <script>
 const ENG = {json.dumps(eng)};
@@ -335,6 +337,22 @@ async function tick() {{
     document.getElementById("last").innerHTML =
       table(q.last_completed ? [q.last_completed] : []);
     const hz = await (await fetch(ENG + "/healthz")).json().catch(e => null);
+    if (hz && hz.serving) {{
+      const s = hz.serving, rc = s.result_cache || {{}};
+      document.getElementById("serving").innerHTML =
+        "<table><tr><th>active</th><th>queue depth</th><th>sessions</th>"
+        + "<th>requests</th><th>rejected</th><th>cache hit ratio</th></tr>"
+        + "<tr><td class='num'>" + s.active_requests + "/" + s.max_inflight
+        + "</td><td class='num'>" + s.queue_depth
+        + "</td><td class='num'>" + s.sessions + "/" + s.max_sessions
+        + "</td><td class='num'>" + s.requests
+        + "</td><td class='num'>" + s.rejected
+        + "</td><td class='num'>" + (rc.hit_ratio || 0).toFixed(2)
+        + "</td></tr></table>";
+    }} else {{
+      document.getElementById("serving").innerHTML =
+        "<p>serving layer off (spark.rapids.serving.enabled)</p>";
+    }}
     if (hz && hz.sampler && hz.sampler.latest) {{
       const rows = Object.entries(hz.sampler.latest).map(
         ([k, v]) => "<tr><td>" + k + "</td><td class='num'>" + v
