@@ -96,6 +96,16 @@ TPU-L014  every HTTP route literal the obs endpoint's handlers compare
           carries mutating routes (POST /sql, POST
           /queries/<id>/cancel), so an undocumented or drifted route is
           an invisible API surface (the L007-L013 roster pattern).
+TPU-L015  every serving request-span literal at a ``request_span("...")``
+          call site must be a key of the ``REQUEST_SPANS`` roster in
+          ``runtime/obs/reqtrace.py``, and every sampling-verdict
+          literal at a ``_v("...")`` checkpoint (the verdict-decision
+          shape, scoped to runtime/obs/ + runtime/serving/) must be a
+          key of its ``VERDICTS`` roster — both with stale-entry and
+          docs-presence halves. A request's exported timeline and the
+          rapids_reqtrace_verdicts_total counter are operator-facing
+          vocabularies: an unrostered name is an invisible phase or an
+          uncountable verdict (the L007-L014 roster pattern).
 
 Suppression
 -----------
@@ -147,6 +157,10 @@ RULES: Dict[str, str] = {
                 "(or a stale/undocumented roster entry)",
     "TPU-L014": "HTTP route literal not registered in the "
                 "runtime/obs/endpoint.py ROUTES roster (or a "
+                "stale/undocumented roster entry)",
+    "TPU-L015": "serving request-span / sampling-verdict literal not "
+                "registered in the runtime/obs/reqtrace.py "
+                "REQUEST_SPANS / VERDICTS roster (or a "
                 "stale/undocumented roster entry)",
 }
 
@@ -266,7 +280,9 @@ class _FileLinter(ast.NodeVisitor):
                  known_states: Optional[Set[str]] = None,
                  known_series: Optional[Set[str]] = None,
                  kernel_modules: Optional[Set[str]] = None,
-                 known_routes: Optional[Set[str]] = None):
+                 known_routes: Optional[Set[str]] = None,
+                 known_request_spans: Optional[Set[str]] = None,
+                 known_verdicts: Optional[Set[str]] = None):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
@@ -277,6 +293,12 @@ class _FileLinter(ast.NodeVisitor):
         self.known_series = known_series
         self.kernel_modules = kernel_modules
         self.known_routes = known_routes
+        self.known_request_spans = known_request_spans
+        self.known_verdicts = known_verdicts
+        #: literals actually used at request_span()/_v() call sites —
+        #: lint_tree aggregates these for the TPU-L015 stale half
+        self.used_request_spans: Set[str] = set()
+        self.used_verdicts: Set[str] = set()
         self.violations: List[Violation] = []
         # stack of (lock_keys, with_lineno) for held-lock regions
         self._lock_stack: List[Tuple[Set[str], int]] = []
@@ -443,6 +465,7 @@ class _FileLinter(ast.NodeVisitor):
         self._check_compile_entry(node)
         self._check_kernel_roster(node)
         self._check_unbounded_wait(node)
+        self._check_reqtrace_names(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -482,6 +505,51 @@ class _FileLinter(ast.NodeVisitor):
                            f"in the runtime/obs/endpoint.py ROUTES "
                            f"roster — register it so the endpoint index "
                            f"and generated docs stay complete")
+
+    # -- TPU-L015 ----------------------------------------------------------
+
+    def _check_reqtrace_names(self, node: ast.Call) -> None:
+        """A ``request_span("...")`` literal names a phase of every
+        request's exported timeline; a ``_v("...")`` literal (the
+        verdict-decision checkpoint shape, meaningful only in the
+        reqtrace/serving modules) names a tail-sampling outcome. Both
+        vocabularies are operator-facing — they must live in the
+        reqtrace rosters or they are invisible to the fleet tooling and
+        the generated docs."""
+        term = _terminal(node.func)
+        if term == "request_span":
+            # the module-level helper takes the name first; the
+            # recorder method takes (ctx, name) — scan string-literal
+            # positionals so both shapes register
+            for arg in node.args[:2]:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    self.used_request_spans.add(arg.value)
+                    if self.known_request_spans is not None \
+                            and arg.value not in self.known_request_spans:
+                        self._emit(
+                            "TPU-L015", node,
+                            f"request span {arg.value!r} is not "
+                            f"registered in the runtime/obs/reqtrace.py "
+                            f"REQUEST_SPANS roster — register it so "
+                            f"per-request timelines and generated docs "
+                            f"stay complete")
+        elif term == "_v" and (
+                "runtime/obs/" in self.relpath
+                or "runtime/serving/" in self.relpath):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    self.used_verdicts.add(arg.value)
+                    if self.known_verdicts is not None \
+                            and arg.value not in self.known_verdicts:
+                        self._emit(
+                            "TPU-L015", node,
+                            f"sampling verdict {arg.value!r} is not "
+                            f"registered in the runtime/obs/reqtrace.py "
+                            f"VERDICTS roster — register it so the "
+                            f"verdict counter and generated docs stay "
+                            f"complete")
 
     # -- TPU-L002 ----------------------------------------------------------
 
@@ -929,6 +997,22 @@ def endpoint_served_routes(path: str) -> Set[str]:
     return served
 
 
+def known_request_spans(pkg_root: str) -> Set[str]:
+    """Registered serving request-span names: the keys of the
+    REQUEST_SPANS dict literal in runtime/obs/reqtrace.py."""
+    return _dict_literal_keys(
+        os.path.join(pkg_root, "runtime", "obs", "reqtrace.py"),
+        "REQUEST_SPANS")
+
+
+def known_reqtrace_verdicts(pkg_root: str) -> Set[str]:
+    """Registered tail-sampling verdicts: the keys of the VERDICTS dict
+    literal in runtime/obs/reqtrace.py."""
+    return _dict_literal_keys(
+        os.path.join(pkg_root, "runtime", "obs", "reqtrace.py"),
+        "VERDICTS")
+
+
 def known_kernel_primitives(pkg_root: str) -> Set[str]:
     """Registered kernel-emitting modules: the keys of the
     KERNEL_PRIMITIVES dict literal in analysis/kernel_audit.py."""
@@ -1017,8 +1101,10 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                 known_states: Optional[Set[str]] = None,
                 known_series: Optional[Set[str]] = None,
                 kernel_modules: Optional[Set[str]] = None,
-                known_routes: Optional[Set[str]] = None
-                ) -> List[Violation]:
+                known_routes: Optional[Set[str]] = None,
+                known_request_spans: Optional[Set[str]] = None,
+                known_verdicts: Optional[Set[str]] = None,
+                collect: Optional[dict] = None) -> List[Violation]:
     tree = ast.parse(source, path)
     linter = _FileLinter(path, source, known_metrics,
                          relpath if relpath is not None else path,
@@ -1028,8 +1114,16 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                          known_states=known_states,
                          known_series=known_series,
                          kernel_modules=kernel_modules,
-                         known_routes=known_routes)
+                         known_routes=known_routes,
+                         known_request_spans=known_request_spans,
+                         known_verdicts=known_verdicts)
     linter.visit(tree)
+    if collect is not None:
+        # cross-file usage aggregation (the TPU-L015 stale half needs
+        # every call site in the tree, not just this file's)
+        collect.setdefault("request_spans", set()).update(
+            linter.used_request_spans)
+        collect.setdefault("verdicts", set()).update(linter.used_verdicts)
     return linter.violations
 
 
@@ -1046,6 +1140,9 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
     series = known_sampler_series(pkg_root)
     kernel_mods = known_kernel_primitives(pkg_root)
     routes = known_http_routes(pkg_root)
+    req_spans = known_request_spans(pkg_root)
+    verdicts = known_reqtrace_verdicts(pkg_root)
+    used: dict = {}
     violations: List[Violation] = []
     n_files = 0
     for dirpath, dirnames, filenames in os.walk(pkg_root):
@@ -1061,7 +1158,9 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 known_sites=sites, known_buckets=buckets,
                 pallas_modules=pallas_mods,
                 known_states=states, known_series=series,
-                kernel_modules=kernel_mods, known_routes=routes))
+                kernel_modules=kernel_mods, known_routes=routes,
+                known_request_spans=req_spans, known_verdicts=verdicts,
+                collect=used))
     # the stale half of TPU-L013: a roster entry whose module no longer
     # exists or no longer emits kernels claims audit coverage that
     # isn't there
@@ -1093,6 +1192,19 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 f"ROUTES roster entry {route!r} matches no handler "
                 f"path comparison in runtime/obs/endpoint.py — stale "
                 f"entry"))
+    # the stale half of TPU-L015: a roster entry no request_span()/_v()
+    # call site uses claims a timeline phase / verdict that never occurs
+    rtpath = os.path.join(pkg_root, "runtime", "obs", "reqtrace.py")
+    for name in sorted(req_spans - used.get("request_spans", set())):
+        violations.append(Violation(
+            "TPU-L015", rtpath, 1,
+            f"REQUEST_SPANS roster entry {name!r} matches no "
+            f"request_span(...) call site — stale entry"))
+    for name in sorted(verdicts - used.get("verdicts", set())):
+        violations.append(Violation(
+            "TPU-L015", rtpath, 1,
+            f"VERDICTS roster entry {name!r} matches no _v(...) "
+            f"verdict checkpoint — stale entry"))
     documented = docs_metric_names(repo_root)
     mpath = os.path.join(pkg_root, "runtime", "metrics.py")
     if documented is None:
@@ -1137,6 +1249,16 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 "TPU-L014", eppath, 1,
                 f"HTTP route {route!r} absent from docs/metrics.md — "
                 f"regenerate with 'python tools/gen_docs.py'"))
+        for name in sorted(req_spans - documented):
+            violations.append(Violation(
+                "TPU-L015", rtpath, 1,
+                f"request span {name!r} absent from docs/metrics.md — "
+                f"regenerate with 'python tools/gen_docs.py'"))
+        for name in sorted(verdicts - documented):
+            violations.append(Violation(
+                "TPU-L015", rtpath, 1,
+                f"sampling verdict {name!r} absent from docs/metrics.md "
+                f"— regenerate with 'python tools/gen_docs.py'"))
     stats = {
         "files": n_files,
         "violations": sum(1 for v in violations if not v.suppressed),

@@ -257,6 +257,64 @@ OBS_FLIGHT_MAX_DUMPS = conf_int(
     "spark.rapids.obs.flight.path; older ones are pruned after each "
     "dump.")
 
+OBS_REQTRACE_ENABLED = conf_bool(
+    "spark.rapids.obs.reqtrace.enabled", False,
+    "Run the per-request tail-sampled tracer "
+    "(runtime/obs/reqtrace.py): every serving request buffers its span "
+    "tree (serving spans + the engine exec spans of its query, joined "
+    "by query id) in a bounded per-request ring fed from the SAME "
+    "instrumentation points the flight recorder uses. At request end a "
+    "sampling verdict either drops the buffer or exports a "
+    "self-contained per-request timeline (Chrome-trace + an OTLP-JSON-"
+    "shaped file) under reqtrace.path. Errors, cancellations, "
+    "deadlines, SLO breaches and runs slower than the digest baseline "
+    "are ALWAYS kept; ordinary requests and hot cache hits sample at "
+    "reqtrace.sampleRatio. The disabled path is one module-global "
+    "read; armed overhead is gated <2% by tools/reqtrace_smoke.py.",
+    commonly_used=True)
+
+OBS_REQTRACE_PATH = conf_str(
+    "spark.rapids.obs.reqtrace.path", "/tmp/rapids_tpu_reqtrace",
+    "Directory receiving per-request timeline exports "
+    "(req_<seq>_<verdict>_<trace_id>.json Chrome-trace files plus the "
+    "matching req_<seq>_<verdict>_<trace_id>.otlp.json OTLP-JSON-"
+    "shaped file).")
+
+OBS_REQTRACE_EVENTS = conf_int(
+    "spark.rapids.obs.reqtrace.events", 4096,
+    "Per-request ring capacity: how many span/instant events one "
+    "request retains for its timeline. Older events are overwritten; "
+    "the export reports how many were dropped.")
+
+OBS_REQTRACE_SAMPLE_RATIO = conf_float(
+    "spark.rapids.obs.reqtrace.sampleRatio", 0.01,
+    "Probability that an ordinary successful request (including a hot "
+    "result-cache hit) exports its timeline. Error/cancelled/deadline/"
+    "SLO-breach/slower-than-baseline requests always export regardless "
+    "of this ratio. 0 keeps only the always-keep classes.")
+
+OBS_REQTRACE_MIN_INTERVAL_S = conf_float(
+    "spark.rapids.obs.reqtrace.minIntervalSeconds", 1.0,
+    "Rate limit between per-request timeline exports: a failure storm "
+    "exports at most one timeline per interval (always-keep verdicts "
+    "and sampled keeps alike). 0 disables the limit (tests).")
+
+OBS_REQTRACE_MAX_DUMPS = conf_int(
+    "spark.rapids.obs.reqtrace.maxDumps", 100,
+    "Bounded retention: only the newest N per-request exports (Chrome "
+    "+ OTLP pairs) are kept in spark.rapids.obs.reqtrace.path; older "
+    "ones are pruned after each export.")
+
+OBS_REPLICA_ID = conf_str(
+    "spark.rapids.obs.replicaId", "",
+    "Stable identity of THIS serving replica in a fleet sharing one "
+    "spark.rapids.obs.historyDir. Stamped into every query history "
+    "record, response doc and per-request timeline so "
+    "tools/fleet_report.py can split a digest's latency/compile/cache "
+    "profile per replica. Empty (the default) derives pid-<os pid>, "
+    "which is unique per process but not stable across restarts.",
+    commonly_used=True)
+
 OBS_SLO_ENABLED = conf_bool(
     "spark.rapids.obs.slo.enabled", True,
     "Check every successful top-level query against its SLO "

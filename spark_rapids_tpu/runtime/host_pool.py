@@ -152,6 +152,9 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
     # way the conf fingerprint does: a task constructed on a wave thread
     # must attribute to the query that fanned it out
     qid = _live.current_query_id()
+    # ... and so does the serving request context (distributed tracing):
+    # spans a wave thread emits must land in the request's ring
+    rctx = _live.current_request()
     nice = qos_nice()
 
     def bound(item):
@@ -161,6 +164,8 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
             _attr.set_thread_suppressed(True)
         if qid is not None:
             _live.bind(qid)
+        if rctx is not None:
+            _live.bind_request(rctx)
         try:
             # wave-start cooperative checkpoint: partitions of an
             # already-cancelled query unwind before doing any work
@@ -169,6 +174,8 @@ def run_task_wave(fn, items, max_concurrency: int = 16) -> list:
                 return run_at_nice(nice, fn, item)
             return fn(item)
         finally:
+            if rctx is not None:
+                _live.bind_request(None)
             if qid is not None:
                 _live.bind(None)
 
@@ -241,6 +248,15 @@ class HostTaskPool:
 
             def fn(*a):  # noqa: F811 - bound wrapper replaces fn
                 return _live.run_bound(qid, inner_fn, *a)
+        # the submitter's serving request context rides the same seam
+        # (distributed tracing): prefetch/serde/decode spans run on a
+        # shared worker still land in the request's ring
+        rctx = _live.current_request()
+        if rctx is not None:
+            req_fn = fn
+
+            def fn(*a):  # noqa: F811 - request-bound wrapper replaces fn
+                return _live.run_request_bound(rctx, req_fn, *a)
         # the submitter's QoS tier rides along the same way: background
         # requests keep their raised niceness on whichever worker runs
         # the task (restored after, so shared workers aren't poisoned)

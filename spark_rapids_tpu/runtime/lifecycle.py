@@ -41,9 +41,10 @@ query *cooperatively* killable:
 Overhead discipline (the trace/flight/live bar, gated by
 tools/chaos_smoke.py on the count-times-delta methodology):
 :func:`check_current` with no query in flight is ONE module-global dict
-truthiness read; with queries in flight it is a fault-site global read, a
-thread-local read, one dict get and a branch. Registration happens once
-per query, never per batch.
+truthiness read (two within ~60s of a cancel, while the orphan-worker
+tombstones drain); with queries in flight it is a fault-site global
+read, a thread-local read, one dict get and a branch. Registration
+happens once per query, never per batch.
 """
 from __future__ import annotations
 
@@ -172,6 +173,22 @@ _CANCELLED_TOTAL = 0
 #: (query_id, reason, seconds from cancel() to terminal) of recent
 #: cancels — the chaos latency gate reads this
 _LAST_LATENCIES: List[tuple] = []
+#: recently-cancelled query ids -> (reason, finishing thread id): the
+#: orphaned-worker hole. finish_action pops the token BEFORE a cancelled
+#: query's pool workers finish unwinding, so an orphan's next
+#: check_current() used to silently return (token gone) and the task ran
+#: on — worst case parking forever on a bounded handoff with no consumer
+#: while holding its semaphore permit (the tier-1 test_cancel teardown
+#: leak). Tombstoned qids still raise at the checkpoint — EXCEPT on the
+#: finishing thread itself, whose observability epilogue (metric
+#: snapshots, history writes) must never re-raise the cancel. Bounded
+#: insertion-ordered ring: 64 entries outlive any unwind window without
+#: growing with query count, and begin_action drops entries older than
+#: the TTL so a long-running engine's checkpoint fast path returns to
+#: the single-read disarmed cost once the unwind window has passed.
+_TOMBSTONES: Dict[int, tuple] = {}
+_TOMBSTONE_CAP = 64
+_TOMBSTONE_TTL_S = 60.0
 
 #: checkpoint-interval probe (chaos only): measures the largest gap
 #: between consecutive check_current() calls of one thread inside one
@@ -206,8 +223,14 @@ def check_current() -> None:
     thread's bound query has been cancelled; otherwise returns. Placed at
     the engine's per-batch choke points (fused dispatch, pipeline refill,
     wave task start, retry backoff, exchange offsets fetch, semaphore
-    acquire). No query in flight: one module-global read."""
+    acquire). No query in flight: one module-global read (plus a second,
+    the tombstone table, only within ~60s of a cancel)."""
     if not _TOKENS:
+        # the registry being empty does NOT mean no orphan: the last
+        # cancelled query's workers may still be unwinding after
+        # finish_action popped their token — the teardown-leak scenario
+        if _TOMBSTONES:
+            _check_tombstone()
         return
     # the query.cancel crossing site: a `cancel`-kind schedule delivers a
     # cancel at a named checkpoint pass (chaos storms use count/skip to
@@ -218,11 +241,27 @@ def check_current() -> None:
         return
     tok = _TOKENS.get(qid)
     if tok is None:
+        _check_tombstone()
         return
     if _PROBE:
         _probe_tick(qid)
     if tok._cancelled:
         raise QueryCancelledError(tok.query_id, tok.reason)
+
+
+def _check_tombstone() -> None:
+    """No live token for this thread's bound qid: either a stale binding
+    (fine) or an orphaned worker of a just-cancelled query whose token
+    finish_action already popped — the tombstone ring tells them apart,
+    and the orphan unwinds here instead of running on. The thread that
+    ran finish_action (and now runs the observability epilogue) is
+    exempt."""
+    qid = _live.current_query_id()
+    if qid is None:
+        return
+    ts = _TOMBSTONES.get(qid)
+    if ts is not None and ts[1] != threading.get_ident():
+        raise QueryCancelledError(qid, ts[0])
 
 
 def cancel(query_id, reason: str = "user") -> bool:
@@ -283,6 +322,14 @@ def begin_action(query_id: Optional[int], conf,
     budget = int(conf.get(C.QUERY_DEVICE_BUDGET) or 0)
     local = query_id is None
     with _LOCK:
+        if _TOMBSTONES:
+            # expire tombstones past the unwind window (insertion order
+            # = age order, so stop at the first fresh entry)
+            cutoff = time.monotonic() - _TOMBSTONE_TTL_S
+            for k, ts in list(_TOMBSTONES.items()):
+                if ts[2] >= cutoff:
+                    break
+                del _TOMBSTONES[k]
         if local:
             _LOCAL_SEQ -= 1
             query_id = _LOCAL_SEQ
@@ -309,7 +356,12 @@ def admit(token: CancelToken, conf) -> None:
     _GATE.configure(limit,
                     int(conf.get(C.QUERY_MAX_QUEUED) or 0),
                     float(conf.get(C.QUERY_QUEUE_TIMEOUT_S) or 0.0))
-    _GATE.acquire(token)
+    # serving-span tree: a /sql request's time parked in the gate is the
+    # "admission_wait" phase of its per-request timeline (no-op unless a
+    # request context is bound — runtime/obs/reqtrace.py)
+    from spark_rapids_tpu.runtime.obs import reqtrace as _rt
+    with _rt.request_span("admission_wait"):
+        _GATE.acquire(token)
 
 
 def finish_action(token: Optional[CancelToken], status: str) -> None:
@@ -322,6 +374,15 @@ def finish_action(token: Optional[CancelToken], status: str) -> None:
         return
     with _LOCK:
         _TOKENS.pop(token.query_id, None)
+        if token.cancelled:
+            # tombstone the qid so orphaned pool workers still observe
+            # the cancel at their next checkpoint (this thread — which
+            # runs the epilogue — is exempt; see _TOMBSTONES)
+            _TOMBSTONES[token.query_id] = (token.reason or "user",
+                                           threading.get_ident(),
+                                           time.monotonic())
+            while len(_TOMBSTONES) > _TOMBSTONE_CAP:
+                _TOMBSTONES.pop(next(iter(_TOMBSTONES)))
     _GATE.forget(token)
     if token.local:
         _live.bind(None)
@@ -372,19 +433,29 @@ _SWEEPER_STOP = threading.Event()
 
 
 def _ensure_sweeper() -> None:
-    global _SWEEPER
+    global _SWEEPER, _SWEEPER_STOP
     with _LOCK:
-        if _SWEEPER is not None and _SWEEPER.is_alive():
+        if (_SWEEPER is not None and _SWEEPER.is_alive()
+                and not _SWEEPER_STOP.is_set()):
+            # a live sweeper whose stop event fired is a CONDEMNED
+            # generation draining out — spawn a fresh one past it
             return
-        _SWEEPER_STOP.clear()
+        # each sweeper generation owns its OWN stop event. Clearing a
+        # shared event here used to resurrect a previous sweeper that
+        # reset_for_tests had stopped but that hadn't yet observed the
+        # set (join(2) can time out under full-suite load) — the zombie
+        # then swept a LATER test's tokens (the second half of the
+        # tier-1 test_cancel teardown flake).
+        stop = threading.Event()
+        _SWEEPER_STOP = stop
         from spark_rapids_tpu.runtime.host_pool import spawn_service_thread
-        _SWEEPER = spawn_service_thread(_sweep_loop,
+        _SWEEPER = spawn_service_thread(lambda: _sweep_loop(stop),
                                         name="rapids-query-deadline")
 
 
-def _sweep_loop() -> None:
+def _sweep_loop(stop: threading.Event) -> None:
     global _SWEEPER
-    while not _SWEEPER_STOP.wait(_SWEEP_INTERVAL_S):
+    while not stop.wait(_SWEEP_INTERVAL_S):
         now = time.monotonic()
         armed = False
         for tok in list(_TOKENS.values()):
@@ -404,7 +475,10 @@ def _sweep_loop() -> None:
             with _LOCK:
                 if any(t.deadline_at for t in _TOKENS.values()):
                     continue
-                _SWEEPER = None
+                if _SWEEPER is threading.current_thread():
+                    # a replaced generation must not clear the handle of
+                    # the sweeper that superseded it
+                    _SWEEPER = None
                 return
 
 
@@ -558,6 +632,7 @@ def reset_for_tests() -> None:
     global _SWEEPER, _REJECTED, _CANCELLED_TOTAL, _PROBE, _PROBE_MAX
     with _LOCK:
         _TOKENS.clear()
+        _TOMBSTONES.clear()
         _LAST_LATENCIES.clear()
         _REJECTED = 0
         _CANCELLED_TOTAL = 0

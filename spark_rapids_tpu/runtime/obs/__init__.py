@@ -29,7 +29,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-from spark_rapids_tpu.runtime.obs import attribution, flight, live, sampler
+from spark_rapids_tpu.runtime.obs import (attribution, flight, live,
+                                          reqtrace, sampler)
 from spark_rapids_tpu.runtime.obs.history import (  # noqa: F401 (re-export)
     QueryHistoryStore, build_query_record, conf_delta, plan_digest,
 )
@@ -93,6 +94,10 @@ class ObsState:
         #: the most recent audited query's roofline doc (analysis/
         #: kernel_audit.py) — the /console roofline table reads this
         self.last_roofline: Optional[dict] = None
+        #: this process's fleet identity (spark.rapids.obs.replicaId, or
+        #: pid-derived) — stamped on every history record so a shared
+        #: historyDir splits per replica (tools/fleet_report.py)
+        self.replica_id: str = ""
 
 
 #: per-thread collect depth: a re-entrant collect on the SAME thread is
@@ -231,6 +236,9 @@ def _preregister(reg: MetricsRegistry) -> None:
                   labels={"group": group})
     reg.histogram("rapids_query_wall_time_ms",
                   "Per-query wall time (ms)")
+    reg.histogram("rapids_serving_request_ms",
+                  "Per-request serving wall time (ms), intake to "
+                  "response doc; buckets carry reqtrace exemplars")
     reg.histogram("rapids_task_duration_ms", "Per-task duration (ms)")
     reg.gauge("rapids_max_device_bytes_held",
               "High-water mark of registered device bytes (any task)")
@@ -309,6 +317,9 @@ def install(conf) -> "Optional[ObsState]":
     # (like the flight recorder) even with the live layer off, so every
     # flight dump carries its promised counter tracks
     sampler.maybe_install(conf)
+    # per-request tail-sampled tracing (opt-in:
+    # spark.rapids.obs.reqtrace.enabled) — its own conf's concern too
+    reqtrace.maybe_install(conf)
     if not conf.get(Cf.OBS_ENABLED):
         return _STATE
     with _STATE_LOCK:
@@ -326,6 +337,10 @@ def install(conf) -> "Optional[ObsState]":
                 lg.addFilter(live.QueryLogFilter())
             _STATE = st
         st.progress_enabled = bool(conf.get(Cf.OBS_PROGRESS_ENABLED))
+        if not st.replica_id:
+            import os as _os
+            st.replica_id = (conf.get(Cf.OBS_REPLICA_ID)
+                             or f"pid-{_os.getpid()}")
         hist_dir = conf.get(Cf.OBS_HISTORY_DIR)
         if hist_dir and st.history is None:
             st.history = QueryHistoryStore(hist_dir)
@@ -518,11 +533,21 @@ def on_query_end(token, *, session, plan, status: str,
     except Exception:  # noqa: BLE001 - the registry must never fail a
         pass  # query epilogue
     live.bind(None)
+    # distributed tracing: the epilogue runs on the request's handler
+    # thread, so the bound serving request (if any) learns its query's
+    # live id here — the join key between its serving span tree and the
+    # engine exec spans sharing its ring
+    rctx = live.current_request()
+    if rctx is not None and isinstance(token, int):
+        rctx.query_id = token
     reg = st.registry
     try:
         reg.counter("rapids_queries_total",
                     labels={"status": status}).inc()
-        reg.histogram("rapids_query_wall_time_ms").observe(duration_ns / 1e6)
+        reg.histogram("rapids_query_wall_time_ms").observe(
+            duration_ns / 1e6,
+            exemplar=({"trace_id": rctx.trace_id}
+                      if rctx is not None else None))
         if attribution_doc:
             for phase, secs in attribution_doc.get("buckets", {}).items():
                 if secs:
@@ -570,6 +595,9 @@ def on_query_end(token, *, session, plan, status: str,
         breach = None
         if st.slo is not None and status == "ok" and digest:
             breach = st.slo.record(digest, duration_ns / 1e9)
+        if rctx is not None and breach is not None:
+            # the request's tail-sampling verdict must see the breach
+            rctx.slo_breach = True
         if breach is not None:
             if attribution_doc is None:
                 # no rollup consumer took a snapshot for this query —
@@ -622,7 +650,9 @@ def on_query_end(token, *, session, plan, status: str,
                 snaps=snaps, degraded_reason=degraded_reason,
                 attribution=attribution_doc, roofline=roofline_doc,
                 aqe=aqe_doc, slo_breach=breach,
-                flight_dump=flight_dump, digest=digest)
+                flight_dump=flight_dump, digest=digest,
+                replica_id=st.replica_id or None,
+                trace_id=rctx.trace_id if rctx is not None else None)
             st.history.append(rec)
         st.last_query = {
             "query_id": token, "status": status,

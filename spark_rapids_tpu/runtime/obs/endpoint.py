@@ -219,9 +219,29 @@ class ObsHttpServer:
                                 "error_type": "ValueError",
                                 "message": "body must be a JSON object"}
                         else:
+                            # W3C trace-context propagation: the caller's
+                            # traceparent header rides into the serving
+                            # layer (which honors a valid one and mints
+                            # otherwise — runtime/obs/reqtrace.py)
+                            tp = self.headers.get("traceparent")
+                            if tp is not None:
+                                payload["_traceparent"] = tp
                             code, doc = outer._sql(payload)
-                        self._send(code, json.dumps(doc).encode(),
-                                   "application/json")
+                        body = json.dumps(doc).encode()
+                        self.send_response(code)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        if cors_origin:
+                            self.send_header(
+                                "Access-Control-Allow-Origin",
+                                cors_origin)
+                        if isinstance(doc, dict) and doc.get("traceparent"):
+                            self.send_header("traceparent",
+                                             doc["traceparent"])
+                        self.end_headers()
+                        self.wfile.write(body)
                     except Exception as e:  # noqa: BLE001 - must answer
                         self._send(500, f"error: {e}\n".encode(),
                                    "text/plain")

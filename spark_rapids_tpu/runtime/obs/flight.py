@@ -48,6 +48,10 @@ from spark_rapids_tpu.analysis import sanitizer as _san
 # flight hot path's whole budget is a tuple store, so this is the only
 # addition the correlation layer makes to it)
 from spark_rapids_tpu.runtime.obs import live as _live
+# per-request tail sampling rides the SAME entry point: an event landing
+# in the flight ring also lands in the bound request's ring
+# (reqtrace._REC is None when reqtrace is off — one module-global read)
+from spark_rapids_tpu.runtime.obs import reqtrace as _reqtrace
 
 log = logging.getLogger("spark_rapids_tpu")
 
@@ -145,9 +149,12 @@ class FlightRecorder:
             r = self._tls.ring
         except AttributeError:
             r = self._new_ring()
-        r.buf[r.idx % r.cap] = (name, cat, t0_ns, dur_ns, args,
-                                _live.current_query_id())
+        qid = _live.current_query_id()
+        r.buf[r.idx % r.cap] = (name, cat, t0_ns, dur_ns, args, qid)
         r.idx += 1
+        rr = _reqtrace._REC
+        if rr is not None:
+            rr.feed(name, cat, t0_ns, dur_ns, args, qid)
 
     def instant(self, name: str, cat: str,
                 args: Optional[dict] = None) -> None:

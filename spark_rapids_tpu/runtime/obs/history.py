@@ -124,7 +124,9 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
                        aqe: Optional[dict] = None,
                        slo_breach: Optional[dict] = None,
                        flight_dump: Optional[str] = None,
-                       digest: Optional[str] = None) -> dict:
+                       digest: Optional[str] = None,
+                       replica_id: Optional[str] = None,
+                       trace_id: Optional[str] = None) -> dict:
     """Assemble one history record from a finished action's state. Every
     sub-extraction is best-effort: history must never fail a query.
     `snaps` is the caller's last_metrics() snapshot when it already took
@@ -141,6 +143,14 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
         "duration_ns": int(duration_ns),
         "status": status,
     }
+    if replica_id is not None:
+        # fleet identity: which replica of a shared historyDir ran this
+        # query (tools/fleet_report.py splits per-digest stats by it)
+        rec["replica_id"] = replica_id
+    if trace_id is not None:
+        # the W3C trace id of the serving request that carried this
+        # query — the history<->reqtrace-timeline join key
+        rec["trace_id"] = trace_id
     if degraded_reason is not None:
         rec["degraded_reason"] = degraded_reason
     if attribution is not None:

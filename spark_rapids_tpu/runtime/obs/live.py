@@ -128,6 +128,33 @@ def run_bound(qid: Optional[int], fn, *args):
         bind(prev)
 
 
+def current_request():
+    """The RequestContext (runtime/obs/reqtrace.py) bound to THIS
+    thread — None outside any serving request's work. One thread-local
+    read, the same budget as current_query_id()."""
+    return getattr(_TLS, "req", None)
+
+
+def bind_request(rctx):
+    """Bind a serving RequestContext to this thread; returns the
+    previous binding so pool workers (which outlive any one request)
+    can restore it. Rides the exact conf/query-id seams: task waves,
+    HostTaskPool submits, pipeline refills."""
+    prev = getattr(_TLS, "req", None)
+    _TLS.req = rctx
+    return prev
+
+
+def run_request_bound(rctx, fn, *args):
+    """Run fn(*args) with rctx bound to this thread, restoring the
+    previous binding after (the host-pool submit wrapper)."""
+    prev = bind_request(rctx)
+    try:
+        return fn(*args)
+    finally:
+        bind_request(prev)
+
+
 class QueryLogFilter:
     """logging.Filter stamping the thread's bound query id onto every
     record as ``record.query_id`` ("-" when unbound), so any formatter
@@ -382,3 +409,5 @@ def reset_for_tests() -> None:
         _LAST_COMPLETED = None
     if hasattr(_TLS, "qid"):
         del _TLS.qid
+    if hasattr(_TLS, "req"):
+        del _TLS.req

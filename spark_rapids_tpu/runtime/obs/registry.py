@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 #: sub-buckets per power of two; 8 keeps relative bucket width at
@@ -132,7 +133,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "help", "labels", "_lock", "_buckets", "_zero",
-                 "count", "sum", "min", "max")
+                 "count", "sum", "min", "max", "_exemplars")
 
     def __init__(self, name: str, help: str = "",
                  labels: Optional[Dict[str, str]] = None):
@@ -146,8 +147,17 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        #: bucket idx -> (value, unix_ts, labels) — the latest exemplar
+        #: per bucket (OpenMetrics: a p99 spike on /metrics resolves to
+        #: a concrete trace_id + per-request timeline path)
+        self._exemplars: Dict[int, tuple] = {}
 
-    def observe(self, v: float) -> None:
+    @staticmethod
+    def _bucket_idx(v: float) -> int:
+        return math.floor(math.log2(v) * _OCTAVE_SUBDIV)
+
+    def observe(self, v: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         v = float(v)
         with self._lock:
             self.count += 1
@@ -159,8 +169,42 @@ class Histogram:
             if v <= 0.0:
                 self._zero += 1
                 return
-            idx = math.floor(math.log2(v) * _OCTAVE_SUBDIV)
+            idx = self._bucket_idx(v)
             self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            if exemplar:
+                self._exemplars[idx] = (v, time.time(), dict(exemplar))
+
+    def attach_exemplar(self, v: float,
+                        exemplar: Dict[str, str]) -> None:
+        """Attach an exemplar to the bucket an already-observed value v
+        landed in (for call sites that learn the trace identity AFTER
+        the observation — e.g. the reqtrace export path)."""
+        v = float(v)
+        if v <= 0.0 or not exemplar:
+            return
+        with self._lock:
+            self._exemplars[self._bucket_idx(v)] = (v, time.time(),
+                                                    dict(exemplar))
+
+    def exemplars(self) -> Dict[int, tuple]:
+        with self._lock:
+            return {i: (val, ts, dict(lbl))
+                    for i, (val, ts, lbl) in self._exemplars.items()}
+
+    def openmetrics_buckets(self) -> List[tuple]:
+        """[(le, cumulative_count, exemplar_or_None)] ending with the
+        +Inf bucket — the explicit-bucket series /metrics renders when
+        at least one exemplar exists (the summary alone has nowhere to
+        hang an exemplar per the OpenMetrics grammar)."""
+        with self._lock:
+            cum = self._zero
+            out: List[tuple] = []
+            for idx in sorted(self._buckets):
+                cum += self._buckets[idx]
+                out.append((2.0 ** ((idx + 1) / _OCTAVE_SUBDIV), cum,
+                            self._exemplars.get(idx)))
+            out.append((math.inf, self.count, None))
+            return out
 
     def quantile(self, q: float) -> float:
         with self._lock:
@@ -287,6 +331,21 @@ class MetricsRegistry:
                                  f"{repr(snap['sum'])}")
                     lines.append(f"{name}_count{_label_str(base or None)} "
                                  f"{snap['count']}")
+                    # exemplar-carrying histograms additionally render
+                    # explicit cumulative buckets with OpenMetrics
+                    # exemplar syntax: `name_bucket{le="..."} N
+                    # # {trace_id="..."} value timestamp`
+                    if m._exemplars:
+                        for le, cum, ex in m.openmetrics_buckets():
+                            lbl = dict(base)
+                            lbl["le"] = ("+Inf" if le == math.inf
+                                         else repr(le))
+                            line = f"{name}_bucket{_label_str(lbl)} {cum}"
+                            if ex is not None:
+                                v, ts, exl = ex
+                                line += (f" # {_label_str(exl)} "
+                                         f"{repr(v)} {repr(ts)}")
+                            lines.append(line)
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> Dict[str, object]:

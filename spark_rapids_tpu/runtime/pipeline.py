@@ -143,6 +143,10 @@ class PipelinedIterator:
         # submit-time wrapper cannot cover (the refill re-arms ITSELF
         # from inside _refill_loop's exit paths via the consumer)
         self._query_id = _live.current_query_id()
+        # the consumer's serving request context rides the same seam:
+        # producer-side spans land in the request's reqtrace ring even
+        # when a consumer-armed refill runs on a fresh pool worker
+        self._req = _live.current_request()
         self._lock = _san.lock("pipeline.iterator")
         self._cancel = False
         self._refill_running = False
@@ -178,6 +182,7 @@ class PipelinedIterator:
         from spark_rapids_tpu.runtime.task import TaskContext
         prev = TaskContext.peek()
         prev_qid = _live.bind(self._query_id)
+        prev_req = _live.bind_request(self._req)
         if self._ctx is not None:
             TaskContext.set_current(self._ctx)
         try:
@@ -195,6 +200,7 @@ class PipelinedIterator:
                         except queue.Full:
                             self._hand = _ProducerError(e)
         finally:
+            _live.bind_request(prev_req)
             _live.bind(prev_qid)
             if self._ctx is not None:
                 if prev is not None:

@@ -154,8 +154,10 @@ class ResultCache:
                     ev.set()
             # follower: wait in bounded slices, then re-check — if the
             # leader failed (no entry), loop back and become the leader
-            while not ev.wait(timeout=0.05):
-                pass
+            from spark_rapids_tpu.runtime.obs import reqtrace as _rt
+            with _rt.request_span("single_flight_wait"):
+                while not ev.wait(timeout=0.05):
+                    pass
 
     def _insert(self, key: tuple, payload: bytes) -> None:
         n = len(payload)

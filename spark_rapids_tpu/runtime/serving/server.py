@@ -28,11 +28,14 @@ can explain its p99 from response docs alone.
 from __future__ import annotations
 
 import base64
+import os
 import threading
 import time
 from typing import Dict, Optional, Tuple
 
 from spark_rapids_tpu import config as C
+from spark_rapids_tpu.runtime.obs import live as _live
+from spark_rapids_tpu.runtime.obs import reqtrace as RT
 from spark_rapids_tpu.runtime.serving.result_cache import ResultCache
 
 
@@ -79,6 +82,13 @@ class QueryServer:
         self.warm_boot: Optional[dict] = None
         self._warm_mgr = None
         self._warm_deadline = 0.0
+        # distributed request tracing (spark.rapids.obs.reqtrace.*):
+        # first-wins install like the flight recorder; the replica
+        # identity stamps response docs whether or not reqtrace is on
+        RT.maybe_install(conf)
+        rec = RT.recorder()
+        self.replica_id = rec.replica_id if rec is not None else \
+            (conf.get(C.OBS_REPLICA_ID) or f"pid-{os.getpid()}")
 
     # -- boot -----------------------------------------------------------
 
@@ -158,7 +168,33 @@ class QueryServer:
     # -- request handling -----------------------------------------------
 
     def handle(self, payload: dict) -> Tuple[int, dict]:
-        """One POST /sql request -> (http_code, response_doc)."""
+        """One POST /sql request -> (http_code, response_doc).
+
+        With reqtrace armed, the whole in-server handling runs under a
+        bound RequestContext (honoring or minting the W3C traceparent
+        the transport passed as payload["_traceparent"]), the "intake"
+        span covers it, and the request ends with a tail-sampling
+        verdict + trace identity stamped into the response doc."""
+        traceparent = payload.pop("_traceparent", None)
+        rctx = RT.begin_request(traceparent)
+        if rctx is None:
+            return self._handle_counted(payload)
+        t0 = time.perf_counter()
+        prev = _live.bind_request(rctx)
+        try:
+            with RT.request_span("intake"):
+                code, doc = self._handle_counted(payload)
+        finally:
+            _live.bind_request(prev)
+        try:
+            self._finish_request(rctx, doc,
+                                 (time.perf_counter() - t0) * 1e3)
+        except Exception:  # noqa: BLE001 - tracing never fails a request
+            pass
+        return code, doc
+
+    def _handle_counted(self, payload: dict) -> Tuple[int, dict]:
+        """Bounded intake + dispatch (the pre-tracing handle body)."""
         with self._lock:
             self._stats["requests"] += 1
             if self._active >= self.max_inflight:
@@ -210,13 +246,15 @@ class QueryServer:
             return 400, _error_doc("bad_request", type(e).__name__,
                                    str(e))
 
-        self._await_warm_boot()
+        with RT.request_span("warm_boot_wait"):
+            self._await_warm_boot()
         timeout_s = payload.get("timeout_seconds")
         want_cache = bool(payload.get("cache", True))
         key = None
         if self.cache is not None:
             if want_cache:
-                key = self.cache.key_for(df.plan, sess.conf)
+                with RT.request_span("cache_lookup"):
+                    key = self.cache.key_for(df.plan, sess.conf)
             else:
                 self.cache.note_bypass()
 
@@ -224,8 +262,10 @@ class QueryServer:
         compiles0 = CC.stats()["xla_compiles"]
 
         def execute() -> bytes:
-            tbl = sess.collect(df.plan, timeout_seconds=timeout_s)
-            return serialize_table(tbl)
+            with RT.request_span("execute"):
+                tbl = sess.collect(df.plan, timeout_seconds=timeout_s)
+            with RT.request_span("serialize"):
+                return serialize_table(tbl)
 
         try:
             if key is not None:
@@ -241,8 +281,11 @@ class QueryServer:
         except LC.QueryCancelledError as e:
             with self._lock:
                 self._stats["cancelled"] += 1
-            return 499, _error_doc("cancelled", type(e).__name__,
-                                   str(e))
+            doc = _error_doc("cancelled", type(e).__name__, str(e))
+            # deadline vs user/fault cancel changes the tail-sampling
+            # verdict (the token's first-cancel reason wins)
+            doc["cancel_reason"] = getattr(e, "reason", None) or "user"
+            return 499, doc
         except Exception as e:  # noqa: BLE001 - the typed failure doc
             with self._lock:
                 self._stats["failed"] += 1
@@ -266,6 +309,59 @@ class QueryServer:
             self._record_hit_history(key[0], wall_ms)
         return 200, doc
 
+    def _finish_request(self, rctx, doc: dict, wall_ms: float) -> None:
+        """Land the tail-sampling verdict for one finished request and
+        stamp the trace identity (+ any export) into the response doc
+        and the serving latency histogram's exemplar."""
+        status = doc.get("status", "failed")
+        digest = doc.get("plan_digest")
+        out = RT.end_request(
+            rctx, status=status,
+            cancel_reason=doc.pop("cancel_reason", None),
+            slo_breach=rctx.slo_breach,
+            slow_vs_baseline=self._slow_vs_baseline(
+                status, digest, wall_ms / 1e3),
+            error=doc.get("error_type"),
+            cache_outcome=doc.get("cache"), wall_ms=wall_ms)
+        doc["trace_id"] = rctx.trace_id
+        doc["traceparent"] = rctx.traceparent()
+        doc["replica_id"] = rctx.replica_id
+        if out is not None:
+            doc["reqtrace"] = {"verdict": out["verdict"],
+                               "path": out["path"]}
+        try:
+            from spark_rapids_tpu.runtime import obs as OBS
+            st = OBS.state()
+            if st is not None:
+                ex = {"trace_id": rctx.trace_id}
+                if out is not None and out["path"]:
+                    ex["path"] = out["path"]
+                st.registry.histogram(
+                    "rapids_serving_request_ms").observe(wall_ms,
+                                                         exemplar=ex)
+        except Exception:  # noqa: BLE001 - metrics are advisory
+            pass
+
+    @staticmethod
+    def _slow_vs_baseline(status: str, digest, wall_s: float) -> bool:
+        """Did an otherwise-clean request run slower than its digest's
+        history baseline mean x reqtrace.TAIL_FACTOR? (Below the SLO's
+        baselineFactor — the tail between "slower than usual" and a
+        breach still always exports.)"""
+        if status != "ok" or not digest:
+            return False
+        try:
+            from spark_rapids_tpu.runtime import obs as OBS
+            st = OBS.state()
+            if st is None or st.slo is None:
+                return False
+            base = st.slo.baseline(digest)
+            if not base or base["runs"] < st.slo.min_runs:
+                return False
+            return wall_s > base["mean_seconds"] * RT.TAIL_FACTOR
+        except Exception:  # noqa: BLE001 - a baseline read must not
+            return False  # affect the request
+
     def _record_hit_history(self, digest: str, wall_ms: float) -> None:
         """Cache hits make history too (type=result_cache_hit, so the
         warmup/SLO filters on type=='query' ignore them) — a digest's
@@ -274,10 +370,15 @@ class QueryServer:
             from spark_rapids_tpu.runtime import obs as OBS
             st = OBS.state()
             if st is not None and st.history is not None:
-                st.history.append({
+                rec = {
                     "type": "result_cache_hit", "plan_digest": digest,
                     "wall_ms": round(wall_ms, 3),
-                    "wall_start_unix": time.time()})
+                    "wall_start_unix": time.time(),
+                    "replica_id": self.replica_id}
+                rctx = _live.current_request()
+                if rctx is not None:
+                    rec["trace_id"] = rctx.trace_id
+                st.history.append(rec)
         except Exception:  # noqa: BLE001 - history is advisory
             pass
 
@@ -319,6 +420,7 @@ class QueryServer:
             sessions = len(self._sessions)
         out = {
             "enabled": True,
+            "replica_id": self.replica_id,
             "active_requests": active,
             "max_inflight": self.max_inflight,
             "sessions": sessions,
@@ -327,6 +429,7 @@ class QueryServer:
             "warm_boot": self.warm_boot,
             "result_cache": (self.cache.stats()
                              if self.cache is not None else None),
+            "reqtrace": RT.doc(),
         }
         out.update(stats)
         return out

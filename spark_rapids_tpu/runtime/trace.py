@@ -59,6 +59,11 @@ from spark_rapids_tpu.analysis import sanitizer as _san  # noqa: E402
 # failure can dump a retroactive timeline. _flight._REC is None when the
 # recorder is off — one module-global read past the tracer check.
 from spark_rapids_tpu.runtime.obs import flight as _flight  # noqa: E402
+# per-request tail sampling (runtime/obs/reqtrace.py): when the flight
+# recorder is ON its record() feeds the bound request's ring, so the
+# branches below only cover the flight-OFF + reqtrace-ON combination —
+# the disabled path stays one module-global read per hook.
+from spark_rapids_tpu.runtime.obs import reqtrace as _reqtrace  # noqa: E402
 # cross-thread query correlation (runtime/obs/live.py): traced events
 # carry the emitting thread's bound query id so two queries' events in
 # one trace (nested collects, pool threads) stay attributable
@@ -279,6 +284,11 @@ class _Span:
         if fr is not None and self.level < DEBUG:
             fr.record(self.name, self.cat, self.t0, dur,
                       self.args or None)
+        elif self.level < DEBUG:
+            rr = _reqtrace._REC
+            if rr is not None:
+                rr.feed(self.name, self.cat, self.t0, dur,
+                        self.args or None, _live.current_query_id())
         return False
 
 
@@ -304,6 +314,12 @@ def metric_span(name: str, metric, cat: str = "exec",
                                else getattr(metric, "level",
                                             MODERATE)) < DEBUG:
             return fr.span(name, metric, cat)
+        rr = _reqtrace._REC
+        if fr is None and rr is not None \
+                and (level if level is not None
+                     else getattr(metric, "level", MODERATE)) < DEBUG \
+                and _live.current_request() is not None:
+            return rr.span(name, metric, cat)
         return metric.ns() if metric is not None else _NULL
     return _Span(tr, name, metric, cat, args,
                  level=(level if level is not None
@@ -320,6 +336,11 @@ def exec_span(node, metric, name: Optional[str] = None):
         fr = _flight._REC
         if fr is not None and metric.level < DEBUG:
             return fr.span(name or f"{node.name()}.{metric.name}",
+                           metric, "exec")
+        rr = _reqtrace._REC
+        if fr is None and rr is not None and metric.level < DEBUG \
+                and _live.current_request() is not None:
+            return rr.span(name or f"{node.name()}.{metric.name}",
                            metric, "exec")
         return metric.ns()
     args = None
@@ -338,6 +359,10 @@ def span(name: str, cat: str = "runtime", args: Optional[dict] = None,
         fr = _flight._REC
         if fr is not None and level < DEBUG:
             return fr.span(name, None, cat)
+        rr = _reqtrace._REC
+        if fr is None and rr is not None and level < DEBUG \
+                and _live.current_request() is not None:
+            return rr.span(name, None, cat)
         return _NULL
     return _Span(tr, name, None, cat, args, level=level)
 
@@ -350,6 +375,11 @@ def instant(name: str, cat: str = "runtime", args: Optional[dict] = None,
     fr = _flight._REC
     if fr is not None and level < DEBUG:
         fr.instant(name, cat, args)
+    elif level < DEBUG:
+        rr = _reqtrace._REC
+        if rr is not None:
+            rr.feed(name, cat, time.perf_counter_ns(), -1, args,
+                    _live.current_query_id())
 
 
 def emit_span(name: str, t0_ns: int, dur_ns: int, cat: str = "exec",
@@ -363,6 +393,11 @@ def emit_span(name: str, t0_ns: int, dur_ns: int, cat: str = "exec",
     fr = _flight._REC
     if fr is not None and level < DEBUG:
         fr.record(name, cat, t0_ns, dur_ns, args)
+    elif level < DEBUG:
+        rr = _reqtrace._REC
+        if rr is not None:
+            rr.feed(name, cat, t0_ns, dur_ns, args,
+                    _live.current_query_id())
 
 
 def on_task_complete(ctx) -> None:

@@ -48,8 +48,12 @@ def _reset_runtime():
     # flight rings / dump rate-limit state, the per-query attribution
     # aggregate, and SLO baselines are process-global too
     from spark_rapids_tpu.runtime import obs
-    from spark_rapids_tpu.runtime.obs import attribution, flight, live
+    from spark_rapids_tpu.runtime.obs import (attribution, flight, live,
+                                              reqtrace)
     flight.uninstall_for_tests()
+    # the per-request recorder (and this thread's request binding) is
+    # process-global the same way the flight recorder is
+    reqtrace.uninstall_for_tests()
     attribution.reset_for_tests()
     # the live query registry and this thread's query-id binding are
     # process-global (the sampler's one daemon thread deliberately

@@ -620,6 +620,92 @@ def test_l014_roster_extraction_served_and_documented():
     assert documented is not None and routes <= documented
 
 
+def _lint_reqtrace(src, relpath="runtime/obs/x.py",
+                   spans=frozenset({"intake", "execute"}),
+                   verdicts=frozenset({"error", "sampled"}),
+                   collect=None):
+    return lint.lint_source(textwrap.dedent(src), "/x/" + relpath,
+                            {"opTime"}, relpath=relpath,
+                            known_request_spans=set(spans),
+                            known_verdicts=set(verdicts),
+                            collect=collect)
+
+
+def test_l015_off_roster_span_flagged():
+    vs = _lint_reqtrace("""
+        def handle(self, ctx):
+            with RT.request_span("intake"):
+                pass
+            with rec.request_span(ctx, "mystery_phase"):
+                pass
+    """)
+    assert _rules(vs) == ["TPU-L015"]
+    assert "mystery_phase" in vs[0].message
+
+
+def test_l015_off_roster_verdict_flagged_and_scoped():
+    src = """
+        def decide(self):
+            return _v("weird_outcome")
+    """
+    assert _rules(_lint_reqtrace(src)) == ["TPU-L015"]
+    # the _v shape is the reqtrace/serving verdict checkpoint — an
+    # unrelated _v helper elsewhere in the engine must never match
+    assert _rules(_lint_reqtrace(src, relpath="exec/x.py")) == []
+
+
+def test_l015_suppression_and_skipped_without_roster():
+    vs = _lint_reqtrace("""
+        def handle(self):
+            with RT.request_span("debug_phase"):  # tpulint: disable=TPU-L015 experiment
+                pass
+    """)
+    assert _rules(vs) == []
+    assert _rules(vs, suppressed=True) == ["TPU-L015"]
+    assert _rules(lint.lint_source(textwrap.dedent("""
+        def handle(self):
+            with RT.request_span("anything"):
+                pass
+    """), "/x/runtime/obs/x.py", {"opTime"},
+        relpath="runtime/obs/x.py")) == []
+
+
+def test_l015_collect_aggregates_call_sites():
+    used = {}
+    _lint_reqtrace("""
+        def handle(self):
+            with RT.request_span("intake"):
+                return _v("error")
+    """, collect=used)
+    assert used["request_spans"] == {"intake"}
+    assert used["verdicts"] == {"error"}
+
+
+def test_l015_roster_extraction_used_and_documented():
+    pkg = os.path.join(REPO, "spark_rapids_tpu")
+    from spark_rapids_tpu.runtime.obs.reqtrace import (REQUEST_SPANS,
+                                                       VERDICTS)
+    spans = lint.known_request_spans(pkg)
+    verdicts = lint.known_reqtrace_verdicts(pkg)
+    assert spans == set(REQUEST_SPANS)
+    assert verdicts == set(VERDICTS)
+    assert {"intake", "admission_wait", "execute", "serialize"} <= spans
+    assert {"error", "cancelled", "deadline", "slo_breach", "sampled",
+            "dropped"} <= verdicts
+    # the generated docs carry every roster name (the docs-presence half)
+    documented = lint.docs_metric_names(REPO)
+    assert documented is not None
+    assert spans <= documented and verdicts <= documented
+    # every verdict is used by the decide() checkpoints in the real
+    # source (the stale half's input)
+    used = {}
+    rtpath = os.path.join(pkg, "runtime", "obs", "reqtrace.py")
+    lint.lint_source(open(rtpath).read(), rtpath,
+                     {"opTime"}, relpath="runtime/obs/reqtrace.py",
+                     known_verdicts=verdicts, collect=used)
+    assert verdicts <= used["verdicts"]
+
+
 def test_l011_roster_extraction_matches_live_modules():
     pkg = os.path.join(REPO, "spark_rapids_tpu")
     from spark_rapids_tpu.runtime.obs.live import STATES

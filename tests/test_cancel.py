@@ -439,6 +439,92 @@ def test_deadline_conf_applies_and_override_wins():
     assert sess2.last_action_status[0] == "ok"
 
 
+def test_orphaned_worker_checkpoint_raises_after_finish_action():
+    """Regression (the tier-1 test_cancel teardown leak): finish_action
+    pops the token BEFORE a cancelled query's pool workers unwind, so an
+    orphan's next check_current() used to silently return and the task
+    ran on holding its semaphore permit. The tombstone ring must make
+    the orphan raise — while the finishing thread itself (which runs the
+    observability epilogue) stays exempt."""
+    from spark_rapids_tpu.runtime.obs import live
+    tok = LC.begin_action(31337, C.RapidsConf())
+    tok.cancel("deadline")
+    prev = live.bind(31337)
+    try:
+        # this thread calls finish_action below, so it is the epilogue
+        # thread: the tombstone must NOT re-raise here
+        LC.finish_action(tok, "cancelled")
+        LC.check_current()
+    finally:
+        live.bind(prev)
+    # a DIFFERENT thread still bound to the dead qid is an orphaned
+    # worker: its checkpoint must observe the cancel via the tombstone
+    box = {}
+
+    def orphan():
+        live.bind(31337)
+        try:
+            LC.check_current()
+            box["outcome"] = "silent"
+        except QueryCancelledError as e:
+            box["outcome"] = "raised"
+            box["reason"] = e.reason
+        finally:
+            live.bind(None)
+
+    th = threading.Thread(target=orphan)
+    th.start()
+    th.join(5)
+    assert box["outcome"] == "raised"
+    assert box["reason"] == "deadline"
+    # an UNCANCELLED finished query leaves no tombstone: stale bindings
+    # to normally-completed qids stay silent
+    tok2 = LC.begin_action(31338, C.RapidsConf())
+    LC.finish_action(tok2, "ok")
+    prev = live.bind(31338)
+    try:
+        LC.check_current()
+    finally:
+        live.bind(prev)
+
+
+def test_tombstone_ring_is_bounded():
+    for i in range(200):
+        tok = LC.begin_action(40000 + i, C.RapidsConf())
+        tok.cancel("user")
+        LC.finish_action(tok, "cancelled")
+    assert len(LC._TOMBSTONES) <= LC._TOMBSTONE_CAP
+    # newest entries survive, oldest were evicted
+    assert 40199 in LC._TOMBSTONES and 40000 not in LC._TOMBSTONES
+
+
+def test_sweeper_stop_is_per_generation():
+    """Regression (the flake's second hole): reset_for_tests' join(2)
+    can time out under load, and _ensure_sweeper clearing a SHARED stop
+    event then resurrected the half-stopped old sweeper — two sweepers
+    racing one registry. Each generation now owns its stop event, so a
+    stopped generation can never be revived."""
+    tok = LC.begin_action(None, C.RapidsConf(), timeout_seconds=30)
+    old_sweeper, old_stop = LC._SWEEPER, LC._SWEEPER_STOP
+    assert old_sweeper is not None and old_sweeper.is_alive()
+    # stop the generation the way reset_for_tests does, but WITHOUT
+    # joining — the zombie window the shared event left open
+    old_stop.set()
+    LC.finish_action(tok, "ok")
+    tok2 = LC.begin_action(None, C.RapidsConf(), timeout_seconds=30)
+    try:
+        # the new generation has its own thread AND its own stop event:
+        # spawning it must not clear (revive) the old generation's stop
+        assert LC._SWEEPER is not old_sweeper
+        assert LC._SWEEPER_STOP is not old_stop
+        assert old_stop.is_set()
+        _wait_for(lambda: not old_sweeper.is_alive(), timeout=5,
+                  what="old sweeper generation exit")
+        assert LC._SWEEPER.is_alive()
+    finally:
+        LC.finish_action(tok2, "ok")
+
+
 # ---------------------------------------------------------------------------
 # admission control
 # ---------------------------------------------------------------------------

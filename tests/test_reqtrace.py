@@ -1,0 +1,360 @@
+"""Distributed request tracing (runtime/obs/reqtrace.py): W3C context
+round-trip, the serving<->exec span join, the tail-sampling verdict
+matrix, metric exemplars on /metrics, and the multi-replica fleet view
+(tools/fleet_report.py) over a shared historyDir.
+"""
+import http.client
+import json
+import os
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.runtime import serving
+from spark_rapids_tpu.runtime.obs import reqtrace
+from spark_rapids_tpu.runtime.obs.history import QueryHistoryStore
+from spark_rapids_tpu.runtime.obs.registry import MetricsRegistry
+from spark_rapids_tpu.sql.session import TpuSession
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import fleet_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """Reqtrace rides the serving/obs singletons; each test gets fresh
+    ones (the reqtrace recorder itself is reset by conftest)."""
+    from spark_rapids_tpu.runtime import obs
+    obs.shutdown_for_tests()
+    yield
+    obs.shutdown_for_tests()
+
+
+def _table(n=500, seed=7):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": rng.integers(0, 8, n),
+                     "v": rng.integers(1, 1000, n)})
+
+
+def _serving_session(**extra):
+    conf = {"spark.rapids.serving.enabled": "true"}
+    conf.update(extra)
+    s = TpuSession(conf)
+    s.create_or_replace_temp_view("t", s.create_dataframe(_table()))
+    return s
+
+
+_SQL = "SELECT k, SUM(v) AS sv FROM t GROUP BY k ORDER BY k"
+_TID = "ab" * 16
+_TP = f"00-{_TID}-{'cd' * 8}-01"
+
+
+# ---------------------------------------------------------------------------
+# W3C traceparent round-trip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("header", [
+    None, "", "garbage", "00-abc-def-01",
+    f"00-{'0' * 32}-{'cd' * 8}-01",      # all-zero trace id
+    f"00-{_TID}-{'0' * 16}-01",          # all-zero parent span
+    f"ff-{_TID}-{'cd' * 8}-01",          # forbidden version
+    f"00-{'xy' * 16}-{'cd' * 8}-01",     # non-hex
+    f"00-{_TID}-{'cd' * 8}",             # missing field
+])
+def test_malformed_traceparent_mints(header):
+    assert reqtrace.parse_traceparent(header) is None
+    ctx = reqtrace.RequestContext(64, "r1", traceparent=header)
+    assert not ctx.honored and ctx.parent_span_id is None
+    assert len(ctx.trace_id) == 32 and int(ctx.trace_id, 16) >= 0
+    assert ctx.trace_id != _TID
+
+
+def test_valid_traceparent_honored_and_propagated():
+    assert reqtrace.parse_traceparent(_TP) == (_TID, "cd" * 8, "01")
+    ctx = reqtrace.RequestContext(64, "r1", traceparent=_TP)
+    assert ctx.honored and ctx.trace_id == _TID
+    assert ctx.parent_span_id == "cd" * 8
+    # the OUTGOING header keeps the trace id but parents on this
+    # request's own root span (a fresh 16-hex id)
+    out = ctx.traceparent()
+    assert out.startswith(f"00-{_TID}-") and out.endswith("-01")
+    assert out.split("-")[2] == ctx.span_id != "cd" * 8
+
+
+def test_http_traceparent_roundtrip(tmp_path):
+    """POST /sql honors an incoming traceparent header and answers with
+    the outgoing one; absent a header the server mints a fresh trace."""
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    reqtrace.install(out_dir=str(tmp_path), sample_ratio=0.0)
+    _serving_session(**{"spark.rapids.obs.port": str(port)})
+    from spark_rapids_tpu.runtime import obs
+    port = obs.state().server.port
+
+    def post(headers):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/sql", body=json.dumps({"sql": _SQL}),
+                     headers=dict({"Content-Type": "application/json"},
+                                  **headers))
+        resp = conn.getresponse()
+        doc = json.loads(resp.read())
+        hdr = resp.getheader("traceparent")
+        conn.close()
+        return doc, hdr
+
+    doc, hdr = post({"traceparent": _TP})
+    assert doc["trace_id"] == _TID
+    assert hdr == doc["traceparent"]
+    assert hdr.startswith(f"00-{_TID}-") and hdr.endswith("-01")
+    doc2, hdr2 = post({})
+    assert len(doc2["trace_id"]) == 32 and doc2["trace_id"] != _TID
+    assert hdr2.startswith(f"00-{doc2['trace_id']}-")
+
+
+# ---------------------------------------------------------------------------
+# the serving<->exec span join in an exported timeline
+# ---------------------------------------------------------------------------
+
+def test_export_joins_serving_and_exec_spans(tmp_path):
+    rec = reqtrace.install(out_dir=str(tmp_path), sample_ratio=1.0,
+                           min_interval_s=0.0, replica_id="repl-a")
+    _serving_session()
+    code, doc = serving.handle_sql({"sql": _SQL})
+    assert code == 200 and doc["status"] == "ok"
+    assert doc["replica_id"] == "repl-a"
+    rt = doc["reqtrace"]
+    assert rt["verdict"] == "sampled" and os.path.exists(rt["path"])
+    timeline = json.load(open(rt["path"]))
+    meta = timeline["otherData"]
+    assert meta["trace_id"] == doc["trace_id"]
+    assert meta["replica_id"] == "repl-a"
+    events = timeline["traceEvents"]
+    serving_spans = {e["name"] for e in events
+                     if e.get("cat") == "serving"}
+    # the serving layer's own span tree
+    assert {"intake", "cache_lookup", "execute",
+            "serialize"} <= serving_spans
+    # joined engine exec spans: the epilogue stamped the request's
+    # query id, and engine events in the ring carry the same id
+    qid = meta["query_id"]
+    assert isinstance(qid, int)
+    engine = [e for e in events if e.get("cat") not in ("serving", None)
+              and (e.get("args") or {}).get("query_id") == qid]
+    assert engine, "no engine exec spans joined to the request's query"
+    # the OTLP sibling parents serving phases on the request root
+    otlp = json.load(open(rt["path"][:-5] + ".otlp.json"))
+    spans = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    root = next(s for s in spans if s["name"] == "POST /sql")
+    assert root["traceId"] == doc["trace_id"]
+    intake = next(s for s in spans if s["name"] == "intake")
+    assert intake["parentSpanId"] == root["spanId"]
+    assert rec.exports == 1
+
+
+def test_cache_hit_timeline_and_history_trace_id(tmp_path):
+    hist = tmp_path / "hist"
+    reqtrace.install(out_dir=str(tmp_path / "rt"), sample_ratio=1.0,
+                     min_interval_s=0.0)
+    _serving_session(**{"spark.rapids.obs.historyDir": str(hist)})
+    _, d1 = serving.handle_sql({"sql": _SQL})
+    code, d2 = serving.handle_sql({"sql": _SQL})
+    assert code == 200 and d2["cache"] == "hit"
+    assert d2["reqtrace"]["verdict"] == "sampled"
+    timeline = json.load(open(d2["reqtrace"]["path"]))
+    names = {e["name"] for e in timeline["traceEvents"]
+             if e.get("cat") == "serving"}
+    assert "cache_lookup" in names and "execute" not in names
+    # the history store carries each request's trace id (the fleet
+    # view's join key back to the exported timelines)
+    recs = QueryHistoryStore(str(hist)).read_all()
+    by_type = {}
+    for r in recs:
+        by_type.setdefault(r["type"], []).append(r)
+    assert by_type["query"][-1]["trace_id"] == d1["trace_id"]
+    assert by_type["result_cache_hit"][-1]["trace_id"] == d2["trace_id"]
+
+
+# ---------------------------------------------------------------------------
+# the tail-sampling verdict matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw,verdict", [
+    (dict(status="failed"), "error"),
+    (dict(status="failed", slo_breach=True), "error"),  # precedence
+    (dict(status="cancelled", cancel_reason="user"), "cancelled"),
+    (dict(status="cancelled", cancel_reason="deadline"), "deadline"),
+    (dict(status="ok", slo_breach=True), "slo_breach"),
+    (dict(status="ok", slow_vs_baseline=True), "slow_vs_baseline"),
+    (dict(status="ok", slo_breach=True, slow_vs_baseline=True),
+     "slo_breach"),
+    (dict(status="ok", draw=0.001), "sampled"),
+    (dict(status="ok", draw=0.999), "dropped"),
+    (dict(status="bad_request", draw=0.001), "sampled"),
+])
+def test_verdict_matrix(tmp_path, kw, verdict):
+    rec = reqtrace.ReqTraceRecorder(out_dir=str(tmp_path),
+                                    sample_ratio=0.01)
+    assert rec.decide(**kw) == verdict
+
+
+def test_verdict_ratio_edges_and_export_bookkeeping(tmp_path):
+    # ratio 0: nothing ordinary ever keeps, even draw == 0
+    rec = reqtrace.ReqTraceRecorder(out_dir=str(tmp_path),
+                                    sample_ratio=0.0)
+    assert rec.decide(status="ok", draw=0.0) == "dropped"
+    # ratio 1: everything ordinary keeps
+    rec = reqtrace.ReqTraceRecorder(out_dir=str(tmp_path),
+                                    sample_ratio=1.0, min_interval_s=0.0)
+    assert rec.decide(status="ok", draw=0.999999) == "sampled"
+    # end(): dropped rings write nothing; kept rings write the pair
+    ctx = rec.begin()
+    out = rec.end(ctx, status="failed", error="Boom")
+    assert out["kept"] and out["verdict"] == "error"
+    assert os.path.exists(out["path"])
+    assert os.path.exists(out["otlp_path"])
+    assert json.load(open(out["path"]))["otherData"]["error"] == "Boom"
+    rec2 = reqtrace.ReqTraceRecorder(out_dir=str(tmp_path / "none"),
+                                     sample_ratio=0.0)
+    ctx2 = rec2.begin()
+    out2 = rec2.end(ctx2, status="ok")
+    assert not out2["kept"] and out2["path"] is None
+    assert not os.path.exists(str(tmp_path / "none"))
+    assert rec2.dropped == 1
+
+
+def test_sampled_exports_rate_limited_but_errors_never(tmp_path):
+    rec = reqtrace.ReqTraceRecorder(out_dir=str(tmp_path),
+                                    sample_ratio=1.0,
+                                    min_interval_s=3600.0)
+    assert rec.end(rec.begin(), status="ok", draw=0.0)["path"]
+    # within the interval: a sampled keep is rate-limited away...
+    out = rec.end(rec.begin(), status="ok", draw=0.0)
+    assert out["kept"] and out["path"] is None
+    assert rec.rate_limited == 1
+    # ...but an always-keep verdict bypasses the interval
+    assert rec.end(rec.begin(), status="failed")["path"]
+
+
+# ---------------------------------------------------------------------------
+# exemplars on /metrics
+# ---------------------------------------------------------------------------
+
+def test_exemplar_renders_openmetrics_bucket_lines():
+    reg = MetricsRegistry()
+    h = reg.histogram("rapids_serving_request_ms", "request wall")
+    h.observe(3.0)
+    h.observe(12.5, exemplar={"trace_id": "deadbeef" * 4})
+    out = reg.render_prometheus()
+    bucket_lines = [ln for ln in out.splitlines()
+                    if ln.startswith("rapids_serving_request_ms_bucket")]
+    assert bucket_lines and bucket_lines[-1].count('le="+Inf"') == 1
+    ex_lines = [ln for ln in bucket_lines if " # {" in ln]
+    assert len(ex_lines) == 1
+    assert 'trace_id="' + "deadbeef" * 4 + '"' in ex_lines[0]
+    # cumulative counts are monotone and end at the total
+    counts = [int(ln.split(" # ")[0].rsplit(" ", 1)[1])
+              for ln in bucket_lines]
+    assert counts == sorted(counts) and counts[-1] == 2
+
+
+def test_serving_request_records_resolvable_exemplar(tmp_path):
+    reqtrace.install(out_dir=str(tmp_path), sample_ratio=1.0,
+                     min_interval_s=0.0)
+    _serving_session()
+    code, doc = serving.handle_sql({"sql": _SQL})
+    assert code == 200
+    from spark_rapids_tpu.runtime import obs
+    out = obs.state().registry.render_prometheus()
+    ex_lines = [ln for ln in out.splitlines()
+                if ln.startswith("rapids_serving_request_ms_bucket")
+                and " # {" in ln]
+    assert ex_lines, "serving latency histogram carries no exemplar"
+    assert f'trace_id="{doc["trace_id"]}"' in ex_lines[0]
+    # the exemplar resolves to the exported per-request timeline
+    path = ex_lines[0].split('path="')[1].split('"')[0]
+    assert path == doc["reqtrace"]["path"] and os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# the fleet view over a shared historyDir
+# ---------------------------------------------------------------------------
+
+def _fleet_record(replica, digest, wall_ms, trace_id, status="ok",
+                  compile_s=0.0, slo=None):
+    rec = {"type": "query", "replica_id": replica, "plan_digest": digest,
+           "duration_ns": int(wall_ms * 1e6), "status": status,
+           "trace_id": trace_id,
+           "attribution": {"buckets": {"compile": compile_s}}}
+    if slo is not None:
+        rec["slo_breach"] = slo
+    return rec
+
+
+def test_two_replica_fleet_report_merge(tmp_path):
+    """Two replicas appending to ONE historyDir: the fleet summary
+    splits each digest per replica, flags cross-replica p99 skew, and
+    joins reqtrace artifacts back to history trace ids."""
+    hist = str(tmp_path / "hist")
+    a = QueryHistoryStore(hist)   # replica A's handle
+    b = QueryHistoryStore(hist)   # replica B's handle on the SAME dir
+    tid_a = "aa" * 16
+    tid_b = "bb" * 16
+    for w in (10.0, 11.0, 12.0):
+        a.append(_fleet_record("repl-a", "digX", w, tid_a,
+                               compile_s=0.5))
+    for w in (40.0, 44.0, 48.0):
+        b.append(_fleet_record("repl-b", "digX", w, tid_b, slo={"x": 1}))
+    b.append(_fleet_record("repl-b", "digY", 5.0, "cc" * 16,
+                           status="failed"))
+    b.append({"type": "result_cache_hit", "replica_id": "repl-b",
+              "plan_digest": "digX", "wall_ms": 1.0, "trace_id": tid_b})
+    rt = tmp_path / "rt"
+    rt.mkdir()
+    (rt / f"req_00001_slo_breach_{tid_b[:8]}.json").write_text("{}")
+    (rt / "req_00002_error_99999999.json").write_text("{}")
+
+    doc = fleet_report.fleet_summary(
+        QueryHistoryStore(hist).read_all(),
+        reqtrace_dirs=[str(rt)], skew_factor=1.5)
+    assert doc["replicas"] == ["repl-a", "repl-b"]
+    assert doc["totals"]["repl-a"]["queries"] == 3
+    assert doc["totals"]["repl-b"]["slo_breaches"] == 3
+    assert doc["totals"]["repl-b"]["failed"] == 1
+    assert doc["totals"]["repl-b"]["cache_hits"] == 1
+    # the per-digest split keeps the replicas separate
+    cell = doc["digests"]["digX"]
+    assert cell["repl-a"]["runs"] == 3 and cell["repl-b"]["runs"] == 3
+    assert cell["repl-a"]["compile_s"] == 1.5
+    assert cell["repl-a"]["p99_ms"] == 12.0
+    assert cell["repl-b"]["p99_ms"] == 48.0
+    assert tid_a in cell["repl-a"]["trace_ids"]
+    # digX is skewed 4x between the replicas; digY ran on one only
+    assert [s["plan_digest"] for s in doc["skewed"]] == ["digX"]
+    assert doc["skewed"][0]["slow"] == "repl-b"
+    assert doc["skewed"][0]["ratio"] == 4.0
+    # artifact join: B's timeline resolves, the orphan reports itself
+    arts = {a["file"].rsplit("/", 1)[-1]: a for a in doc["reqtrace"]}
+    assert arts[f"req_00001_slo_breach_{tid_b[:8]}.json"][
+        "trace_id"] == tid_b
+    assert arts["req_00002_error_99999999.json"]["trace_id"] is None
+    text = fleet_report.render_text(doc)
+    assert "repl-a" in text and "skew" in text and "slo_breach" in text
+
+
+def test_fleet_report_cli_json(tmp_path, capsys):
+    hist = str(tmp_path / "hist")
+    QueryHistoryStore(hist).append(
+        _fleet_record("r1", "d", 3.0, "ee" * 16))
+    sys_argv = sys.argv
+    sys.argv = ["fleet_report.py", hist, "--json"]
+    try:
+        assert fleet_report.main() == 0
+    finally:
+        sys.argv = sys_argv
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["replicas"] == ["r1"]

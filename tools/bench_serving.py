@@ -13,9 +13,18 @@ Drives the query server over its real HTTP surface and records:
   aggregations under a device-budget quota and the background QoS tier
   (spark.rapids.serving.requestNice) must move a neighbor tenant's p99
   — a hot/uncached request mix, so the tail lands on real device work —
-  by <= 1.25x of its solo run.
+  by <= 1.25x of its solo run;
+* request tracing evidence (round 18): the whole run is served with
+  reqtrace armed through the real conf surface
+  (spark.rapids.obs.reqtrace.*), then a deterministic evidence phase
+  proves deadline-cancelled / failed / SLO-breaching requests export
+  100% of the time, hot cache hits are kept exactly at the seeded
+  sampleRatio, /metrics latency histograms carry exemplars resolving to
+  exported timelines on disk, every artifact validates as a Chrome
+  trace with serving<->exec spans joined by query id, and the armed
+  hot-path overhead stays <2% by count x delta.
 
-Usage: python tools/bench_serving.py [--clients 8] [--out SERVING_r01.json]
+Usage: python tools/bench_serving.py [--clients 8] [--out SERVING_r02.json]
 """
 from __future__ import annotations
 
@@ -47,13 +56,25 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _post(port: int, payload: dict, timeout: float = 300.0):
+def _post(port: int, payload: dict, timeout: float = 300.0,
+          headers: dict | None = None):
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
     try:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
         conn.request("POST", "/sql", body=json.dumps(payload).encode(),
-                     headers={"Content-Type": "application/json"})
+                     headers=hdrs)
         resp = conn.getresponse()
         return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _get_text(port: int, path: str, timeout: float = 30.0) -> str:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        return conn.getresponse().read().decode()
     finally:
         conn.close()
 
@@ -72,14 +93,23 @@ def _timed(port, payload):
     return (time.perf_counter() - t0) * 1e3, code, doc
 
 
-def boot(port: int):
+def boot(port: int, reqtrace_dir: str, ratio: float):
     import numpy as np
     import pyarrow as pa
     from spark_rapids_tpu.sql.session import TpuSession
     rng = np.random.default_rng(2026)
+    # reqtrace armed through the real conf surface: the whole load run
+    # buffers + tail-samples every request (minInterval 0 so every
+    # sampled keep actually exports — the bench validates the artifacts)
     sess = TpuSession({
         "spark.rapids.serving.enabled": "true",
         "spark.rapids.obs.port": str(port),
+        "spark.rapids.obs.reqtrace.enabled": "true",
+        "spark.rapids.obs.reqtrace.path": reqtrace_dir,
+        "spark.rapids.obs.reqtrace.sampleRatio": str(ratio),
+        "spark.rapids.obs.reqtrace.minIntervalSeconds": "0",
+        "spark.rapids.obs.reqtrace.maxDumps": "10000",
+        "spark.rapids.obs.replicaId": "bench-replica",
     })
     n = 150_000
     sess.create_or_replace_temp_view("t", sess.create_dataframe(
@@ -147,6 +177,15 @@ def mixed_load(port: int, clients: int, per_client: int) -> dict:
         for name, secs in (attr.get("buckets") or {}).items():
             buckets.setdefault(name, []).append(secs * 1e3)
     hits = sum(1 for d in docs if d["cache"] == "hit")
+    # every response doc carries its trace identity and tail-sampling
+    # verdict — the load run explains its own sampling behavior
+    verdicts = {}
+    for d in docs:
+        v = (d.get("reqtrace") or {}).get("verdict") or "untraced"
+        verdicts[v] = verdicts.get(v, 0) + 1
+    hits_kept = sum(1 for d in docs if d["cache"] == "hit"
+                    and (d.get("reqtrace") or {}).get("verdict")
+                    == "sampled")
     return {
         "clients": clients,
         "requests": len(lat),
@@ -156,6 +195,9 @@ def mixed_load(port: int, clients: int, per_client: int) -> dict:
         "p99_ms": round(_pct(lat, 0.99), 3),
         "cache_hits": hits,
         "executed": len(docs) - hits,
+        "traced": sum(1 for d in docs if d.get("trace_id")),
+        "reqtrace_verdicts": verdicts,
+        "hot_hits_kept": hits_kept,
         "attribution_p99_ms": {
             name: round(_pct(ms, 0.99), 3)
             for name, ms in sorted(buckets.items())},
@@ -232,6 +274,162 @@ def quota_isolation(port: int, samples: int, hogs: int) -> dict:
             "neighbor_p99_ratio": round(p99_loaded / p99_solo, 3)}
 
 
+def reqtrace_evidence(port: int, out_dir: str, ratio: float,
+                      errors: int, hits: int) -> tuple:
+    """Deterministic request-tracing evidence over the served surface.
+
+    The load phases already ran with the conf-armed recorder; this
+    phase (a) bounds the armed hot-path cost by count x delta on a real
+    served request, then (b) swaps in a SEEDED recorder (same artifact
+    dir) so every assertion replays exactly: a deadline-cancelled, N
+    failed, and an SLO-breaching request must export 100% of the time,
+    hot cache hits must keep exactly the seeded sampleRatio draw, the
+    /metrics latency histogram must carry an exemplar resolving to an
+    exported timeline, and every artifact in the dir must validate as a
+    Chrome trace + OTLP pair with serving<->exec spans joined by query
+    id (reqtrace_smoke's validator, run over the bench's own output).
+    """
+    import random
+    import reqtrace_smoke as RS
+    from spark_rapids_tpu.runtime.obs import flight, live, reqtrace
+
+    res = {"ratio": ratio}
+    checks = {}
+    fails = []
+
+    # -- armed hot-path overhead on a served request (count x delta) ----
+    rec = reqtrace.recorder()
+    assert rec is not None, "load phases must run with reqtrace armed"
+    counts = [0]
+    real = flight.FlightRecorder.record
+
+    def counting(self, *a, **kw):
+        counts[0] += 1
+        return real(self, *a, **kw)
+
+    flight.FlightRecorder.record = counting
+    try:
+        wall_ms, code, _doc = _timed(
+            port, {"sql": COLD_SQLS[2], "cache": False})
+    finally:
+        flight.FlightRecorder.record = real
+    assert code == 200 and counts[0] > 0
+    ctx = rec.begin()
+    prev = live.bind_request(ctx)
+    try:
+        iters = 200_000
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            rec.feed("bench", "exec", 0, 1, None, 7)
+        per_call = (time.perf_counter() - t0) / iters
+    finally:
+        live.bind_request(prev)
+    pct = counts[0] * per_call / (wall_ms / 1e3) * 100
+    res["armed_overhead"] = {
+        "feed_sites": counts[0], "per_call_ns": round(per_call * 1e9, 1),
+        "request_wall_ms": round(wall_ms, 3), "pct": round(pct, 5)}
+    checks["armed_overhead_lt_2pct"] = pct < 2.0
+
+    # -- seeded recorder: the verdict assertions replay exactly ---------
+    rec = reqtrace.install(out_dir=out_dir, sample_ratio=ratio,
+                           min_interval_s=0.0, max_dumps=10_000,
+                           replica_id="bench-replica",
+                           sample_seed=RS.SEED)
+
+    # deadline-cancelled: a tiny per-query budget against the hog-sized
+    # scan (~700ms of device work — a small query can finish before the
+    # sweeper's first tick, landing status=ok and silently consuming a
+    # sampler draw, which would shift the seeded hits replay below)
+    code, doc = _post(port, {
+        "sql": HOG_SQL, "cache": False, "session": "deadl",
+        "conf": {"spark.rapids.query.timeoutSeconds": "0.01"}})
+    rt = doc.get("reqtrace") or {}
+    dl_ok = (code == 499 and doc.get("status") == "cancelled"
+             and rt.get("verdict") == "deadline" and rt.get("path")
+             and os.path.exists(rt["path"]))
+    if not dl_ok:
+        fails.append(f"deadline request not kept: code={code} rt={rt}")
+    res["deadline"] = {"code": code, "verdict": rt.get("verdict")}
+
+    # failed: injected scan ioerrors, 100% kept
+    err_kept = 0
+    for _ in range(errors):
+        code, doc = _post(port, {
+            "sql": HOT_SQL, "cache": False, "session": "faulty",
+            "conf": {"spark.rapids.debug.faults":
+                     f"scan.decode:ioerror:{errors}"}})
+        rt = doc.get("reqtrace") or {}
+        if code == 500 and rt.get("verdict") == "error" \
+                and rt.get("path") and os.path.exists(rt["path"]):
+            err_kept += 1
+    if err_kept != errors:
+        fails.append(f"only {err_kept}/{errors} failed requests kept")
+    res["errors"] = {"sent": errors, "kept": err_kept}
+
+    # SLO breach: a tiny absolute bound the executed request must trip
+    code, doc = _post(port, {
+        "sql": COLD_SQLS[1], "cache": False, "session": "slo",
+        "conf": {"spark.rapids.obs.slo.latencySeconds": "0.0005"}})
+    rt = doc.get("reqtrace") or {}
+    slo_ok = (code == 200 and rt.get("verdict") == "slo_breach"
+              and rt.get("path") and os.path.exists(rt["path"]))
+    if not slo_ok:
+        fails.append(f"SLO breach not kept: code={code} rt={rt}")
+    res["slo_breach"] = {"code": code, "verdict": rt.get("verdict")}
+    checks["always_keeps_100pct"] = bool(
+        dl_ok and err_kept == errors and slo_ok)
+
+    # hot cache hits: only these consume sampler draws on the seeded
+    # recorder (always-keeps never draw), serialized -> exact replay
+    rng = random.Random(RS.SEED)
+    expected = sum(1 for _ in range(hits) if rng.random() < ratio)
+    kept = 0
+    for i in range(hits):
+        hdrs = {"traceparent": RS.TP} if i == 0 else None
+        code, doc = _post(port, {"sql": HOT_SQL}, headers=hdrs)
+        if code != 200 or doc.get("cache") != "hit":
+            fails.append(f"hit {i}: code={code} cache={doc.get('cache')}")
+            break
+        if i == 0 and doc.get("trace_id") != RS.TP_TID:
+            fails.append(f"incoming traceparent not honored over HTTP: "
+                         f"{doc.get('trace_id')}")
+        if (doc.get("reqtrace") or {}).get("verdict") == "sampled":
+            kept += 1
+    if kept != expected:
+        fails.append(f"seeded sampler kept {kept}/{hits} hits, "
+                     f"expected {expected} (ratio {ratio})")
+    res["hits"] = {"sent": hits, "kept": kept, "expected": expected}
+    checks["hot_hits_kept_at_seeded_ratio"] = kept == expected
+
+    # /metrics exemplar -> exported timeline on disk
+    metrics = _get_text(port, "/metrics")
+    resolvable = 0
+    example = None
+    for line in metrics.splitlines():
+        if "# {" not in line or "rapids_serving_request_ms" not in line:
+            continue
+        lbl = line.split("# {", 1)[1].split("}", 1)[0]
+        path = next((p.split('"')[1] for p in lbl.split(",")
+                     if p.strip().startswith('path="')), None)
+        if path and os.path.exists(path):
+            resolvable += 1
+            example = example or line.strip()
+    if resolvable == 0:
+        fails.append("no /metrics latency exemplar resolves to an "
+                     "exported timeline")
+    res["exemplars"] = {"resolvable_bucket_lines": resolvable,
+                        "example": example}
+    checks["exemplars_resolvable"] = resolvable > 0
+
+    # every artifact (load phases + this one): Chrome trace + OTLP pair,
+    # serving<->exec spans joined by the request's query id
+    vfails = RS.validate_timelines(out_dir, res)
+    fails.extend(vfails)
+    checks["timelines_valid_and_joined"] = not vfails
+    res["checks"] = checks
+    return res, fails
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=8)
@@ -239,8 +437,15 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=30)
     ap.add_argument("--samples", type=int, default=200)
     ap.add_argument("--hogs", type=int, default=1)
+    ap.add_argument("--ratio", type=float, default=0.05,
+                    help="reqtrace sampleRatio for the whole run")
+    ap.add_argument("--hits", type=int, default=200,
+                    help="serialized hot hits in the evidence phase")
+    ap.add_argument("--errors", type=int, default=3)
+    ap.add_argument("--reqtrace-dir",
+                    default="/tmp/rapids_tpu_bench_reqtrace")
     ap.add_argument("--out", default=os.path.join(
-        os.path.dirname(__file__), "..", "SERVING_r01.json"))
+        os.path.dirname(__file__), "..", "SERVING_r02.json"))
     args = ap.parse_args()
 
     # serving-process thread fairness: the default 5ms GIL switch
@@ -249,31 +454,43 @@ def main() -> int:
     # runs with a tighter interval (recorded in the artifact)
     sys.setswitchinterval(0.001)
 
-    port = _free_port()
-    _sess, port = boot(port)
+    import shutil
+    shutil.rmtree(args.reqtrace_dir, ignore_errors=True)
 
-    print("[1/3] hot-path vs uncached p50...", flush=True)
+    port = _free_port()
+    _sess, port = boot(port, args.reqtrace_dir, args.ratio)
+
+    print("[1/4] hot-path vs uncached p50...", flush=True)
     hot = hot_vs_uncached(port, args.reps)
     print(f"  {hot}")
 
-    print(f"[2/3] mixed hot/cold load, {args.clients} clients...",
+    print(f"[2/4] mixed hot/cold load, {args.clients} clients...",
           flush=True)
     load = mixed_load(port, args.clients, args.per_client)
     print(f"  {load}")
 
-    print(f"[3/3] quota isolation ({args.hogs} hogs vs 1 neighbor)...",
+    print(f"[3/4] quota isolation ({args.hogs} hogs vs 1 neighbor)...",
           flush=True)
     iso = quota_isolation(port, args.samples, args.hogs)
     print(f"  {iso}")
 
+    print("[4/4] request-tracing evidence (reqtrace armed)...",
+          flush=True)
+    rt, rt_fails = reqtrace_evidence(port, args.reqtrace_dir,
+                                     args.ratio, args.errors, args.hits)
+    print(f"  {rt}")
+    for f in rt_fails:
+        print(f"  FAIL: {f}")
+
     from spark_rapids_tpu.runtime import serving
     result = {
         "bench": "serving_load",
-        "round": 17,
+        "round": 18,
         "backend": "cpu-sim",
         "hot_vs_uncached": hot,
         "mixed_load": load,
         "quota_isolation": iso,
+        "reqtrace": rt,
         "server": serving.server_doc(),
         "acceptance": {
             "hot_speedup_p50_ge_10x":
@@ -281,6 +498,7 @@ def main() -> int:
             "neighbor_p99_ratio_le_1_25":
                 iso["neighbor_p99_ratio"] <= 1.25,
             "clients_ge_8": load["clients"] >= 8,
+            "reqtrace_evidence": not rt_fails,
         },
     }
     out = os.path.abspath(args.out)

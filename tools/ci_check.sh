@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
 # chaos smoke, obs smoke, flight smoke, pipeline smoke, compile smoke,
-# audit smoke, aqe smoke, decode smoke, serving smoke, tier-1 tests.
+# audit smoke, aqe smoke, decode smoke, serving smoke, reqtrace smoke,
+# tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
@@ -93,7 +94,20 @@ if ! python tools/serving_smoke.py; then
     fail=1
 fi
 
+step "reqtrace smoke (per-request tracing: errors/SLO breaches 100% exported, hot cache hits kept exactly at the seeded sampleRatio, disabled + armed paths <2% by count x delta, exported timelines Chrome-trace + OTLP valid with serving<->exec spans joined by query id)"
+if ! python tools/reqtrace_smoke.py; then
+    fail=1
+fi
+
 if [[ "${1:-}" != "--fast" ]]; then
+    step "re-homed @slow representatives (tools/slow_rehomed.txt: parametrizations tier-1 deselected in the round-18 headroom squeeze)"
+    if ! grep -v '^#' tools/slow_rehomed.txt | grep -v '^$' | \
+            xargs env JAX_PLATFORMS=cpu python -m pytest -q \
+            -p no:cacheprovider -p no:xdist -p no:randomly; then
+        echo "FAIL: re-homed @slow set"
+        fail=1
+    fi
+
     step "tier-1 tests (ROADMAP.md command)"
     set -o pipefail; rm -f /tmp/_t1.log
     timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

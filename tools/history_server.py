@@ -193,7 +193,16 @@ def render_query_page(rec: dict) -> str:
             + f" · started {_fmt_time(rec.get('wall_start_unix'))}"
             f" · wall {rec.get('duration_ns', 0) / 1e6:.1f} ms"
             f" · digest <small class='digest'>"
-            f"{_esc(rec.get('plan_digest'))}</small></p>"]
+            f"{_esc(rec.get('plan_digest'))}</small>"
+            + (f" · replica <code>{_esc(rec.get('replica_id'))}</code>"
+               if rec.get("replica_id") else "") + "</p>"]
+    if rec.get("trace_id"):
+        # the serving request that carried this query: the join key into
+        # its exported per-request timeline (reqtrace/)
+        body.append(f"<p>Trace: <small class='digest'>"
+                    f"{_esc(rec.get('trace_id'))}</small> — per-request "
+                    f"timeline under the replica's reqtrace dir when the "
+                    f"sampling verdict kept it</p>")
     if rec.get("slo_breach"):
         b = rec["slo_breach"]
         body.append(
@@ -382,9 +391,15 @@ def render_index(records: List[dict], diff_digests: List[str],
         body.append(f"<p><b><a href='console.html'>live console</a></b> "
                     f"— in-flight query progress + resource gauges "
                     f"(polls {_esc(engine_url)})</p>")
+    # the replica column only earns its width on a SHARED historyDir
+    # (multiple replicas appending) — single-writer stores skip it
+    replicas = {r.get("replica_id") for r in records
+                if r.get("type") == "query" and r.get("replica_id")}
+    show_replica = len(replicas) > 1
     body += ["<h2>Queries</h2><table><tr><th>id</th><th>started</th>"
             "<th>status</th><th class='num'>wall ms</th><th>digest</th>"
-            "<th class='num'>fallbacks</th><th></th></tr>"]
+            + ("<th>replica</th>" if show_replica else "")
+            + "<th class='num'>fallbacks</th><th></th></tr>"]
     for i in reversed(range(len(records))):
         rec = records[i]
         if rec.get("type") == "nds_scorecard":
@@ -401,7 +416,9 @@ def render_index(records: List[dict], diff_digests: List[str],
             f"<td class='num'>{rec.get('duration_ns', 0) / 1e6:.1f}</td>"
             f"<td><small class='digest'>{_esc(rec.get('plan_digest'))}"
             f"</small></td>"
-            f"<td class='num'>{len(rec.get('fallback_reasons', []))}</td>"
+            + (f"<td><code>{_esc(rec.get('replica_id', ''))}</code></td>"
+               if show_replica else "")
+            + f"<td class='num'>{len(rec.get('fallback_reasons', []))}</td>"
             f"<td><a href='{page_names[i]}'>plan</a></td></tr>")
     body.append("</table>")
     if diff_digests:
