@@ -108,10 +108,11 @@ KERNEL_PRIMITIVES: Dict[str, str] = {
                             "pallas module",
     "parallel/distributed.py": "ICI mesh shard-step kernels "
                                "(compile_cache.jit sites)",
-    "exec/tpu_nodes.py": "the ICI all-to-all exchange shard jit (the "
-                         "one exec-layer compile_cache.jit site; every "
-                         "other exec dispatch routes through the keyed "
-                         "fuse/run_stage entries)",
+    # exec/tpu_nodes.py left the roster in round 19: the ICI exchange
+    # shard program now compiles through the KEYED fuse layer
+    # ("ici_exchange"/"ici_hash" families), so the exec layer has no
+    # direct compile_cache.jit site — every dispatch routes through the
+    # keyed fuse/run_stage entries.
 }
 
 #: audit exec-classes whose device time lands in the attribution
@@ -146,6 +147,12 @@ _PENDING: List[Tuple] = []
 #: top-level action is running (the attribution._AGG singleton pattern,
 #: same known concurrent-queries limit)
 _AGG: Optional[Dict[Tuple, int]] = None
+
+#: the ACTIVE query's per-wave shard row tallies: (n_shards, rows) where
+#: rows is the UNRESOLVED [n_shards] device vector of live output rows
+#: per shard (exec/sharded.py notes one entry per SPMD wave — no sync on
+#: the dispatch path; finish_query fetches them in one bulk device_get)
+_SHARD_NOTES: List[Tuple[int, object]] = []
 
 #: audit anomalies (unresolvable cost analysis, steady-state dispatches
 #: of entries traced before the audit armed): the golden generator
@@ -215,6 +222,7 @@ def reset_for_tests(drop_records: bool = False) -> None:
         _AGG = None
         del _FINDINGS[:]
         del _PENDING[:]
+        del _SHARD_NOTES[:]
         if drop_records:
             _RECORDS.clear()
             for k in _STATS:
@@ -425,6 +433,23 @@ def note(entry_key: Tuple) -> None:
             agg[entry_key] = agg.get(entry_key, 0) + 1
 
 
+def note_shards(n_shards: int, rows) -> None:
+    """One SPMD wave of a sharded stage (exec/sharded.py): tally the
+    per-shard live output rows into the active query. `rows` is the
+    [n_shards] device vector — stored UNRESOLVED so the dispatch path
+    never syncs; finish_query fetches every wave in one bulk device_get.
+    No active query, or a warmup-replay thread: drop (the note()
+    discipline)."""
+    if _AGG is None:
+        return
+    from spark_rapids_tpu.runtime.obs import attribution as _attr
+    if _attr.thread_suppressed():
+        return
+    with _LOCK:
+        if _AGG is not None:
+            _SHARD_NOTES.append((int(n_shards), rows))
+
+
 def _note_kernel_trace(entry_key: Tuple) -> None:
     """Module-level kernels dispatch beneath jax's signature cache where
     no per-call choke point exists: credit one observation per audited
@@ -581,6 +606,7 @@ def on_query_start(conf=None) -> None:
         return
     with _LOCK:
         _AGG = {}
+        del _SHARD_NOTES[:]  # a query that never finished must not leak
 
 
 def finish_query() -> Optional[dict]:
@@ -590,12 +616,46 @@ def finish_query() -> Optional[dict]:
     global _AGG
     with _LOCK:
         agg, _AGG = _AGG, None
+        shard_notes, _SHARD_NOTES[:] = list(_SHARD_NOTES), []
     # resolve even when this query dispatched nothing: trace-time
     # audits queued by nested/background work must not pile up
     resolve_pending()
     if not agg:
         return None
-    return _summarize(agg)
+    summary = _summarize(agg)
+    shards = _resolve_shards(shard_notes)
+    if shards is not None:
+        # conditional key: query_signature reads only summary["classes"],
+        # and default-path (non-multichip) summaries never carry this —
+        # golden cost signatures stay byte-identical
+        summary["shards"] = shards
+    return summary
+
+
+def _resolve_shards(notes: List[Tuple[int, object]]) -> Optional[dict]:
+    """Fold the per-wave shard row vectors into the skew document the
+    roofline table and EXPLAIN ANALYZE print. ONE bulk device_get for
+    all waves (off the dispatch path)."""
+    if not notes:
+        return None
+    import jax as _jax
+    try:
+        fetched = _jax.device_get([r for _n, r in notes])
+    except Exception:  # noqa: BLE001 - an unresolvable vector drops the
+        return None  # skew column, never the query
+    n_shards = max(n for n, _r in notes)
+    totals = [0] * n_shards
+    for (_n, _r), vals in zip(notes, fetched):
+        flat = list(map(int, getattr(vals, "flat", vals)))
+        for i, v in enumerate(flat[:n_shards]):
+            totals[i] += v
+    mean = sum(totals) / n_shards if n_shards else 0.0
+    return {
+        "n_shards": int(n_shards),
+        "waves": len(notes),
+        "rows_per_shard": totals,
+        "skew": round(max(totals) / mean, 4) if mean > 0 else 0.0,
+    }
 
 
 def _summarize(agg: Dict[Tuple, int]) -> dict:
@@ -827,6 +887,11 @@ def roofline(summary: Optional[dict], snaps: Optional[Dict[str, dict]],
             if _PEAK_GFLOPS else None,
         } for family, c in sorted(summary["classes"].items())},
     }
+    shards = summary.get("shards")
+    if shards is not None:
+        # the per-shard skew column (multichip runs only): conditional
+        # key so default-path roofline docs stay byte-identical
+        doc["shards"] = shards
     return doc
 
 
@@ -856,6 +921,13 @@ def render_text(doc: Optional[dict], width: int = 24) -> List[str]:
             f"({t['roofline_pct_bw']:>6.3f}% roofline) "
             f"over {sum(g['dispatches'] for g in doc['groups'].values())}"
             f" audited dispatches")
+    sh = doc.get("shards")
+    if sh:
+        rows = sh.get("rows_per_shard") or []
+        lines.append(
+            f"  {'shards':<15} n={sh['n_shards']} "
+            f"waves={sh['waves']} skew={sh['skew']:.2f}x "
+            f"rows/shard=[{', '.join(str(r) for r in rows)}]")
     return lines
 
 

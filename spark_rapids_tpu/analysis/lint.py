@@ -106,6 +106,15 @@ TPU-L015  every serving request-span literal at a ``request_span("...")``
           rapids_reqtrace_verdicts_total counter are operator-facing
           vocabularies: an unrostered name is an invisible phase or an
           uncountable verdict (the L007-L014 roster pattern).
+TPU-L016  every XLA collective call site (``lax.all_to_all``,
+          ``lax.psum``, ``shard_map``) must live in a module registered
+          in the ``SANCTIONED_COLLECTIVE_MODULES`` roster of
+          ``parallel/mesh.py`` (with stale-entry and docs-presence
+          halves). Collectives are SPMD program structure: a stray one
+          outside the sanctioned exchange/planner modules deadlocks the
+          mesh when shards diverge, dodges the mesh-fingerprint compile
+          keys, and is invisible to the shard-skew audit (the L010
+          confinement pattern applied to multi-chip).
 
 Suppression
 -----------
@@ -162,6 +171,10 @@ RULES: Dict[str, str] = {
                 "registered in the runtime/obs/reqtrace.py "
                 "REQUEST_SPANS / VERDICTS roster (or a "
                 "stale/undocumented roster entry)",
+    "TPU-L016": "XLA collective call site (all_to_all/psum/shard_map) "
+                "outside the parallel/mesh.py "
+                "SANCTIONED_COLLECTIVE_MODULES roster (or a "
+                "stale/undocumented roster entry)",
 }
 
 #: modules owning the cancellation waiter protocol itself: their naked
@@ -215,6 +228,11 @@ _CALLBACK_NAMES = {"fn", "cb", "callback", "hook"}
 
 #: host-sync calls inside span bodies (TPU-L004)
 _SYNC_TERMINALS = {"item", "device_get", "asarray"}
+
+#: XLA collective entry points (TPU-L016): calling any of these makes
+#: the module SPMD program structure — it must be in the
+#: parallel/mesh.py SANCTIONED_COLLECTIVE_MODULES roster
+_COLLECTIVE_TERMINALS = {"all_to_all", "psum", "shard_map"}
 
 _OBS_FUNCS = {"on_query_start", "on_query_end", "on_task_complete",
               "state", "install"}
@@ -282,7 +300,8 @@ class _FileLinter(ast.NodeVisitor):
                  kernel_modules: Optional[Set[str]] = None,
                  known_routes: Optional[Set[str]] = None,
                  known_request_spans: Optional[Set[str]] = None,
-                 known_verdicts: Optional[Set[str]] = None):
+                 known_verdicts: Optional[Set[str]] = None,
+                 collective_modules: Optional[Set[str]] = None):
         self.path = path
         self.relpath = relpath.replace(os.sep, "/")
         self.lines = source.splitlines()
@@ -295,6 +314,7 @@ class _FileLinter(ast.NodeVisitor):
         self.known_routes = known_routes
         self.known_request_spans = known_request_spans
         self.known_verdicts = known_verdicts
+        self.collective_modules = collective_modules
         #: literals actually used at request_span()/_v() call sites —
         #: lint_tree aggregates these for the TPU-L015 stale half
         self.used_request_spans: Set[str] = set()
@@ -466,6 +486,7 @@ class _FileLinter(ast.NodeVisitor):
         self._check_kernel_roster(node)
         self._check_unbounded_wait(node)
         self._check_reqtrace_names(node)
+        self._check_collective_site(node)
         self.generic_visit(node)
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
@@ -851,6 +872,29 @@ class _FileLinter(ast.NodeVisitor):
                 and (_base_name(node.func) or "").lower() in self._CC_BASES:
             self._kernel_site(node)
 
+    # -- TPU-L016 ----------------------------------------------------------
+
+    def _check_collective_site(self, node: ast.Call) -> None:
+        """A ``lax.all_to_all``/``lax.psum``/``shard_map`` call is SPMD
+        program structure: every shard must reach it or the mesh
+        deadlocks, and its compiled entry must carry the
+        mesh-fingerprint compile-cache key. Confining call sites to the
+        rostered exchange/planner modules keeps that reasoning local
+        (the TPU-L010 confinement pattern)."""
+        if self.collective_modules is None:
+            return
+        term = _terminal(node.func)
+        if term not in _COLLECTIVE_TERMINALS:
+            return
+        if self.relpath in self.collective_modules:
+            return
+        self._emit("TPU-L016", node,
+                   f"collective primitive {term}() in unrostered module "
+                   f"{self.relpath!r} — collectives live in the "
+                   f"parallel/mesh.py SANCTIONED_COLLECTIVE_MODULES "
+                   f"roster so SPMD divergence and compile-key "
+                   f"reasoning stay local")
+
 
 # ---------------------------------------------------------------------------
 # Registry extraction (AST-only: no engine import)
@@ -1013,6 +1057,28 @@ def known_reqtrace_verdicts(pkg_root: str) -> Set[str]:
         "VERDICTS")
 
 
+def known_collective_modules(pkg_root: str) -> Set[str]:
+    """Registered collective-calling modules: the keys of the
+    SANCTIONED_COLLECTIVE_MODULES dict literal in parallel/mesh.py."""
+    return _dict_literal_keys(
+        os.path.join(pkg_root, "parallel", "mesh.py"),
+        "SANCTIONED_COLLECTIVE_MODULES")
+
+
+def module_uses_collectives(path: str) -> bool:
+    """Does a module contain a collective call site (all_to_all / psum /
+    shard_map invocation)? Used for the stale-roster half of
+    TPU-L016."""
+    if not os.path.exists(path):
+        return False
+    tree = ast.parse(open(path).read(), path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _terminal(node.func) in _COLLECTIVE_TERMINALS:
+            return True
+    return False
+
+
 def known_kernel_primitives(pkg_root: str) -> Set[str]:
     """Registered kernel-emitting modules: the keys of the
     KERNEL_PRIMITIVES dict literal in analysis/kernel_audit.py."""
@@ -1104,6 +1170,7 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                 known_routes: Optional[Set[str]] = None,
                 known_request_spans: Optional[Set[str]] = None,
                 known_verdicts: Optional[Set[str]] = None,
+                collective_modules: Optional[Set[str]] = None,
                 collect: Optional[dict] = None) -> List[Violation]:
     tree = ast.parse(source, path)
     linter = _FileLinter(path, source, known_metrics,
@@ -1116,7 +1183,8 @@ def lint_source(source: str, path: str, known_metrics: Set[str],
                          kernel_modules=kernel_modules,
                          known_routes=known_routes,
                          known_request_spans=known_request_spans,
-                         known_verdicts=known_verdicts)
+                         known_verdicts=known_verdicts,
+                         collective_modules=collective_modules)
     linter.visit(tree)
     if collect is not None:
         # cross-file usage aggregation (the TPU-L015 stale half needs
@@ -1142,6 +1210,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
     routes = known_http_routes(pkg_root)
     req_spans = known_request_spans(pkg_root)
     verdicts = known_reqtrace_verdicts(pkg_root)
+    coll_mods = known_collective_modules(pkg_root)
     used: dict = {}
     violations: List[Violation] = []
     n_files = 0
@@ -1160,7 +1229,7 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
                 known_states=states, known_series=series,
                 kernel_modules=kernel_mods, known_routes=routes,
                 known_request_spans=req_spans, known_verdicts=verdicts,
-                collect=used))
+                collective_modules=coll_mods, collect=used))
     # the stale half of TPU-L013: a roster entry whose module no longer
     # exists or no longer emits kernels claims audit coverage that
     # isn't there
@@ -1205,6 +1274,23 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
             "TPU-L015", rtpath, 1,
             f"VERDICTS roster entry {name!r} matches no _v(...) "
             f"verdict checkpoint — stale entry"))
+    # the stale half of TPU-L016: a SANCTIONED_COLLECTIVE_MODULES entry
+    # whose module no longer exists or no longer calls a collective
+    # licenses SPMD surface area that isn't there
+    mshpath = os.path.join(pkg_root, "parallel", "mesh.py")
+    for mod in sorted(coll_mods):
+        cpath2 = os.path.join(pkg_root, mod.replace("/", os.sep))
+        if not os.path.exists(cpath2):
+            violations.append(Violation(
+                "TPU-L016", mshpath, 1,
+                f"SANCTIONED_COLLECTIVE_MODULES roster entry {mod!r} "
+                f"names a module that does not exist"))
+        elif not module_uses_collectives(cpath2):
+            violations.append(Violation(
+                "TPU-L016", mshpath, 1,
+                f"SANCTIONED_COLLECTIVE_MODULES roster entry {mod!r} "
+                f"has no all_to_all/psum/shard_map call site — stale "
+                f"entry"))
     documented = docs_metric_names(repo_root)
     mpath = os.path.join(pkg_root, "runtime", "metrics.py")
     if documented is None:
@@ -1258,6 +1344,11 @@ def lint_tree(repo_root: str) -> Tuple[List[Violation], Dict[str, int]]:
             violations.append(Violation(
                 "TPU-L015", rtpath, 1,
                 f"sampling verdict {name!r} absent from docs/metrics.md "
+                f"— regenerate with 'python tools/gen_docs.py'"))
+        for mod in sorted(coll_mods - documented):
+            violations.append(Violation(
+                "TPU-L016", mshpath, 1,
+                f"collective module {mod!r} absent from docs/metrics.md "
                 f"— regenerate with 'python tools/gen_docs.py'"))
     stats = {
         "files": n_files,

@@ -277,7 +277,10 @@ def dispatch_budget(exec_root) -> dict:
         census[name] = census.get(name, 0) + 1
         if name == "PipelineExec":
             pipeline_boundaries += 1
-        elif name == "FusedStageExec":
+        elif name in ("FusedStageExec", "ShardedStageExec"):
+            # a sharded stage is still ONE narrow dispatch per batch —
+            # per WAVE it is one per n_shards batches, but the budget
+            # pins the per-batch upper bound of the plan shape
             narrow += 1
         elif name == "HashAggregateExec":
             narrow += 1

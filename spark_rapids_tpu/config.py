@@ -1019,6 +1019,28 @@ STAGE_FUSION_ENABLED = conf_bool(
     "deliberately lacks). A stage whose composed trace fails falls back to "
     "the unfused operator chain.", commonly_used=True)
 
+MULTICHIP_ENABLED = conf_bool(
+    "spark.rapids.sql.multichip.enabled", False,
+    "Shard whole fused stages across the `part` axis of the device mesh "
+    "and run them as ONE SPMD dispatch per batch-wave (exec/sharded.py), "
+    "with the hash exchange executing as an in-program ICI all-to-all "
+    "instead of a host-side round-trip — the TPU analog of the "
+    "reference's UCX/RDMA shuffle manager. Stages the planner cannot "
+    "shard (carries, LIMIT early-exit, flat string planes) fall back "
+    "per-shard to the single-device path through the tagging tree. "
+    "Compile-cache keys gain a mesh fingerprint while this is on, so "
+    "sharded and single-device executables never collide.",
+    commonly_used=True)
+
+MULTICHIP_DEVICES = conf_int(
+    "spark.rapids.sql.multichip.devices", 0,
+    "Devices to place on the `part` axis of the execution mesh when "
+    "multichip is enabled: 0 means all of jax.devices(), any other "
+    "value is clamped to what the process actually has. 1 is a valid "
+    "degenerate mesh — the full shard/wave machinery runs over a "
+    "single device, which is how tier-1 exercises the sharded path "
+    "without virtual devices.")
+
 
 class RapidsConf:
     """A snapshot of config values: defaults, then environment overrides

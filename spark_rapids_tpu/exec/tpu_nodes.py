@@ -3460,10 +3460,17 @@ class ShuffleExchangeExec(ExchangeExec):
         return self.n_out
 
     @property
+    def _ici_first(self):
+        # the in-program all_to_all is the shuffle whenever the session
+        # runs sharded (multichip) or asks for it outright (SHUFFLE_MODE)
+        return (self.conf.get(C.SHUFFLE_MODE).upper() == "ICI"
+                or bool(self.conf.get(C.MULTICHIP_ENABLED)))
+
+    @property
     def _streaming_ok(self):
         # the ICI eligibility probe and vocab alignment iterate the child
         # results twice — a live stream cannot be replayed
-        return self.conf.get(C.SHUFFLE_MODE).upper() != "ICI"
+        return not self._ici_first
 
     def _item_rows(self, item, pidx):
         if isinstance(item, _LazyShuffleBlobs):
@@ -3477,7 +3484,7 @@ class ShuffleExchangeExec(ExchangeExec):
 
     def _repartition(self, child_results):
         mode = self.conf.get(C.SHUFFLE_MODE).upper()
-        if mode == "ICI":
+        if self._ici_first:
             with self.span(self.metrics.metric(M.PARTITION_TIME)):
                 out = self._repartition_ici(child_results)
             if out is not None:
@@ -3736,10 +3743,17 @@ class ShuffleExchangeExec(ExchangeExec):
         cap = max(round_capacity(max(int(b.num_rows), 1)) for b in live_parts)
         mesh = make_mesh(n, axis_names=("part",))
 
-        # build global [n*cap] planes sharded over the mesh
+        # build global [n*cap] planes sharded over the mesh. Assembled
+        # in NUMPY: each jnp pad/concat here is an eager XLA program
+        # (~200 of them per exchange), while numpy pad+concat is a
+        # memcpy — the planes hit the device exactly once, at the
+        # sharded device_put below.
         def pad_plane(arr, fill, dtype):
-            out = jnp.full(cap, fill, dtype)
-            return out.at[: arr.shape[0]].set(arr[:cap].astype(dtype))
+            dt = np.dtype(dtype)
+            out = np.full(cap, fill, dt)
+            a = np.asarray(arr)[:cap]
+            out[: a.shape[0]] = a.astype(dt, copy=False)
+            return out
 
         planes = {}
         per_col_meta = []
@@ -3748,55 +3762,96 @@ class ShuffleExchangeExec(ExchangeExec):
             if c.is_dict:
                 per_col_meta.append(("dict", c.dtype, c.data["dict_offsets"],
                                      c.data["dict_bytes"], c.dict_unique))
-                shards = [pad_plane(b.columns[ci].data["codes"], 0, jnp.int32)
-                          if b is not None else jnp.zeros(cap, jnp.int32)
+                shards = [pad_plane(b.columns[ci].data["codes"], 0, np.int32)
+                          if b is not None else np.zeros(cap, np.int32)
                           for b in batches]
             else:
-                dt = c.data.dtype
+                dt = np.dtype(c.data.dtype)
                 per_col_meta.append(("fixed", c.dtype, None, None, True))
                 shards = [pad_plane(b.columns[ci].data, 0, dt)
-                          if b is not None else jnp.zeros(cap, dt)
+                          if b is not None else np.zeros(cap, dt)
                           for b in batches]
-            planes[key] = jnp.concatenate(shards)
+            planes[key] = np.concatenate(shards)
             vshards = []
             for b in batches:
                 if b is None:
-                    vshards.append(jnp.zeros(cap, jnp.bool_))
+                    vshards.append(np.zeros(cap, np.bool_))
                 else:
                     col = b.columns[ci]
                     v = col.validity if col.validity is not None else \
-                        (jnp.arange(col.capacity) < traced_rows(b.num_rows))
-                    vshards.append(pad_plane(v, False, jnp.bool_))
-            planes[key + "_v"] = jnp.concatenate(vshards)
-        live = jnp.concatenate([
-            pad_plane(b.live_mask(), False, jnp.bool_) if b is not None
-            else jnp.zeros(cap, jnp.bool_) for b in batches])
+                        (np.arange(col.capacity) <
+                         int(traced_rows(b.num_rows)))
+                    vshards.append(pad_plane(v, False, np.bool_))
+            planes[key + "_v"] = np.concatenate(vshards)
+        live = np.concatenate([
+            pad_plane(b.live_mask(), False, np.bool_) if b is not None
+            else np.zeros(cap, np.bool_) for b in batches])
 
         # target partition ids from the key hash, computed globally, plus
-        # per-(source, destination) counts for the right-sizing pass
-        tgt_parts = []
-        count_parts = []
-        for b in batches:
-            if b is None:
-                tgt_parts.append(jnp.zeros(cap, jnp.int32))
-                count_parts.append(jnp.zeros(n, jnp.int32))
-                continue
-            ectx = EvalCtx(b.columns, traced_rows(b.num_rows), b.capacity,
-                           False, live=b.live_mask())
-            key_cols = [e.eval_tpu(ectx) for e in self.keys]
-            h = K.partition_hash_batch(key_cols, b.num_rows, live=b.live_mask())
-            pid = _pmod(h, n)
-            # per-(src,dst) counts via the counting-sort kernel's bucket
-            # pass (ops/repartition.py) — one code path sizes both the
-            # compact slices and the ICI send lanes
-            count_parts.append(RP.partition_counts(pid, b.live_mask(), n))
-            tgt_parts.append(pad_plane(pid, 0, jnp.int32))
-        target = jnp.concatenate(tgt_parts)
+        # per-(source, destination) counts for the right-sizing pass.
+        # FAST PATH (all fixed-width columns): ONE jitted program
+        # evaluates the keys, hashes, and counts the per-(src,dst) lanes
+        # over the packed planes — the per-source loop costs three eager
+        # kernel launches per source. Grouping keys are row-local
+        # expressions, so evaluating them on the concatenated planes is
+        # exact; dict-encoded keys hash decoded values, so they keep the
+        # per-source path.
+        n_cols = len(per_col_meta)
+        if all(meta[0] == "fixed" for meta in per_col_meta):
+            dts = [meta[1] for meta in per_col_meta]
+
+            def _build_hash():
+                def f(data_planes, valid_planes, live):
+                    cols = [ColumnVector(dt, d, v) for dt, d, v
+                            in zip(dts, data_planes, valid_planes)]
+                    total = live.shape[0]
+                    ectx = EvalCtx(cols, total, total, False, live=live)
+                    key_cols = [e.eval_tpu(ectx) for e in self.keys]
+                    h = K.partition_hash_batch(key_cols, total, live=live)
+                    pid = jnp.where(live, _pmod(h, n), 0).astype(jnp.int32)
+                    # per-(src,dst) counts via the counting-sort kernel's
+                    # bucket pass (ops/repartition.py) — one code path
+                    # sizes both the compact slices and the ICI send lanes
+                    counts = jax.vmap(
+                        lambda p_, l_: RP.partition_counts(p_, l_, n)
+                    )(pid.reshape(n, cap), live.reshape(n, cap))
+                    return pid, counts
+                return f
+
+            hfn = fuse.fused(
+                ("ici_hash", n, cap,
+                 tuple(e.fingerprint() for e in self.keys),
+                 tuple(str(planes[f"c{ci}"].dtype)
+                       for ci in range(n_cols))),
+                _build_hash)
+            pid_all, counts_dev = hfn(
+                [planes[f"c{ci}"] for ci in range(n_cols)],
+                [planes[f"c{ci}_v"] for ci in range(n_cols)], live)
+            target, counts_host = jax.device_get((pid_all, counts_dev))
+            counts_host = np.asarray(counts_host)
+        else:
+            tgt_parts = []
+            count_parts = []
+            for b in batches:
+                if b is None:
+                    tgt_parts.append(np.zeros(cap, np.int32))
+                    count_parts.append(jnp.zeros(n, jnp.int32))
+                    continue
+                ectx = EvalCtx(b.columns, traced_rows(b.num_rows),
+                               b.capacity, False, live=b.live_mask())
+                key_cols = [e.eval_tpu(ectx) for e in self.keys]
+                h = K.partition_hash_batch(key_cols, b.num_rows,
+                                           live=b.live_mask())
+                pid = _pmod(h, n)
+                count_parts.append(
+                    RP.partition_counts(pid, b.live_mask(), n))
+                tgt_parts.append(pad_plane(pid, 0, np.int32))
+            target = np.concatenate(tgt_parts)
+            counts_host = np.asarray(jax.device_get(jnp.stack(count_parts)))
         # ONE host fetch sizes the send lanes: C = max rows any source
         # sends any destination, rounded to a capacity bucket — the ICI
         # collective then moves ~rows/P per lane instead of the whole
         # local capacity (VERDICT r3 weak #5: capacity-naive buffers)
-        counts_host = np.asarray(jax.device_get(jnp.stack(count_parts)))
         send_cap = min(cap, round_capacity(max(int(counts_host.max()), 1)))
 
         spec = PS("part")
@@ -3809,15 +3864,37 @@ class ShuffleExchangeExec(ExchangeExec):
             return X.all_to_all_exchange(planes, live, target, ("part",),
                                          send_cap=send_cap)
 
-        from spark_rapids_tpu.runtime import compile_cache as _cc
-        fn = _cc.jit(shard_map(shard_fn, mesh=mesh,
-                               in_specs=(spec, spec, spec),
-                               out_specs=({k: spec for k in planes}, spec)))
-        out_planes, out_live = fn(planes, live, target)
+        # the KEYED compile layer, not _cc.jit: shard_fn is a fresh
+        # closure every repartition, so raw jax.jit would retrace the
+        # whole collective each collect. The key pins the shapes that
+        # matter (mesh width, capacity buckets, plane dtypes) and the
+        # compile-cache fingerprint adds the mesh component under
+        # multichip — repeated exchanges replay the warm executable.
+        key = ("ici_exchange", n, cap, send_cap,
+               tuple((k, str(planes[k].dtype)) for k in sorted(planes)))
+        fn = fuse.fused(key, lambda: shard_map(
+            shard_fn, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=({k: spec for k in planes}, spec)))
+        # the collective dispatch itself, timed with NO host sync inside
+        # the span (async dispatch; the interval is issue cost plus any
+        # backend blocking). NESTED inside the partitionTime span the
+        # caller opened — rollups/attribution exclude it (metrics.
+        # NESTED_TIME_METRICS) and the 'ici_exchange' attribution view
+        # reports it separately.
+        with self.span(self.metrics.metric(M.ICI_EXCHANGE_TIME)):
+            out_planes, out_live = fn(planes, live, target)
 
         # slice the global result back into per-partition, PER-SENDER
         # batches (consumers like the aggregate merge rely on "one batch =
-        # rows from one upstream partial" for their unique-key reasoning)
+        # rows from one upstream partial" for their unique-key reasoning).
+        # ONE host assembly first: the n*n slices below are eager ops, and
+        # on the sharded collective output each would run the GSPMD
+        # partitioner (20-40x a single-device slice). device_get gathers
+        # the local shards without an XLA program; the emitted batches
+        # keep the host numpy views — consumers feed them into jitted
+        # kernels (which accept numpy) or host packers, and re-uploading
+        # each of the n*n*planes slices measured ~0.15ms apiece.
+        out_planes, out_live = jax.device_get((out_planes, out_live))
         out: List[List[ColumnarBatch]] = []
         shard_rows = n * send_cap  # each device receives n*send_cap slots
         for p in range(n):
@@ -3838,7 +3915,7 @@ class ShuffleExchangeExec(ExchangeExec):
                         cols.append(ColumnVector(dtype, data, valid))
                 mask = out_live[sl]
                 subs.append(ColumnarBatch(
-                    cols, LazyRowCount(jnp.sum(mask.astype(jnp.int32))), mask))
+                    cols, int(mask.sum()), mask))
             out.append(subs)
         return out
 

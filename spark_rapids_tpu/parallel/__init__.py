@@ -11,4 +11,11 @@ bounce buffers) is replaced TPU-natively by XLA collectives over ICI:
 No transport code, no bounce buffers, no heartbeat registry: XLA compiles
 the collective into the program and the ICI fabric moves the bytes.
 """
-from spark_rapids_tpu.parallel.mesh import make_mesh, mesh_devices  # noqa: F401
+from spark_rapids_tpu.parallel.mesh import (  # noqa: F401
+    MeshDeviceError,
+    check_mesh_devices,
+    make_mesh,
+    mesh_devices,
+    mesh_fingerprint,
+    multichip_devices,
+)

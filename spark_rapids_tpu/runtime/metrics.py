@@ -65,6 +65,17 @@ PARTITION_HOST_FETCHES = "partitionHostFetches"
 #: computation per batch; the unfused chain pays one per member operator.
 #: Dispatch-budget tests assert stageDispatches == input batch count.
 STAGE_DISPATCHES = "stageDispatches"
+#: SPMD waves a sharded stage (exec/sharded.py) dispatched: each wave
+#: runs up to n_shards partition batches as ONE shard_map program over
+#: the mesh, so shardWaves * n_shards bounds the partition batches the
+#: multichip path absorbed into collective dispatches
+SHARD_WAVES = "shardWaves"
+#: ns a shuffle exchange spent inside the in-program ICI all_to_all
+#: dispatch (the shard_map'd collective itself, issued with NO host
+#: sync in the span). NESTED inside partitionTime — rollups and
+#: attribution exclude it so exchange time is never double-counted;
+#: the attribution 'ici_exchange' view reports it separately.
+ICI_EXCHANGE_TIME = "iciExchangeTime"
 #: post-shuffle sub-batches merged by tiny-partition coalescing
 #: (spark.rapids.shuffle.coalesceTinyRows): adjacent device sub-batches
 #: under the threshold concat into one batch before downstream
@@ -94,6 +105,11 @@ PIPELINE_PRODUCER_TIME = "pipelineProducerTime"
 #: upstream's own decode/upload time, already on the upstream's metrics)
 WAIT_TIME_METRICS = frozenset((
     SEMAPHORE_WAIT_TIME, PIPELINE_STALL_TIME, PIPELINE_PRODUCER_TIME))
+
+#: *Time metrics that are NESTED inside another *Time metric on the same
+#: exec (iciExchangeTime runs inside partitionTime's span): folding both
+#: into a rollup would count the nested interval twice
+NESTED_TIME_METRICS = frozenset((ICI_EXCHANGE_TIME,))
 
 
 class GpuMetric:
@@ -245,7 +261,8 @@ def exec_rollup(snapshot: Dict[str, int]) -> Dict[str, int]:
     (semaphore wait, pipeline stall, pipeline producer time) — wait is
     scheduling and producer time is overlapped upstream work, not this
     operator's own; folding either in would make every hot-path
-    comparison lie under contention."""
+    comparison lie under contention — and the NESTED_TIME_METRICS,
+    whose intervals already sit inside another metric's span."""
     rows = int(snapshot.get(NUM_OUTPUT_ROWS, 0))
     # presence-based fallback, NOT falsy-or: an exec that RECORDED zero
     # output batches (every input row filtered away) must report 0, not
@@ -258,7 +275,8 @@ def exec_rollup(snapshot: Dict[str, int]) -> Dict[str, int]:
                      if STAGE_DISPATCHES in snapshot
                      else snapshot.get(PARTITION_DISPATCHES, 0))
     time_ns = sum(int(v) for k, v in snapshot.items()
-                  if k.endswith("Time") and k not in WAIT_TIME_METRICS)
+                  if k.endswith("Time") and k not in WAIT_TIME_METRICS
+                  and k not in NESTED_TIME_METRICS)
     return {"rows": rows, "batches": batches, "dispatches": dispatches,
             "time_ns": time_ns}
 

@@ -643,6 +643,18 @@ def on_query_end(token, *, session, plan, status: str,
             _publish_exec_rollups(reg, snaps)
         rec = None
         if st.history is not None:
+            mesh_doc = None
+            try:
+                conf = getattr(session, "conf", None)
+                from spark_rapids_tpu import config as C
+                if conf is not None and conf.get(C.MULTICHIP_ENABLED):
+                    from spark_rapids_tpu.parallel import mesh as _mesh
+                    mesh_doc = {
+                        "n_devices": _mesh.multichip_devices(conf),
+                        "axes": [_mesh.PART_AXIS],
+                    }
+            except Exception:  # noqa: BLE001 - history never fails a query
+                mesh_doc = None
             rec = build_query_record(
                 query_id=token, wall_start_unix=wall_start_unix,
                 duration_ns=duration_ns, status=status, error=error,
@@ -652,7 +664,8 @@ def on_query_end(token, *, session, plan, status: str,
                 aqe=aqe_doc, slo_breach=breach,
                 flight_dump=flight_dump, digest=digest,
                 replica_id=st.replica_id or None,
-                trace_id=rctx.trace_id if rctx is not None else None)
+                trace_id=rctx.trace_id if rctx is not None else None,
+                mesh=mesh_doc)
             st.history.append(rec)
         st.last_query = {
             "query_id": token, "status": status,

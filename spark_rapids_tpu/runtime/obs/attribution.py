@@ -66,10 +66,13 @@ BUCKETS: Dict[str, str] = {
              "result assembly (zero when concurrency-scaled)",
 }
 
-#: *Time metrics that are overlapped upstream work, never critical path
-#: (mirrors metrics.WAIT_TIME_METRICS reasoning: producer time is the
-#: upstream's own decode/upload, already counted on the upstream node)
-_EXCLUDED_METRICS = frozenset(("pipelineProducerTime",))
+#: *Time metrics that are overlapped upstream work or nested inside
+#: another metric's span, never critical path on their own (mirrors
+#: metrics.WAIT_TIME_METRICS/NESTED_TIME_METRICS reasoning: producer
+#: time is the upstream's own decode/upload, already counted on the
+#: upstream node; iciExchangeTime runs inside partitionTime's span and
+#: is reported separately as the 'ici_exchange' view)
+_EXCLUDED_METRICS = frozenset(("pipelineProducerTime", "iciExchangeTime"))
 
 #: metric-name -> bucket for the per-exec snapshot half; a *Time metric
 #: absent here buckets as device_compute (or shuffle on an exchange exec)
@@ -275,6 +278,19 @@ def attribute(snaps: Optional[Dict[str, dict]], duration_ns: int,
     for per_bucket in classify_exec_times(snaps).values():
         for b, v in per_bucket.items():
             totals[b] += v
+    # views: named sub-intervals of a bucket, reported beside it rather
+    # than as buckets of their own (they nest inside an already-counted
+    # metric, so adding them to totals would double-count). ici_exchange
+    # is the in-program all_to_all dispatch inside the shuffle bucket's
+    # partitionTime. Raw measured ns, like measured_seconds — never
+    # concurrency-scaled.
+    ici_ns = 0
+    for snap in (snaps or {}).values():
+        try:
+            ici_ns += int(snap.get("iciExchangeTime", 0))
+        except Exception:  # noqa: BLE001 - non-numeric snapshot entry
+            pass
+    views = {"ici_exchange": round(ici_ns / 1e9, 9)} if ici_ns > 0 else {}
     for b, v in (extra or {}).items():
         if b in totals:
             totals[b] += int(v)
@@ -298,7 +314,7 @@ def attribute(snaps: Optional[Dict[str, dict]], duration_ns: int,
     else:
         factor = 1.0
         totals["other"] += wall_ns - measured
-    return {
+    doc = {
         # 9 decimals = full ns resolution: a 6-decimal round would zero
         # genuine sub-microsecond buckets and break the exact-sum
         # invariant the reconciliation tests assert
@@ -308,6 +324,11 @@ def attribute(snaps: Optional[Dict[str, dict]], duration_ns: int,
         "measured_seconds": round(measured / 1e9, 9),
         "concurrency_factor": round(factor, 3),
     }
+    if views:
+        # keyed only when present so default-path documents (and every
+        # golden artifact derived from them) stay byte-identical
+        doc["views"] = views
+    return doc
 
 
 # ---------------------------------------------------------------------------
@@ -333,6 +354,9 @@ def render_text(doc: Optional[dict], width: int = 24) -> List[str]:
         frac = fracs.get(b, 0.0)
         bar = "#" * max(1, int(frac * width))
         lines.append(f"  {b:<15} {s:>9.3f}s {frac * 100:>5.1f}%  {bar}")
+    for name, s in sorted(doc.get("views", {}).items()):
+        lines.append(f"  view:{name:<10} {s:>9.3f}s  (measured, nested "
+                     f"in shuffle)")
     return lines
 
 

@@ -126,7 +126,8 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
                        flight_dump: Optional[str] = None,
                        digest: Optional[str] = None,
                        replica_id: Optional[str] = None,
-                       trace_id: Optional[str] = None) -> dict:
+                       trace_id: Optional[str] = None,
+                       mesh: Optional[dict] = None) -> dict:
     """Assemble one history record from a finished action's state. Every
     sub-extraction is best-effort: history must never fail a query.
     `snaps` is the caller's last_metrics() snapshot when it already took
@@ -151,6 +152,13 @@ def build_query_record(*, query_id: int, wall_start_unix: float,
         # the W3C trace id of the serving request that carried this
         # query — the history<->reqtrace-timeline join key
         rec["trace_id"] = trace_id
+    if mesh is not None:
+        # the execution mesh shape ({"n_devices": int, "axes": [...]})
+        # of a multichip run: per-digest latencies are only comparable
+        # across replicas of the SAME mesh size, so fleet_report splits
+        # by it. Absent on single-device records (conditional-key
+        # discipline: default-path records stay byte-identical).
+        rec["mesh"] = mesh
     if degraded_reason is not None:
         rec["degraded_reason"] = degraded_reason
     if attribution is not None:

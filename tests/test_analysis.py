@@ -562,6 +562,70 @@ def test_l013_roster_extraction_and_staleness():
         os.path.join(pkg, "runtime", "metrics.py"))
 
 
+def _lint_collective(src, relpath="exec/new_shuffle.py",
+                     roster=frozenset({"parallel/exchange.py"})):
+    return lint.lint_source(textwrap.dedent(src), "/x/" + relpath,
+                            {"opTime"}, relpath=relpath,
+                            collective_modules=set(roster))
+
+
+def test_l016_collective_outside_roster_flagged():
+    """lax.all_to_all / lax.psum / shard_map are SPMD program structure:
+    a call site outside SANCTIONED_COLLECTIVE_MODULES fails — every
+    shard must reach the collective and its compiled entry must carry
+    the mesh-fingerprint compile key, reasoning the roster keeps local."""
+    vs = _lint_collective("""
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+
+        def exchange(x, mesh, spec):
+            f = shard_map(lambda s: lax.all_to_all(
+                s, "part", 0, 0), mesh=mesh, in_specs=spec,
+                out_specs=spec)
+            return f(x), lax.psum(jnp.sum(x), "part")
+    """)
+    assert _rules(vs) == ["TPU-L016", "TPU-L016", "TPU-L016"]
+
+
+def test_l016_rostered_module_and_plain_code_pass():
+    src = """
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+
+        def exchange(x, mesh, spec):
+            return shard_map(lambda s: lax.all_to_all(s, "part", 0, 0),
+                             mesh=mesh, in_specs=spec, out_specs=spec)(x)
+    """
+    assert _rules(_lint_collective(
+        src, relpath="parallel/exchange.py")) == []
+    # collective-free modules owe the roster nothing
+    assert _rules(_lint_collective("""
+        def plain(x):
+            return x.all_to_all_like_name  # attribute, not a call
+    """)) == []
+
+
+def test_l016_roster_extraction_and_staleness():
+    """known_collective_modules mirrors the runtime roster
+    (parallel/mesh.py SANCTIONED_COLLECTIVE_MODULES), every entry
+    exists and really calls a collective, and the round-19 modules —
+    the sharded-stage planner and the ICI exchange — are rostered."""
+    pkg = os.path.join(REPO, "spark_rapids_tpu")
+    mods = lint.known_collective_modules(pkg)
+    from spark_rapids_tpu.parallel.mesh import \
+        SANCTIONED_COLLECTIVE_MODULES
+    assert mods == set(SANCTIONED_COLLECTIVE_MODULES)
+    assert "exec/sharded.py" in mods
+    assert "exec/tpu_nodes.py" in mods
+    for mod in mods:
+        path = os.path.join(pkg, mod.replace("/", os.sep))
+        assert os.path.exists(path), mod
+        assert lint.module_uses_collectives(path), mod
+    assert not lint.module_uses_collectives(
+        os.path.join(pkg, "runtime", "metrics.py"))
+
+
 def _lint_routes(src, routes=frozenset({"/metrics", "/healthz"})):
     return lint.lint_source(textwrap.dedent(src), "/x/runtime/obs/x.py",
                             {"opTime"}, relpath="runtime/obs/x.py",

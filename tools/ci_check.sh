@@ -2,7 +2,7 @@
 # CI gate: tpulint, docs drift, trace-overhead smoke, sanitizer smoke,
 # chaos smoke, obs smoke, flight smoke, pipeline smoke, compile smoke,
 # audit smoke, aqe smoke, decode smoke, serving smoke, reqtrace smoke,
-# tier-1 tests.
+# multichip smoke, tier-1 tests.
 #
 #   tools/ci_check.sh            # everything (tier-1 last: ~13 min)
 #   tools/ci_check.sh --fast     # skip tier-1 (lint + docs drift + smokes)
@@ -96,6 +96,11 @@ fi
 
 step "reqtrace smoke (per-request tracing: errors/SLO breaches 100% exported, hot cache hits kept exactly at the seeded sampleRatio, disabled + armed paths <2% by count x delta, exported timelines Chrome-trace + OTLP valid with serving<->exec spans joined by query id)"
 if ! python tools/reqtrace_smoke.py; then
+    fail=1
+fi
+
+step "multichip smoke (sharded execution over 8 virtual devices: probe parity on/off byte-identical, narrow chain planned as ShardedStageExec with shardWaves, shuffle spends time in the in-program all_to_all, disabled-path conf gate <2% by count x delta)"
+if ! python tools/multichip_smoke.py; then
     fail=1
 fi
 

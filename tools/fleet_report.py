@@ -97,14 +97,25 @@ def fleet_summary(records: List[dict], reqtrace_dirs=(),
     def replica(rec) -> str:
         return rec.get("replica_id") or UNKNOWN_REPLICA
 
+    def mesh_key(rec) -> str:
+        """Compact mesh-shape label ("8 part" / "single"). Multichip
+        records carry rec["mesh"] = {n_devices, axes}; records without
+        it ran single-device."""
+        m = rec.get("mesh")
+        if not isinstance(m, dict):
+            return "single"
+        axes = m.get("axes") or []
+        return f"{m.get('n_devices', '?')} {'x'.join(str(a) for a in axes)}"
+
     # ---- per-replica totals ------------------------------------------------
     totals: Dict[str, dict] = {}
     for r in queries:
         t = totals.setdefault(replica(r), {
             "queries": 0, "ok": 0, "failed": 0, "cancelled": 0,
             "degraded": 0, "slo_breaches": 0, "cache_hits": 0,
-            "compile_s": 0.0, "_walls": []})
+            "compile_s": 0.0, "_walls": [], "_meshes": set()})
         t["queries"] += 1
+        t["_meshes"].add(mesh_key(r))
         st = r.get("status", "?")
         if st in t:
             t[st] += 1
@@ -116,13 +127,14 @@ def fleet_summary(records: List[dict], reqtrace_dirs=(),
         t = totals.setdefault(replica(r), {
             "queries": 0, "ok": 0, "failed": 0, "cancelled": 0,
             "degraded": 0, "slo_breaches": 0, "cache_hits": 0,
-            "compile_s": 0.0, "_walls": []})
+            "compile_s": 0.0, "_walls": [], "_meshes": set()})
         t["cache_hits"] += 1
     for t in totals.values():
         walls = sorted(t.pop("_walls"))
         t["p50_ms"] = round(_pctl(walls, 0.50), 3)
         t["p99_ms"] = round(_pctl(walls, 0.99), 3)
         t["compile_s"] = round(t["compile_s"], 3)
+        t["meshes"] = sorted(t.pop("_meshes")) or ["single"]
 
     # ---- per-digest x per-replica split ------------------------------------
     digests: Dict[str, Dict[str, dict]] = {}
@@ -132,8 +144,10 @@ def fleet_summary(records: List[dict], reqtrace_dirs=(),
             continue
         cell = digests.setdefault(d, {}).setdefault(replica(r), {
             "runs": 0, "failed": 0, "slo_breaches": 0, "cache_hits": 0,
-            "compile_s": 0.0, "_walls": [], "trace_ids": []})
+            "compile_s": 0.0, "_walls": [], "trace_ids": [],
+            "_meshes": set()})
         cell["runs"] += 1
+        cell["_meshes"].add(mesh_key(r))
         if r.get("status") not in ("ok", "degraded"):
             cell["failed"] += 1
         if r.get("slo_breach") is not None:
@@ -148,27 +162,37 @@ def fleet_summary(records: List[dict], reqtrace_dirs=(),
             continue
         cell = digests.setdefault(d, {}).setdefault(replica(r), {
             "runs": 0, "failed": 0, "slo_breaches": 0, "cache_hits": 0,
-            "compile_s": 0.0, "_walls": [], "trace_ids": []})
+            "compile_s": 0.0, "_walls": [], "trace_ids": [],
+            "_meshes": set()})
         cell["cache_hits"] += 1
         if r.get("trace_id"):
             cell["trace_ids"].append(r["trace_id"])
     skewed: List[dict] = []
     for d, per in digests.items():
-        p99s = {}
+        # p99s grouped by mesh shape: a 1-device replica being slower
+        # than an 8-device one on a shuffle-heavy digest is the
+        # EXPECTED scaling, not a fleet anomaly — only replicas on the
+        # same mesh are comparable (history records carry rec["mesh"])
+        p99s_by_mesh: Dict[str, Dict[str, float]] = {}
         for rep, cell in per.items():
             walls = sorted(cell.pop("_walls"))
             cell["p50_ms"] = round(_pctl(walls, 0.50), 3)
             cell["p99_ms"] = round(_pctl(walls, 0.99), 3)
             cell["compile_s"] = round(cell["compile_s"], 3)
             cell["trace_ids"] = cell["trace_ids"][-5:]  # newest few
+            cell["meshes"] = sorted(cell.pop("_meshes")) or ["single"]
             if cell["runs"]:
-                p99s[rep] = cell["p99_ms"]
-        if len(p99s) >= 2:
+                for mk in cell["meshes"]:
+                    p99s_by_mesh.setdefault(mk, {})[rep] = cell["p99_ms"]
+        for mk, p99s in p99s_by_mesh.items():
+            if len(p99s) < 2:
+                continue
             lo_rep = min(p99s, key=p99s.get)
             hi_rep = max(p99s, key=p99s.get)
             lo, hi = p99s[lo_rep], p99s[hi_rep]
             if lo > 0 and hi > lo * skew_factor:
-                skewed.append({"plan_digest": d, "fast": lo_rep,
+                skewed.append({"plan_digest": d, "mesh": mk,
+                               "fast": lo_rep,
                                "slow": hi_rep, "fast_p99_ms": lo,
                                "slow_p99_ms": hi,
                                "ratio": round(hi / lo, 2)})
@@ -199,13 +223,14 @@ def render_text(doc: dict) -> str:
              + ", ".join(doc["replicas"]), ""]
     lines.append(f"{'replica':<24} {'queries':>8} {'hits':>6} "
                  f"{'failed':>7} {'slo':>4} {'p50 ms':>9} {'p99 ms':>9} "
-                 f"{'compile s':>10}")
+                 f"{'compile s':>10}  {'mesh'}")
     for rep in doc["replicas"]:
         t = doc["totals"][rep]
         lines.append(f"{rep:<24} {t['queries']:>8} {t['cache_hits']:>6} "
                      f"{t['failed']:>7} {t['slo_breaches']:>4} "
                      f"{t['p50_ms']:>9.1f} {t['p99_ms']:>9.1f} "
-                     f"{t['compile_s']:>10.3f}")
+                     f"{t['compile_s']:>10.3f}  "
+                     f"{', '.join(t.get('meshes', ['single']))}")
     lines.append("")
     for d, per in sorted(doc["digests"].items()):
         lines.append(f"digest {d}:")
@@ -215,13 +240,15 @@ def render_text(doc: dict) -> str:
                 f"  {rep:<22} runs={c['runs']:<4} hits={c['cache_hits']:<4}"
                 f" failed={c['failed']:<3} slo={c['slo_breaches']:<3}"
                 f" p50={c['p50_ms']:.1f}ms p99={c['p99_ms']:.1f}ms"
-                f" compile={c['compile_s']:.3f}s")
+                f" compile={c['compile_s']:.3f}s"
+                f" mesh={','.join(c.get('meshes', ['single']))}")
     if doc["skewed"]:
         lines.append("")
         lines.append(f"cross-replica skew (p99 ratio > "
-                     f"{doc['skew_factor']}x):")
+                     f"{doc['skew_factor']}x, same mesh only):")
         for s in doc["skewed"]:
-            lines.append(f"  {s['plan_digest']}: {s['slow']} "
+            lines.append(f"  {s['plan_digest']} [{s.get('mesh', 'single')}]:"
+                         f" {s['slow']} "
                          f"{s['slow_p99_ms']:.1f}ms vs {s['fast']} "
                          f"{s['fast_p99_ms']:.1f}ms ({s['ratio']}x)")
     if doc["reqtrace"]:
